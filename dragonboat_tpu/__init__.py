@@ -1,0 +1,23 @@
+"""dragonboat_tpu — a TPU-native multi-group Raft consensus framework.
+
+A ground-up re-design of the capabilities of Dragonboat (a multi-group Raft
+library, reference at /root/reference) for TPU hosts: per-group protocol
+bookkeeping (vote tallies, match-index/commit advancement, tick and election
+timers) is batched into ``(nGroups, nPeers)`` JAX device tensors stepped by
+fused XLA/Pallas kernels once per tick, while I/O (log persistence, network,
+user state machines) remains on the host, with a C++ native log engine.
+
+Public surface mirrors the reference's L0 facade: ``NodeHost``, per-group
+``Config`` / per-host ``NodeHostConfig``, the three user state machine
+interfaces, client sessions, and the pluggable LogDB/transport factories.
+"""
+
+__version__ = "0.1.0"
+
+from .config import (  # noqa: F401
+    Config,
+    ConfigError,
+    ExpertConfig,
+    LogDBConfig,
+    NodeHostConfig,
+)

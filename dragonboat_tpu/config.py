@@ -1,0 +1,188 @@
+"""Public configuration types.
+
+Reference: ``config/config.go`` — per-group ``Config`` (:68-223), per-host
+``NodeHostConfig`` (:226-576) and ``LogDBConfig``.  This build adds the
+``ExpertConfig`` plugin boundary called for by the north star (the reference
+v3.3.0-dev has no ``Expert`` field; its pluggability precedent is
+``LogDBFactory``/``RaftRPCFactory``, ``config/config.go:298-305``): the
+batched TPU quorum engine is selected through ``ExpertConfig.quorum_engine``
+so the pure-host scalar path stays available for differential testing.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass
+class Config:
+    """Per-raft-group configuration (reference ``config/config.go:68-223``)."""
+
+    node_id: int = 0
+    cluster_id: int = 0
+    check_quorum: bool = False
+    election_rtt: int = 10
+    heartbeat_rtt: int = 1
+    snapshot_entries: int = 0
+    compaction_overhead: int = 5000
+    ordered_config_change: bool = False
+    max_in_mem_log_size: int = 0
+    snapshot_compression: int = 0  # CompressionType
+    entry_compression: int = 0  # CompressionType
+    disable_auto_compactions: bool = False
+    is_observer: bool = False
+    is_witness: bool = False
+    quiesce: bool = False
+
+    def validate(self) -> None:
+        # mirrors reference config.Config.Validate (config/config.go:168-223)
+        if self.node_id == 0:
+            raise ConfigError("invalid NodeID, it must be >= 1")
+        if self.heartbeat_rtt == 0:
+            raise ConfigError("HeartbeatRTT must be > 0")
+        if self.election_rtt == 0:
+            raise ConfigError("ElectionRTT must be > 0")
+        if self.election_rtt <= 2 * self.heartbeat_rtt:
+            raise ConfigError("invalid ElectionRTT, must be > 2 * HeartbeatRTT")
+        if self.election_rtt < 10 * self.heartbeat_rtt:
+            import warnings
+
+            warnings.warn(
+                "ElectionRTT is not a magnitude larger than HeartbeatRTT",
+                stacklevel=2,
+            )
+        if self.max_in_mem_log_size < 0:
+            raise ConfigError("MaxInMemLogSize must be >= 0")
+        if 0 < self.max_in_mem_log_size < 64 * 1024:
+            raise ConfigError("MaxInMemLogSize must be >= 64KB when set")
+        if self.snapshot_compression not in (0, 1):
+            raise ConfigError("unknown compression type")
+        if self.entry_compression not in (0, 1):
+            raise ConfigError("unknown compression type")
+        if self.is_witness and self.snapshot_entries > 0:
+            raise ConfigError("witness node cannot take snapshot")
+        if self.is_witness and self.is_observer:
+            raise ConfigError("witness node can not be an observer")
+
+
+@dataclass
+class ExpertConfig:
+    """Expert-only knobs; the plugin boundary for the batched quorum engine.
+
+    ``quorum_engine``:
+      - ``"scalar"``: per-group host stepping only (the reference's model).
+      - ``"tpu"``: route hot-path group stepping through the batched
+        ``(nGroups, nPeers)`` device engine (:mod:`dragonboat_tpu.ops`).
+      - ``"auto"``: tpu when a device is available and the group count makes
+        batching worthwhile.
+    """
+
+    quorum_engine: str = "scalar"
+    engine_block_groups: int = 0  # 0 = use Soft.quorum_engine_block_groups
+    step_worker_count: int = 0  # 0 = use Hard.step_engine_worker_count
+    logdb_shards: int = 0  # 0 = use Hard.logdb_pool_size
+
+    def validate(self) -> None:
+        if self.quorum_engine not in ("scalar", "tpu", "auto"):
+            raise ConfigError(f"unknown quorum engine {self.quorum_engine!r}")
+
+
+@dataclass
+class LogDBConfig:
+    """LogDB tuning (reference ``config/config.go`` LogDBConfig).
+
+    The reference exposes RocksDB-style block/cache/WAL knobs; the native
+    engine here is a segmented WAL+index (see ``dragonboat_tpu/native``), so
+    the surface is the subset that translates.
+    """
+
+    kv_write_buffer_size: int = 128 * 1024 * 1024
+    kv_max_write_buffer_number: int = 4
+    kv_block_size: int = 32 * 1024
+    kv_max_background_compactions: int = 2
+    segment_file_size: int = 1024 * 1024 * 1024
+    shards: int = 16
+
+    @staticmethod
+    def default() -> "LogDBConfig":
+        return LogDBConfig()
+
+    @staticmethod
+    def tiny() -> "LogDBConfig":
+        # reference GetTinyMemLogDBConfig: fit small-memory hosts
+        return LogDBConfig(kv_write_buffer_size=4 * 1024 * 1024)
+
+
+@dataclass
+class NodeHostConfig:
+    """Per-host configuration (reference ``config/config.go:226-576``)."""
+
+    deployment_id: int = 0
+    wal_dir: str = ""
+    node_host_dir: str = ""
+    rtt_millisecond: int = 200
+    raft_address: str = ""
+    listen_address: str = ""
+    mutual_tls: bool = False
+    ca_file: str = ""
+    cert_file: str = ""
+    key_file: str = ""
+    max_send_queue_size: int = 0
+    max_receive_queue_size: int = 0
+    enable_metrics: bool = False
+    max_snapshot_send_bytes_per_second: int = 0
+    max_snapshot_recv_bytes_per_second: int = 0
+    notify_commit: bool = False
+    logdb_config: LogDBConfig = field(default_factory=LogDBConfig.default)
+    expert: ExpertConfig = field(default_factory=ExpertConfig)
+    # factories (reference config/config.go:298-305)
+    logdb_factory: Optional[Callable] = None
+    raft_rpc_factory: Optional[Callable] = None
+    fs: Optional[object] = None  # vfs override for tests
+
+    def validate(self) -> None:
+        if self.rtt_millisecond == 0:
+            raise ConfigError("invalid RTTMillisecond")
+        if not self.node_host_dir:
+            raise ConfigError("NodeHostDir not specified")
+        if not self.raft_address:
+            raise ConfigError("RaftAddress not specified")
+        if not _valid_address(self.raft_address):
+            raise ConfigError(f"invalid RaftAddress {self.raft_address!r}")
+        if self.listen_address and not _valid_address(self.listen_address):
+            raise ConfigError(f"invalid ListenAddress {self.listen_address!r}")
+        if self.mutual_tls and (
+            not self.ca_file or not self.cert_file or not self.key_file
+        ):
+            raise ConfigError("CAFile/CertFile/KeyFile must be set for mutual TLS")
+        self.expert.validate()
+
+    def prepare(self) -> None:
+        if not self.listen_address:
+            self.listen_address = self.raft_address
+        if self.deployment_id == 0:
+            self.deployment_id = 1
+
+    def get_deployment_id(self) -> int:
+        return self.deployment_id if self.deployment_id else 1
+
+    def get_listen_address(self) -> str:
+        return self.listen_address or self.raft_address
+
+
+def _valid_address(addr: str) -> bool:
+    # host:port validation (reference utils/stringutil IsValidAddress)
+    if ":" not in addr:
+        return False
+    host, _, port = addr.rpartition(":")
+    if not host:
+        return False
+    try:
+        p = int(port)
+    except ValueError:
+        return False
+    return 0 < p < 65536

@@ -1,0 +1,14 @@
+from .log import (  # noqa: F401
+    CompactedError,
+    EntryLog,
+    ILogDB,
+    SnapshotOutOfDateError,
+    UnavailableError,
+)
+from .inmemory import InMemory  # noqa: F401
+from .memlogdb import InMemLogDB, TestLogDB  # noqa: F401
+from .peer import Peer, PeerAddress  # noqa: F401
+from .raft import Raft, RaftState  # noqa: F401
+from .rate import InMemRateLimiter, RateLimiter  # noqa: F401
+from .readindex import ReadIndex  # noqa: F401
+from .remote import Remote, RemoteState  # noqa: F401

@@ -1,0 +1,18 @@
+"""Test harness config: force a deterministic 8-device CPU platform.
+
+Multi-chip hardware is not available in CI; sharding tests run on a virtual
+8-device CPU mesh (same XLA collectives, same GSPMD partitioner) — the driver
+separately dry-run-compiles the multi-chip path via ``__graft_entry__``.
+Must run before jax is imported anywhere.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,187 @@
+"""etcd-style in-memory multi-node raft test harness.
+
+Models the network/black-hole harness used by the reference's ported etcd
+conformance tests (``internal/raft/raft_etcd_test.go``): a set of Raft state
+machines wired through an in-memory message router with drop/isolate/cut
+controls.  Deterministic: peers are stepped in sorted id order and all
+randomness comes from per-node seeded PRNGs.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from dragonboat_tpu.config import Config
+from dragonboat_tpu.raft import InMemLogDB, Raft
+from dragonboat_tpu.raft.raft import RaftState
+from dragonboat_tpu.wire import Entry, Message, MessageType
+
+MT = MessageType
+
+
+def new_test_config(
+    node_id: int, election: int = 10, heartbeat: int = 1, check_quorum: bool = False
+) -> Config:
+    return Config(
+        node_id=node_id,
+        cluster_id=1,
+        election_rtt=election,
+        heartbeat_rtt=heartbeat,
+        check_quorum=check_quorum,
+    )
+
+
+def new_test_raft(
+    node_id: int,
+    peers: List[int],
+    election: int = 10,
+    heartbeat: int = 1,
+    logdb: Optional[InMemLogDB] = None,
+    check_quorum: bool = False,
+    seed: int = 0,
+) -> Raft:
+    logdb = logdb or InMemLogDB()
+    r = Raft(
+        new_test_config(node_id, election, heartbeat, check_quorum),
+        logdb,
+        seed=seed + node_id,
+    )
+    for p in peers:
+        if p not in r.remotes:
+            r.remotes[p] = __import__(
+                "dragonboat_tpu.raft.remote", fromlist=["Remote"]
+            ).Remote(next=1)
+    r.reset_match_value_array()
+    # the reference exposes this test-only hook to ease porting the etcd
+    # conformance suite (raft.go:1463-1469): the harness applies nothing, so
+    # the committed>applied campaign guard would otherwise always trip
+    r.has_not_applied_config_change = lambda: False
+    return r
+
+
+class BlackHole:
+    """Drops everything (etcd's nopStepper)."""
+
+    node_id = -1
+
+    def handle(self, m: Message) -> None:
+        pass
+
+    @property
+    def msgs(self) -> List[Message]:
+        return []
+
+
+class Network:
+    """Reference etcd `network` harness."""
+
+    def __init__(self, *peers, election: int = 10, heartbeat: int = 1):
+        self.peers: Dict[int, object] = {}
+        self.storage: Dict[int, InMemLogDB] = {}
+        self.dropm: Dict[Tuple[int, int], float] = {}
+        self.ignorem: Dict[MessageType, bool] = {}
+        size = len(peers)
+        ids = list(range(1, size + 1))
+        for i, p in enumerate(peers):
+            nid = ids[i]
+            if p is None:
+                logdb = InMemLogDB()
+                self.storage[nid] = logdb
+                self.peers[nid] = new_test_raft(
+                    nid, ids, election, heartbeat, logdb
+                )
+            elif isinstance(p, BlackHole):
+                self.peers[nid] = p
+            elif isinstance(p, Raft):
+                p.node_id = nid
+                self.peers[nid] = p
+            else:
+                raise TypeError(f"unexpected peer type {type(p)}")
+
+    def raft(self, nid: int) -> Raft:
+        p = self.peers[nid]
+        assert isinstance(p, Raft)
+        return p
+
+    def send(self, *msgs: Message) -> None:
+        queue = list(msgs)
+        while queue:
+            m = queue.pop(0)
+            p = self.peers.get(m.to)
+            if p is None:
+                continue
+            p.handle(m)
+            if isinstance(p, Raft):
+                queue.extend(self.filter(self.take_msgs(p)))
+
+    def take_msgs(self, r: Raft) -> List[Message]:
+        msgs = r.msgs
+        r.msgs = []
+        for m in msgs:
+            m.cluster_id = 1
+        return msgs
+
+    def drop(self, from_: int, to: int, perc: float) -> None:
+        self.dropm[(from_, to)] = perc
+
+    def cut(self, one: int, other: int) -> None:
+        self.drop(one, other, 1.0)
+        self.drop(other, one, 1.0)
+
+    def isolate(self, nid: int) -> None:
+        for i in self.peers:
+            if i != nid:
+                self.cut(nid, i)
+
+    def ignore(self, t: MessageType) -> None:
+        self.ignorem[t] = True
+
+    def recover(self) -> None:
+        self.dropm = {}
+        self.ignorem = {}
+
+    def filter(self, msgs: List[Message]) -> List[Message]:
+        out = []
+        for m in msgs:
+            if self.ignorem.get(m.type):
+                continue
+            if m.type == MT.ELECTION:
+                raise RuntimeError("unexpected Election message")
+            perc = self.dropm.get((m.from_, m.to), 0.0)
+            if perc >= 1.0:
+                continue
+            out.append(m)
+        return out
+
+
+def campaign(r: Raft) -> Message:
+    """Fire an Election message locally (what a timeout would do)."""
+    return Message(from_=r.node_id, to=r.node_id, type=MT.ELECTION)
+
+
+def propose(nid: int, data: bytes = b"somedata") -> Message:
+    return Message(
+        from_=nid, to=nid, type=MT.PROPOSE, entries=[Entry(cmd=data)]
+    )
+
+
+def readindex(nid: int, low: int = 1, high: int = 1) -> Message:
+    return Message(from_=nid, to=nid, type=MT.READ_INDEX, hint=low, hint_high=high)
+
+
+def tick_until_election(r: Raft) -> None:
+    """Tick a raft node just past its randomized election timeout."""
+    for _ in range(r.randomized_election_timeout + 1):
+        r.tick()
+
+
+__all__ = [
+    "BlackHole",
+    "Network",
+    "RaftState",
+    "campaign",
+    "new_test_config",
+    "new_test_raft",
+    "propose",
+    "readindex",
+    "tick_until_election",
+]

@@ -1,0 +1,202 @@
+"""Election conformance tests, modeled on the reference's ported etcd suite
+(internal/raft/raft_etcd_test.go, raft_etcd_paper_test.go §5.2)."""
+from raft_harness import (
+    BlackHole,
+    Network,
+    RaftState,
+    campaign,
+    new_test_raft,
+    propose,
+)
+from dragonboat_tpu.raft import InMemLogDB
+from dragonboat_tpu.wire import Message, MessageType
+
+MT = MessageType
+
+
+def test_leader_election_3_nodes():
+    nt = Network(None, None, None)
+    nt.send(campaign(nt.raft(1)))
+    assert nt.raft(1).state == RaftState.LEADER
+    assert nt.raft(2).state == RaftState.FOLLOWER
+    assert nt.raft(3).state == RaftState.FOLLOWER
+    assert nt.raft(1).term == 1
+    for nid in (2, 3):
+        assert nt.raft(nid).term == 1
+        assert nt.raft(nid).leader_id == 1
+
+
+def test_leader_election_one_vote_missing():
+    # one unresponsive node: candidate still wins 2/3
+    nt = Network(None, None, BlackHole())
+    nt.send(campaign(nt.raft(1)))
+    assert nt.raft(1).state == RaftState.LEADER
+
+
+def test_leader_election_no_quorum():
+    # two black holes: candidate stays candidate
+    nt = Network(None, BlackHole(), BlackHole())
+    nt.send(campaign(nt.raft(1)))
+    assert nt.raft(1).state == RaftState.CANDIDATE
+
+
+def test_leader_election_quorum_of_5():
+    nt = Network(None, BlackHole(), BlackHole(), None, None)
+    nt.send(campaign(nt.raft(1)))
+    assert nt.raft(1).state == RaftState.LEADER
+
+
+def test_election_with_higher_term_log_rejects():
+    # node with shorter/older log cannot win over up-to-date voters
+    nt = Network(None, None, None)
+    nt.send(campaign(nt.raft(1)))
+    nt.send(propose(1))
+    # now node 2/3 logs contain entries from term 1
+    # isolate 1; let 2 campaign and win
+    nt.isolate(1)
+    nt.send(campaign(nt.raft(2)))
+    assert nt.raft(2).state == RaftState.LEADER
+
+
+def test_single_node_election():
+    nt = Network(None)
+    nt.send(campaign(nt.raft(1)))
+    assert nt.raft(1).state == RaftState.LEADER
+    assert nt.raft(1).term == 1
+
+
+def test_candidate_steps_down_on_majority_rejection():
+    nt = Network(None, None, None)
+    # make 2 the leader first, so 1's log stays behind after proposals
+    nt.send(campaign(nt.raft(2)))
+    nt.isolate(1)
+    nt.send(propose(2))
+    nt.recover()
+    # 1 campaigns with a stale log: 2 and 3 both reject; etcd behavior is to
+    # become follower when a quorum rejects
+    r1 = nt.raft(1)
+    nt.send(campaign(r1))
+    assert r1.state == RaftState.FOLLOWER
+    assert nt.raft(2).log.committed >= 2
+
+
+def test_leader_steps_down_on_higher_term_message():
+    nt = Network(None, None, None)
+    nt.send(campaign(nt.raft(1)))
+    assert nt.raft(1).state == RaftState.LEADER
+    nt.send(Message(from_=2, to=1, type=MT.REPLICATE_RESP, term=99))
+    assert nt.raft(1).state == RaftState.FOLLOWER
+    assert nt.raft(1).term == 99
+
+
+def test_vote_granted_once_per_term():
+    r = new_test_raft(1, [1, 2, 3])
+    r.handle(Message(from_=2, to=1, type=MT.REQUEST_VOTE, term=1,
+                     log_index=0, log_term=0))
+    resp = r.msgs[-1]
+    assert resp.type == MT.REQUEST_VOTE_RESP and not resp.reject
+    assert r.vote == 2
+    # different candidate same term is rejected
+    r.handle(Message(from_=3, to=1, type=MT.REQUEST_VOTE, term=1,
+                     log_index=0, log_term=0))
+    resp = r.msgs[-1]
+    assert resp.reject
+    # same candidate same term re-granted
+    r.handle(Message(from_=2, to=1, type=MT.REQUEST_VOTE, term=1,
+                     log_index=0, log_term=0))
+    resp = r.msgs[-1]
+    assert not resp.reject
+
+
+def test_vote_rejected_for_stale_log():
+    logdb = InMemLogDB()
+    r = new_test_raft(1, [1, 2, 3], logdb=logdb)
+    # local log: term 2 entry at index 1
+    from dragonboat_tpu.wire import Entry
+
+    r.log.append([Entry(term=2, index=1)])
+    r.term = 2
+    r.handle(Message(from_=2, to=1, type=MT.REQUEST_VOTE, term=3,
+                     log_index=0, log_term=0))
+    # candidate's log (0,0) is older than ours (1, term2) -> reject
+    resp = r.msgs[-1]
+    assert resp.type == MT.REQUEST_VOTE_RESP and resp.reject
+    # up-to-date candidate gets the vote
+    r.handle(Message(from_=3, to=1, type=MT.REQUEST_VOTE, term=3,
+                     log_index=5, log_term=2))
+    resp = r.msgs[-1]
+    assert not resp.reject
+
+
+def test_randomized_election_timeout_in_range():
+    r = new_test_raft(1, [1, 2, 3], election=10)
+    seen = set()
+    for _ in range(50):
+        r.set_randomized_election_timeout()
+        assert 10 <= r.randomized_election_timeout < 20
+        seen.add(r.randomized_election_timeout)
+    assert len(seen) > 1  # actually randomized
+
+
+def test_randomized_election_timeout_deterministic_for_seed():
+    a = new_test_raft(1, [1, 2, 3], seed=42)
+    b = new_test_raft(1, [1, 2, 3], seed=42)
+    seq_a = []
+    seq_b = []
+    for _ in range(10):
+        a.set_randomized_election_timeout()
+        b.set_randomized_election_timeout()
+        seq_a.append(a.randomized_election_timeout)
+        seq_b.append(b.randomized_election_timeout)
+    assert seq_a == seq_b
+
+
+def test_tick_drives_election():
+    r = new_test_raft(1, [1], election=10)
+    for _ in range(r.randomized_election_timeout + 1):
+        r.tick()
+    # single-node quorum: becomes leader immediately after campaigning
+    assert r.state == RaftState.LEADER
+
+
+def test_observer_does_not_campaign():
+    from raft_harness import new_test_config
+    from dragonboat_tpu.raft import Raft
+
+    cfg = new_test_config(4)
+    cfg.is_observer = True
+    logdb = InMemLogDB()
+    r = Raft(cfg, logdb)
+    r.observers[4] = __import__(
+        "dragonboat_tpu.raft.remote", fromlist=["Remote"]
+    ).Remote(next=1)
+    for _ in range(50):
+        r.tick()
+    assert r.state == RaftState.OBSERVER
+    assert not r.msgs or all(m.type != MT.REQUEST_VOTE for m in r.msgs)
+
+
+def test_check_quorum_leader_steps_down():
+    nt = Network(None, None, None)
+    for nid in (1, 2, 3):
+        nt.raft(nid).check_quorum = True
+    nt.send(campaign(nt.raft(1)))
+    r1 = nt.raft(1)
+    assert r1.state == RaftState.LEADER
+    # no responses flow: after 2 election timeouts without quorum contact the
+    # leader must step down (reference raft.go:1582-1588)
+    for _ in range(2 * r1.election_timeout + 1):
+        r1.tick()
+        r1.msgs = []
+    assert r1.state == RaftState.FOLLOWER
+
+
+def test_leader_transfer_basic():
+    nt = Network(None, None, None)
+    nt.send(campaign(nt.raft(1)))
+    r1 = nt.raft(1)
+    # ask the leader to transfer to 2
+    nt.send(Message(from_=2, to=1, type=MT.LEADER_TRANSFER, hint=2))
+    assert nt.raft(2).state == RaftState.LEADER
+    assert r1.state == RaftState.FOLLOWER
+    assert nt.raft(2).term == 2

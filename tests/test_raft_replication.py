@@ -1,0 +1,211 @@
+"""Log replication / commit conformance tests (reference etcd suite §5.3/5.4)."""
+from raft_harness import (
+    BlackHole,
+    Network,
+    RaftState,
+    campaign,
+    new_test_raft,
+    propose,
+    readindex,
+)
+from dragonboat_tpu.wire import Entry, Message, MessageType
+
+MT = MessageType
+
+
+def committed_entries(nt: Network, nid: int):
+    r = nt.raft(nid)
+    return r.log.get_entries(1, r.log.committed + 1, 1 << 30)
+
+
+def test_proposal_commits_on_all_nodes():
+    nt = Network(None, None, None)
+    nt.send(campaign(nt.raft(1)))
+    nt.send(propose(1, b"hello"))
+    for nid in (1, 2, 3):
+        r = nt.raft(nid)
+        # noop (index 1) + proposal (index 2)
+        assert r.log.committed == 2
+        ents = committed_entries(nt, nid)
+        assert ents[-1].cmd == b"hello"
+
+
+def test_proposal_by_follower_is_forwarded():
+    nt = Network(None, None, None)
+    nt.send(campaign(nt.raft(1)))
+    nt.send(propose(2, b"via-follower"))
+    assert nt.raft(1).log.committed == 2
+    assert committed_entries(nt, 1)[-1].cmd == b"via-follower"
+
+
+def test_proposal_dropped_without_leader():
+    nt = Network(None, None, None)
+    # no leader elected; proposal via node 1 is dropped
+    nt.send(propose(1, b"nope"))
+    r = nt.raft(1)
+    assert r.log.committed == 0
+
+
+def test_commit_requires_quorum():
+    nt = Network(None, BlackHole(), BlackHole(), None, None)
+    nt.send(campaign(nt.raft(1)))
+    assert nt.raft(1).state == RaftState.LEADER
+    nt.send(propose(1))
+    # quorum 3 of {1,4,5} reachable -> commit advances
+    assert nt.raft(1).log.committed == 2
+    # now cut 4 and 5 too
+    nt.isolate(4)
+    nt.isolate(5)
+    nt.send(propose(1))
+    assert nt.raft(1).log.committed == 2  # cannot commit w/o quorum
+
+
+def test_old_term_entries_not_committed_by_counting():
+    # raft paper p8 fig 8: leader only commits entries from its own term by
+    # counting replicas
+    nt = Network(None, None, None)
+    nt.send(campaign(nt.raft(1)))
+    nt.send(propose(1, b"t1"))
+    committed_before = nt.raft(1).log.committed
+    # partition, 2 becomes leader at term 2
+    nt.isolate(1)
+    nt.send(campaign(nt.raft(2)))
+    assert nt.raft(2).state == RaftState.LEADER
+    # its noop at term 2 commits (quorum 2,3), which also commits older entries
+    assert nt.raft(2).log.committed > committed_before
+
+
+def test_follower_log_repair_after_divergence():
+    nt = Network(None, None, None)
+    nt.send(campaign(nt.raft(1)))
+    # 3 is partitioned; leader appends entries
+    nt.isolate(3)
+    nt.send(propose(1, b"a"))
+    nt.send(propose(1, b"b"))
+    assert nt.raft(3).log.last_index() == 1  # only the noop
+    nt.recover()
+    # heartbeat response triggers replication catch-up
+    nt.send(Message(from_=1, to=1, type=MT.LEADER_HEARTBEAT))
+    assert nt.raft(3).log.last_index() == nt.raft(1).log.last_index()
+    assert nt.raft(3).log.committed == nt.raft(1).log.committed
+
+
+def test_divergent_follower_entries_overwritten():
+    nt = Network(None, None, None)
+    nt.send(campaign(nt.raft(1)))
+    # 1 gets a proposal it can't commit (everyone partitioned)
+    nt.isolate(1)
+    nt.send(propose(1, b"uncommitted"))
+    nt.send(propose(1, b"uncommitted2"))
+    # 2 wins a new term and commits different entries
+    nt.send(campaign(nt.raft(2)))
+    nt.send(propose(2, b"committed"))
+    # heal: 1 rejoins and must adopt 2's log
+    nt.recover()
+    nt.send(Message(from_=2, to=2, type=MT.LEADER_HEARTBEAT))
+    r1 = nt.raft(1)
+    assert r1.state == RaftState.FOLLOWER
+    ents = committed_entries(nt, 1)
+    cmds = [e.cmd for e in ents if e.cmd]
+    assert b"uncommitted" not in cmds
+    assert b"committed" in cmds
+    assert r1.log.committed == nt.raft(2).log.committed
+
+
+def test_leader_sync_sends_empty_replicate_on_heartbeat_resp():
+    r = new_test_raft(1, [1, 2])
+    r.become_candidate()
+    r.become_leader()
+    r.msgs = []
+    # follower responds to heartbeat while behind
+    r.handle(Message(from_=2, to=1, type=MT.HEARTBEAT_RESP, term=r.term))
+    assert any(m.type == MT.REPLICATE for m in r.msgs)
+
+
+def test_duplicate_replicate_resp_ignored():
+    r = new_test_raft(1, [1, 2, 3])
+    r.become_candidate()
+    r.become_leader()
+    last = r.log.last_index()
+    r.handle(Message(from_=2, to=1, type=MT.REPLICATE_RESP, term=r.term,
+                     log_index=last))
+    committed = r.log.committed
+    # replaying the same ack must not change anything
+    r.handle(Message(from_=2, to=1, type=MT.REPLICATE_RESP, term=r.term,
+                     log_index=last))
+    assert r.log.committed == committed
+
+
+def test_reject_decrements_next_and_retries():
+    r = new_test_raft(1, [1, 2])
+    r.become_candidate()
+    r.become_leader()
+    r.msgs = []
+    rp = r.remotes[2]
+    rp.become_replicate()
+    rp.next = 10
+    rp.match = 0
+    r.handle(
+        Message(from_=2, to=1, type=MT.REPLICATE_RESP, term=r.term,
+                reject=True, log_index=9, hint=3)
+    )
+    assert rp.next == 1  # replicate state resets next to match+1
+    assert any(m.type == MT.REPLICATE for m in r.msgs)
+
+
+def test_single_node_commits_immediately():
+    nt = Network(None)
+    nt.send(campaign(nt.raft(1)))
+    nt.send(propose(1, b"x"))
+    assert nt.raft(1).log.committed == 2
+
+
+def test_read_index_round():
+    nt = Network(None, None, None)
+    nt.send(campaign(nt.raft(1)))
+    nt.send(propose(1, b"x"))
+    r1 = nt.raft(1)
+    r1.ready_to_read = []
+    nt.send(readindex(1, 7, 9))
+    assert len(r1.ready_to_read) == 1
+    rtr = r1.ready_to_read[0]
+    assert rtr.index == r1.log.committed
+    assert rtr.system_ctx.low == 7 and rtr.system_ctx.high == 9
+
+
+def test_read_index_forwarded_by_follower():
+    nt = Network(None, None, None)
+    nt.send(campaign(nt.raft(1)))
+    nt.send(propose(1, b"x"))
+    r2 = nt.raft(2)
+    r2.ready_to_read = []
+    nt.send(readindex(2, 3, 4))
+    # follower receives ReadIndexResp and surfaces ready-to-read
+    assert len(r2.ready_to_read) == 1
+    assert r2.ready_to_read[0].index == nt.raft(1).log.committed
+
+
+def test_witness_replicates_metadata_only():
+    from raft_harness import new_test_config
+    from dragonboat_tpu.raft import InMemLogDB, Raft
+    from dragonboat_tpu.raft.remote import Remote
+    from dragonboat_tpu.wire import EntryType
+
+    r = new_test_raft(1, [1, 2])
+    r.witnesses[3] = Remote(next=1)
+    r.reset_match_value_array()
+    r.campaign()  # self-votes; one more vote reaches quorum (2 of 3)
+    r.handle(Message(from_=2, to=1, type=MT.REQUEST_VOTE_RESP, term=r.term))
+    assert r.state == RaftState.LEADER
+    # witness acks the noop so its remote unpauses into Replicate state
+    r.handle(Message(from_=3, to=1, type=MT.REPLICATE_RESP, term=r.term,
+                     log_index=r.log.last_index()))
+    r.msgs = []
+    r.handle(Message(from_=1, to=1, type=MT.PROPOSE, entries=[Entry(cmd=b"data")]))
+    witness_msgs = [m for m in r.msgs if m.to == 3 and m.type == MT.REPLICATE]
+    assert witness_msgs
+    for m in witness_msgs:
+        for e in m.entries:
+            if e.type != EntryType.CONFIG_CHANGE:
+                assert e.type == EntryType.METADATA
+                assert e.cmd == b""

@@ -1,0 +1,120 @@
+"""Progress tracker conformance (reference internal/raft/remote_test.go)."""
+from dragonboat_tpu.raft import Remote, RemoteState
+
+
+def test_initial_state():
+    r = Remote()
+    assert r.state == RemoteState.RETRY
+    assert r.match == 0 and r.next == 0
+
+
+def test_become_retry_from_snapshot_uses_snapshot_index():
+    r = Remote(match=5, next=10)
+    r.become_snapshot(20)
+    assert r.state == RemoteState.SNAPSHOT
+    r.become_retry()
+    assert r.next == 21
+    assert r.state == RemoteState.RETRY
+    assert r.snapshot_index == 0
+
+
+def test_become_retry_from_other_state():
+    r = Remote(match=5, next=10)
+    r.become_retry()
+    assert r.next == 6
+
+
+def test_retry_wait_transitions():
+    r = Remote()
+    r.retry_to_wait()
+    assert r.state == RemoteState.WAIT
+    assert r.is_paused()
+    r.wait_to_retry()
+    assert r.state == RemoteState.RETRY
+    assert not r.is_paused()
+
+
+def test_become_replicate():
+    r = Remote(match=7)
+    r.become_replicate()
+    assert r.state == RemoteState.REPLICATE
+    assert r.next == 8
+    assert not r.is_paused()
+
+
+def test_try_update():
+    r = Remote(match=5, next=6)
+    assert r.try_update(10)
+    assert r.match == 10 and r.next == 11
+    # stale update is a no-op
+    assert not r.try_update(3)
+    assert r.match == 10
+    # next never decreases
+    assert r.next == 11
+
+
+def test_try_update_unpauses_wait():
+    r = Remote(match=5, next=6)
+    r.retry_to_wait()
+    assert r.try_update(8)
+    assert r.state == RemoteState.RETRY
+
+
+def test_progress_replicate_advances_next():
+    r = Remote(match=5)
+    r.become_replicate()
+    r.progress(20)
+    assert r.next == 21
+
+
+def test_progress_retry_enters_wait():
+    r = Remote()
+    r.progress(10)
+    assert r.state == RemoteState.WAIT
+
+
+def test_responded_to_retry_becomes_replicate():
+    r = Remote(match=3)
+    r.responded_to()
+    assert r.state == RemoteState.REPLICATE
+
+
+def test_responded_to_snapshot_completion():
+    r = Remote(match=5)
+    r.become_snapshot(10)
+    r.responded_to()  # match < snapshot index: stay
+    assert r.state == RemoteState.SNAPSHOT
+    r.match = 10
+    r.responded_to()
+    assert r.state == RemoteState.RETRY
+    assert r.next == 11
+
+
+def test_decrease_to_replicate_state():
+    r = Remote(match=5, next=10)
+    r.become_replicate()
+    r.next = 10
+    # rejected <= match: stale
+    assert not r.decrease_to(4, 100)
+    assert r.decrease_to(9, 100)
+    assert r.next == r.match + 1
+
+
+def test_decrease_to_retry_state():
+    r = Remote(match=0, next=10)
+    # mismatched rejection is stale
+    assert not r.decrease_to(5, 100)
+    assert r.decrease_to(9, 3)
+    assert r.next == 4  # min(rejected, last+1)
+    r2 = Remote(match=0, next=10)
+    assert r2.decrease_to(9, 100)
+    assert r2.next == 9
+
+
+def test_active_flag():
+    r = Remote()
+    assert not r.is_active()
+    r.set_active()
+    assert r.is_active()
+    r.set_not_active()
+    assert not r.is_active()

@@ -1,0 +1,193 @@
+"""Wire type + codec roundtrip tests (reference raftpb fuzz/marshal tests)."""
+import pytest
+
+from dragonboat_tpu.wire import (
+    Bootstrap,
+    Chunk,
+    ConfigChange,
+    ConfigChangeType,
+    Entry,
+    EntryType,
+    Membership,
+    Message,
+    MessageBatch,
+    MessageType,
+    Snapshot,
+    SnapshotFile,
+    State,
+    StateMachineType,
+    codec,
+)
+
+
+def test_entry_roundtrip():
+    e = Entry(
+        term=3,
+        index=1000000,
+        type=EntryType.CONFIG_CHANGE,
+        key=2**63,
+        client_id=42,
+        series_id=7,
+        responded_to=6,
+        cmd=b"hello world",
+    )
+    assert codec.decode_entry(codec.encode_entry(e)) == e
+
+
+def test_entry_defaults_roundtrip():
+    e = Entry()
+    assert codec.decode_entry(codec.encode_entry(e)) == e
+
+
+def test_entry_batch_roundtrip():
+    batch = [Entry(term=i, index=i, cmd=bytes([i])) for i in range(48)]
+    assert codec.decode_entry_batch(codec.encode_entry_batch(batch)) == batch
+
+
+def test_state_roundtrip():
+    st = State(term=5, vote=2, commit=99)
+    assert codec.decode_state(codec.encode_state(st)) == st
+
+
+def test_membership_roundtrip_deterministic():
+    m = Membership(
+        config_change_id=9,
+        addresses={3: "c:3", 1: "a:1", 2: "b:2"},
+        removed={5: True},
+        observers={7: "o:7"},
+        witnesses={9: "w:9"},
+    )
+    data1 = codec.encode_membership(m)
+    # insertion order must not affect bytes (determinism for state hashing)
+    m2 = Membership(
+        config_change_id=9,
+        addresses={1: "a:1", 2: "b:2", 3: "c:3"},
+        removed={5: True},
+        observers={7: "o:7"},
+        witnesses={9: "w:9"},
+    )
+    assert data1 == codec.encode_membership(m2)
+    assert codec.decode_membership(data1) == m
+
+
+def test_snapshot_roundtrip():
+    ss = Snapshot(
+        filepath="/tmp/snap.gbsnap",
+        file_size=12345,
+        index=100,
+        term=3,
+        membership=Membership(addresses={1: "a:1"}),
+        files=[SnapshotFile(filepath="/x", file_size=5, file_id=1, metadata=b"m")],
+        checksum=b"\x01\x02",
+        dummy=True,
+        cluster_id=7,
+        type=StateMachineType.REGULAR,
+        imported=True,
+        on_disk_index=55,
+        witness=False,
+    )
+    assert codec.decode_snapshot(codec.encode_snapshot(ss)) == ss
+
+
+def test_message_roundtrip():
+    m = Message(
+        type=MessageType.REPLICATE,
+        to=2,
+        from_=1,
+        cluster_id=77,
+        term=3,
+        log_term=2,
+        log_index=10,
+        commit=9,
+        reject=True,
+        hint=123,
+        hint_high=456,
+        entries=[Entry(term=3, index=11, cmd=b"x")],
+        snapshot=Snapshot(index=5, term=1),
+    )
+    got = codec.decode_message(codec.encode_message(m))
+    assert got == m
+
+
+def test_message_batch_roundtrip():
+    b = MessageBatch(
+        requests=[
+            Message(type=MessageType.HEARTBEAT, to=1, from_=2, cluster_id=3),
+            Message(type=MessageType.REPLICATE_RESP, to=2, from_=1, reject=True),
+        ],
+        deployment_id=88,
+        source_address="host:1234",
+        bin_ver=1,
+    )
+    assert codec.decode_message_batch(codec.encode_message_batch(b)) == b
+
+
+def test_config_change_roundtrip():
+    cc = ConfigChange(
+        config_change_id=4,
+        type=ConfigChangeType.ADD_WITNESS,
+        node_id=5,
+        address="h:1",
+        initialize=True,
+    )
+    assert codec.decode_config_change(codec.encode_config_change(cc)) == cc
+
+
+def test_bootstrap_roundtrip():
+    b = Bootstrap(addresses={1: "a:1", 2: "b:2"}, join=False,
+                  type=StateMachineType.ON_DISK)
+    assert codec.decode_bootstrap(codec.encode_bootstrap(b)) == b
+    assert b.validate()
+    assert not Bootstrap().validate()
+    assert Bootstrap(join=True).validate()
+
+
+def test_chunk_roundtrip():
+    c = Chunk(
+        cluster_id=1,
+        node_id=2,
+        from_=3,
+        chunk_id=4,
+        chunk_size=5,
+        chunk_count=6,
+        data=b"payload",
+        index=7,
+        term=8,
+        membership=Membership(addresses={1: "a:1"}),
+        filepath="f",
+        file_size=9,
+        deployment_id=10,
+        file_chunk_id=11,
+        file_chunk_count=12,
+        has_file_info=True,
+        file_info=SnapshotFile(filepath="g", file_size=1, file_id=2),
+        bin_ver=13,
+        on_disk_index=14,
+        witness=True,
+    )
+    assert codec.decode_chunk(codec.encode_chunk(c)) == c
+
+
+def test_corrupt_data_raises():
+    e = Entry(term=1, index=2, cmd=b"abc")
+    data = codec.encode_entry(e)
+    with pytest.raises(codec.CodecError):
+        codec.decode_entry(data + b"\x00")
+    with pytest.raises(codec.CodecError):
+        codec.decode_entry(data[:-1])
+
+
+def test_entry_session_predicates():
+    from dragonboat_tpu.wire import (
+        NOOP_CLIENT_ID,
+        SERIES_ID_FOR_REGISTER,
+        SERIES_ID_FOR_UNREGISTER,
+    )
+
+    e = Entry(client_id=NOOP_CLIENT_ID)
+    assert e.is_noop_session()
+    assert not e.is_session_managed()
+    reg = Entry(client_id=5, series_id=SERIES_ID_FOR_REGISTER)
+    assert reg.is_new_session_request()
+    unreg = Entry(client_id=5, series_id=SERIES_ID_FOR_UNREGISTER)
+    assert unreg.is_end_of_session_request()
