@@ -1,0 +1,12 @@
+"""Log storage layer (reference ``internal/logdb/``).
+
+Sharded, write-batched persistent storage for raft entries, state,
+bootstrap records and snapshot metadata.  The storage contract is
+``IKVStore``-shaped (reference ``internal/logdb/kv/kv.go:28``): write-batch
+atomicity, range delete and manual compaction — satisfied by the pure-Python
+backends in :mod:`dragonboat_tpu.logdb.kv` and by the C++ native engine in
+``dragonboat_tpu/native`` once built.
+"""
+from .kv import IKVStore, InMemKV, KVWriteBatch, WalKV  # noqa: F401
+from .logreader import LogReader  # noqa: F401
+from .sharded import ShardedDB, open_logdb  # noqa: F401

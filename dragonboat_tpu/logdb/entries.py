@@ -1,0 +1,211 @@
+"""Entry record managers: plain (one entry per key) and batched.
+
+Reference: ``internal/logdb/plain.go`` and ``internal/logdb/batch.go`` — the
+plain manager stores each entry under its own ``(cluster, node, index)`` key;
+the batched manager packs ``Hard.logdb_entry_batch_size`` (48) consecutive
+entries into one record keyed by ``index // 48``.  The open path auto-detects
+which format is on disk (reference ``logdb.go:44-56``).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..settings import Hard
+from ..wire import Entry
+from ..wire.codec import (
+    decode_entry,
+    decode_entry_batch,
+    encode_entry,
+    encode_entry_batch,
+)
+from . import keys
+from .kv import IKVStore, KVWriteBatch
+
+
+class PlainEntries:
+    """One entry per record (reference ``plain.go:31``)."""
+
+    name = "plain"
+
+    def __init__(self, kv: IKVStore):
+        self.kv = kv
+
+    def record_entries(
+        self, wb: KVWriteBatch, cluster_id: int, node_id: int, entries: List[Entry]
+    ) -> int:
+        """Append entry records to the write batch; returns the max index."""
+        if not entries:
+            return 0
+        for e in entries:
+            wb.put(keys.entry_key(cluster_id, node_id, e.index), encode_entry(e))
+        return entries[-1].index
+
+    def iterate_entries(
+        self,
+        ents: List[Entry],
+        size: int,
+        cluster_id: int,
+        node_id: int,
+        low: int,
+        high: int,
+        max_size: int,
+    ) -> Tuple[List[Entry], int]:
+        """Collect entries in ``[low, high)`` up to ``max_size`` bytes.
+
+        Mirrors the reference's contract: stops at the first hole, always
+        returns at least one entry if one exists at ``low``.
+        """
+        fk = keys.entry_key(cluster_id, node_id, low)
+        lk = keys.entry_key(cluster_id, node_id, high - 1)
+        expected = low
+        for _, v in self.kv.iterate(fk, lk, True):
+            e = decode_entry(v)
+            if e.index != expected:
+                break
+            size += e.size()
+            if ents and size > max_size:
+                return ents, size
+            ents.append(e)
+            expected += 1
+        return ents, size
+
+    def get_entry(self, cluster_id: int, node_id: int, index: int) -> Optional[Entry]:
+        v = self.kv.get(keys.entry_key(cluster_id, node_id, index))
+        return decode_entry(v) if v is not None else None
+
+    def remove_entries_to(
+        self, wb: KVWriteBatch, cluster_id: int, node_id: int, index: int
+    ) -> None:
+        wb.delete_range(
+            keys.entry_key(cluster_id, node_id, 0),
+            keys.entry_key(cluster_id, node_id, index + 1),
+        )
+
+    def remove_all(self, wb: KVWriteBatch, cluster_id: int, node_id: int) -> None:
+        wb.delete_range(
+            keys.entry_key(cluster_id, node_id, 0),
+            keys.entry_key(cluster_id, node_id, keys.MAX_INDEX),
+        )
+
+    def compact_range(self, cluster_id: int, node_id: int, index: int) -> None:
+        self.kv.compact_entries(
+            keys.entry_key(cluster_id, node_id, 0),
+            keys.entry_key(cluster_id, node_id, index + 1),
+        )
+
+
+class BatchedEntries:
+    """48-entry batch records (reference ``batch.go:142``).
+
+    A batch record with id ``b`` holds entries with ``index // batch_size ==
+    b`` that were live at write time; overwrites after a conflict rewrite the
+    first affected batch (merging the surviving prefix) and then replace all
+    later batches.
+    """
+
+    name = "batched"
+
+    def __init__(self, kv: IKVStore):
+        self.kv = kv
+        self.batch_size = Hard.logdb_entry_batch_size
+
+    def _bid(self, index: int) -> int:
+        return index // self.batch_size
+
+    def _read_batch(
+        self, cluster_id: int, node_id: int, bid: int
+    ) -> List[Entry]:
+        v = self.kv.get(keys.entry_batch_key(cluster_id, node_id, bid))
+        return decode_entry_batch(v) if v is not None else []
+
+    def record_entries(
+        self, wb: KVWriteBatch, cluster_id: int, node_id: int, entries: List[Entry]
+    ) -> int:
+        if not entries:
+            return 0
+        first = entries[0]
+        fbid = self._bid(first.index)
+        # merge surviving prefix of the first touched batch
+        existing = self._read_batch(cluster_id, node_id, fbid)
+        merged = [e for e in existing if e.index < first.index]
+        batch: List[Entry] = merged
+        bid = fbid
+        for e in entries:
+            ebid = self._bid(e.index)
+            if ebid != bid:
+                wb.put(
+                    keys.entry_batch_key(cluster_id, node_id, bid),
+                    encode_entry_batch(batch),
+                )
+                bid = ebid
+                batch = []
+            batch.append(e)
+        wb.put(
+            keys.entry_batch_key(cluster_id, node_id, bid),
+            encode_entry_batch(batch),
+        )
+        return entries[-1].index
+
+    def iterate_entries(
+        self,
+        ents: List[Entry],
+        size: int,
+        cluster_id: int,
+        node_id: int,
+        low: int,
+        high: int,
+        max_size: int,
+    ) -> Tuple[List[Entry], int]:
+        expected = low
+        for bid in range(self._bid(low), self._bid(high - 1) + 1):
+            batch = self._read_batch(cluster_id, node_id, bid)
+            if not batch:
+                return ents, size
+            for e in batch:
+                if e.index < expected or e.index >= high:
+                    continue
+                if e.index != expected:
+                    return ents, size
+                size += e.size()
+                if ents and size > max_size:
+                    return ents, size
+                ents.append(e)
+                expected += 1
+        return ents, size
+
+    def get_entry(self, cluster_id: int, node_id: int, index: int) -> Optional[Entry]:
+        for e in self._read_batch(cluster_id, node_id, self._bid(index)):
+            if e.index == index:
+                return e
+        return None
+
+    def remove_entries_to(
+        self, wb: KVWriteBatch, cluster_id: int, node_id: int, index: int
+    ) -> None:
+        # only whole batches strictly below the boundary can be removed
+        wb.delete_range(
+            keys.entry_batch_key(cluster_id, node_id, 0),
+            keys.entry_batch_key(cluster_id, node_id, self._bid(index + 1)),
+        )
+
+    def remove_all(self, wb: KVWriteBatch, cluster_id: int, node_id: int) -> None:
+        wb.delete_range(
+            keys.entry_batch_key(cluster_id, node_id, 0),
+            keys.entry_batch_key(cluster_id, node_id, keys.MAX_INDEX),
+        )
+
+    def compact_range(self, cluster_id: int, node_id: int, index: int) -> None:
+        self.kv.compact_entries(
+            keys.entry_batch_key(cluster_id, node_id, 0),
+            keys.entry_batch_key(cluster_id, node_id, self._bid(index + 1)),
+        )
+
+
+def has_entry_records(kv: IKVStore, batched: bool) -> bool:
+    """Format self-check helper (reference ``sharded_rdb.go`` selfCheckFailed)."""
+    tag = keys.TAG_ENTRY_BATCH if batched else keys.TAG_ENTRY
+    first = keys.make_key(tag, 0, 0, 0)
+    last = keys.make_key(tag, 2**64 - 1, 2**64 - 1, keys.MAX_INDEX)
+    for _ in kv.iterate(first, last, True):
+        return True
+    return False

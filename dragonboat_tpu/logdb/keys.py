@@ -1,0 +1,68 @@
+"""Key schema for the log storage layer.
+
+Reference: ``internal/logdb/pooledkey.go:23-55`` — fixed-size binary keys
+whose lexical order equals numeric ``(clusterID, nodeID, index)`` order, so
+range scans and range deletes cover exactly one node's records.
+
+Layout (25 bytes): ``<tag u8><cluster u64 BE><node u64 BE><index u64 BE>``.
+Big-endian makes byte order == integer order.  Bootstrap/state/max-index
+records use index 0; snapshot and entry records key on their raft index.
+"""
+from __future__ import annotations
+
+import struct
+
+_KEY = struct.Struct(">BQQQ")
+
+TAG_BOOTSTRAP = 0x01
+TAG_STATE = 0x02
+TAG_MAX_INDEX = 0x03
+TAG_SNAPSHOT = 0x04
+TAG_ENTRY = 0x05
+TAG_ENTRY_BATCH = 0x06
+
+KEY_SIZE = _KEY.size
+
+MAX_INDEX = 2**64 - 1
+
+
+def make_key(tag: int, cluster_id: int, node_id: int, index: int = 0) -> bytes:
+    return _KEY.pack(tag, cluster_id, node_id, index)
+
+
+def parse_key(key: bytes):
+    return _KEY.unpack(key)
+
+
+def bootstrap_key(cluster_id: int, node_id: int) -> bytes:
+    return make_key(TAG_BOOTSTRAP, cluster_id, node_id)
+
+
+def state_key(cluster_id: int, node_id: int) -> bytes:
+    return make_key(TAG_STATE, cluster_id, node_id)
+
+
+def max_index_key(cluster_id: int, node_id: int) -> bytes:
+    return make_key(TAG_MAX_INDEX, cluster_id, node_id)
+
+
+def snapshot_key(cluster_id: int, node_id: int, index: int) -> bytes:
+    return make_key(TAG_SNAPSHOT, cluster_id, node_id, index)
+
+
+def entry_key(cluster_id: int, node_id: int, index: int) -> bytes:
+    return make_key(TAG_ENTRY, cluster_id, node_id, index)
+
+
+def entry_batch_key(cluster_id: int, node_id: int, batch_id: int) -> bytes:
+    return make_key(TAG_ENTRY_BATCH, cluster_id, node_id, batch_id)
+
+
+def node_first_key(cluster_id: int, node_id: int) -> bytes:
+    """Smallest possible key for a node, across all tags."""
+    return make_key(TAG_BOOTSTRAP, cluster_id, node_id, 0)
+
+
+def node_last_key(cluster_id: int, node_id: int) -> bytes:
+    """Largest possible key for a node, across all tags."""
+    return make_key(TAG_ENTRY_BATCH, cluster_id, node_id, MAX_INDEX)

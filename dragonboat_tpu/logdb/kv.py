@@ -1,0 +1,289 @@
+"""Key-value storage abstraction and pure-Python backends.
+
+Reference: ``internal/logdb/kv/kv.go:28-73`` (``IKVStore``: iterate / get /
+put / delete, atomic WriteBatch, BulkRemoveEntries range-delete, manual
+CompactEntries) and the Pebble backend (``kv/pebble/kv_pebble.go``).
+
+Two host backends are provided here:
+
+- :class:`InMemKV` — ordered in-memory map (plays the role of the memfs
+  Pebble used by the reference test builds).
+- :class:`WalKV` — :class:`InMemKV` plus an append-only write-ahead file so
+  state survives process restart; every committed write batch is one framed,
+  crc-checked WAL record.  This is the interim durable engine until the C++
+  native log engine (``dragonboat_tpu/native``) takes over.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from bisect import bisect_left, insort
+from typing import Callable, Iterator, List, Optional, Protocol, Tuple
+
+_PUT = 0
+_DELETE = 1
+_DELETE_RANGE = 2
+
+
+class KVWriteBatch:
+    """Atomic group of writes (reference ``kv.go`` ``IWriteBatch``)."""
+
+    __slots__ = ("ops",)
+
+    def __init__(self) -> None:
+        self.ops: List[Tuple[int, bytes, bytes]] = []
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self.ops.append((_PUT, bytes(key), bytes(value)))
+
+    def delete(self, key: bytes) -> None:
+        self.ops.append((_DELETE, bytes(key), b""))
+
+    def delete_range(self, first: bytes, last: bytes) -> None:
+        """Delete keys in ``[first, last)``."""
+        self.ops.append((_DELETE_RANGE, bytes(first), bytes(last)))
+
+    def clear(self) -> None:
+        self.ops.clear()
+
+    def __len__(self) -> int:
+        return len(self.ops)
+
+
+class IKVStore(Protocol):
+    """Reference ``internal/logdb/kv/kv.go:28``."""
+
+    def name(self) -> str: ...
+
+    def get(self, key: bytes) -> Optional[bytes]: ...
+
+    def put(self, key: bytes, value: bytes) -> None: ...
+
+    def delete(self, key: bytes) -> None: ...
+
+    def iterate(
+        self, first: bytes, last: bytes, inc_last: bool
+    ) -> Iterator[Tuple[bytes, bytes]]: ...
+
+    def get_write_batch(self) -> KVWriteBatch: ...
+
+    def commit_write_batch(self, wb: KVWriteBatch) -> None: ...
+
+    def bulk_remove_entries(self, first: bytes, last: bytes) -> None: ...
+
+    def compact_entries(self, first: bytes, last: bytes) -> None: ...
+
+    def full_compaction(self) -> None: ...
+
+    def close(self) -> None: ...
+
+
+class InMemKV:
+    """Ordered in-memory KV store with atomic write batches."""
+
+    def __init__(self) -> None:
+        self._data: dict = {}
+        self._keys: List[bytes] = []  # sorted
+        self._mu = threading.Lock()
+
+    def name(self) -> str:
+        return "inmem"
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._mu:
+            return self._data.get(key)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        wb = self.get_write_batch()
+        wb.put(key, value)
+        self.commit_write_batch(wb)
+
+    def delete(self, key: bytes) -> None:
+        wb = self.get_write_batch()
+        wb.delete(key)
+        self.commit_write_batch(wb)
+
+    def iterate(
+        self, first: bytes, last: bytes, inc_last: bool
+    ) -> Iterator[Tuple[bytes, bytes]]:
+        # yields in bounded chunks (re-bisecting per chunk) so early-exit
+        # consumers don't pay a full-range copy
+        chunk = 128
+        cursor = first
+        first_round = True
+        while True:
+            with self._mu:
+                lo = bisect_left(self._keys, cursor)
+                if not first_round:
+                    # skip the cursor key itself, already yielded
+                    if lo < len(self._keys) and self._keys[lo] == cursor:
+                        lo += 1
+                pairs = []
+                for i in range(lo, min(lo + chunk, len(self._keys))):
+                    k = self._keys[i]
+                    if k > last or (k == last and not inc_last):
+                        break
+                    pairs.append((k, self._data[k]))
+            if not pairs:
+                return
+            yield from pairs
+            cursor = pairs[-1][0]
+            first_round = False
+            if len(pairs) < chunk:
+                return
+
+    def get_write_batch(self) -> KVWriteBatch:
+        return KVWriteBatch()
+
+    def commit_write_batch(self, wb: KVWriteBatch) -> None:
+        with self._mu:
+            self._apply_locked(wb)
+
+    def _apply_locked(self, wb: KVWriteBatch) -> None:
+        for op, k, v in wb.ops:
+            if op == _PUT:
+                if k not in self._data:
+                    insort(self._keys, k)
+                self._data[k] = v
+            elif op == _DELETE:
+                if k in self._data:
+                    del self._data[k]
+                    i = bisect_left(self._keys, k)
+                    del self._keys[i]
+            else:  # _DELETE_RANGE [k, v)
+                lo = bisect_left(self._keys, k)
+                hi = bisect_left(self._keys, v)
+                for dk in self._keys[lo:hi]:
+                    del self._data[dk]
+                del self._keys[lo:hi]
+
+    def bulk_remove_entries(self, first: bytes, last: bytes) -> None:
+        wb = self.get_write_batch()
+        wb.delete_range(first, last)
+        self.commit_write_batch(wb)
+
+    def compact_entries(self, first: bytes, last: bytes) -> None:
+        pass  # no LSM levels to compact
+
+    def full_compaction(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+_WAL_MAGIC = 0x57414C31  # "WAL1"
+_HDR = struct.Struct("<IIi")  # crc32(payload), payload len, op count
+
+
+class WalKV(InMemKV):
+    """Durable KV: in-memory index + append-only WAL, one record per batch.
+
+    Record framing: ``<crc32><len><nops>`` header followed by
+    ``nops`` × ``<op u8><klen u32><key><vlen u32><value>``.  Torn tails are
+    detected by the crc and dropped on replay.  ``full_compaction`` rewrites
+    the WAL as a single snapshot batch of live keys.
+    """
+
+    def __init__(self, dirname: str, fsync: bool = True) -> None:
+        super().__init__()
+        self._dir = dirname
+        self._fsync = fsync
+        os.makedirs(dirname, exist_ok=True)
+        self._path = os.path.join(dirname, "kv.wal")
+        self._replay()
+        self._f = open(self._path, "ab")
+
+    def name(self) -> str:
+        return "walkv"
+
+    @staticmethod
+    def _encode_batch(wb: KVWriteBatch) -> bytes:
+        buf = bytearray()
+        for op, k, v in wb.ops:
+            buf.append(op)
+            buf += struct.pack("<I", len(k))
+            buf += k
+            buf += struct.pack("<I", len(v))
+            buf += v
+        payload = bytes(buf)
+        return _HDR.pack(zlib.crc32(payload), len(payload), len(wb.ops)) + payload
+
+    def _replay(self) -> None:
+        if not os.path.exists(self._path):
+            return
+        with open(self._path, "rb") as f:
+            data = f.read()
+        pos, n = 0, len(data)
+        valid_to = 0
+        while pos + _HDR.size <= n:
+            crc, plen, nops = _HDR.unpack_from(data, pos)
+            body_start = pos + _HDR.size
+            if body_start + plen > n:
+                break
+            payload = data[body_start : body_start + plen]
+            if zlib.crc32(payload) != crc:
+                break
+            wb = KVWriteBatch()
+            p = 0
+            ok = True
+            for _ in range(nops):
+                try:
+                    op = payload[p]
+                    klen = struct.unpack_from("<I", payload, p + 1)[0]
+                    p += 5
+                    k = payload[p : p + klen]
+                    p += klen
+                    vlen = struct.unpack_from("<I", payload, p)[0]
+                    p += 4
+                    v = payload[p : p + vlen]
+                    p += vlen
+                except (IndexError, struct.error):
+                    ok = False
+                    break
+                wb.ops.append((op, bytes(k), bytes(v)))
+            if not ok:
+                break
+            self._apply_locked(wb)
+            pos = body_start + plen
+            valid_to = pos
+        if valid_to < n:  # truncate torn tail
+            with open(self._path, "r+b") as f:
+                f.truncate(valid_to)
+
+    def commit_write_batch(self, wb: KVWriteBatch) -> None:
+        rec = self._encode_batch(wb)
+        with self._mu:
+            self._f.write(rec)
+            self._f.flush()
+            if self._fsync:
+                os.fsync(self._f.fileno())
+            self._apply_locked(wb)
+
+    def full_compaction(self) -> None:
+        with self._mu:
+            wb = KVWriteBatch()
+            for k in self._keys:
+                wb.put(k, self._data[k])
+            rec = self._encode_batch(wb)
+            tmp = self._path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(rec)
+                f.flush()
+                os.fsync(f.fileno())
+            self._f.close()
+            os.replace(tmp, self._path)
+            self._f = open(self._path, "ab")
+
+    def close(self) -> None:
+        with self._mu:
+            if not self._f.closed:
+                self._f.flush()
+                if self._fsync:
+                    os.fsync(self._f.fileno())
+                self._f.close()
+
+
+KVFactory = Callable[[str], IKVStore]

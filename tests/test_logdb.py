@@ -1,0 +1,378 @@
+"""LogDB layer tests (reference test model: ``internal/logdb/*_test.go``)."""
+import os
+
+import pytest
+
+from dragonboat_tpu.logdb import InMemKV, LogReader, WalKV, open_logdb
+from dragonboat_tpu.logdb.entries import BatchedEntries, PlainEntries
+from dragonboat_tpu.logdb.rdb import RDB
+from dragonboat_tpu.raft.log import CompactedError, UnavailableError
+from dragonboat_tpu.wire import Bootstrap, Entry, Membership, Snapshot, State, Update
+
+
+def make_entries(lo, hi, term=1, size=8):
+    return [Entry(term=term, index=i, cmd=b"x" * size) for i in range(lo, hi)]
+
+
+# ---------- KV ----------
+
+
+def test_inmem_kv_ordered_iterate():
+    kv = InMemKV()
+    kv.put(b"b", b"2")
+    kv.put(b"a", b"1")
+    kv.put(b"c", b"3")
+    assert [k for k, _ in kv.iterate(b"a", b"c", True)] == [b"a", b"b", b"c"]
+    assert [k for k, _ in kv.iterate(b"a", b"c", False)] == [b"a", b"b"]
+
+
+def test_inmem_kv_write_batch_atomic_delete_range():
+    kv = InMemKV()
+    for i in range(10):
+        kv.put(bytes([i]), b"v")
+    wb = kv.get_write_batch()
+    wb.delete_range(bytes([2]), bytes([5]))
+    wb.put(bytes([11]), b"w")
+    kv.commit_write_batch(wb)
+    assert kv.get(bytes([2])) is None
+    assert kv.get(bytes([4])) is None
+    assert kv.get(bytes([5])) == b"v"
+    assert kv.get(bytes([11])) == b"w"
+
+
+def test_walkv_survives_reopen(tmp_path):
+    d = str(tmp_path / "kv")
+    kv = WalKV(d, fsync=False)
+    kv.put(b"k1", b"v1")
+    wb = kv.get_write_batch()
+    wb.put(b"k2", b"v2")
+    wb.delete(b"k1")
+    kv.commit_write_batch(wb)
+    kv.close()
+    kv2 = WalKV(d, fsync=False)
+    assert kv2.get(b"k1") is None
+    assert kv2.get(b"k2") == b"v2"
+    kv2.close()
+
+
+def test_walkv_drops_torn_tail(tmp_path):
+    d = str(tmp_path / "kv")
+    kv = WalKV(d, fsync=False)
+    kv.put(b"k1", b"v1")
+    kv.put(b"k2", b"v2")
+    kv.close()
+    path = os.path.join(d, "kv.wal")
+    sz = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(sz - 3)  # corrupt the last record
+    kv2 = WalKV(d, fsync=False)
+    assert kv2.get(b"k1") == b"v1"
+    assert kv2.get(b"k2") is None
+    kv2.close()
+
+
+def test_walkv_full_compaction_preserves_data(tmp_path):
+    d = str(tmp_path / "kv")
+    kv = WalKV(d, fsync=False)
+    for i in range(100):
+        kv.put(f"k{i:03d}".encode(), b"v" * 100)
+    for i in range(50):
+        kv.delete(f"k{i:03d}".encode())
+    before = os.path.getsize(os.path.join(d, "kv.wal"))
+    kv.full_compaction()
+    after = os.path.getsize(os.path.join(d, "kv.wal"))
+    assert after < before
+    kv.close()
+    kv2 = WalKV(d, fsync=False)
+    assert kv2.get(b"k049") is None
+    assert kv2.get(b"k050") == b"v" * 100
+    kv2.close()
+
+
+# ---------- entry managers ----------
+
+
+@pytest.mark.parametrize("mgr_cls", [PlainEntries, BatchedEntries])
+def test_entry_manager_roundtrip(mgr_cls):
+    kv = InMemKV()
+    mgr = mgr_cls(kv)
+    wb = kv.get_write_batch()
+    mi = mgr.record_entries(wb, 1, 2, make_entries(1, 101))
+    kv.commit_write_batch(wb)
+    assert mi == 100
+    ents, size = mgr.iterate_entries([], 0, 1, 2, 1, 101, 1 << 62)
+    assert [e.index for e in ents] == list(range(1, 101))
+    assert size > 0
+    assert mgr.get_entry(1, 2, 50).index == 50
+    assert mgr.get_entry(1, 2, 101) is None
+
+
+@pytest.mark.parametrize("mgr_cls", [PlainEntries, BatchedEntries])
+def test_entry_manager_conflict_overwrite(mgr_cls):
+    kv = InMemKV()
+    mgr = mgr_cls(kv)
+    wb = kv.get_write_batch()
+    mgr.record_entries(wb, 1, 2, make_entries(1, 101, term=1))
+    kv.commit_write_batch(wb)
+    # overwrite a suffix with higher-term entries
+    wb = kv.get_write_batch()
+    mgr.record_entries(wb, 1, 2, make_entries(50, 81, term=2))
+    kv.commit_write_batch(wb)
+    ents, _ = mgr.iterate_entries([], 0, 1, 2, 1, 81, 1 << 62)
+    assert [e.index for e in ents] == list(range(1, 81))
+    assert all(e.term == 1 for e in ents if e.index < 50)
+    assert all(e.term == 2 for e in ents if e.index >= 50)
+    # stale entries beyond the new tail: rdb bounds `high` by max_index, so
+    # emulate the caller passing high = max_index + 1 = 81
+    ents2, _ = mgr.iterate_entries([], 0, 1, 2, 75, 81, 1 << 62)
+    assert [e.index for e in ents2] == list(range(75, 81))
+    assert all(e.term == 2 for e in ents2)
+    if mgr_cls is BatchedEntries:
+        # the batch rewrite physically drops stale entries beyond the tail
+        ents3, _ = mgr.iterate_entries([], 0, 1, 2, 75, 101, 1 << 62)
+        assert [e.index for e in ents3 if e.index > 80] == []
+
+
+@pytest.mark.parametrize("mgr_cls", [PlainEntries, BatchedEntries])
+def test_entry_manager_max_size_stops_iteration(mgr_cls):
+    kv = InMemKV()
+    mgr = mgr_cls(kv)
+    wb = kv.get_write_batch()
+    mgr.record_entries(wb, 1, 2, make_entries(1, 11, size=100))
+    kv.commit_write_batch(wb)
+    ents, _ = mgr.iterate_entries([], 0, 1, 2, 1, 11, 300)
+    assert 1 <= len(ents) < 10
+    # always returns at least one entry even if over budget
+    ents1, _ = mgr.iterate_entries([], 0, 1, 2, 1, 11, 1)
+    assert len(ents1) == 1
+
+
+# ---------- rdb ----------
+
+
+def make_update(cluster_id=1, node_id=2, lo=1, hi=11, term=1, commit=0, ss=None):
+    st = State(term=term, vote=0, commit=commit or hi - 1)
+    return Update(
+        cluster_id=cluster_id,
+        node_id=node_id,
+        state=st,
+        entries_to_save=make_entries(lo, hi, term=term),
+        snapshot=ss,
+    )
+
+
+def test_rdb_save_and_read_state():
+    rdb = RDB(InMemKV())
+    ud = make_update()
+    wb = rdb.kv.get_write_batch()
+    rdb.save_raft_state([ud], wb)
+    rs = rdb.read_raft_state(1, 2, 0)
+    assert rs.state.term == 1
+    assert rs.state.commit == 10
+    assert rs.first_index == 1
+    assert rs.entry_count == 10
+    assert rdb.read_max_index(1, 2) == 10
+
+
+def test_rdb_state_cache_suppresses_redundant_writes():
+    rdb = RDB(InMemKV())
+    ud = make_update()
+    wb = rdb.kv.get_write_batch()
+    rdb.save_raft_state([ud], wb)
+    # same state again: nothing new in the batch
+    ud2 = Update(cluster_id=1, node_id=2, state=ud.state)
+    wb2 = rdb.kv.get_write_batch()
+    rdb.save_raft_state([ud2], wb2)
+    assert len(wb2) == 0
+
+
+def test_rdb_bootstrap_roundtrip_and_listing():
+    rdb = RDB(InMemKV())
+    bs = Bootstrap(addresses={1: "a1:1", 2: "a2:2"}, type=1)
+    rdb.save_bootstrap(5, 1, bs)
+    rdb.save_bootstrap(7, 3, bs)
+    got = rdb.get_bootstrap(5, 1)
+    assert got.addresses == {1: "a1:1", 2: "a2:2"}
+    infos = rdb.list_node_info()
+    assert {(i.cluster_id, i.node_id) for i in infos} == {(5, 1), (7, 3)}
+
+
+def test_rdb_snapshot_listing_ascending():
+    rdb = RDB(InMemKV())
+    for idx in (30, 10, 20):
+        rdb.save_snapshot(1, 2, Snapshot(index=idx, term=1, cluster_id=1))
+    lst = rdb.list_snapshots(1, 2)
+    assert [s.index for s in lst] == [10, 20, 30]
+    lst = rdb.list_snapshots(1, 2, 20)
+    assert [s.index for s in lst] == [10, 20]
+    rdb.delete_snapshot(1, 2, 20)
+    assert [s.index for s in rdb.list_snapshots(1, 2)] == [10, 30]
+
+
+def test_rdb_remove_node_data():
+    rdb = RDB(InMemKV())
+    ud = make_update()
+    wb = rdb.kv.get_write_batch()
+    rdb.save_raft_state([ud], wb)
+    rdb.save_snapshot(1, 2, Snapshot(index=5, term=1, cluster_id=1))
+    rdb.save_bootstrap(1, 2, Bootstrap(addresses={2: "a:1"}))
+    rdb.remove_node_data(1, 2)
+    assert rdb.read_state(1, 2) is None
+    assert rdb.list_snapshots(1, 2) == []
+    assert rdb.get_bootstrap(1, 2) is None
+    ents, _ = rdb.iterate_entries([], 0, 1, 2, 1, 11, 1 << 62)
+    assert ents == []
+
+
+def test_rdb_remove_node_data_spares_other_nodes():
+    # regression: tag-major keys mean a naive cross-tag range delete would
+    # wipe every other node in the shard
+    rdb = RDB(InMemKV())
+    for cid, nid in ((1, 2), (3, 7)):
+        wb = rdb.kv.get_write_batch()
+        rdb.save_raft_state([make_update(cluster_id=cid, node_id=nid)], wb)
+        rdb.save_snapshot(cid, nid, Snapshot(index=5, term=1, cluster_id=cid))
+        rdb.save_bootstrap(cid, nid, Bootstrap(addresses={nid: "a:1"}))
+    rdb.remove_node_data(1, 2)
+    assert rdb.read_state(1, 2) is None
+    assert rdb.read_state(3, 7) is not None
+    assert rdb.read_max_index(3, 7) == 10
+    assert [s.index for s in rdb.list_snapshots(3, 7)] == [5]
+    assert rdb.get_bootstrap(3, 7) is not None
+    ents, _ = rdb.iterate_entries([], 0, 3, 7, 1, 11, 1 << 62)
+    assert [e.index for e in ents] == list(range(1, 11))
+
+
+def test_rdb_import_snapshot():
+    rdb = RDB(InMemKV())
+    wb = rdb.kv.get_write_batch()
+    rdb.save_raft_state([make_update()], wb)
+    rdb.save_snapshot(1, 2, Snapshot(index=20, term=1, cluster_id=1))
+    ss = Snapshot(
+        index=15,
+        term=2,
+        cluster_id=1,
+        type=1,
+        membership=Membership(addresses={2: "a:1"}, config_change_id=1),
+    )
+    rdb.import_snapshot(ss, 2)
+    snaps = rdb.list_snapshots(1, 2)
+    assert [s.index for s in snaps] == [15]
+    st = rdb.read_state(1, 2)
+    assert st.term == 2 and st.commit == 15
+    assert rdb.read_max_index(1, 2) == 15
+
+
+# ---------- sharded ----------
+
+
+def test_sharded_db_routes_by_cluster():
+    db = open_logdb(shards=4)
+    uds = [make_update(cluster_id=c, node_id=1) for c in range(8)]
+    db.save_raft_state(uds)
+    for c in range(8):
+        rs = db.read_raft_state(c, 1, 0)
+        assert rs is not None and rs.entry_count == 10
+    infos = db.list_node_info()
+    assert infos == []  # no bootstrap records yet
+    db.close()
+
+
+def test_sharded_db_remove_entries_and_compaction():
+    db = open_logdb(shards=2)
+    db.save_raft_state([make_update(cluster_id=1, node_id=2, lo=1, hi=101)])
+    db.remove_entries_to(1, 2, 50)
+    done = db.compact_entries_to(1, 2, 50)
+    assert done.wait(timeout=5)
+    ents, _ = db.iterate_entries([], 0, 1, 2, 1, 101, 1 << 62)
+    assert ents == [] or ents[0].index > 50
+    ents, _ = db.iterate_entries([], 0, 1, 2, 51, 101, 1 << 62)
+    assert [e.index for e in ents] == list(range(51, 101))
+    db.close()
+
+
+def test_sharded_db_durable_reopen(tmp_path):
+    d = str(tmp_path / "logdb")
+    db = open_logdb(d, shards=2, fsync=False)
+    db.save_bootstrap_info(1, 2, Bootstrap(addresses={2: "a:1"}))
+    db.save_raft_state([make_update(cluster_id=1, node_id=2)])
+    db.save_snapshot(1, 2, Snapshot(index=5, term=1, cluster_id=1))
+    db.close()
+    db2 = open_logdb(d, shards=2, fsync=False)
+    assert db2.get_bootstrap_info(1, 2).addresses == {2: "a:1"}
+    rs = db2.read_raft_state(1, 2, 0)
+    assert rs.entry_count == 10
+    assert [s.index for s in db2.list_snapshots(1, 2)] == [5]
+    db2.close()
+
+
+# ---------- LogReader ----------
+
+
+def make_reader_with_entries(lo=1, hi=11):
+    db = open_logdb(shards=1)
+    db.save_raft_state([make_update(cluster_id=1, node_id=2, lo=lo, hi=hi)])
+    lr = LogReader(1, 2, db)
+    lr.append(make_entries(lo, hi))
+    return db, lr
+
+
+def test_logreader_range_term_entries():
+    db, lr = make_reader_with_entries()
+    assert lr.get_range() == (1, 10)
+    assert lr.term(5) == 1
+    assert lr.term(0) == 0  # marker
+    ents = lr.entries(3, 8, 1 << 62)
+    assert [e.index for e in ents] == [3, 4, 5, 6, 7]
+    with pytest.raises(UnavailableError):
+        lr.term(11)
+    db.close()
+
+
+def test_logreader_compact_moves_marker():
+    db, lr = make_reader_with_entries()
+    lr.compact(5)
+    assert lr.get_range() == (6, 10)
+    assert lr.term(5) == 1  # marker term retained
+    with pytest.raises(CompactedError):
+        lr.entries(4, 8, 1 << 62)
+    with pytest.raises(CompactedError):
+        lr.compact(3)
+    db.close()
+
+
+def test_logreader_apply_snapshot_resets_window():
+    db, lr = make_reader_with_entries()
+    ss = Snapshot(index=20, term=3, cluster_id=1)
+    lr.apply_snapshot(ss)
+    assert lr.get_range() == (21, 20)  # empty window
+    assert lr.term(20) == 3
+    assert lr.snapshot().index == 20
+    db.close()
+
+
+def test_logreader_load_from_storage():
+    db = open_logdb(shards=1)
+    db.save_raft_state([make_update(cluster_id=1, node_id=2, lo=1, hi=21)])
+    db.save_snapshot(
+        1, 2, Snapshot(index=5, term=1, cluster_id=1)
+    )
+    lr = LogReader.load(1, 2, db)
+    assert lr.snapshot().index == 5
+    assert lr.get_range() == (6, 20)
+    assert lr.state.commit == 20
+    db.close()
+
+
+def test_logreader_set_range_merging():
+    db = open_logdb(shards=1)
+    lr = LogReader(1, 2, db)
+    lr.set_range(1, 10)
+    assert lr.get_range() == (1, 10)
+    lr.set_range(5, 10)  # overlap
+    assert lr.get_range() == (1, 14)
+    lr.set_range(15, 5)  # contiguous
+    assert lr.get_range() == (1, 19)
+    with pytest.raises(RuntimeError):
+        lr.set_range(30, 5)  # gap
+    db.close()
