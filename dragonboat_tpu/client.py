@@ -1,0 +1,96 @@
+"""Client-side session state machine for exactly-once proposals.
+
+Reference: ``client/session.go:23-167`` — a session carries
+``(ClientID, SeriesID, RespondedTo)``; the RSM's session store dedups retried
+proposals by ``SeriesID`` and evicts cached responses up to ``RespondedTo``.
+NoOP sessions opt out of exactly-once semantics.
+"""
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+
+from .wire import (
+    NOOP_CLIENT_ID,
+    NOOP_SERIES_ID,
+    SERIES_ID_FIRST_PROPOSAL,
+    SERIES_ID_FOR_REGISTER,
+    SERIES_ID_FOR_UNREGISTER,
+)
+
+
+@dataclass
+class Session:
+    """Reference ``client/session.go:45`` ``Session``."""
+
+    cluster_id: int = 0
+    client_id: int = NOOP_CLIENT_ID
+    series_id: int = NOOP_SERIES_ID
+    responded_to: int = 0
+
+    # ---- constructors ----
+
+    @staticmethod
+    def new_session(cluster_id: int, rng=None) -> "Session":
+        cid = (rng() if rng is not None else secrets.randbits(64)) or 1
+        return Session(
+            cluster_id=cluster_id,
+            client_id=cid,
+            series_id=SERIES_ID_FOR_REGISTER,
+        )
+
+    @staticmethod
+    def noop_session(cluster_id: int) -> "Session":
+        return Session(
+            cluster_id=cluster_id,
+            client_id=NOOP_CLIENT_ID,
+            series_id=NOOP_SERIES_ID,
+        )
+
+    # ---- lifecycle (reference session.go:87-167) ----
+
+    def prepare_for_register(self) -> None:
+        self.series_id = SERIES_ID_FOR_REGISTER
+
+    def prepare_for_unregister(self) -> None:
+        self.series_id = SERIES_ID_FOR_UNREGISTER
+
+    def prepare_for_propose(self) -> None:
+        if self.series_id in (SERIES_ID_FOR_REGISTER, SERIES_ID_FOR_UNREGISTER):
+            self.series_id = SERIES_ID_FIRST_PROPOSAL
+
+    def proposal_completed(self) -> None:
+        """Must be called once a proposal's result is accepted; advances the
+        series and marks everything up to it as responded."""
+        if self.is_noop_session():
+            return
+        if self.series_id in (SERIES_ID_FOR_REGISTER, SERIES_ID_FOR_UNREGISTER):
+            raise RuntimeError(
+                "proposal_completed called on a register/unregister session"
+            )
+        self.responded_to = self.series_id
+        self.series_id += 1
+
+    # ---- predicates ----
+
+    def is_noop_session(self) -> bool:
+        return self.client_id == NOOP_CLIENT_ID
+
+    def validate_for_proposal(self, cluster_id: int) -> bool:
+        if self.cluster_id != cluster_id:
+            return False
+        if self.is_noop_session():
+            return self.series_id == NOOP_SERIES_ID
+        return self.series_id not in (
+            SERIES_ID_FOR_REGISTER,
+            SERIES_ID_FOR_UNREGISTER,
+        ) or self.series_id == SERIES_ID_FOR_REGISTER  # registration proposals
+        # travel through the same path
+
+    def validate_for_session_op(self, cluster_id: int) -> bool:
+        if self.cluster_id != cluster_id or self.is_noop_session():
+            return False
+        return self.series_id in (
+            SERIES_ID_FOR_REGISTER,
+            SERIES_ID_FOR_UNREGISTER,
+        )

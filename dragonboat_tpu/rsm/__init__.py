@@ -1,0 +1,16 @@
+"""Replicated state machine layer (reference ``internal/rsm/``).
+
+Adapts the three public SM contracts to one managed interface, drives apply
+batches with exactly-once client sessions, tracks applied membership, and
+owns the versioned snapshot file format.
+"""
+from .adapters import (  # noqa: F401
+    IManagedStateMachine,
+    from_concurrent_sm,
+    from_on_disk_sm,
+    from_regular_sm,
+)
+from .membership import MembershipState  # noqa: F401
+from .session import SessionManager  # noqa: F401
+from .statemachine import StateMachine, SSMeta, SSRequest, SSReqType, Task  # noqa: F401
+from .taskqueue import TaskQueue  # noqa: F401

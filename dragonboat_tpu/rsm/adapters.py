@@ -1,0 +1,189 @@
+"""Uniform managed interface over the three public SM contracts.
+
+Reference: ``internal/rsm/sm.go:27-386`` (adapter structs) and
+``internal/rsm/native.go:55`` (``IManagedStateMachine``).  Each adapter
+normalizes its contract to batch update + snapshot hooks so the
+:class:`dragonboat_tpu.rsm.statemachine.StateMachine` manager never branches
+on the user SM kind except where semantics genuinely differ (concurrent
+snapshotting, on-disk open/sync).
+"""
+from __future__ import annotations
+
+import abc
+from typing import BinaryIO, List, Optional
+
+from ..statemachine import (
+    IConcurrentStateMachine,
+    IOnDiskStateMachine,
+    IStateMachine,
+    Result,
+    SMEntry,
+    SnapshotFile,
+    SnapshotFileCollection,
+    StopChecker,
+)
+from ..wire import StateMachineType
+
+
+class IManagedStateMachine(abc.ABC):
+    """Reference ``native.go:55``."""
+
+    sm_type: StateMachineType = StateMachineType.REGULAR
+
+    @property
+    def concurrent_snapshot(self) -> bool:
+        return False
+
+    @property
+    def on_disk(self) -> bool:
+        return False
+
+    def open(self, stopc: StopChecker) -> int:
+        """On-disk SMs return their last applied index; others 0."""
+        return 0
+
+    @abc.abstractmethod
+    def update(self, entries: List[SMEntry]) -> List[SMEntry]: ...
+
+    @abc.abstractmethod
+    def lookup(self, query: object) -> object: ...
+
+    def sync(self) -> None:
+        pass
+
+    def prepare_snapshot(self) -> object:
+        return None
+
+    @abc.abstractmethod
+    def save_snapshot(
+        self,
+        ctx: object,
+        w: BinaryIO,
+        files: Optional[SnapshotFileCollection],
+        stopc: StopChecker,
+    ) -> None: ...
+
+    @abc.abstractmethod
+    def recover_from_snapshot(
+        self, r: BinaryIO, files: List[SnapshotFile], stopc: StopChecker
+    ) -> None: ...
+
+    def close(self) -> None:
+        pass
+
+
+class RegularSM(IManagedStateMachine):
+    """Reference ``sm.go`` ``RegularStateMachine``."""
+
+    sm_type = StateMachineType.REGULAR
+
+    def __init__(self, sm: IStateMachine):
+        self.sm = sm
+
+    def update(self, entries: List[SMEntry]) -> List[SMEntry]:
+        for e in entries:
+            e.result = self.sm.update(e.cmd) or Result()
+        return entries
+
+    def lookup(self, query: object) -> object:
+        return self.sm.lookup(query)
+
+    def save_snapshot(self, ctx, w, files, stopc) -> None:
+        self.sm.save_snapshot(w, files, stopc)
+
+    def recover_from_snapshot(self, r, files, stopc) -> None:
+        self.sm.recover_from_snapshot(r, files, stopc)
+
+    def close(self) -> None:
+        self.sm.close()
+
+
+class ConcurrentSM(IManagedStateMachine):
+    """Reference ``sm.go`` ``ConcurrentStateMachine``."""
+
+    sm_type = StateMachineType.CONCURRENT
+
+    def __init__(self, sm: IConcurrentStateMachine):
+        self.sm = sm
+
+    @property
+    def concurrent_snapshot(self) -> bool:
+        return True
+
+    def update(self, entries: List[SMEntry]) -> List[SMEntry]:
+        return self.sm.update(entries)
+
+    def lookup(self, query: object) -> object:
+        return self.sm.lookup(query)
+
+    def prepare_snapshot(self) -> object:
+        return self.sm.prepare_snapshot()
+
+    def save_snapshot(self, ctx, w, files, stopc) -> None:
+        self.sm.save_snapshot(ctx, w, files, stopc)
+
+    def recover_from_snapshot(self, r, files, stopc) -> None:
+        self.sm.recover_from_snapshot(r, files, stopc)
+
+    def close(self) -> None:
+        self.sm.close()
+
+
+class OnDiskSM(IManagedStateMachine):
+    """Reference ``sm.go`` ``OnDiskStateMachine``."""
+
+    sm_type = StateMachineType.ON_DISK
+
+    def __init__(self, sm: IOnDiskStateMachine):
+        self.sm = sm
+        self._opened = False
+
+    @property
+    def concurrent_snapshot(self) -> bool:
+        return True
+
+    @property
+    def on_disk(self) -> bool:
+        return True
+
+    def open(self, stopc: StopChecker) -> int:
+        idx = self.sm.open(stopc)
+        self._opened = True
+        return idx
+
+    def update(self, entries: List[SMEntry]) -> List[SMEntry]:
+        if not self._opened:
+            raise RuntimeError("update called before open")
+        return self.sm.update(entries)
+
+    def lookup(self, query: object) -> object:
+        return self.sm.lookup(query)
+
+    def sync(self) -> None:
+        self.sm.sync()
+
+    def prepare_snapshot(self) -> object:
+        return self.sm.prepare_snapshot()
+
+    def save_snapshot(self, ctx, w, files, stopc) -> None:
+        # on-disk snapshots carry no external file collection: state streams
+        # directly from the SM's own store (reference statemachine/disk.go)
+        self.sm.save_snapshot(ctx, w, stopc)
+
+    def recover_from_snapshot(self, r, files, stopc) -> None:
+        self.sm.recover_from_snapshot(r, stopc)
+
+    def close(self) -> None:
+        self.sm.close()
+
+
+def from_regular_sm(sm: IStateMachine) -> IManagedStateMachine:
+    return RegularSM(sm)
+
+
+def from_concurrent_sm(sm: IConcurrentStateMachine) -> IManagedStateMachine:
+    return ConcurrentSM(sm)
+
+
+def from_on_disk_sm(sm: IOnDiskStateMachine) -> IManagedStateMachine:
+    return OnDiskSM(sm)

@@ -1,0 +1,162 @@
+"""Applied membership state.
+
+Reference: ``internal/rsm/membership.go:56`` — the authoritative view of
+addresses / observers / witnesses / removed ids plus the ConfigChangeId used
+for ordered-config-change enforcement and add/remove dedup.
+"""
+from __future__ import annotations
+
+import zlib
+from typing import Optional
+
+from ..config import Config
+from ..logger import get_logger
+from ..wire import ConfigChange, ConfigChangeType, Membership
+from ..wire.codec import encode_membership
+
+plog = get_logger("rsm")
+
+CCT = ConfigChangeType
+
+
+class MembershipState:
+    """Reference ``membership.go`` ``membership``."""
+
+    def __init__(self, cluster_id: int, node_id: int, ordered: bool):
+        self.cluster_id = cluster_id
+        self.node_id = node_id
+        self.ordered = ordered
+        self.members = Membership()
+
+    # ---- snapshot plumbing ----
+
+    def set(self, m: Membership) -> None:
+        self.members = m.clone()
+
+    def get(self) -> Membership:
+        return self.members.clone()
+
+    def hash(self) -> int:
+        return zlib.crc32(encode_membership(self.members))
+
+    # ---- application (reference membership.go:131-292) ----
+
+    def is_empty(self) -> bool:
+        return len(self.members.addresses) == 0
+
+    def is_config_change_up_to_date(self, cc: ConfigChange) -> bool:
+        if not self.ordered or cc.initialize:
+            return True
+        return self.members.config_change_id == cc.config_change_id
+
+    def is_adding_removed_node(self, cc: ConfigChange) -> bool:
+        if cc.type in (CCT.ADD_NODE, CCT.ADD_OBSERVER, CCT.ADD_WITNESS):
+            return cc.node_id in self.members.removed
+        return False
+
+    def is_promoting_observer(self, cc: ConfigChange) -> bool:
+        if cc.type != CCT.ADD_NODE:
+            return False
+        addr = self.members.observers.get(cc.node_id)
+        return addr is not None and addr == cc.address
+
+    def is_invalid_observer_promotion(self, cc: ConfigChange) -> bool:
+        if cc.type != CCT.ADD_NODE:
+            return False
+        addr = self.members.observers.get(cc.node_id)
+        return addr is not None and addr != cc.address
+
+    def is_adding_existing_member(self, cc: ConfigChange) -> bool:
+        # adding again with a different address is the dangerous case
+        if cc.type == CCT.ADD_NODE:
+            if self.is_promoting_observer(cc):
+                return False
+            if cc.node_id in self.members.addresses:
+                return self.members.addresses[cc.node_id] != cc.address
+            return cc.address in self.members.addresses.values()
+        if cc.type == CCT.ADD_OBSERVER:
+            if cc.node_id in self.members.observers:
+                return self.members.observers[cc.node_id] != cc.address
+            return (
+                cc.address in self.members.addresses.values()
+                or cc.address in self.members.observers.values()
+            )
+        if cc.type == CCT.ADD_WITNESS:
+            if cc.node_id in self.members.witnesses:
+                return True
+            return cc.address in self.members.addresses.values()
+        return False
+
+    def is_adding_node_as_observer(self, cc: ConfigChange) -> bool:
+        return cc.type == CCT.ADD_OBSERVER and cc.node_id in self.members.addresses
+
+    def is_adding_node_as_witness(self, cc: ConfigChange) -> bool:
+        return cc.type == CCT.ADD_WITNESS and (
+            cc.node_id in self.members.addresses
+            or cc.node_id in self.members.observers
+        )
+
+    def is_deleting_only_node(self, cc: ConfigChange) -> bool:
+        return (
+            cc.type == CCT.REMOVE_NODE
+            and len(self.members.addresses) == 1
+            and cc.node_id in self.members.addresses
+        )
+
+    def handle_config_change(self, cc: ConfigChange, index: int) -> bool:
+        """Validate + apply; returns True when accepted
+        (reference ``membership.go`` ``handleConfigChange``)."""
+        accepted = (
+            self.is_config_change_up_to_date(cc)
+            and not self.is_adding_removed_node(cc)
+            and not self.is_adding_existing_member(cc)
+            and not self.is_invalid_observer_promotion(cc)
+            and not self.is_adding_node_as_observer(cc)
+            and not self.is_adding_node_as_witness(cc)
+            and not self.is_deleting_only_node(cc)
+        )
+        if not accepted:
+            plog.warning(
+                "cluster %d rejected config change %s at index %d",
+                self.cluster_id,
+                cc,
+                index,
+            )
+            return False
+        self._apply(cc, index)
+        return True
+
+    def _apply(self, cc: ConfigChange, index: int) -> None:
+        self.members.config_change_id = index
+        if cc.type == CCT.ADD_NODE:
+            self.members.observers.pop(cc.node_id, None)
+            if cc.node_id in self.members.witnesses:
+                raise RuntimeError("promoting a witness is not allowed")
+            self.members.addresses[cc.node_id] = cc.address
+        elif cc.type == CCT.ADD_OBSERVER:
+            self.members.observers[cc.node_id] = cc.address
+        elif cc.type == CCT.ADD_WITNESS:
+            self.members.witnesses[cc.node_id] = cc.address
+        elif cc.type == CCT.REMOVE_NODE:
+            self.members.addresses.pop(cc.node_id, None)
+            self.members.observers.pop(cc.node_id, None)
+            self.members.witnesses.pop(cc.node_id, None)
+            self.members.removed[cc.node_id] = True
+        else:
+            raise RuntimeError(f"unknown config change type {cc.type}")
+
+    # ---- queries ----
+
+    def local_node_removed(self) -> bool:
+        # only an applied RemoveNode counts: a joining node legitimately has
+        # no membership entry until its AddNode commits
+        return self.node_id in self.members.removed
+
+    @staticmethod
+    def bootstrap(
+        cluster_id: int, node_id: int, config: Config, addresses
+    ) -> "MembershipState":
+        m = MembershipState(cluster_id, node_id, config.ordered_config_change)
+        for nid, addr in addresses.items():
+            m.members.addresses[nid] = addr
+        return m

@@ -1,0 +1,150 @@
+"""Server-side client session store: exactly-once apply semantics.
+
+Reference: ``internal/rsm/session.go`` (per-session response cache keyed by
+SeriesID), ``internal/rsm/lrusession.go`` (LRU over sessions, max 4096 =
+``settings/hard.go:85``) and ``internal/rsm/sessionmanager.go``.  The whole
+store serializes into every snapshot so all replicas evict identically.
+"""
+from __future__ import annotations
+
+import zlib
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+from ..settings import Hard
+from ..statemachine import Result
+from ..wire.codec import _read_bytes, _read_uvarint, _write_bytes, _write_uvarint
+
+
+class Session:
+    """Reference ``internal/rsm/session.go:49``."""
+
+    __slots__ = ("client_id", "responded_up_to", "history")
+
+    def __init__(self, client_id: int):
+        self.client_id = client_id
+        self.responded_up_to = 0
+        self.history: Dict[int, Result] = {}
+
+    def add_response(self, series_id: int, result: Result) -> None:
+        if series_id in self.history:
+            raise RuntimeError("adding a duplicated response")
+        self.history[series_id] = result
+
+    def get_response(self, series_id: int) -> Tuple[Optional[Result], bool]:
+        r = self.history.get(series_id)
+        return r, r is not None
+
+    def has_responded(self, series_id: int) -> bool:
+        return series_id <= self.responded_up_to
+
+    def clear_to(self, series_id: int) -> None:
+        """Evict cached responses up to ``series_id`` inclusive (reference
+        ``session.go`` ``clearTo``)."""
+        if series_id <= self.responded_up_to:
+            return
+        if series_id == self.responded_up_to + 1:
+            self.history.pop(series_id, None)
+        else:
+            for k in [k for k in self.history if k <= series_id]:
+                del self.history[k]
+        self.responded_up_to = series_id
+
+    # deterministic serialization (order by series id)
+    def save(self, buf: bytearray) -> None:
+        _write_uvarint(buf, self.client_id)
+        _write_uvarint(buf, self.responded_up_to)
+        _write_uvarint(buf, len(self.history))
+        for sid in sorted(self.history):
+            r = self.history[sid]
+            _write_uvarint(buf, sid)
+            _write_uvarint(buf, r.value)
+            _write_bytes(buf, r.data)
+
+    @staticmethod
+    def load(data: bytes, pos: int) -> Tuple["Session", int]:
+        cid, pos = _read_uvarint(data, pos)
+        s = Session(cid)
+        s.responded_up_to, pos = _read_uvarint(data, pos)
+        n, pos = _read_uvarint(data, pos)
+        for _ in range(n):
+            sid, pos = _read_uvarint(data, pos)
+            val, pos = _read_uvarint(data, pos)
+            d, pos = _read_bytes(data, pos)
+            s.history[sid] = Result(value=val, data=d)
+        return s, pos
+
+
+class SessionManager:
+    """LRU session store (reference ``lrusession.go:54`` +
+    ``sessionmanager.go:27-135``)."""
+
+    def __init__(self, max_sessions: int = 0):
+        self._max = max_sessions or Hard.lru_max_session_count
+        self._sessions: "OrderedDict[int, Session]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._sessions)
+
+    # ---- registration (reference sessionmanager.go:49-88) ----
+
+    def register_client_id(self, client_id: int) -> Result:
+        if client_id in self._sessions:
+            self._sessions.move_to_end(client_id)
+            return Result(value=client_id)
+        self._sessions[client_id] = Session(client_id)
+        if len(self._sessions) > self._max:
+            self._sessions.popitem(last=False)  # evict LRU
+        return Result(value=client_id)
+
+    def unregister_client_id(self, client_id: int) -> Result:
+        if client_id not in self._sessions:
+            return Result(value=0)
+        del self._sessions[client_id]
+        return Result(value=client_id)
+
+    def client_registered(self, client_id: int) -> Optional[Session]:
+        s = self._sessions.get(client_id)
+        if s is not None:
+            self._sessions.move_to_end(client_id)
+        return s
+
+    # ---- dedup (reference sessionmanager.go:90-135) ----
+
+    def update_required(
+        self, session: Session, series_id: int
+    ) -> Tuple[Optional[Result], bool]:
+        """Returns ``(cached_result, update_required)``."""
+        if session.has_responded(series_id):
+            return None, False  # already responded; result no longer cached
+        cached, ok = session.get_response(series_id)
+        if ok:
+            return cached, False
+        return None, True
+
+    def add_response(self, session: Session, series_id: int, result: Result):
+        session.add_response(series_id, result)
+
+    # ---- snapshot serialization ----
+
+    def save(self) -> bytes:
+        buf = bytearray()
+        _write_uvarint(buf, len(self._sessions))
+        # LRU order must be preserved so evictions replay identically
+        for s in self._sessions.values():
+            s.save(buf)
+        return bytes(buf)
+
+    @staticmethod
+    def load(data: bytes, max_sessions: int = 0) -> "SessionManager":
+        sm = SessionManager(max_sessions)
+        n, pos = _read_uvarint(data, 0)
+        for _ in range(n):
+            s, pos = Session.load(data, pos)
+            sm._sessions[s.client_id] = s
+        return sm
+
+    def hash(self) -> int:
+        """Cross-replica consistency hash (reference ``monkey.go`` session
+        hash via ``GetSessionHash``)."""
+        return zlib.crc32(self.save())

@@ -1,0 +1,261 @@
+"""Versioned snapshot file IO: header + crc-checked block payload.
+
+Reference: ``internal/rsm/snapshotio.go`` (SnapshotWriter/Reader/Validator,
+witness image, shrink) and ``internal/rsm/rw.go`` (v2 block writer with
+per-block crc32).  Layout here:
+
+    [1KB header][block]*[tail crc]
+    header: magic(8) version(4) checksum_type(4) compression_type(4)
+            session_size(8) payload_checksum(4) reserved... header_crc(4 @1020)
+    block:  len(u32) crc32(u32) data[len]      (1MB data per block)
+
+``session_size`` lets recovery split the payload into the session store image
+and the user SM image without framing inside the payload.  Shrinking keeps
+the header and replaces the payload with an empty image (reference
+``snapshotio.go:443-516``), used by on-disk SMs whose state needs no replay.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import BinaryIO, List, Tuple
+
+from ..settings import Hard
+from ..wire import Snapshot, SnapshotFile
+
+MAGIC = b"DBTPUSS1"
+V2 = 2
+BLOCK_SIZE = 1024 * 1024
+_HEADER_FMT = struct.Struct("<8sIIIQI")  # magic, ver, cks, comp, session, payload_crc
+_BLOCK_HDR = struct.Struct("<II")
+_HEADER_CRC_OFF = 1020
+
+EMPTY_PAYLOAD_CRC = 0
+
+
+class SnapshotFormatError(ValueError):
+    pass
+
+
+class BlockWriter:
+    """Buffers payload into crc'd blocks (reference ``rw.go:89-205``)."""
+
+    def __init__(self, f: BinaryIO):
+        self._f = f
+        self._buf = bytearray()
+        self._crc = 0  # running crc over block crcs
+        self.total = 0
+
+    def write(self, data: bytes) -> int:
+        self._buf += data
+        self.total += len(data)
+        while len(self._buf) >= BLOCK_SIZE:
+            self._flush_block(self._buf[:BLOCK_SIZE])
+            del self._buf[:BLOCK_SIZE]
+        return len(data)
+
+    def _flush_block(self, block) -> None:
+        crc = zlib.crc32(bytes(block))
+        self._f.write(_BLOCK_HDR.pack(len(block), crc))
+        self._f.write(bytes(block))
+        self._crc = zlib.crc32(crc.to_bytes(4, "little"), self._crc)
+
+    def flush(self) -> int:
+        """Flush the final partial block; returns the payload checksum."""
+        if self._buf:
+            self._flush_block(self._buf)
+            self._buf.clear()
+        return self._crc
+
+
+class BlockReader:
+    """Streaming reader over crc'd blocks."""
+
+    def __init__(self, f: BinaryIO):
+        self._f = f
+        self._pending = bytearray()
+        self._crc = 0
+        self._eof = False
+
+    def _next_block(self) -> bool:
+        hdr = self._f.read(_BLOCK_HDR.size)
+        if len(hdr) < _BLOCK_HDR.size:
+            self._eof = True
+            return False
+        ln, crc = _BLOCK_HDR.unpack(hdr)
+        data = self._f.read(ln)
+        if len(data) != ln or zlib.crc32(data) != crc:
+            raise SnapshotFormatError("corrupted snapshot block")
+        self._crc = zlib.crc32(crc.to_bytes(4, "little"), self._crc)
+        self._pending += data
+        return True
+
+    def read(self, n: int = -1) -> bytes:
+        while not self._eof and (n < 0 or len(self._pending) < n):
+            self._next_block()
+        if n < 0:
+            out, self._pending = bytes(self._pending), bytearray()
+        else:
+            out = bytes(self._pending[:n])
+            del self._pending[:n]
+        return out
+
+    def checksum(self) -> int:
+        return self._crc
+
+
+class SnapshotWriter:
+    """Reference ``snapshotio.go:163`` ``SnapshotWriter``."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "wb")
+        self._f.write(b"\0" * Hard.snapshot_header_size)  # placeholder
+        self._bw = BlockWriter(self._f)
+        self.session_size = 0
+        self._closed = False
+
+    def write_session(self, data: bytes) -> None:
+        self.session_size = len(data)
+        self._bw.write(data)
+
+    def write(self, data: bytes) -> int:
+        return self._bw.write(data)
+
+    def finalize(self) -> None:
+        payload_crc = self._bw.flush()
+        header = bytearray(Hard.snapshot_header_size)
+        _HEADER_FMT.pack_into(
+            header, 0, MAGIC, V2, 0, 0, self.session_size, payload_crc
+        )
+        hcrc = zlib.crc32(bytes(header[:_HEADER_CRC_OFF]))
+        struct.pack_into("<I", header, _HEADER_CRC_OFF, hcrc)
+        self._f.flush()
+        self._f.seek(0)
+        self._f.write(bytes(header))
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        self._closed = True
+
+    def abort(self) -> None:
+        if not self._closed:
+            self._f.close()
+            os.unlink(self.path)
+            self._closed = True
+
+
+def read_header(f: BinaryIO) -> Tuple[int, int, int]:
+    """Returns (session_size, payload_crc, version); validates header crc."""
+    header = f.read(Hard.snapshot_header_size)
+    if len(header) != Hard.snapshot_header_size:
+        raise SnapshotFormatError("truncated snapshot header")
+    magic, ver, _cks, _comp, session_size, payload_crc = _HEADER_FMT.unpack_from(
+        header, 0
+    )
+    if magic != MAGIC:
+        raise SnapshotFormatError("bad snapshot magic")
+    if ver != V2:
+        raise SnapshotFormatError(f"unsupported snapshot version {ver}")
+    (hcrc,) = struct.unpack_from("<I", header, _HEADER_CRC_OFF)
+    if zlib.crc32(header[:_HEADER_CRC_OFF]) != hcrc:
+        raise SnapshotFormatError("corrupted snapshot header")
+    return session_size, payload_crc, ver
+
+
+class SnapshotReader:
+    """Reference ``snapshotio.go:272`` ``SnapshotReader``."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "rb")
+        self.session_size, self.payload_crc, self.version = read_header(self._f)
+        self._br = BlockReader(self._f)
+
+    def read_session(self) -> bytes:
+        return self._br.read(self.session_size)
+
+    def read(self, n: int = -1) -> bytes:
+        return self._br.read(n)
+
+    def validate_payload(self) -> None:
+        self._br.read(-1)  # drain
+        if self._br.checksum() != self.payload_crc:
+            raise SnapshotFormatError("snapshot payload checksum mismatch")
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def validate_snapshot_file(path: str) -> bool:
+    """Reference ``snapshotio.go:392`` ``SnapshotValidator``."""
+    try:
+        r = SnapshotReader(path)
+        try:
+            r.validate_payload()
+        finally:
+            r.close()
+        return True
+    except (OSError, SnapshotFormatError):
+        return False
+
+
+def shrink_snapshot(src: str, dst: str) -> None:
+    """Strip the payload, keep sessions-empty image (reference
+    ``snapshotio.go:443-516`` ``ShrinkSnapshot``): used when an on-disk SM
+    restarts — its state needs no replay, only valid metadata."""
+    r = SnapshotReader(src)
+    try:
+        r.validate_payload()
+    finally:
+        r.close()
+    w = SnapshotWriter(dst)
+    w.write_session(b"")
+    w.finalize()
+
+
+def write_witness_snapshot(path: str) -> None:
+    """Tiny dummy image for witness replicas (reference
+    ``snapshotio.go:133``)."""
+    w = SnapshotWriter(path)
+    w.write_session(b"")
+    w.finalize()
+
+
+class FileCollection:
+    """External snapshot file collection (reference ``internal/rsm/files.go``
+    implementing ``sm.ISnapshotFileCollection``)."""
+
+    def __init__(self, tmpdir: str):
+        self.tmpdir = tmpdir
+        self.files: List[SnapshotFile] = []
+        self._ids = set()
+
+    def add_file(self, file_id: int, path: str, metadata: bytes) -> None:
+        if file_id in self._ids:
+            raise ValueError(f"duplicated external file id {file_id}")
+        self._ids.add(file_id)
+        self.files.append(
+            SnapshotFile(file_id=file_id, filepath=path, metadata=metadata)
+        )
+
+    def prepare_files(self, ss: Snapshot) -> None:
+        """Record collected files into the snapshot metadata with their
+        final names (reference ``files.go`` ``PrepareFiles``)."""
+        for f in self.files:
+            final = os.path.join(
+                os.path.dirname(ss.filepath) or self.tmpdir,
+                f"external-file-{f.file_id}",
+            )
+            if os.path.exists(f.filepath):
+                os.replace(f.filepath, final)
+            size = os.path.getsize(final) if os.path.exists(final) else 0
+            ss.files.append(
+                SnapshotFile(
+                    filepath=final,
+                    file_size=size,
+                    file_id=f.file_id,
+                    metadata=f.metadata,
+                )
+            )

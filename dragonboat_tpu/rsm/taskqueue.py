@@ -1,0 +1,41 @@
+"""Apply task queue between step and apply workers.
+
+Reference: ``internal/rsm/taskqueue.go:31-107`` — a mutex-protected slice
+queue with a "busy" watermark (target length 1024, ``settings/soft.go:94``)
+used for backpressure, drained in batches by the apply worker.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from ..settings import Soft
+
+
+class TaskQueue:
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._tasks: List = []
+
+    def enqueue(self, task) -> None:
+        with self._mu:
+            self._tasks.append(task)
+
+    def get(self) -> Optional[object]:
+        with self._mu:
+            if not self._tasks:
+                return None
+            return self._tasks.pop(0)
+
+    def get_all(self) -> List:
+        with self._mu:
+            tasks, self._tasks = self._tasks, []
+            return tasks
+
+    def size(self) -> int:
+        with self._mu:
+            return len(self._tasks)
+
+    def more_entries_to_apply(self) -> bool:
+        """Backpressure check (reference ``taskqueue.go`` ``MoreEntryToApply``)."""
+        return self.size() < Soft.task_queue_target_length

@@ -1,0 +1,187 @@
+"""Public user state machine interfaces.
+
+Reference: ``statemachine/rsm.go`` (``IStateMachine``),
+``statemachine/concurrent.go`` (``IConcurrentStateMachine``) and
+``statemachine/disk.go:59`` (``IOnDiskStateMachine``).  Applications implement
+one of the three contracts; the RSM layer adapts them to a uniform managed
+interface (:mod:`dragonboat_tpu.rsm.adapters`).
+"""
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import BinaryIO, List, Optional, Tuple
+
+
+@dataclass(slots=True)
+class Result:
+    """Outcome of an update (reference ``statemachine/rsm.go`` ``Result``)."""
+
+    value: int = 0
+    data: bytes = b""
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, Result)
+            and self.value == other.value
+            and self.data == other.data
+        )
+
+
+@dataclass(slots=True)
+class SMEntry:
+    """An entry handed to the state machine (reference ``statemachine/rsm.go``
+    ``Entry``)."""
+
+    index: int = 0
+    cmd: bytes = b""
+    result: Result = field(default_factory=Result)
+
+
+class SnapshotFileCollection(abc.ABC):
+    """Collects external files into a snapshot (reference
+    ``statemachine/rsm.go`` ``ISnapshotFileCollection``)."""
+
+    @abc.abstractmethod
+    def add_file(self, file_id: int, path: str, metadata: bytes) -> None: ...
+
+
+@dataclass(slots=True)
+class SnapshotFile:
+    """An external file restored with a snapshot (reference
+    ``statemachine/rsm.go`` ``SnapshotFile``)."""
+
+    file_id: int = 0
+    filepath: str = ""
+    metadata: bytes = b""
+
+
+class SnapshotStopped(Exception):
+    """Raised by SM snapshot ops when the node is being stopped
+    (reference ``statemachine/rsm.go`` ``ErrSnapshotStopped``)."""
+
+
+class SnapshotAborted(Exception):
+    """Raised by user SMs to abort a snapshot operation."""
+
+
+class IStateMachine(abc.ABC):
+    """The regular (in-memory, serialized-access) SM
+    (reference ``statemachine/rsm.go:184``)."""
+
+    @abc.abstractmethod
+    def update(self, cmd: bytes) -> Result: ...
+
+    @abc.abstractmethod
+    def lookup(self, query: object) -> object: ...
+
+    @abc.abstractmethod
+    def save_snapshot(
+        self,
+        w: BinaryIO,
+        files: SnapshotFileCollection,
+        done: "StopChecker",
+    ) -> None: ...
+
+    @abc.abstractmethod
+    def recover_from_snapshot(
+        self,
+        r: BinaryIO,
+        files: List[SnapshotFile],
+        done: "StopChecker",
+    ) -> None: ...
+
+    def close(self) -> None:
+        pass
+
+
+class IConcurrentStateMachine(abc.ABC):
+    """Concurrent-snapshot SM (reference ``statemachine/concurrent.go``):
+    update batches are serialized, but snapshotting runs concurrently with
+    updates using the state captured by ``prepare_snapshot``."""
+
+    @abc.abstractmethod
+    def update(self, entries: List[SMEntry]) -> List[SMEntry]: ...
+
+    @abc.abstractmethod
+    def lookup(self, query: object) -> object: ...
+
+    @abc.abstractmethod
+    def prepare_snapshot(self) -> object: ...
+
+    @abc.abstractmethod
+    def save_snapshot(
+        self,
+        ctx: object,
+        w: BinaryIO,
+        files: SnapshotFileCollection,
+        done: "StopChecker",
+    ) -> None: ...
+
+    @abc.abstractmethod
+    def recover_from_snapshot(
+        self,
+        r: BinaryIO,
+        files: List[SnapshotFile],
+        done: "StopChecker",
+    ) -> None: ...
+
+    def close(self) -> None:
+        pass
+
+
+class IOnDiskStateMachine(abc.ABC):
+    """On-disk SM (reference ``statemachine/disk.go:59``): state lives in the
+    SM's own durable store; raft log replay resumes from ``open()``'s index
+    and snapshots stream state directly between replicas."""
+
+    @abc.abstractmethod
+    def open(self, stopc) -> int:
+        """Open existing state; returns the index of the last applied entry."""
+
+    @abc.abstractmethod
+    def update(self, entries: List[SMEntry]) -> List[SMEntry]: ...
+
+    @abc.abstractmethod
+    def lookup(self, query: object) -> object: ...
+
+    @abc.abstractmethod
+    def sync(self) -> None: ...
+
+    @abc.abstractmethod
+    def prepare_snapshot(self) -> object: ...
+
+    @abc.abstractmethod
+    def save_snapshot(self, ctx: object, w: BinaryIO, done: "StopChecker") -> None: ...
+
+    @abc.abstractmethod
+    def recover_from_snapshot(self, r: BinaryIO, done: "StopChecker") -> None: ...
+
+    def close(self) -> None:
+        pass
+
+
+class StopChecker:
+    """Polled cancellation flag passed to snapshot operations (plays the role
+    of the reference's ``<-chan struct{}``)."""
+
+    __slots__ = ("_stopped",)
+
+    def __init__(self) -> None:
+        self._stopped = False
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    def __bool__(self) -> bool:
+        return self._stopped
+
+    def check(self) -> None:
+        if self._stopped:
+            raise SnapshotStopped()
+
+
+# factory signatures (reference nodehost.go StartCluster's factory args)
+CreateStateMachineFunc = "Callable[[int, int], IStateMachine]"
+CreateConcurrentStateMachineFunc = "Callable[[int, int], IConcurrentStateMachine]"
+CreateOnDiskStateMachineFunc = "Callable[[int, int], IOnDiskStateMachine]"
