@@ -1,0 +1,4 @@
+"""Cross-cutting host runtime utilities (reference ``internal/server/``)."""
+from .message import MessageQueue  # noqa: F401
+from .partition import FixedPartitioner  # noqa: F401
+from .snapshotenv import SSEnv, SSMode  # noqa: F401

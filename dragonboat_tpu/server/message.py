@@ -1,0 +1,71 @@
+"""Inbound raft message queue.
+
+Reference: ``internal/server/message.go:24-172`` — a double-buffered queue
+with a byte-size rate limit; snapshot messages use the ``MustAdd`` lane so a
+full queue never drops an InstallSnapshot.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List
+
+from ..wire import Message, MessageType
+
+
+class MessageQueue:
+    def __init__(self, size: int, ch: bool = False, lazy_free_cycle: int = 0,
+                 max_bytes: int = 0):
+        self.size = size
+        self.max_bytes = max_bytes
+        self._mu = threading.Lock()
+        self._left: List[Message] = []
+        self._right: List[Message] = []
+        self._use_left = True
+        self._bytes = 0
+        self._stopped = False
+        del ch, lazy_free_cycle  # reference-compat args; unused host-side
+
+    def _active(self) -> List[Message]:
+        return self._left if self._use_left else self._right
+
+    def add(self, m: Message) -> bool:
+        with self._mu:
+            if self._stopped:
+                return False
+            q = self._active()
+            if len(q) >= self.size:
+                return False
+            if self.max_bytes:
+                sz = sum(len(e.cmd) for e in m.entries)
+                if self._bytes + sz > self.max_bytes:
+                    return False
+                self._bytes += sz
+            q.append(m)
+            return True
+
+    def must_add(self, m: Message) -> bool:
+        """Snapshot lane: never rejected by size limits (reference
+        ``MustAdd``)."""
+        with self._mu:
+            if self._stopped:
+                return False
+            self._active().append(m)
+            return True
+
+    def get(self) -> List[Message]:
+        """Swap buffers and return everything queued."""
+        with self._mu:
+            q = self._active()
+            self._use_left = not self._use_left
+            out = list(q)
+            q.clear()
+            self._bytes = 0
+            return out
+
+    def close(self) -> None:
+        with self._mu:
+            self._stopped = True
+
+
+def is_snapshot_message(m: Message) -> bool:
+    return m.type == MessageType.INSTALL_SNAPSHOT

@@ -1,0 +1,23 @@
+"""Group→worker partitioners (reference ``internal/server/partition.go``)."""
+from __future__ import annotations
+
+
+class FixedPartitioner:
+    """``clusterID % capacity`` (reference ``partition.go:22-45``)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+
+    def get_partition_id(self, cluster_id: int) -> int:
+        return cluster_id % self.capacity
+
+
+class DoubleFixedPartitioner:
+    """Reference ``partition.go:47-61``: stable under two capacities."""
+
+    def __init__(self, capacity: int, workers: int):
+        self.capacity = capacity
+        self.workers = workers
+
+    def get_partition_id(self, cluster_id: int) -> int:
+        return (cluster_id % self.capacity) % self.workers

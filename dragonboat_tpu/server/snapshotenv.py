@@ -1,0 +1,151 @@
+"""Snapshot directory lifecycle.
+
+Reference: ``internal/server/snapshotenv.go:116`` — every snapshot is built
+in a mode-suffixed temp dir (``.generating`` for local saves,
+``.receiving`` for streamed ones), fsync'd, then atomically renamed to the
+final ``snapshot-{index:016X}`` dir containing a flag file with the snapshot
+metadata.  Orphan/zombie dirs left by crashes are recognized by these
+suffixes and garbage collected by the snapshotter.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import re
+from typing import Optional
+
+from ..wire import Snapshot
+from ..wire.codec import decode_snapshot, encode_snapshot
+
+GENERATING_SUFFIX = "generating"
+RECEIVING_SUFFIX = "receiving"
+SNAPSHOT_FLAG_FILE = "snapshot.message"
+SNAPSHOT_DIR_RE = re.compile(r"^snapshot-([0-9A-F]{16})$")
+TEMP_DIR_RE = re.compile(
+    r"^snapshot-[0-9A-F]{16}(-[0-9A-F]+)?\.(generating|receiving)$"
+)
+
+
+class SSMode(enum.Enum):
+    SNAPSHOT = GENERATING_SUFFIX  # created by the local SM save path
+    RECEIVING = RECEIVING_SUFFIX  # streamed in from a remote replica
+
+
+def snapshot_dir_name(index: int) -> str:
+    return f"snapshot-{index:016X}"
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class SSEnv:
+    """Reference ``snapshotenv.go`` ``SSEnv``."""
+
+    def __init__(
+        self,
+        root_dir: str,
+        index: int,
+        from_node_id: int,
+        mode: SSMode,
+    ):
+        self.root_dir = root_dir
+        self.index = index
+        final = snapshot_dir_name(index)
+        self.final_dir = os.path.join(root_dir, final)
+        if mode == SSMode.SNAPSHOT:
+            tmp = f"{final}.{GENERATING_SUFFIX}"
+        else:
+            tmp = f"{final}-{from_node_id:X}.{RECEIVING_SUFFIX}"
+        self.tmp_dir = os.path.join(root_dir, tmp)
+
+    # ---- temp stage ----
+
+    def create_tmp_dir(self) -> None:
+        os.makedirs(self.tmp_dir, exist_ok=False)
+        _fsync_dir(self.root_dir)
+
+    def get_tmp_dir(self) -> str:
+        return self.tmp_dir
+
+    def get_final_dir(self) -> str:
+        return self.final_dir
+
+    def get_tmp_filepath(self) -> str:
+        return os.path.join(self.tmp_dir, f"{snapshot_dir_name(self.index)}.ss")
+
+    def get_filepath(self) -> str:
+        return os.path.join(self.final_dir, f"{snapshot_dir_name(self.index)}.ss")
+
+    def save_ss_metadata(self, ss: Snapshot) -> None:
+        """Write the flag file into the temp dir (reference
+        ``fileutil.CreateFlagFile``)."""
+        flag = os.path.join(self.tmp_dir, SNAPSHOT_FLAG_FILE)
+        data = encode_snapshot(ss)
+        with open(flag, "wb") as f:
+            f.write(len(data).to_bytes(8, "little"))
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_dir(self.tmp_dir)
+
+    # ---- finalize ----
+
+    def finalize_snapshot(self) -> None:
+        """Atomically promote temp → final (reference
+        ``finalizeSnapshot``); raises FileExistsError if another replica
+        already installed this index."""
+        if os.path.exists(self.final_dir):
+            raise FileExistsError(self.final_dir)
+        os.rename(self.tmp_dir, self.final_dir)
+        _fsync_dir(self.root_dir)
+
+    def has_flag_file(self) -> bool:
+        return os.path.exists(os.path.join(self.final_dir, SNAPSHOT_FLAG_FILE))
+
+    def remove_flag_file(self) -> None:
+        os.unlink(os.path.join(self.final_dir, SNAPSHOT_FLAG_FILE))
+
+    def remove_tmp_dir(self) -> None:
+        _rmtree(self.tmp_dir)
+
+    def remove_final_dir(self) -> None:
+        _rmtree(self.final_dir)
+
+
+def read_ss_metadata(dirname: str) -> Optional[Snapshot]:
+    flag = os.path.join(dirname, SNAPSHOT_FLAG_FILE)
+    try:
+        with open(flag, "rb") as f:
+            n = int.from_bytes(f.read(8), "little")
+            return decode_snapshot(f.read(n))
+    except (OSError, ValueError):
+        return None
+
+
+def is_temp_snapshot_dir(name: str) -> bool:
+    return TEMP_DIR_RE.match(name) is not None
+
+
+def is_final_snapshot_dir(name: str) -> bool:
+    return SNAPSHOT_DIR_RE.match(name) is not None
+
+
+def snapshot_index_from_dir(name: str) -> int:
+    m = SNAPSHOT_DIR_RE.match(name)
+    if not m:
+        raise ValueError(f"not a snapshot dir {name!r}")
+    return int(m.group(1), 16)
+
+
+def _rmtree(path: str) -> None:
+    import shutil
+
+    shutil.rmtree(path, ignore_errors=True)

@@ -1,0 +1,13 @@
+"""Transport layer (reference ``internal/transport/``).
+
+Message plane: per-remote queued senders with batching + circuit breakers.
+Snapshot plane: chunked transfers on dedicated connections.  Wire modules
+are pluggable (``IRaftRPC``): framed TCP with optional mutual TLS, or the
+in-memory chan transport for single-process clusters and tests.
+"""
+from .chan import ChanRouter, ChanTransport, DEFAULT_ROUTER  # noqa: F401
+from .chunks import Chunks  # noqa: F401
+from .registry import Registry  # noqa: F401
+from .rpc import IConnection, IRaftRPC, ISnapshotConnection, TransportError  # noqa: F401
+from .tcp import TCPTransport  # noqa: F401
+from .transport import CircuitBreaker, Transport, create_transport  # noqa: F401

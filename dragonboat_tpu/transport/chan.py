@@ -1,0 +1,159 @@
+"""In-memory channel transport: deterministic single-process wire layer.
+
+Reference: ``plugin/chan/chan.go`` — the test transport selected by the
+memfs builds; also the template for pluggable transports.  A process-global
+router maps addresses to receive handlers; chaos hooks (partitions, drops)
+mirror the reference's monkey-test hooks (``monkey.go:184-213``).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Set, Tuple
+
+from ..wire import Chunk, MessageBatch
+from .rpc import (
+    ChunkHandler,
+    IConnection,
+    IRaftRPC,
+    ISnapshotConnection,
+    RequestHandler,
+    TransportError,
+)
+
+
+class ChanRouter:
+    """Process-global address → handler registry."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._handlers: Dict[str, Tuple[RequestHandler, ChunkHandler]] = {}
+        self._partitioned: Set[Tuple[str, str]] = set()
+        self._drop_hook: Optional[Callable[[MessageBatch], bool]] = None
+
+    def register(self, addr: str, rh: RequestHandler, ch: ChunkHandler) -> None:
+        with self._mu:
+            self._handlers[addr] = (rh, ch)
+
+    def unregister(self, addr: str) -> None:
+        with self._mu:
+            self._handlers.pop(addr, None)
+
+    def resolve(self, addr: str):
+        with self._mu:
+            return self._handlers.get(addr)
+
+    # ---- chaos hooks ----
+
+    def partition(self, a: str, b: str) -> None:
+        """Symmetric partition between two addresses."""
+        with self._mu:
+            self._partitioned.add((a, b))
+            self._partitioned.add((b, a))
+
+    def heal(self, a: str = "", b: str = "") -> None:
+        with self._mu:
+            if not a:
+                self._partitioned.clear()
+            else:
+                self._partitioned.discard((a, b))
+                self._partitioned.discard((b, a))
+
+    def is_partitioned(self, src: str, dst: str) -> bool:
+        with self._mu:
+            return (src, dst) in self._partitioned
+
+    def set_drop_hook(self, hook) -> None:
+        """hook(batch) -> True to drop (reference
+        ``SetTransportDropBatchHook`` ``monkey.go:82``)."""
+        with self._mu:
+            self._drop_hook = hook
+
+    def should_drop(self, batch: MessageBatch) -> bool:
+        with self._mu:
+            hook = self._drop_hook
+        return hook(batch) if hook else False
+
+
+DEFAULT_ROUTER = ChanRouter()
+
+
+class _ChanConnection(IConnection):
+    def __init__(self, rpc: "ChanTransport", target: str):
+        self.rpc = rpc
+        self.target = target
+
+    def send_message_batch(self, batch: MessageBatch) -> None:
+        self.rpc.deliver(self.target, batch)
+
+    def close(self) -> None:
+        pass
+
+
+class _ChanSnapshotConnection(ISnapshotConnection):
+    def __init__(self, rpc: "ChanTransport", target: str):
+        self.rpc = rpc
+        self.target = target
+
+    def send_chunk(self, chunk: Chunk) -> None:
+        self.rpc.deliver_chunk(self.target, chunk)
+
+    def close(self) -> None:
+        pass
+
+
+class ChanTransport(IRaftRPC):
+    """Reference ``plugin/chan/chan.go`` ``ChanTransport``."""
+
+    def __init__(
+        self,
+        source_address: str,
+        request_handler: RequestHandler,
+        chunk_handler: ChunkHandler,
+        router: Optional[ChanRouter] = None,
+    ):
+        self.source_address = source_address
+        self.request_handler = request_handler
+        self.chunk_handler = chunk_handler
+        self.router = router or DEFAULT_ROUTER
+        self._started = False
+
+    def name(self) -> str:
+        return "chan-transport"
+
+    def start(self) -> None:
+        self.router.register(
+            self.source_address, self.request_handler, self.chunk_handler
+        )
+        self._started = True
+
+    def stop(self) -> None:
+        if self._started:
+            self.router.unregister(self.source_address)
+            self._started = False
+
+    def _check(self, target: str):
+        if self.router.is_partitioned(self.source_address, target):
+            raise TransportError(f"partitioned from {target}")
+        h = self.router.resolve(target)
+        if h is None:
+            raise TransportError(f"no handler registered at {target}")
+        return h
+
+    def get_connection(self, target: str) -> IConnection:
+        self._check(target)
+        return _ChanConnection(self, target)
+
+    def get_snapshot_connection(self, target: str) -> ISnapshotConnection:
+        self._check(target)
+        return _ChanSnapshotConnection(self, target)
+
+    def deliver(self, target: str, batch: MessageBatch) -> None:
+        if self.router.should_drop(batch):
+            return
+        rh, _ = self._check(target)
+        rh(batch)
+
+    def deliver_chunk(self, target: str, chunk: Chunk) -> None:
+        _, ch = self._check(target)
+        if not ch(chunk):
+            raise TransportError(f"chunk rejected by {target}")

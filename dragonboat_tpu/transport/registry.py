@@ -1,0 +1,44 @@
+"""Node address registry.
+
+Reference: ``internal/transport/nodes.go`` — ``(clusterID, nodeID) → address``
+resolution for the send path, plus reverse lookup for unreachable events.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class Registry:
+    """Reference ``nodes.go:48`` ``Nodes``."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._addr: Dict[Tuple[int, int], str] = {}
+
+    def add(self, cluster_id: int, node_id: int, address: str) -> None:
+        with self._mu:
+            existing = self._addr.get((cluster_id, node_id))
+            if existing is not None and existing != address:
+                raise ValueError(
+                    f"inconsistent address for ({cluster_id},{node_id}): "
+                    f"{existing} vs {address}"
+                )
+            self._addr[(cluster_id, node_id)] = address
+
+    def remove(self, cluster_id: int, node_id: int) -> None:
+        with self._mu:
+            self._addr.pop((cluster_id, node_id), None)
+
+    def remove_cluster(self, cluster_id: int) -> None:
+        with self._mu:
+            for k in [k for k in self._addr if k[0] == cluster_id]:
+                del self._addr[k]
+
+    def resolve(self, cluster_id: int, node_id: int) -> Optional[str]:
+        with self._mu:
+            return self._addr.get((cluster_id, node_id))
+
+    def reverse_resolve(self, address: str) -> List[Tuple[int, int]]:
+        with self._mu:
+            return [k for k, v in self._addr.items() if v == address]

@@ -1,0 +1,62 @@
+"""Pluggable wire layer contracts.
+
+Reference: ``raftio/rpc.go:90`` — ``IRaftRPC`` with separate message and
+snapshot-chunk planes; implementations here are the in-memory chan transport
+(:mod:`dragonboat_tpu.transport.chan`) and framed TCP
+(:mod:`dragonboat_tpu.transport.tcp`).
+"""
+from __future__ import annotations
+
+import abc
+from typing import Callable, List
+
+from ..wire import Chunk, MessageBatch
+
+# receive-side callbacks (reference raftio/rpc.go RequestHandler/ChunkHandler)
+RequestHandler = Callable[[MessageBatch], None]
+ChunkHandler = Callable[[Chunk], bool]
+
+
+class TransportError(Exception):
+    pass
+
+
+class IConnection(abc.ABC):
+    """One established outbound message channel (reference
+    ``raftio/rpc.go`` ``IConnection``)."""
+
+    @abc.abstractmethod
+    def send_message_batch(self, batch: MessageBatch) -> None: ...
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+
+class ISnapshotConnection(abc.ABC):
+    """One outbound snapshot chunk stream (reference
+    ``raftio/rpc.go`` ``ISnapshotConnection``)."""
+
+    @abc.abstractmethod
+    def send_chunk(self, chunk: Chunk) -> None: ...
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+
+class IRaftRPC(abc.ABC):
+    """Reference ``raftio/rpc.go:90`` ``IRaftRPC``."""
+
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    @abc.abstractmethod
+    def start(self) -> None: ...
+
+    @abc.abstractmethod
+    def stop(self) -> None: ...
+
+    @abc.abstractmethod
+    def get_connection(self, target: str) -> IConnection: ...
+
+    @abc.abstractmethod
+    def get_snapshot_connection(self, target: str) -> ISnapshotConnection: ...

@@ -1,0 +1,100 @@
+"""Snapshot chunk splitting and sending.
+
+Reference: ``internal/transport/snapshot.go:186-292`` (``splitSnapshotMessage``)
+and ``internal/transport/job.go`` — a snapshot transfer is its own connection
+streaming 2MB chunks: main image file first, then each external file, with
+``file_chunk_id/count`` framing and ``has_file_info`` on each external file's
+first chunk.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List
+
+from ..wire import Chunk, Message, SnapshotFile
+from .rpc import ISnapshotConnection
+
+
+def _file_chunks(
+    path: str, size: int, chunk_size: int
+) -> List[tuple]:
+    """(offset, length) pairs covering ``size`` bytes."""
+    if size == 0:
+        return [(0, 0)]
+    out = []
+    off = 0
+    while off < size:
+        out.append((off, min(chunk_size, size - off)))
+        off += chunk_size
+    del path
+    return out
+
+
+def split_snapshot_message(
+    m: Message, deployment_id: int, chunk_size: int
+) -> List[Chunk]:
+    """Plan the chunk sequence; data is loaded lazily at send time."""
+    ss = m.snapshot
+    files = [SnapshotFile(filepath=ss.filepath, file_size=ss.file_size)]
+    files.extend(ss.files)
+    chunks: List[Chunk] = []
+    total = sum(
+        len(_file_chunks(f.filepath, f.file_size, chunk_size)) for f in files
+    )
+    chunk_id = 0
+    for fidx, f in enumerate(files):
+        plan = _file_chunks(f.filepath, f.file_size, chunk_size)
+        for fcid, (off, ln) in enumerate(plan):
+            c = Chunk(
+                cluster_id=m.cluster_id,
+                node_id=m.to,
+                from_=m.from_,
+                chunk_id=chunk_id,
+                chunk_size=ln,
+                chunk_count=total,
+                index=ss.index,
+                term=m.term,
+                membership=ss.membership,
+                filepath=f.filepath,
+                file_size=f.file_size,
+                deployment_id=deployment_id,
+                file_chunk_id=fcid,
+                file_chunk_count=len(plan),
+                on_disk_index=ss.on_disk_index,
+                witness=ss.witness,
+            )
+            if fidx > 0 and fcid == 0:
+                c.has_file_info = True
+                c.file_info = SnapshotFile(
+                    filepath=f.filepath,
+                    file_size=f.file_size,
+                    file_id=f.file_id,
+                    metadata=f.metadata,
+                )
+            c.data = (off, ln)  # placeholder filled by the sender
+            chunks.append(c)
+            chunk_id += 1
+    return chunks
+
+
+def load_chunk_data(c: Chunk) -> Chunk:
+    off, ln = c.data
+    if ln == 0:
+        c.data = b""
+        return c
+    with open(c.filepath, "rb") as f:
+        f.seek(off)
+        data = f.read(ln)
+    if len(data) != ln:
+        raise RuntimeError(f"short read on {c.filepath}")
+    c.data = data
+    return c
+
+
+def send_snapshot_chunks(
+    conn: ISnapshotConnection, chunks: List[Chunk], stopped: threading.Event
+) -> None:
+    for c in chunks:
+        if stopped.is_set():
+            raise RuntimeError("transport stopped")
+        conn.send_chunk(load_chunk_data(c))

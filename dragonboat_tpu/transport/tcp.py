@@ -1,0 +1,262 @@
+"""Framed TCP wire module with optional mutual TLS.
+
+Reference: ``internal/transport/tcp.go`` — magic ``0xAE7D``, fixed header
+{method, payload size, payload crc32, header crc32}, method 100 for raft
+message batches and 200 for snapshot chunks, mutual-TLS via config
+(``tcp.go:582-595``), poison-drain on connection close (``tcp.go:122-147``).
+
+Frame layout here: ``magic(2) method(2) size(8) payload_crc(4) header_crc(4)``
+followed by the payload bytes (codec-encoded MessageBatch or Chunk).
+"""
+from __future__ import annotations
+
+import socket
+import ssl
+import struct
+import threading
+import zlib
+from typing import Optional
+
+from ..logger import get_logger
+from ..wire.codec import (
+    decode_chunk,
+    decode_message_batch,
+    encode_chunk,
+    encode_message_batch,
+)
+from .rpc import (
+    ChunkHandler,
+    IConnection,
+    IRaftRPC,
+    ISnapshotConnection,
+    RequestHandler,
+    TransportError,
+)
+
+plog = get_logger("transport")
+
+MAGIC = 0xAE7D
+RAFT_METHOD = 100
+SNAPSHOT_METHOD = 200
+POISON_METHOD = 999
+_HDR = struct.Struct(">HHQII")
+MAX_PAYLOAD = 1 << 30
+
+
+def _send_frame(sock, method: int, payload: bytes) -> None:
+    pcrc = zlib.crc32(payload)
+    hdr_wo_crc = struct.pack(">HHQI", MAGIC, method, len(payload), pcrc)
+    hcrc = zlib.crc32(hdr_wo_crc)
+    sock.sendall(hdr_wo_crc + struct.pack(">I", hcrc) + payload)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        d = sock.recv(n - len(buf))
+        if not d:
+            raise ConnectionError("peer closed")
+        buf += d
+    return bytes(buf)
+
+
+def _recv_frame(sock):
+    hdr = _recv_exact(sock, _HDR.size)
+    magic, method, size, pcrc, hcrc = _HDR.unpack(hdr)
+    if magic != MAGIC:
+        raise TransportError("bad magic")
+    if zlib.crc32(hdr[:-4]) != hcrc:
+        raise TransportError("corrupted frame header")
+    if size > MAX_PAYLOAD:
+        raise TransportError("oversized frame")
+    payload = _recv_exact(sock, size)
+    if zlib.crc32(payload) != pcrc:
+        raise TransportError("corrupted frame payload")
+    return method, payload
+
+
+class TCPConnection(IConnection):
+    """Reference ``tcp.go:351`` ``TCPConnection``."""
+
+    def __init__(self, sock):
+        self.sock = sock
+
+    def send_message_batch(self, batch) -> None:
+        _send_frame(self.sock, RAFT_METHOD, encode_message_batch(batch))
+
+    def close(self) -> None:
+        try:
+            _send_frame(self.sock, POISON_METHOD, b"")
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class TCPSnapshotConnection(ISnapshotConnection):
+    """Reference ``tcp.go:396``."""
+
+    def __init__(self, sock):
+        self.sock = sock
+
+    def send_chunk(self, chunk) -> None:
+        _send_frame(self.sock, SNAPSHOT_METHOD, encode_chunk(chunk))
+
+    def close(self) -> None:
+        try:
+            _send_frame(self.sock, POISON_METHOD, b"")
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class TCPTransport(IRaftRPC):
+    """Reference ``tcp.go:409`` ``TCPTransport``."""
+
+    def __init__(
+        self,
+        source_address: str,
+        request_handler: RequestHandler,
+        chunk_handler: ChunkHandler,
+        listen_address: str = "",
+        mutual_tls: bool = False,
+        ca_file: str = "",
+        cert_file: str = "",
+        key_file: str = "",
+        connect_timeout: float = 5.0,
+    ):
+        self.source_address = source_address
+        self.request_handler = request_handler
+        self.chunk_handler = chunk_handler
+        self.listen_address = listen_address or source_address
+        self.mutual_tls = mutual_tls
+        self.ca_file, self.cert_file, self.key_file = ca_file, cert_file, key_file
+        self.connect_timeout = connect_timeout
+        self._listener: Optional[socket.socket] = None
+        self._stopped = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+
+    def name(self) -> str:
+        return "tcp-transport"
+
+    # ---- TLS ----
+
+    def _server_ctx(self) -> Optional[ssl.SSLContext]:
+        if not self.mutual_tls:
+            return None
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.cert_file, self.key_file)
+        ctx.load_verify_locations(self.ca_file)
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        return ctx
+
+    def _client_ctx(self) -> Optional[ssl.SSLContext]:
+        if not self.mutual_tls:
+            return None
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.load_cert_chain(self.cert_file, self.key_file)
+        ctx.load_verify_locations(self.ca_file)
+        ctx.check_hostname = False
+        return ctx
+
+    # ---- server side ----
+
+    def start(self) -> None:
+        host, _, port = self.listen_address.rpartition(":")
+        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind((host, int(port)))
+        ls.listen(128)
+        ls.settimeout(0.5)
+        self._listener = ls
+        self._accept_thread = threading.Thread(
+            target=self._accept_main, name=f"tcp-accept-{self.listen_address}",
+            daemon=True,
+        )
+        self._accept_thread.start()
+
+    def _accept_main(self) -> None:
+        ctx = self._server_ctx()
+        assert self._listener is not None
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if ctx is not None:
+                try:
+                    conn = ctx.wrap_socket(conn, server_side=True)
+                except ssl.SSLError as e:
+                    plog.warning("TLS handshake failed: %s", e)
+                    conn.close()
+                    continue
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+
+    def _serve_conn(self, conn) -> None:
+        """Reference ``tcp.go:515`` ``serveConn``."""
+        try:
+            conn.settimeout(60.0)
+            while not self._stopped.is_set():
+                method, payload = _recv_frame(conn)
+                if method == POISON_METHOD:
+                    return
+                if method == RAFT_METHOD:
+                    self.request_handler(decode_message_batch(payload))
+                elif method == SNAPSHOT_METHOD:
+                    if not self.chunk_handler(decode_chunk(payload)):
+                        return
+                else:
+                    plog.warning("unknown method %d", method)
+                    return
+        except (ConnectionError, TransportError, socket.timeout, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # ---- client side ----
+
+    def _dial(self, target: str):
+        host, _, port = target.rpartition(":")
+        sock = socket.create_connection(
+            (host, int(port)), timeout=self.connect_timeout
+        )
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        ctx = self._client_ctx()
+        if ctx is not None:
+            sock = ctx.wrap_socket(sock, server_hostname=host)
+        return sock
+
+    def get_connection(self, target: str) -> TCPConnection:
+        try:
+            return TCPConnection(self._dial(target))
+        except OSError as e:
+            raise TransportError(f"dial {target}: {e}") from e
+
+    def get_snapshot_connection(self, target: str) -> TCPSnapshotConnection:
+        try:
+            return TCPSnapshotConnection(self._dial(target))
+        except OSError as e:
+            raise TransportError(f"dial {target}: {e}") from e
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2)
