@@ -1,23 +1,30 @@
-"""dragonboat_tpu — a TPU-native multi-group Raft consensus framework.
+"""dragonboat_tpu: a TPU-native multi-group Raft consensus framework.
 
-A ground-up re-design of the capabilities of Dragonboat (a multi-group Raft
-library, reference at /root/reference) for TPU hosts: per-group protocol
-bookkeeping (vote tallies, match-index/commit advancement, tick and election
-timers) is batched into ``(nGroups, nPeers)`` JAX device tensors stepped by
-fused XLA/Pallas kernels once per tick, while I/O (log persistence, network,
-user state machines) remains on the host, with a C++ native log engine.
-
-Public surface mirrors the reference's L0 facade: ``NodeHost``, per-group
-``Config`` / per-host ``NodeHostConfig``, the three user state machine
-interfaces, client sessions, and the pluggable LogDB/transport factories.
+Brand-new implementation with the capabilities of the reference dragonboat
+library (multi-group Raft in Go): a NodeHost facade hosting thousands of
+raft groups, pluggable state machines, sharded log storage, chunked snapshot
+transfer — plus a batched (nGroups × nPeers) quorum engine that steps group
+protocol state on TPU via JAX (see ``dragonboat_tpu.ops``).
 """
+from .client import Session  # noqa: F401
+from .config import Config, ExpertConfig, LogDBConfig, NodeHostConfig  # noqa: F401
+from .nodehost import NodeHost  # noqa: F401
+from .requests import (  # noqa: F401
+    ClusterAlreadyExistError,
+    ClusterNotFoundError,
+    RejectedError,
+    RequestError,
+    RequestResult,
+    RequestState,
+    SystemBusyError,
+    TimeoutError_,
+)
+from .statemachine import (  # noqa: F401
+    IConcurrentStateMachine,
+    IOnDiskStateMachine,
+    IStateMachine,
+    Result,
+    SMEntry,
+)
 
 __version__ = "0.1.0"
-
-from .config import (  # noqa: F401
-    Config,
-    ConfigError,
-    ExpertConfig,
-    LogDBConfig,
-    NodeHostConfig,
-)

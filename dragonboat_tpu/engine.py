@@ -1,0 +1,185 @@
+"""Execution engine: the scheduler driving step/apply work across groups.
+
+Reference: ``execengine.go`` — step/apply/snapshot worker pools with groups
+partitioned to workers by ``clusterID % workerCount`` and per-worker
+``workReady`` wakeups.  The Python build keeps the same structure with
+smaller default pools (GIL), and this is exactly the seam the batched TPU
+quorum engine replaces: ``process_steps``'s per-group loop becomes one
+device dispatch per tick (SURVEY.md §7).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, TYPE_CHECKING
+
+from .logger import get_logger
+from .queue import ReadyCluster
+from .server.partition import FixedPartitioner
+
+if TYPE_CHECKING:
+    from .node import Node
+
+plog = get_logger("engine")
+
+
+class _WorkReady:
+    """Per-worker ready-set + wakeup (reference ``execengine.go:90-132``)."""
+
+    def __init__(self, count: int):
+        self.count = count
+        self.partitioner = FixedPartitioner(count)
+        self.ready = [ReadyCluster() for _ in range(count)]
+        self.cv = [threading.Condition() for _ in range(count)]
+        self.flag = [False] * count
+
+    def notify(self, idx: int) -> None:
+        with self.cv[idx]:
+            self.flag[idx] = True
+            self.cv[idx].notify()
+
+    def cluster_ready(self, cluster_id: int) -> None:
+        idx = self.partitioner.get_partition_id(cluster_id)
+        self.ready[idx].set_ready(cluster_id)
+        self.notify(idx)
+
+    def all_ready(self, idx: int) -> None:
+        self.notify(idx)
+
+    def wait(self, idx: int, timeout: float = 1.0) -> None:
+        with self.cv[idx]:
+            if not self.flag[idx]:
+                self.cv[idx].wait(timeout)
+            self.flag[idx] = False
+
+    def get_ready(self, idx: int):
+        return self.ready[idx].get_ready()
+
+
+class Engine:
+    """Reference ``execengine.go:637`` ``execEngine``."""
+
+    def __init__(
+        self,
+        get_nodes,  # Callable[[], Tuple[int, Dict[int, Node]]] → (csi, map)
+        logdb,
+        step_workers: int = 4,
+        apply_workers: int = 4,
+    ):
+        self.get_nodes = get_nodes
+        self.logdb = logdb
+        self._stopped = threading.Event()
+        self.step_ready = _WorkReady(step_workers)
+        self.apply_ready = _WorkReady(apply_workers)
+        self._threads: List[threading.Thread] = []
+        # per-worker node-map cache, reloaded when the cluster-set index
+        # changes (reference loadBucketNodes execengine.go:889)
+        self._step_cache: List = [(-1, {}) for _ in range(step_workers)]
+        self._apply_cache: List = [(-1, {}) for _ in range(apply_workers)]
+        for i in range(step_workers):
+            t = threading.Thread(
+                target=self._step_worker_main, args=(i,),
+                name=f"step-worker-{i}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        for i in range(apply_workers):
+            t = threading.Thread(
+                target=self._apply_worker_main, args=(i,),
+                name=f"apply-worker-{i}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    # ---- wakeups (reference setStepReady / setApplyReady) ----
+
+    def set_step_ready(self, cluster_id: int) -> None:
+        self.step_ready.cluster_ready(cluster_id)
+
+    def set_apply_ready(self, cluster_id: int) -> None:
+        self.apply_ready.cluster_ready(cluster_id)
+
+    def notify_all(self) -> None:
+        for i in range(self.step_ready.count):
+            self.step_ready.notify(i)
+        for i in range(self.apply_ready.count):
+            self.apply_ready.notify(i)
+
+    def _worker_nodes(
+        self, cache: List, idx: int, partitioner: FixedPartitioner
+    ) -> Dict[int, "Node"]:
+        csi, nodes = self.get_nodes()
+        cached_csi, cached = cache[idx]
+        if cached_csi == csi:
+            return cached
+        mine = {
+            cid: n
+            for cid, n in nodes.items()
+            if partitioner.get_partition_id(cid) == idx
+        }
+        cache[idx] = (csi, mine)
+        return mine
+
+    # ---- step path (reference stepWorkerMain/processSteps :860-1010) ----
+
+    def _step_worker_main(self, idx: int) -> None:
+        while not self._stopped.is_set():
+            self.step_ready.wait(idx)
+            if self._stopped.is_set():
+                return
+            nodes = self._worker_nodes(
+                self._step_cache, idx, self.step_ready.partitioner
+            )
+            ready = self.step_ready.get_ready(idx)
+            active = [nodes[cid] for cid in ready if cid in nodes]
+            if active:
+                try:
+                    self.process_steps(active)
+                except Exception:
+                    plog.exception("step worker %d failed", idx)
+
+    def process_steps(self, active: List["Node"]) -> None:
+        """The hot loop (reference ``processSteps`` ``execengine.go:923``):
+        step → send replicates → one batched fsync → execute → commit."""
+        pairs = []
+        for n in active:
+            ud = n.step_node()
+            if ud is not None:
+                pairs.append((n, ud))
+        if not pairs:
+            return
+        for n, ud in pairs:
+            n.process_dropped(ud)
+            n.send_replicate_messages(ud)  # before fsync (thesis §10.2.1)
+        updates = [ud for _, ud in pairs if ud.has_update()]
+        if updates:
+            self.logdb.save_raft_state(updates)
+        for n, ud in pairs:
+            n.process_raft_update(ud)
+        for n, ud in pairs:
+            n.commit_raft_update(ud)
+
+    # ---- apply path (reference applyWorkerMain/processApplies :794-858) ----
+
+    def _apply_worker_main(self, idx: int) -> None:
+        while not self._stopped.is_set():
+            self.apply_ready.wait(idx)
+            if self._stopped.is_set():
+                return
+            nodes = self._worker_nodes(
+                self._apply_cache, idx, self.apply_ready.partitioner
+            )
+            ready = self.apply_ready.get_ready(idx)
+            for cid in ready:
+                n = nodes.get(cid)
+                if n is None:
+                    continue
+                try:
+                    n.handle_apply_tasks()
+                except Exception:
+                    plog.exception("apply worker %d failed on %d", idx, cid)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.notify_all()
+        for t in self._threads:
+            t.join(timeout=2)

@@ -1,0 +1,654 @@
+"""Per-replica node runtime: binds Peer + RSM + snapshotter + queues.
+
+Reference: ``node.go`` — ``stepNode`` pulls queued inputs into raft,
+``processRaftUpdate``/``commitRaftUpdate`` execute the resulting ``Update``
+(messages out before fsync, entries to LogDB, committed entries to the apply
+queue), snapshot task lifecycle, log compaction, tick handling and the
+``rsm.INode`` callbacks completing pending requests.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from .client import Session
+from .config import Config
+from .logdb import LogReader
+from .logger import get_logger
+from .queue import EntryQueue
+from .quiesce import QuiesceManager
+from .requests import (
+    ClusterClosedError,
+    PendingConfigChange,
+    PendingLeaderTransfer,
+    PendingProposal,
+    PendingReadIndex,
+    PendingSnapshot,
+    RequestResult,
+    RequestResultCode,
+    RequestState,
+    SystemBusyError,
+)
+from .rsm import (
+    MembershipState,
+    SSReqType,
+    SSRequest,
+    StateMachine,
+    Task,
+    TaskQueue,
+)
+from .rsm.statemachine import SnapshotIgnored
+from .raft.peer import Peer, PeerAddress
+from .server.message import MessageQueue
+from .settings import Soft
+from .snapshotter import Snapshotter
+from .statemachine import Result
+from .wire import (
+    ConfigChange,
+    ConfigChangeType,
+    Entry,
+    Membership,
+    Message,
+    MessageType,
+    Snapshot,
+    Update,
+    is_empty_snapshot,
+)
+
+plog = get_logger("node")
+MT = MessageType
+
+
+class Node:
+    """Reference ``node.go:58`` ``node``."""
+
+    def __init__(
+        self,
+        nh,  # NodeHost (duck-typed: send_message, send_snapshot_message, engine)
+        config: Config,
+        logdb,
+        logreader: LogReader,
+        snapshotter: Snapshotter,
+        sm: StateMachine,
+        tick_millisecond: int,
+    ):
+        self.nh = nh
+        self.config = config
+        self.cluster_id = config.cluster_id
+        self.node_id = config.node_id
+        self.logdb = logdb
+        self.logreader = logreader
+        self.snapshotter = snapshotter
+        self.sm = sm
+        self.tick_millisecond = tick_millisecond
+        self.raft_mu = threading.RLock()
+        self.peer: Optional[Peer] = None
+        # input queues
+        self.entry_q = EntryQueue(Soft.incoming_proposal_queue_length)
+        self.mq = MessageQueue(Soft.received_message_queue_length)
+        # pending request trackers
+        self.pending_proposals = PendingProposal()
+        self.pending_reads = PendingReadIndex()
+        self.pending_config_change = PendingConfigChange()
+        self.pending_snapshot = PendingSnapshot()
+        self.pending_leader_transfer = PendingLeaderTransfer()
+        # apply pipeline
+        self.to_apply = TaskQueue()
+        self.quiesce_mgr = QuiesceManager(
+            self.cluster_id, self.node_id, config.election_rtt, config.quiesce
+        )
+        self._stopped = threading.Event()
+        self._initialized = threading.Event()
+        self.current_tick = 0
+        self._tick_count_pending = 0
+        self._snapshotting = threading.Lock()
+        self.leader_id = 0
+        self._delete_required = False
+
+    # ---- startup (reference startRaft/replayLog node.go:292,573) ----
+
+    def start(
+        self,
+        addresses: List[PeerAddress],
+        initial: bool,
+        new_node: bool,
+        seed: Optional[int] = None,
+    ) -> None:
+        self.snapshotter.process_orphans()
+        self.peer = Peer.launch(
+            self.config, self.logreader, None, addresses, initial, new_node,
+            seed=seed,
+        )
+        # queue initial recovery so the apply worker restores the newest
+        # local snapshot before any new entries apply
+        self.to_apply.enqueue(
+            Task(
+                cluster_id=self.cluster_id,
+                node_id=self.node_id,
+                recover=True,
+                initial=True,
+                new_node=new_node,
+            )
+        )
+        self.nh.engine.set_apply_ready(self.cluster_id)
+
+    def initialized(self) -> bool:
+        return self._initialized.is_set()
+
+    def wait_initialized(self, timeout: float = 30.0) -> bool:
+        return self._initialized.wait(timeout)
+
+    # ---- user request entry points ----
+
+    def _timeout_ticks(self, timeout_s: float) -> int:
+        ticks = int(timeout_s * 1000 / self.tick_millisecond)
+        return max(ticks, 1)
+
+    def propose(
+        self, session: Session, cmd: bytes, timeout_s: float
+    ) -> RequestState:
+        rs, entry = self.pending_proposals.propose(
+            session.client_id, session.series_id, cmd,
+            self._timeout_ticks(timeout_s),
+        )
+        entry.responded_to = session.responded_to
+        if not self.entry_q.add(entry):
+            self.pending_proposals.dropped(entry.key)
+            raise SystemBusyError()
+        self.nh.engine.set_step_ready(self.cluster_id)
+        return rs
+
+    def propose_session(self, session: Session, timeout_s: float) -> RequestState:
+        rs, entry = self.pending_proposals.propose(
+            session.client_id, session.series_id, b"",
+            self._timeout_ticks(timeout_s),
+        )
+        if not self.entry_q.add(entry):
+            self.pending_proposals.dropped(entry.key)
+            raise SystemBusyError()
+        self.nh.engine.set_step_ready(self.cluster_id)
+        return rs
+
+    def read(self, timeout_s: float) -> RequestState:
+        rs = self.pending_reads.read(self._timeout_ticks(timeout_s))
+        self.nh.engine.set_step_ready(self.cluster_id)
+        return rs
+
+    def request_config_change(
+        self, cc: ConfigChange, timeout_s: float
+    ) -> RequestState:
+        rs = self.pending_config_change.request(
+            cc, self._timeout_ticks(timeout_s)
+        )
+        self.nh.engine.set_step_ready(self.cluster_id)
+        return rs
+
+    def request_snapshot(self, req: SSRequest, timeout_s: float) -> RequestState:
+        rs = self.pending_snapshot.request(req, self._timeout_ticks(timeout_s))
+        self.nh.engine.set_step_ready(self.cluster_id)
+        return rs
+
+    def request_leader_transfer(self, target: int, timeout_s: float) -> RequestState:
+        rs = self.pending_leader_transfer.request(
+            target, self._timeout_ticks(timeout_s)
+        )
+        self.nh.engine.set_step_ready(self.cluster_id)
+        return rs
+
+    def stale_read(self, query):
+        return self.sm.lookup(query)
+
+    # ---- inbound messages ----
+
+    def handle_message_batch(self, m: Message) -> None:
+        if self._stopped.is_set():
+            return
+        if m.type == MT.INSTALL_SNAPSHOT:
+            self.mq.must_add(m)
+        else:
+            self.mq.add(m)
+        self.nh.engine.set_step_ready(self.cluster_id)
+
+    def request_tick(self) -> None:
+        """Reference ``nodehost.go`` sendTickMessage: one LocalTick per RTT."""
+        self.mq.add(Message(type=MT.LOCAL_TICK))
+        self.nh.engine.set_step_ready(self.cluster_id)
+
+    def handle_snapshot_status(self, node_id: int, failed: bool) -> None:
+        self.mq.add(
+            Message(type=MT.SNAPSHOT_STATUS, from_=node_id, reject=failed)
+        )
+        self.nh.engine.set_step_ready(self.cluster_id)
+
+    def handle_unreachable(self, node_id: int) -> None:
+        self.mq.add(Message(type=MT.UNREACHABLE, from_=node_id))
+        self.nh.engine.set_step_ready(self.cluster_id)
+
+    # ---- step path (reference stepNode node.go:1099) ----
+
+    def step_node(self) -> Optional[Update]:
+        with self.raft_mu:
+            if self._stopped.is_set() or self.peer is None:
+                return None
+            if not self.initialized():
+                return None
+            self._handle_events()
+            more = self.to_apply.more_entries_to_apply()
+            if self.peer.has_update(more):
+                ud = self.peer.get_update(more, self.sm.get_last_applied())
+                return ud
+            return None
+
+    def _handle_events(self) -> None:
+        self._handle_received_messages()
+        self._handle_read_index()
+        self._handle_config_change()
+        self._handle_proposals()
+        self._handle_leader_transfer()
+        self._handle_snapshot_request()
+
+    def _handle_received_messages(self) -> None:
+        ticks = 0
+        for m in self.mq.get():
+            if m.type == MT.LOCAL_TICK:
+                ticks += 1
+            elif m.type == MT.QUIESCE:
+                self.quiesce_mgr.try_enter_quiesce()
+            elif m.type == MT.UNREACHABLE:
+                # local report from the transport, not a wire message
+                # (reference node.go:1257-1286 handleReceivedMessages)
+                self.peer.report_unreachable_node(m.from_)
+            elif m.type == MT.SNAPSHOT_STATUS:
+                self.peer.report_snapshot_status(m.from_, m.reject)
+            else:
+                if self.quiesce_mgr.enabled:
+                    self.quiesce_mgr.record_activity(m.type)
+                if m.type == MT.INSTALL_SNAPSHOT and m.snapshot is not None:
+                    self._handle_install_snapshot(m)
+                else:
+                    self.peer.handle(m)
+        if ticks:
+            self._tick(ticks)
+        if self.quiesce_mgr.just_entered_quiesce():
+            self._broadcast_quiesce()
+
+    def _handle_install_snapshot(self, m: Message) -> None:
+        # record arrival; raft decides whether to accept (restore path)
+        self.peer.handle(m)
+
+    def _broadcast_quiesce(self) -> None:
+        for nid in list(self.peer.raft.remotes):
+            if nid != self.node_id:
+                self.nh.send_message(
+                    Message(
+                        type=MT.QUIESCE,
+                        cluster_id=self.cluster_id,
+                        from_=self.node_id,
+                        to=nid,
+                    )
+                )
+
+    def _tick(self, count: int) -> None:
+        for _ in range(count):
+            self.current_tick += 1
+            self.quiesce_mgr.increase_quiesce_tick()
+            if self.quiesce_mgr.quiesced():
+                self.peer.quiesced_tick()
+            else:
+                self.peer.tick()
+            self.pending_proposals.tick()
+            self.pending_reads.tick()
+            self.pending_config_change.tick()
+            self.pending_snapshot.tick()
+            self.pending_leader_transfer.tick()
+        self._update_leader_info()
+
+    def _update_leader_info(self) -> None:
+        lid = self.peer.raft.leader_id
+        if lid != self.leader_id:
+            self.leader_id = lid
+
+    def _handle_proposals(self) -> None:
+        entries = self.entry_q.get()
+        if entries:
+            self.quiesce_mgr.record_activity(MT.PROPOSE)
+            self.peer.propose_entries(entries)
+
+    def _handle_read_index(self) -> None:
+        if self.pending_reads.peep():
+            ctx = self.pending_reads.next_ctx()
+            if self.pending_reads.take_pending(ctx):
+                self.quiesce_mgr.record_activity(MT.READ_INDEX)
+                self.peer.read_index(ctx)
+
+    def _handle_config_change(self) -> None:
+        cc = self.pending_config_change.take()
+        if cc is not None:
+            rs = self.pending_config_change.pending()
+            key = rs.key if rs is not None else 0
+            self.quiesce_mgr.record_activity(MT.CONFIG_CHANGE_EVENT)
+            self.peer.propose_config_change(cc, key)
+
+    def _handle_leader_transfer(self) -> None:
+        target = self.pending_leader_transfer.take()
+        if target is not None:
+            self.peer.request_leader_transfer(target)
+            # completion is observed via leader change, not a raft ack
+            self.pending_leader_transfer.notify(
+                RequestResult(code=RequestResultCode.COMPLETED)
+            )
+
+    def _handle_snapshot_request(self) -> None:
+        req = self.pending_snapshot.take()
+        if req is not None:
+            self.to_apply.enqueue(
+                Task(
+                    cluster_id=self.cluster_id,
+                    node_id=self.node_id,
+                    save=True,
+                    ss_request=req,
+                )
+            )
+            self.nh.engine.set_apply_ready(self.cluster_id)
+
+    # ---- update execution (reference processRaftUpdate node.go:1058) ----
+
+    def process_dropped(self, ud: Update) -> None:
+        for e in ud.dropped_entries:
+            self.pending_proposals.dropped(e.key)
+        if ud.dropped_read_indexes:
+            self.pending_reads.dropped(ud.dropped_read_indexes)
+
+    def send_replicate_messages(self, ud: Update) -> None:
+        """Replicate messages go out BEFORE the fsync (thesis §10.2.1,
+        reference ``execengine.go:954-961``)."""
+        for m in ud.messages:
+            if m.type == MT.REPLICATE:
+                self.nh.send_message(m)
+
+    def process_raft_update(self, ud: Update) -> None:
+        self.logreader.append(ud.entries_to_save)
+        for m in ud.messages:
+            if m.type == MT.REPLICATE:
+                continue
+            if m.type == MT.INSTALL_SNAPSHOT:
+                self.nh.send_snapshot_message(m)
+            else:
+                self.nh.send_message(m)
+        if ud.ready_to_reads:
+            self.pending_reads.add_ready(ud.ready_to_reads)
+            self.pending_reads.applied(self.sm.get_last_applied())
+        self._apply_snapshot_and_update(ud)
+        self._save_snapshot_required()
+
+    def _apply_snapshot_and_update(self, ud: Update) -> None:
+        if not is_empty_snapshot(ud.snapshot):
+            ss = ud.snapshot
+            plog.info(
+                "%s installing snapshot index %d", self.describe(), ss.index
+            )
+            try:
+                self.logreader.apply_snapshot(ss)
+            except Exception as e:  # SnapshotOutOfDate
+                plog.warning("%s apply_snapshot: %s", self.describe(), e)
+            self.to_apply.enqueue(
+                Task(
+                    cluster_id=self.cluster_id,
+                    node_id=self.node_id,
+                    recover=True,
+                    ss=ss,
+                    index=ss.index,
+                )
+            )
+            self.nh.engine.set_apply_ready(self.cluster_id)
+        if ud.committed_entries:
+            self.to_apply.enqueue(
+                Task(
+                    cluster_id=self.cluster_id,
+                    node_id=self.node_id,
+                    entries=ud.committed_entries,
+                )
+            )
+            self.nh.engine.set_apply_ready(self.cluster_id)
+        if ud.more_committed_entries:
+            self.nh.engine.set_step_ready(self.cluster_id)
+
+    def _save_snapshot_required(self) -> None:
+        """Auto snapshot every ``snapshot_entries`` applied (reference
+        ``node.go:605`` ``saveSnapshotRequired``)."""
+        se = self.config.snapshot_entries
+        if se == 0:
+            return
+        applied = self.sm.get_last_applied()
+        if applied - self.sm.get_snapshot_index() < se:
+            return
+        # held until the queued PERIODIC save completes (_save_snapshot
+        # releases it), so duplicate save tasks never pile up
+        if not self._snapshotting.acquire(blocking=False):
+            return
+        self.to_apply.enqueue(
+            Task(
+                cluster_id=self.cluster_id,
+                node_id=self.node_id,
+                save=True,
+                ss_request=SSRequest(type=SSReqType.PERIODIC),
+            )
+        )
+        self.nh.engine.set_apply_ready(self.cluster_id)
+
+    def commit_raft_update(self, ud: Update) -> None:
+        with self.raft_mu:
+            if self.peer is not None:
+                self.peer.commit(ud)
+
+    # ---- apply path (reference processApplies / handleTask) ----
+
+    def handle_apply_tasks(self) -> None:
+        tasks = self.to_apply.get_all()
+        for t in tasks:
+            if self._stopped.is_set():
+                return
+            if t.save:
+                self._save_snapshot(t)
+            elif t.recover:
+                self._recover_from_snapshot(t)
+            else:
+                self.sm.handle([t])
+                applied = self.sm.get_last_applied()
+                with self.raft_mu:
+                    if self.peer is not None:
+                        self.peer.notify_raft_last_applied(applied)
+                self.sm.set_batched_last_applied(applied)
+                self.pending_reads.applied(applied)
+                self.nh.engine.set_step_ready(self.cluster_id)
+
+    def _save_snapshot(self, t: Task) -> None:
+        req = t.ss_request
+        # only user-initiated requests may resolve the pending-snapshot slot;
+        # PERIODIC failures must not complete an unrelated user request
+        user_req = req.type in (SSReqType.USER_REQUESTED, SSReqType.EXPORTED)
+        try:
+            try:
+                ss, env = self.sm.save(req)
+            except SnapshotIgnored:
+                if user_req:
+                    self.pending_snapshot.notify(
+                        RequestResult(code=RequestResultCode.REJECTED)
+                    )
+                return
+            except Exception as e:
+                plog.error("%s snapshot save failed: %s", self.describe(), e)
+                if user_req:
+                    self.pending_snapshot.notify(
+                        RequestResult(code=RequestResultCode.ABORTED)
+                    )
+                return
+            if req.exported:
+                self.pending_snapshot.notify(
+                    RequestResult(
+                        code=RequestResultCode.COMPLETED, snapshot_index=ss.index
+                    )
+                )
+                return
+            try:
+                self.snapshotter.commit(ss, env)
+            except FileExistsError:
+                env.remove_tmp_dir()
+                return
+            try:
+                self.logreader.create_snapshot(ss)
+            except Exception as e:
+                plog.warning("%s create_snapshot: %s", self.describe(), e)
+                return
+            self._compact_log(ss, req)
+            self.snapshotter.compact()
+            if req.type == SSReqType.USER_REQUESTED:
+                self.pending_snapshot.notify(
+                    RequestResult(
+                        code=RequestResultCode.COMPLETED, snapshot_index=ss.index
+                    )
+                )
+        finally:
+            if req.type == SSReqType.PERIODIC:
+                self._snapshotting.release()
+
+    def _compact_log(self, ss: Snapshot, req: SSRequest) -> None:
+        """Reference ``node.go:689-716``: keep ``compaction_overhead``
+        entries behind the snapshot."""
+        overhead = (
+            req.compaction_overhead
+            if req.override_compaction_overhead
+            else self.config.compaction_overhead
+        )
+        if ss.index <= overhead:
+            return
+        compact_to = ss.index - overhead
+        try:
+            self.logreader.compact(compact_to)
+        except Exception:
+            return
+        self.logdb.remove_entries_to(self.cluster_id, self.node_id, compact_to)
+
+    def _recover_from_snapshot(self, t: Task) -> None:
+        if t.initial:
+            # restart path: newest local snapshot, if any
+            ss = self.snapshotter.get_most_recent_snapshot()
+            if ss is not None and not ss.is_empty():
+                t = Task(
+                    cluster_id=self.cluster_id,
+                    node_id=self.node_id,
+                    recover=True,
+                    ss=ss,
+                )
+                self.sm.recover(t)
+            if self.sm.on_disk:
+                self.sm.open()
+            self._initialized.set()
+            self.nh.engine.set_step_ready(self.cluster_id)
+            return
+        try:
+            self.sm.recover(t)
+        except Exception as e:
+            plog.error("%s recover failed: %s", self.describe(), e)
+            raise
+        applied = self.sm.get_last_applied()
+        with self.raft_mu:
+            if self.peer is not None:
+                self.peer.notify_raft_last_applied(applied)
+        self.sm.set_batched_last_applied(applied)
+        self.nh.engine.set_step_ready(self.cluster_id)
+
+    # ---- rsm.INodeProxy callbacks ----
+
+    def node_ready(self) -> None:
+        self.nh.engine.set_step_ready(self.cluster_id)
+
+    def apply_update(
+        self,
+        entry: Entry,
+        result: Result,
+        rejected: bool,
+        ignored: bool,
+        notify_read: bool,
+    ) -> None:
+        if not ignored and entry.key:
+            self.pending_proposals.applied(
+                entry.key, entry.client_id, entry.series_id, result, rejected
+            )
+
+    def apply_config_change(
+        self, cc: ConfigChange, key: int, rejected: bool
+    ) -> None:
+        with self.raft_mu:
+            if self.peer is None:
+                return
+            if rejected:
+                self.peer.reject_config_change()
+            else:
+                self.peer.apply_config_change(cc)
+                self._on_config_change_applied(cc)
+        rs = self.pending_config_change.pending()
+        if rs is not None and rs.key == key and key != 0:
+            code = (
+                RequestResultCode.REJECTED
+                if rejected
+                else RequestResultCode.COMPLETED
+            )
+            self.pending_config_change.notify(RequestResult(code=code))
+
+    def _on_config_change_applied(self, cc: ConfigChange) -> None:
+        if cc.type in (
+            ConfigChangeType.ADD_NODE,
+            ConfigChangeType.ADD_OBSERVER,
+            ConfigChangeType.ADD_WITNESS,
+        ):
+            self.nh.node_registry.add(self.cluster_id, cc.node_id, cc.address)
+        elif cc.type == ConfigChangeType.REMOVE_NODE:
+            self.nh.node_registry.remove(self.cluster_id, cc.node_id)
+            if cc.node_id == self.node_id:
+                self._delete_required = True
+
+    def restore_remotes(self, ss: Snapshot) -> None:
+        with self.raft_mu:
+            if self.peer is not None:
+                self.peer.restore_remotes(ss)
+        for nid, addr in ss.membership.addresses.items():
+            if nid != self.node_id:
+                self.nh.node_registry.add(self.cluster_id, nid, addr)
+
+    def should_stop(self) -> bool:
+        return self._stopped.is_set()
+
+    # ---- status / shutdown ----
+
+    def get_membership(self) -> Membership:
+        return self.sm.get_membership()
+
+    def get_leader_id(self):
+        with self.raft_mu:
+            if self.peer is None:
+                return 0, False
+            lid = self.peer.raft.leader_id
+            return lid, lid != 0
+
+    def is_leader(self) -> bool:
+        with self.raft_mu:
+            return self.peer is not None and self.peer.raft.is_leader()
+
+    def describe(self) -> str:
+        return f"node {self.cluster_id}:{self.node_id}"
+
+    def requested_stop(self) -> bool:
+        return self._stopped.is_set()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.sm.stopc.stop()
+        self.entry_q.close()
+        self.mq.close()
+        self.pending_proposals.close()
+        self.pending_reads.close()
+        self.pending_config_change.close()
+        self.pending_snapshot.close()
+        self.pending_leader_transfer.close()
+        self.sm.offloaded()

@@ -1,0 +1,542 @@
+"""NodeHost: the public facade hosting many raft groups in one process.
+
+Reference: ``nodehost.go`` — lifecycle (``NewNodeHost``, ``StartCluster`` ×3
+SM kinds, ``StopCluster``), request APIs (sync/async propose, linearizable
+read, membership changes, snapshots, leader transfer), the cluster registry
+with its change counter, tick fan-out and incoming-message routing.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Tuple
+
+from .client import Session
+from .config import Config, NodeHostConfig
+from .engine import Engine
+from .logdb import LogReader, open_logdb
+from .logger import get_logger
+from .node import Node
+from .raft.peer import PeerAddress
+from .requests import (
+    ClusterAlreadyExistError,
+    ClusterNotFoundError,
+    RejectedError,
+    RequestResult,
+    RequestState,
+    TimeoutError_,
+)
+from .rsm import (
+    SSReqType,
+    SSRequest,
+    StateMachine,
+    from_concurrent_sm,
+    from_on_disk_sm,
+    from_regular_sm,
+)
+from .snapshotter import Snapshotter
+from .statemachine import Result
+from .transport import Registry, Transport, create_transport
+from .wire import (
+    Bootstrap,
+    ConfigChange,
+    ConfigChangeType,
+    Membership,
+    Message,
+    MessageBatch,
+    MessageType,
+    StateMachineType,
+)
+
+plog = get_logger("nodehost")
+
+
+class NodeHost:
+    """Reference ``nodehost.go:246`` ``NodeHost``."""
+
+    def __init__(self, nhconfig: NodeHostConfig):
+        nhconfig.validate()
+        nhconfig.prepare()
+        self.nhconfig = nhconfig
+        self._mu = threading.Lock()
+        self._clusters: Dict[int, Node] = {}
+        self._csi = 0  # cluster-set change counter (reference clusterMu.csi)
+        self._stopped = threading.Event()
+        # storage
+        in_memory = nhconfig.node_host_dir == ":memory:"
+        if nhconfig.logdb_factory is not None:
+            self.logdb = nhconfig.logdb_factory(nhconfig)
+        elif in_memory:
+            self.logdb = open_logdb("", shards=nhconfig.logdb_config.shards)
+        else:
+            self.logdb = open_logdb(
+                os.path.join(self._host_dir(), "logdb"),
+                shards=nhconfig.logdb_config.shards,
+            )
+        # transport
+        self.node_registry = Registry()
+        self.transport: Transport = create_transport(
+            nhconfig,
+            self.node_registry,
+            self._message_router,
+            self._snapshot_status,
+            unreachable_handler=self._unreachable,
+            snapshot_dir_fn=self.snapshot_dir,
+        )
+        # engine
+        expert = nhconfig.expert
+        workers = expert.step_worker_count or 4
+        self.engine = Engine(
+            self._get_nodes,
+            self.logdb,
+            step_workers=workers,
+            apply_workers=workers,
+        )
+        # ticks
+        self._tick_thread = threading.Thread(
+            target=self._tick_worker_main, name="tick-worker", daemon=True
+        )
+        self._tick_thread.start()
+
+    # ---- dirs ----
+
+    def _host_dir(self) -> str:
+        d = os.path.join(
+            self.nhconfig.node_host_dir,
+            self.nhconfig.raft_address.replace(":", "_"),
+        )
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def snapshot_dir(self, cluster_id: int, node_id: int) -> str:
+        if self.nhconfig.node_host_dir == ":memory:":
+            base = os.path.join("/tmp", "dragonboat-tpu-mem", self.raft_address().replace(":", "_"))
+        else:
+            base = self._host_dir()
+        return os.path.join(
+            base, "snapshot", f"{cluster_id:020d}-{node_id:020d}"
+        )
+
+    def raft_address(self) -> str:
+        return self.nhconfig.raft_address
+
+    # ---- cluster registry ----
+
+    def _get_nodes(self) -> Tuple[int, Dict[int, Node]]:
+        with self._mu:
+            return self._csi, dict(self._clusters)
+
+    def get_node(self, cluster_id: int) -> Node:
+        with self._mu:
+            n = self._clusters.get(cluster_id)
+        if n is None:
+            raise ClusterNotFoundError(f"cluster {cluster_id} not found")
+        return n
+
+    def has_cluster(self, cluster_id: int) -> bool:
+        with self._mu:
+            return cluster_id in self._clusters
+
+    # ---- lifecycle (reference StartCluster nodehost.go:440-520,1509) ----
+
+    def start_cluster(
+        self,
+        initial_members: Dict[int, str],
+        join: bool,
+        create_sm: Callable,
+        config: Config,
+    ) -> None:
+        self._start_cluster(
+            initial_members, join, create_sm, config, StateMachineType.REGULAR
+        )
+
+    def start_concurrent_cluster(
+        self, initial_members, join, create_sm, config: Config
+    ) -> None:
+        self._start_cluster(
+            initial_members, join, create_sm, config, StateMachineType.CONCURRENT
+        )
+
+    def start_on_disk_cluster(
+        self, initial_members, join, create_sm, config: Config
+    ) -> None:
+        self._start_cluster(
+            initial_members, join, create_sm, config, StateMachineType.ON_DISK
+        )
+
+    def _start_cluster(
+        self,
+        initial_members: Dict[int, str],
+        join: bool,
+        create_sm: Callable,
+        config: Config,
+        smtype: StateMachineType,
+    ) -> None:
+        config.validate()
+        cluster_id, node_id = config.cluster_id, config.node_id
+        if join and initial_members:
+            raise ValueError("addresses given for a joining node")
+        if not join and not initial_members:
+            raise ValueError("addresses not given for an initial member")
+        with self._mu:
+            if cluster_id in self._clusters:
+                raise ClusterAlreadyExistError(str(cluster_id))
+        # bootstrap record (reference bootstrapCluster nodehost.go:1479)
+        bs = self.logdb.get_bootstrap_info(cluster_id, node_id)
+        new_node = bs is None
+        if bs is None:
+            bs = Bootstrap(
+                addresses=dict(initial_members), join=join, type=int(smtype)
+            )
+            self.logdb.save_bootstrap_info(cluster_id, node_id, bs)
+        elif bs.type not in (int(StateMachineType.UNKNOWN), int(smtype)):
+            raise ValueError("SM type changed across restarts")
+        members = bs.addresses if not bs.join else initial_members
+        # register peer addresses
+        for nid, addr in (members or {}).items():
+            self.node_registry.add(cluster_id, nid, addr)
+        self.node_registry.add(cluster_id, node_id, self.raft_address())
+        # build the node
+        logreader = LogReader.load(cluster_id, node_id, self.logdb)
+        snapshotter = Snapshotter(
+            self.snapshot_dir(cluster_id, node_id), cluster_id, node_id,
+            self.logdb,
+        )
+        usersm = create_sm(cluster_id, node_id)
+        if smtype == StateMachineType.REGULAR:
+            managed = from_regular_sm(usersm)
+        elif smtype == StateMachineType.CONCURRENT:
+            managed = from_concurrent_sm(usersm)
+        else:
+            managed = from_on_disk_sm(usersm)
+        node = Node(
+            nh=self,
+            config=config,
+            logdb=self.logdb,
+            logreader=logreader,
+            snapshotter=snapshotter,
+            sm=None,  # set below (circular)
+            tick_millisecond=self.nhconfig.rtt_millisecond,
+        )
+        sm = StateMachine(
+            managed,
+            snapshotter,
+            node,
+            cluster_id,
+            node_id,
+            ordered_config_change=config.ordered_config_change,
+            is_witness=config.is_witness,
+            snapshot_compression=config.snapshot_compression,
+        )
+        node.sm = sm
+        addresses = [
+            PeerAddress(node_id=nid, address=a) for nid, a in (members or {}).items()
+        ]
+        node.start(addresses, initial=not join and new_node, new_node=new_node)
+        with self._mu:
+            self._clusters[cluster_id] = node
+            self._csi += 1
+        self.engine.set_step_ready(cluster_id)
+
+    def stop_cluster(self, cluster_id: int) -> None:
+        with self._mu:
+            node = self._clusters.pop(cluster_id, None)
+            self._csi += 1
+        if node is None:
+            raise ClusterNotFoundError(str(cluster_id))
+        node.stop()
+
+    def stop_node(self, cluster_id: int, node_id: int) -> None:
+        self.stop_cluster(cluster_id)
+
+    def stop(self) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        with self._mu:
+            nodes = list(self._clusters.values())
+            self._clusters.clear()
+            self._csi += 1
+        for n in nodes:
+            n.stop()
+        self.engine.stop()
+        self.transport.stop()
+        self.logdb.close()
+
+    # ---- proposals / reads (reference SyncPropose :523, SyncRead :548) ----
+
+    def get_noop_session(self, cluster_id: int) -> Session:
+        return Session.noop_session(cluster_id)
+
+    def propose(
+        self, session: Session, cmd: bytes, timeout: float
+    ) -> RequestState:
+        node = self.get_node(session.cluster_id)
+        return node.propose(session, cmd, timeout)
+
+    def sync_propose(
+        self, session: Session, cmd: bytes, timeout: float = 5.0
+    ) -> Result:
+        r = self._sync_retry(
+            lambda t: self.propose(session, cmd, t), timeout
+        )
+        _raise_on_failure(r)
+        if not session.is_noop_session():
+            session.proposal_completed()
+        return r.result
+
+    def read_index(self, cluster_id: int, timeout: float) -> RequestState:
+        return self.get_node(cluster_id).read(timeout)
+
+    def sync_read(self, cluster_id: int, query, timeout: float = 5.0):
+        r = self._sync_retry(
+            lambda t: self.read_index(cluster_id, t), timeout,
+            retry_timeout=True,
+        )
+        _raise_on_failure(r)
+        return self.get_node(cluster_id).sm.lookup(query)
+
+    def _sync_retry(
+        self, submit, timeout: float, retry_timeout: bool = False
+    ) -> RequestResult:
+        """Retry dropped requests until the deadline (reference
+        ``nodehost.go`` execute-on-temporary-error pattern in Sync* APIs).
+
+        ``retry_timeout=True`` additionally splits the budget into short
+        attempts and retries attempts that time out — safe only for
+        idempotent requests (reads): a request forwarded to a dead leader
+        is silently lost and would otherwise burn the whole budget.
+        """
+        deadline = time.monotonic() + timeout
+        attempt_cap = (
+            max(20 * self.nhconfig.rtt_millisecond / 1000.0, 0.25)
+            if retry_timeout
+            else timeout
+        )
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return RequestResult()  # TIMEOUT
+            attempt = min(remaining, attempt_cap)
+            rs = submit(attempt)
+            r = rs.wait(attempt)
+            if r.dropped or (retry_timeout and r.timeout):
+                time.sleep(self.nhconfig.rtt_millisecond / 1000.0)
+                continue
+            return r
+
+    def stale_read(self, cluster_id: int, query):
+        return self.get_node(cluster_id).stale_read(query)
+
+    # ---- sessions (reference SyncGetSession/SyncCloseSession) ----
+
+    def sync_get_session(self, cluster_id: int, timeout: float = 5.0) -> Session:
+        s = Session.new_session(cluster_id)
+        s.prepare_for_register()
+        node = self.get_node(cluster_id)
+        rs = node.propose_session(s, timeout)
+        r = rs.wait(timeout)
+        _raise_on_failure(r)
+        if r.result.value != s.client_id:
+            raise RejectedError("session registration rejected")
+        s.prepare_for_propose()
+        return s
+
+    def sync_close_session(self, s: Session, timeout: float = 5.0) -> None:
+        s.prepare_for_unregister()
+        node = self.get_node(s.cluster_id)
+        rs = node.propose_session(s, timeout)
+        r = rs.wait(timeout)
+        _raise_on_failure(r)
+
+    # ---- membership (reference RequestAddNode :1133 etc.) ----
+
+    def request_add_node(
+        self, cluster_id: int, node_id: int, address: str,
+        config_change_index: int = 0, timeout: float = 5.0,
+    ) -> RequestState:
+        cc = ConfigChange(
+            type=ConfigChangeType.ADD_NODE,
+            node_id=node_id,
+            address=address,
+            config_change_id=config_change_index,
+        )
+        return self.get_node(cluster_id).request_config_change(cc, timeout)
+
+    def request_delete_node(
+        self, cluster_id: int, node_id: int,
+        config_change_index: int = 0, timeout: float = 5.0,
+    ) -> RequestState:
+        cc = ConfigChange(
+            type=ConfigChangeType.REMOVE_NODE,
+            node_id=node_id,
+            config_change_id=config_change_index,
+        )
+        return self.get_node(cluster_id).request_config_change(cc, timeout)
+
+    def request_add_observer(
+        self, cluster_id: int, node_id: int, address: str,
+        config_change_index: int = 0, timeout: float = 5.0,
+    ) -> RequestState:
+        cc = ConfigChange(
+            type=ConfigChangeType.ADD_OBSERVER,
+            node_id=node_id,
+            address=address,
+            config_change_id=config_change_index,
+        )
+        return self.get_node(cluster_id).request_config_change(cc, timeout)
+
+    def request_add_witness(
+        self, cluster_id: int, node_id: int, address: str,
+        config_change_index: int = 0, timeout: float = 5.0,
+    ) -> RequestState:
+        cc = ConfigChange(
+            type=ConfigChangeType.ADD_WITNESS,
+            node_id=node_id,
+            address=address,
+            config_change_id=config_change_index,
+        )
+        return self.get_node(cluster_id).request_config_change(cc, timeout)
+
+    def sync_request_add_node(self, cluster_id, node_id, address,
+                              config_change_index=0, timeout=5.0) -> None:
+        rs = self.request_add_node(
+            cluster_id, node_id, address, config_change_index, timeout
+        )
+        _raise_on_failure(rs.wait(timeout))
+
+    def sync_request_delete_node(self, cluster_id, node_id,
+                                 config_change_index=0, timeout=5.0) -> None:
+        rs = self.request_delete_node(
+            cluster_id, node_id, config_change_index, timeout
+        )
+        _raise_on_failure(rs.wait(timeout))
+
+    def sync_get_cluster_membership(
+        self, cluster_id: int, timeout: float = 5.0
+    ) -> Membership:
+        r = self._sync_retry(
+            lambda t: self.read_index(cluster_id, t), timeout,
+            retry_timeout=True,
+        )
+        _raise_on_failure(r)
+        return self.get_node(cluster_id).get_membership()
+
+    # ---- snapshots / leadership ----
+
+    def request_snapshot(
+        self, cluster_id: int, export_path: str = "",
+        override_compaction_overhead: bool = False,
+        compaction_overhead: int = 0, timeout: float = 5.0,
+    ) -> RequestState:
+        req = SSRequest(
+            type=SSReqType.EXPORTED if export_path else SSReqType.USER_REQUESTED,
+            path=export_path,
+            override_compaction_overhead=override_compaction_overhead,
+            compaction_overhead=compaction_overhead,
+        )
+        return self.get_node(cluster_id).request_snapshot(req, timeout)
+
+    def sync_request_snapshot(self, cluster_id: int, timeout: float = 5.0) -> int:
+        rs = self.request_snapshot(cluster_id, timeout=timeout)
+        r = rs.wait(timeout)
+        _raise_on_failure(r)
+        return r.snapshot_index
+
+    def request_leader_transfer(self, cluster_id: int, target: int) -> None:
+        self.get_node(cluster_id).request_leader_transfer(target, 5.0)
+
+    def get_leader_id(self, cluster_id: int) -> Tuple[int, bool]:
+        return self.get_node(cluster_id).get_leader_id()
+
+    # ---- data management ----
+
+    def remove_data(self, cluster_id: int, node_id: int) -> None:
+        """Reference ``NodeHost.RemoveData``: only valid once the node is
+        stopped."""
+        with self._mu:
+            if cluster_id in self._clusters:
+                raise RuntimeError("cluster still running")
+        self.logdb.remove_node_data(cluster_id, node_id)
+
+    def get_node_user(self, cluster_id: int) -> Node:
+        return self.get_node(cluster_id)
+
+    # ---- message plumbing ----
+
+    def send_message(self, m: Message) -> None:
+        """Route an outbound raft message: local delivery when the target
+        node lives on this host (reference ``nodehost.go:1792``)."""
+        if m.to == 0:
+            return
+        target = self.node_registry.resolve(m.cluster_id, m.to)
+        if target == self.raft_address():
+            node = self._clusters.get(m.cluster_id)
+            if node is not None and node.node_id == m.to:
+                node.handle_message_batch(m)
+            return
+        self.transport.send(m)
+
+    def send_snapshot_message(self, m: Message) -> None:
+        target = self.node_registry.resolve(m.cluster_id, m.to)
+        if target == self.raft_address():
+            node = self._clusters.get(m.cluster_id)
+            if node is not None and node.node_id == m.to:
+                node.handle_message_batch(m)
+                return
+        if not self.transport.send_snapshot(m):
+            self._snapshot_status(m.cluster_id, m.to, True)
+
+    def _message_router(self, batch: MessageBatch) -> None:
+        """Reference ``messageHandler`` ``nodehost.go:2013``."""
+        for m in batch.requests:
+            node = self._clusters.get(m.cluster_id)
+            if node is None or node.node_id != m.to:
+                continue
+            if batch.source_address:
+                # learn the sender's address so replies route before
+                # membership is applied locally (reference nodes.go)
+                self.node_registry.add_remote(
+                    m.cluster_id, m.from_, batch.source_address
+                )
+            node.handle_message_batch(m)
+
+    def _snapshot_status(self, cluster_id: int, node_id: int, failed: bool):
+        node = self._clusters.get(cluster_id)
+        if node is not None:
+            node.handle_snapshot_status(node_id, failed)
+
+    def _unreachable(self, cluster_id: int, node_id: int) -> None:
+        node = self._clusters.get(cluster_id)
+        if node is not None:
+            node.handle_unreachable(node_id)
+
+    # ---- ticks (reference tickWorkerMain nodehost.go:1725) ----
+
+    def _tick_worker_main(self) -> None:
+        interval = self.nhconfig.rtt_millisecond / 1000.0
+        ticks = 0
+        while not self._stopped.wait(interval):
+            ticks += 1
+            with self._mu:
+                nodes = list(self._clusters.values())
+            for n in nodes:
+                n.request_tick()
+            if ticks % max(1, int(1.0 / max(interval, 0.001))) == 0:
+                self.transport.tick()
+
+
+def _raise_on_failure(r: RequestResult) -> None:
+    if r.completed:
+        return
+    if r.timeout:
+        raise TimeoutError_("request timed out")
+    if r.rejected:
+        raise RejectedError("request rejected")
+    if r.dropped:
+        raise RejectedError("request dropped")
+    if r.terminated:
+        raise ClusterNotFoundError("cluster terminated")
+    raise RejectedError(f"request failed: {r.code}")
+

@@ -1,0 +1,121 @@
+"""Input queues between the API layer and the step engine.
+
+Reference: ``queue.go`` — double-buffered ``entryQueue`` for proposals,
+``readIndexQueue`` for reads, and the ``readyCluster`` map pair used by the
+engine's wakeup paths.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set
+
+from .requests import RequestState
+from .wire import Entry
+
+
+class EntryQueue:
+    """Reference ``queue.go:24`` — bounded, double-buffered."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self._mu = threading.Lock()
+        self._left: List[Entry] = []
+        self._right: List[Entry] = []
+        self._use_left = True
+        self._stopped = False
+        self._paused = False
+
+    def _active(self) -> List[Entry]:
+        return self._left if self._use_left else self._right
+
+    def add(self, e: Entry) -> bool:
+        with self._mu:
+            if self._stopped or self._paused:
+                return False
+            q = self._active()
+            if len(q) >= self.size:
+                return False
+            q.append(e)
+            return True
+
+    def get(self, paused: bool = False) -> List[Entry]:
+        with self._mu:
+            self._paused = paused
+            q = self._active()
+            self._use_left = not self._use_left
+            out = list(q)
+            q.clear()
+            return out
+
+    def close(self) -> None:
+        with self._mu:
+            self._stopped = True
+
+
+class ReadIndexQueue:
+    """Reference ``queue.go:110``."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self._mu = threading.Lock()
+        self._reqs: List[RequestState] = []
+        self._stopped = False
+
+    def add(self, rs: RequestState) -> bool:
+        with self._mu:
+            if self._stopped or len(self._reqs) >= self.size:
+                return False
+            self._reqs.append(rs)
+            return True
+
+    def get(self) -> List[RequestState]:
+        with self._mu:
+            out, self._reqs = self._reqs, []
+            return out
+
+    def peep(self) -> bool:
+        with self._mu:
+            return bool(self._reqs)
+
+    def close(self) -> None:
+        with self._mu:
+            self._stopped = True
+
+
+class ReadyCluster:
+    """Set of clusters with pending work, swapped atomically
+    (reference ``queue.go:178`` ``readyCluster``)."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._ready: Set[int] = set()
+
+    def set_ready(self, cluster_id: int) -> None:
+        with self._mu:
+            self._ready.add(cluster_id)
+
+    def get_ready(self) -> Set[int]:
+        with self._mu:
+            out, self._ready = self._ready, set()
+            return out
+
+
+class LeaderInfoQueue:
+    """Reference ``queue.go:213`` — leader change notifications."""
+
+    def __init__(self, size: int = 2048):
+        self.size = size
+        self._mu = threading.Lock()
+        self._q: List = []
+
+    def add(self, info) -> bool:
+        with self._mu:
+            if len(self._q) >= self.size:
+                return False
+            self._q.append(info)
+            return True
+
+    def get(self) -> List:
+        with self._mu:
+            out, self._q = self._q, []
+            return out

@@ -1,0 +1,85 @@
+"""Per-group idle detection.
+
+Reference: ``quiesce.go`` — a group with no message activity for
+10× election ticks enters quiesce: ticks stop generating heartbeats and
+replicas exchange ``Quiesce`` messages; any new activity (or an incoming
+election-class message) exits quiesce and fast-forwards the election tick.
+"""
+from __future__ import annotations
+
+from .settings import Soft
+from .wire import Message, MessageType
+
+MT = MessageType
+
+
+class QuiesceManager:
+    """Reference ``quiesce.go:23`` ``quiesceManager``."""
+
+    def __init__(self, cluster_id: int, node_id: int, election_tick: int,
+                 enabled: bool):
+        self.cluster_id = cluster_id
+        self.node_id = node_id
+        self.enabled = enabled
+        self.election_tick = election_tick
+        self.threshold = election_tick * Soft.quiesce_threshold_factor
+        self.current_tick = 0
+        self.idle_since = 0
+        self.quiesced_since = 0
+        self._quiesced = False
+        self.new_quiesce_trigger = False
+
+    def quiesced(self) -> bool:
+        return self.enabled and self._quiesced
+
+    def increase_quiesce_tick(self) -> int:
+        if not self.enabled:
+            return 0
+        self.current_tick += 1
+        if not self._quiesced:
+            if self.current_tick - self.idle_since > self.threshold:
+                self._quiesced = True
+                self.quiesced_since = self.current_tick
+                self.new_quiesce_trigger = False
+        return self.current_tick
+
+    def record_activity(self, msg_type: MessageType) -> None:
+        if not self.enabled:
+            return
+        if msg_type == MT.HEARTBEAT or msg_type == MT.HEARTBEAT_RESP:
+            if not self._quiesced:
+                return
+        self.idle_since = self.current_tick
+        if self._quiesced:
+            self._exit_quiesce()
+
+    def just_entered_quiesce(self) -> bool:
+        """True exactly once after entering quiesce — the trigger for
+        broadcasting Quiesce messages (reference ``quiesce.go:107``).  Ticks
+        arrive in batches, so any tick past the entry point fires it."""
+        if not self.enabled or not self._quiesced:
+            return False
+        if not self.new_quiesce_trigger and self.current_tick > self.quiesced_since:
+            self.new_quiesce_trigger = True
+            return True
+        return False
+
+    def try_enter_quiesce(self) -> None:
+        """A peer told us it quiesced (reference exchange of Quiesce msgs)."""
+        if self.enabled and not self._quiesced:
+            self._quiesced = True
+            self.quiesced_since = self.current_tick
+            self.idle_since = self.current_tick
+
+    def _exit_quiesce(self) -> None:
+        self._quiesced = False
+
+    def should_handle(self, m: Message) -> bool:
+        """Filter messages while quiesced; activity-bearing ones wake us."""
+        if not self.quiesced():
+            return True
+        if m.type == MT.QUIESCE:
+            self.try_enter_quiesce()
+            return False
+        self.record_activity(m.type)
+        return True

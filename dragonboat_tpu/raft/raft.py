@@ -434,6 +434,9 @@ class Raft:
 
     def send(self, m: Message) -> None:
         m.from_ = self.node_id
+        # stamp the group id so the runtime can route between hosts
+        # (reference raft.go send path sets ClusterId on every message)
+        m.cluster_id = self.cluster_id
         m = self.finalize_message_term(m)
         self.msgs.append(m)
 
@@ -1216,7 +1219,6 @@ class Raft:
 
     def handle_candidate_read_index(self, m: Message) -> None:
         self.report_dropped_read_index(m)
-        self.dropped_read_indexes.append(SystemCtx(low=m.hint, high=m.hint_high))
 
     # receiving Replicate/InstallSnapshot/Heartbeat at equal term implies a
     # leader exists for this term (raft paper §5.2 4th paragraph)
@@ -1260,6 +1262,9 @@ class Raft:
             )
 
     def report_dropped_read_index(self, m: Message) -> None:
+        # record the ctx so the runtime can fail the pending read instead of
+        # letting it sit until timeout (reference reportDroppedReadIndex)
+        self.dropped_read_indexes.append(SystemCtx(low=m.hint, high=m.hint_high))
         if self.events is not None:
             self.events.read_index_dropped(self.cluster_id, self.node_id)
 

@@ -1,0 +1,437 @@
+"""Pending-request tracking: the future/promise layer between user API calls
+and the asynchronous engine.
+
+Reference: ``requests.go`` — pooled ``RequestState`` futures with result
+channels; ``pendingProposal`` sharded 16 ways on a random 64-bit key
+(:446,:943); ``pendingReadIndex`` batching by ``SystemCtx`` (:457);
+single-slot ``pendingConfigChange``/``pendingSnapshot``/
+``pendingLeaderTransfer`` (:471-486); logical-clock GC of timed-out requests.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Dict, List, Optional, Tuple
+
+from .settings import Soft
+from .statemachine import Result
+from .wire import Entry, ReadyToRead, SystemCtx
+
+
+class RequestError(Exception):
+    pass
+
+
+class ClusterNotFoundError(RequestError):
+    pass
+
+
+class ClusterAlreadyExistError(RequestError):
+    pass
+
+
+class ClusterNotReadyError(RequestError):
+    pass
+
+
+class ClusterClosedError(RequestError):
+    pass
+
+
+class SystemBusyError(RequestError):
+    pass
+
+
+class InvalidSessionError(RequestError):
+    pass
+
+
+class TimeoutError_(RequestError):
+    pass
+
+
+class CanceledError(RequestError):
+    pass
+
+
+class RejectedError(RequestError):
+    pass
+
+
+class PendingConfigChangeExistError(RequestError):
+    pass
+
+
+class PendingSnapshotExistError(RequestError):
+    pass
+
+
+class PendingLeaderTransferExistError(RequestError):
+    pass
+
+
+class RequestResultCode(IntEnum):
+    TIMEOUT = 0
+    COMPLETED = 1
+    TERMINATED = 2
+    REJECTED = 3
+    DROPPED = 4
+    ABORTED = 5
+    COMMITTED = 6
+
+
+@dataclass
+class RequestResult:
+    code: RequestResultCode = RequestResultCode.TIMEOUT
+    result: Result = field(default_factory=Result)
+    snapshot_index: int = 0
+
+    @property
+    def completed(self) -> bool:
+        return self.code == RequestResultCode.COMPLETED
+
+    @property
+    def rejected(self) -> bool:
+        return self.code == RequestResultCode.REJECTED
+
+    @property
+    def timeout(self) -> bool:
+        return self.code == RequestResultCode.TIMEOUT
+
+    @property
+    def terminated(self) -> bool:
+        return self.code == RequestResultCode.TERMINATED
+
+    @property
+    def dropped(self) -> bool:
+        return self.code == RequestResultCode.DROPPED
+
+
+class RequestState:
+    """Reference ``requests.go:267`` ``RequestState`` — a one-shot future."""
+
+    __slots__ = (
+        "key",
+        "client_id",
+        "series_id",
+        "deadline",
+        "_event",
+        "_result",
+        "read_index",
+    )
+
+    def __init__(self, key: int = 0, deadline: int = 0):
+        self.key = key
+        self.client_id = 0
+        self.series_id = 0
+        self.deadline = deadline
+        self._event = threading.Event()
+        self._result: Optional[RequestResult] = None
+        self.read_index = 0
+
+    def notify(self, result: RequestResult) -> None:
+        self._result = result
+        self._event.set()
+
+    def wait(self, timeout: Optional[float] = None) -> RequestResult:
+        if not self._event.wait(timeout):
+            return RequestResult(code=RequestResultCode.TIMEOUT)
+        assert self._result is not None
+        return self._result
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def result(self) -> Optional[RequestResult]:
+        return self._result
+
+
+class _LogicalClock:
+    """Reference ``requests.go:216`` ``logicalClock``."""
+
+    def __init__(self) -> None:
+        self.tick = 0
+
+    def advance(self) -> None:
+        self.tick += 1
+
+
+class PendingProposal:
+    """Sharded proposal tracker (reference ``requests.go:446,943``)."""
+
+    def __init__(self, shards: int = 0, rng: Optional[random.Random] = None):
+        self.nshards = shards or Soft.pending_proposal_shards
+        self._shards: List[Dict[int, RequestState]] = [
+            {} for _ in range(self.nshards)
+        ]
+        self._locks = [threading.Lock() for _ in range(self.nshards)]
+        self._clock = _LogicalClock()
+        self._rng = rng or random.Random()
+        self._stopped = False
+
+    def _next_key(self) -> int:
+        return self._rng.getrandbits(64) or 1
+
+    def propose(
+        self, client_id: int, series_id: int, cmd: bytes, timeout_ticks: int
+    ) -> Tuple[RequestState, Entry]:
+        if self._stopped:
+            raise ClusterClosedError()
+        key = self._next_key()
+        rs = RequestState(key=key, deadline=self._clock.tick + timeout_ticks)
+        rs.client_id = client_id
+        rs.series_id = series_id
+        shard = key % self.nshards
+        with self._locks[shard]:
+            self._shards[shard][key] = rs
+        entry = Entry(
+            key=key, client_id=client_id, series_id=series_id, cmd=cmd
+        )
+        return rs, entry
+
+    def applied(
+        self,
+        key: int,
+        client_id: int,
+        series_id: int,
+        result: Result,
+        rejected: bool,
+    ) -> None:
+        """Completion from the apply path (reference ``requests.go:1155``)."""
+        shard = key % self.nshards
+        with self._locks[shard]:
+            rs = self._shards[shard].get(key)
+            if rs is None:
+                return
+            if rs.client_id != client_id or rs.series_id != series_id:
+                return
+            del self._shards[shard][key]
+        code = (
+            RequestResultCode.REJECTED if rejected else RequestResultCode.COMPLETED
+        )
+        rs.notify(RequestResult(code=code, result=result))
+
+    def dropped(self, key: int) -> None:
+        shard = key % self.nshards
+        with self._locks[shard]:
+            rs = self._shards[shard].pop(key, None)
+        if rs is not None:
+            rs.notify(RequestResult(code=RequestResultCode.DROPPED))
+
+    def close(self) -> None:
+        self._stopped = True
+        for shard, lock in zip(self._shards, self._locks):
+            with lock:
+                for rs in shard.values():
+                    rs.notify(RequestResult(code=RequestResultCode.TERMINATED))
+                shard.clear()
+
+    def tick(self) -> None:
+        self._clock.advance()
+        now = self._clock.tick
+        for shard, lock in zip(self._shards, self._locks):
+            timed_out = []
+            with lock:
+                for key, rs in list(shard.items()):
+                    if rs.deadline < now:
+                        timed_out.append(rs)
+                        del shard[key]
+            for rs in timed_out:
+                rs.notify(RequestResult(code=RequestResultCode.TIMEOUT))
+
+
+class PendingReadIndex:
+    """ReadIndex batching tracker (reference ``requests.go:457,782``)."""
+
+    def __init__(self, rng: Optional[random.Random] = None):
+        self._mu = threading.Lock()
+        self._rng = rng or random.Random()
+        # requests waiting to be batched into the next ReadIndex
+        self._pending: List[RequestState] = []
+        # ctx → batch already submitted to raft
+        self._batches: Dict[SystemCtx, List[RequestState]] = {}
+        # confirmed (index known) but waiting for apply to catch up
+        self._confirmed: List[Tuple[int, RequestState]] = []
+        self._clock = _LogicalClock()
+        self._stopped = False
+
+    def read(self, timeout_ticks: int) -> RequestState:
+        if self._stopped:
+            raise ClusterClosedError()
+        rs = RequestState(deadline=self._clock.tick + timeout_ticks)
+        with self._mu:
+            self._pending.append(rs)
+        return rs
+
+    def peep(self) -> bool:
+        with self._mu:
+            return bool(self._pending)
+
+    def next_ctx(self) -> SystemCtx:
+        return SystemCtx(
+            low=self._rng.getrandbits(64), high=self._rng.getrandbits(64) or 1
+        )
+
+    def take_pending(self, ctx: SystemCtx) -> bool:
+        """Move queued requests into a submitted batch keyed by ``ctx``."""
+        with self._mu:
+            if not self._pending:
+                return False
+            self._batches[ctx] = self._pending
+            self._pending = []
+            return True
+
+    def add_ready(self, readies: List[ReadyToRead]) -> None:
+        """Raft confirmed these contexts at an index
+        (reference ``requests.go:821``)."""
+        if not readies:
+            return
+        with self._mu:
+            for r in readies:
+                batch = self._batches.pop(r.system_ctx, None)
+                if batch is None:
+                    continue
+                for rs in batch:
+                    rs.read_index = r.index
+                    self._confirmed.append((r.index, rs))
+
+    def applied(self, applied_index: int) -> None:
+        """Apply watermark moved; complete reads whose index is covered
+        (reference ``requests.go:868``)."""
+        done: List[RequestState] = []
+        with self._mu:
+            if not self._confirmed:
+                return
+            keep = []
+            for idx, rs in self._confirmed:
+                if idx <= applied_index:
+                    done.append(rs)
+                else:
+                    keep.append((idx, rs))
+            self._confirmed = keep
+        for rs in done:
+            rs.notify(RequestResult(code=RequestResultCode.COMPLETED))
+
+    def dropped(self, ctxs: List[SystemCtx]) -> None:
+        with self._mu:
+            batches = [self._batches.pop(c, None) for c in ctxs]
+        for batch in batches:
+            if batch:
+                for rs in batch:
+                    rs.notify(RequestResult(code=RequestResultCode.DROPPED))
+
+    def close(self) -> None:
+        self._stopped = True
+        with self._mu:
+            all_rs = list(self._pending)
+            self._pending = []
+            for batch in self._batches.values():
+                all_rs.extend(batch)
+            self._batches.clear()
+            all_rs.extend(rs for _, rs in self._confirmed)
+            self._confirmed = []
+        for rs in all_rs:
+            rs.notify(RequestResult(code=RequestResultCode.TERMINATED))
+
+    def tick(self) -> None:
+        self._clock.advance()
+        now = self._clock.tick
+        timed_out: List[RequestState] = []
+        with self._mu:
+            self._pending, expired = (
+                [rs for rs in self._pending if rs.deadline >= now],
+                [rs for rs in self._pending if rs.deadline < now],
+            )
+            timed_out.extend(expired)
+            for ctx in list(self._batches):
+                batch = self._batches[ctx]
+                live = [rs for rs in batch if rs.deadline >= now]
+                dead = [rs for rs in batch if rs.deadline < now]
+                timed_out.extend(dead)
+                if live:
+                    self._batches[ctx] = live
+                else:
+                    del self._batches[ctx]
+            keep = []
+            for idx, rs in self._confirmed:
+                if rs.deadline < now:
+                    timed_out.append(rs)
+                else:
+                    keep.append((idx, rs))
+            self._confirmed = keep
+        for rs in timed_out:
+            rs.notify(RequestResult(code=RequestResultCode.TIMEOUT))
+
+
+class _SingleSlot:
+    """Single-in-flight request trackers (reference ``requests.go:471-486``)."""
+
+    exist_error = RequestError
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._pending: Optional[RequestState] = None
+        self._payload: Optional[object] = None
+        self._clock = _LogicalClock()
+        self._stopped = False
+
+    def request(self, payload, timeout_ticks: int) -> RequestState:
+        if self._stopped:
+            raise ClusterClosedError()
+        with self._mu:
+            if self._pending is not None:
+                raise self.exist_error()
+            rs = RequestState(
+                key=random.getrandbits(64),
+                deadline=self._clock.tick + timeout_ticks,
+            )
+            self._pending = rs
+            self._payload = payload
+            return rs
+
+    def take(self):
+        with self._mu:
+            p, self._payload = self._payload, None
+            return p
+
+    def pending(self) -> Optional[RequestState]:
+        with self._mu:
+            return self._pending
+
+    def notify(self, result: RequestResult) -> None:
+        with self._mu:
+            rs, self._pending = self._pending, None
+            self._payload = None
+        if rs is not None:
+            rs.notify(result)
+
+    def close(self) -> None:
+        self._stopped = True
+        self.notify(RequestResult(code=RequestResultCode.TERMINATED))
+
+    def tick(self) -> None:
+        self._clock.advance()
+        with self._mu:
+            rs = self._pending
+            if rs is not None and rs.deadline < self._clock.tick:
+                self._pending = None
+                self._payload = None
+            else:
+                rs = None
+        if rs is not None:
+            rs.notify(RequestResult(code=RequestResultCode.TIMEOUT))
+
+
+class PendingConfigChange(_SingleSlot):
+    exist_error = PendingConfigChangeExistError
+
+
+class PendingSnapshot(_SingleSlot):
+    exist_error = PendingSnapshotExistError
+
+
+class PendingLeaderTransfer(_SingleSlot):
+    exist_error = PendingLeaderTransferExistError

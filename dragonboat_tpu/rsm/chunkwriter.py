@@ -1,0 +1,134 @@
+"""ChunkWriter: stream a snapshot image directly into transport chunks.
+
+Reference: ``internal/rsm/chunkwriter.go:35`` — on-disk SMs stream their
+state to a slow follower without materializing a file; the byte stream is a
+valid snapshot image (header + crc'd blocks) so the receiver's assembled
+file can be opened by the normal :class:`SnapshotReader`.
+
+Because the aggregate payload crc cannot be known before streaming starts,
+streamed images set ``checksum_type = STREAMED`` in the header and rely on
+the per-block crcs (the reference's v2 format solves the same problem with
+tail checksums).  The total chunk count is equally unknown, so the final
+chunk carries the ``LAST_CHUNK_COUNT`` sentinel.
+"""
+from __future__ import annotations
+
+import struct
+import zlib
+
+from ..settings import Hard, Soft
+from ..wire import Chunk, LAST_CHUNK_COUNT
+from ..server.snapshotenv import snapshot_dir_name
+from .snapshotio import (
+    _BLOCK_HDR,
+    _HEADER_CRC_OFF,
+    _HEADER_FMT,
+    BLOCK_SIZE,
+    CKS_STREAMED,
+    MAGIC,
+    V2,
+)
+
+
+class ChunkWriter:
+    """File-like writer emitting transport chunks (reference
+    ``chunkwriter.go``).
+
+    ``sink.receive(chunk) -> bool`` consumes chunks; the last one is marked
+    with the ``LAST_CHUNK_COUNT`` sentinel so ``Chunk.is_last_chunk()`` is
+    true on the receiving tracker.
+    """
+
+    def __init__(
+        self,
+        sink,
+        meta,
+        cluster_id: int,
+        node_id: int,
+        from_node_id: int,
+        deployment_id: int,
+    ):
+        self.sink = sink
+        self.meta = meta
+        self.cluster_id = cluster_id
+        self.node_id = node_id
+        self.from_node_id = from_node_id
+        self.deployment_id = deployment_id
+        self._chunk_buf = bytearray()
+        self._block_buf = bytearray()
+        self._chunk_id = 0
+        self._finalized = False
+        self.total = 0
+        self._write_header()
+
+    # ---- snapshot-image framing ----
+
+    def _write_header(self) -> None:
+        header = bytearray(Hard.snapshot_header_size)
+        _HEADER_FMT.pack_into(header, 0, MAGIC, V2, CKS_STREAMED, 0, 0, 0)
+        hcrc = zlib.crc32(bytes(header[:_HEADER_CRC_OFF]))
+        struct.pack_into("<I", header, _HEADER_CRC_OFF, hcrc)
+        self._emit(bytes(header))
+
+    def write_session(self, data: bytes) -> None:
+        # streamed images carry no session store (on-disk SMs only)
+        if data:
+            raise ValueError("streamed snapshots cannot carry sessions")
+
+    def write(self, data: bytes) -> int:
+        self._block_buf += data
+        self.total += len(data)
+        while len(self._block_buf) >= BLOCK_SIZE:
+            self._emit_block(self._block_buf[:BLOCK_SIZE])
+            del self._block_buf[:BLOCK_SIZE]
+        return len(data)
+
+    def _emit_block(self, block) -> None:
+        crc = zlib.crc32(bytes(block))
+        self._emit(_BLOCK_HDR.pack(len(block), crc) + bytes(block))
+
+    # ---- chunk framing ----
+
+    def _emit(self, data: bytes) -> None:
+        self._chunk_buf += data
+        while len(self._chunk_buf) >= Soft.snapshot_chunk_size:
+            self._send_chunk(
+                bytes(self._chunk_buf[: Soft.snapshot_chunk_size]), False
+            )
+            del self._chunk_buf[: Soft.snapshot_chunk_size]
+
+    def _make_chunk(self, data: bytes, last: bool) -> Chunk:
+        c = Chunk(
+            cluster_id=self.cluster_id,
+            node_id=self.node_id,
+            from_=self.from_node_id,
+            chunk_id=self._chunk_id,
+            chunk_size=len(data),
+            chunk_count=LAST_CHUNK_COUNT if last else 0,
+            data=data,
+            index=self.meta.index,
+            term=self.meta.term,
+            membership=self.meta.membership,
+            filepath=f"{snapshot_dir_name(self.meta.index)}.ss",
+            deployment_id=self.deployment_id,
+            file_chunk_id=self._chunk_id,
+            file_chunk_count=0,
+            on_disk_index=self.meta.on_disk_index,
+        )
+        return c
+
+    def _send_chunk(self, data: bytes, last: bool) -> None:
+        c = self._make_chunk(data, last)
+        self._chunk_id += 1
+        if not self.sink.receive(c):
+            raise RuntimeError("chunk sink failed")
+
+    def finalize(self) -> None:
+        if self._finalized:
+            return
+        if self._block_buf:
+            self._emit_block(self._block_buf)
+            self._block_buf.clear()
+        self._send_chunk(bytes(self._chunk_buf), True)
+        self._chunk_buf.clear()
+        self._finalized = True

@@ -33,6 +33,12 @@ _HEADER_CRC_OFF = 1020
 
 EMPTY_PAYLOAD_CRC = 0
 
+# checksum_type header values: DEFAULT has the aggregate payload crc in the
+# header; STREAMED images (ChunkWriter) rely on per-block crcs because the
+# aggregate cannot be known before streaming starts
+CKS_DEFAULT = 0
+CKS_STREAMED = 1
+
 
 class SnapshotFormatError(ValueError):
     pass
@@ -146,12 +152,13 @@ class SnapshotWriter:
             self._closed = True
 
 
-def read_header(f: BinaryIO) -> Tuple[int, int, int]:
-    """Returns (session_size, payload_crc, version); validates header crc."""
+def read_header(f: BinaryIO) -> Tuple[int, int, int, int]:
+    """Returns (session_size, payload_crc, version, checksum_type);
+    validates the header crc."""
     header = f.read(Hard.snapshot_header_size)
     if len(header) != Hard.snapshot_header_size:
         raise SnapshotFormatError("truncated snapshot header")
-    magic, ver, _cks, _comp, session_size, payload_crc = _HEADER_FMT.unpack_from(
+    magic, ver, cks, _comp, session_size, payload_crc = _HEADER_FMT.unpack_from(
         header, 0
     )
     if magic != MAGIC:
@@ -161,7 +168,7 @@ def read_header(f: BinaryIO) -> Tuple[int, int, int]:
     (hcrc,) = struct.unpack_from("<I", header, _HEADER_CRC_OFF)
     if zlib.crc32(header[:_HEADER_CRC_OFF]) != hcrc:
         raise SnapshotFormatError("corrupted snapshot header")
-    return session_size, payload_crc, ver
+    return session_size, payload_crc, ver, cks
 
 
 class SnapshotReader:
@@ -170,7 +177,12 @@ class SnapshotReader:
     def __init__(self, path: str):
         self.path = path
         self._f = open(path, "rb")
-        self.session_size, self.payload_crc, self.version = read_header(self._f)
+        (
+            self.session_size,
+            self.payload_crc,
+            self.version,
+            self.checksum_type,
+        ) = read_header(self._f)
         self._br = BlockReader(self._f)
 
     def read_session(self) -> bytes:
@@ -180,8 +192,11 @@ class SnapshotReader:
         return self._br.read(n)
 
     def validate_payload(self) -> None:
-        self._br.read(-1)  # drain
-        if self._br.checksum() != self.payload_crc:
+        self._br.read(-1)  # drain; per-block crcs verified as a side effect
+        if (
+            self.checksum_type != CKS_STREAMED
+            and self._br.checksum() != self.payload_crc
+        ):
             raise SnapshotFormatError("snapshot payload checksum mismatch")
 
     def close(self) -> None:

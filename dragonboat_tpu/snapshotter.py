@@ -1,0 +1,170 @@
+"""Snapshotter: snapshot directory/record lifecycle for one replica.
+
+Reference: ``snapshotter.go`` — owns the per-node snapshot root dir,
+produces snapshots through :class:`SSEnv` temp dirs, commits records to the
+LogDB, keeps the 3 newest snapshots (``snapshotter.go:34``), shrinks old
+images and garbage-collects orphaned dirs left behind by crashes.
+Implements the RSM layer's ``ISnapshotter`` contract.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from .logger import get_logger
+from .rsm.snapshotio import SnapshotReader, SnapshotWriter, shrink_snapshot
+from .rsm.statemachine import SSMeta
+from .server.snapshotenv import (
+    SSEnv,
+    SSMode,
+    _rmtree,
+    is_final_snapshot_dir,
+    is_temp_snapshot_dir,
+    snapshot_index_from_dir,
+)
+from .wire import Snapshot
+
+plog = get_logger("snapshotter")
+
+SNAPSHOTS_TO_KEEP = 3
+
+
+class NoSnapshotError(Exception):
+    pass
+
+
+class Snapshotter:
+    """Reference ``snapshotter.go:57``."""
+
+    def __init__(self, root_dir: str, cluster_id: int, node_id: int, logdb):
+        self.root_dir = root_dir
+        self.cluster_id = cluster_id
+        self.node_id = node_id
+        self.logdb = logdb
+        os.makedirs(root_dir, exist_ok=True)
+
+    # ---- ISnapshotter ----
+
+    def save(self, savable, meta: SSMeta) -> Tuple[Snapshot, SSEnv]:
+        """Write a snapshot image into a temp dir (reference
+        ``snapshotter.go:103-150`` ``Save``)."""
+        env = SSEnv(self.root_dir, meta.index, self.node_id, SSMode.SNAPSHOT)
+        env.remove_tmp_dir()
+        env.create_tmp_dir()
+        path = env.get_tmp_filepath()
+        w = SnapshotWriter(path)
+        try:
+            savable.save_snapshot_payload(meta, w)
+            w.finalize()
+        except Exception:
+            w.abort()
+            env.remove_tmp_dir()
+            raise
+        ss = Snapshot(
+            filepath=env.get_filepath(),
+            file_size=os.path.getsize(path),
+            index=meta.index,
+            term=meta.term,
+            membership=meta.membership,
+            cluster_id=self.cluster_id,
+            type=meta.type,
+            on_disk_index=meta.on_disk_index,
+            witness=False,
+        )
+        env.save_ss_metadata(ss)
+        return ss, env
+
+    def commit(self, ss: Snapshot, env: SSEnv) -> None:
+        """Promote temp → final and record in the LogDB (reference
+        ``snapshotter.go:181`` ``Commit``)."""
+        env.finalize_snapshot()
+        self.logdb.save_snapshot(self.cluster_id, self.node_id, ss)
+
+    def recover(self, recoverable, ss: Snapshot) -> None:
+        """Reference ``snapshotter.go`` recover path: open + validate the
+        image and hand the payload to the RSM."""
+        r = SnapshotReader(ss.filepath)
+        try:
+            recoverable.recover_from_payload(ss, r)
+        finally:
+            r.close()
+
+    def stream(
+        self, streamable, meta: SSMeta, sink, to_node_id: int,
+        deployment_id: int,
+    ) -> None:
+        from .rsm.chunkwriter import ChunkWriter
+
+        cw = ChunkWriter(
+            sink, meta, self.cluster_id, to_node_id, self.node_id,
+            deployment_id,
+        )
+        streamable.save_snapshot_payload(meta, cw)
+        cw.finalize()
+
+    def get_snapshot(self, index: int = 0) -> Snapshot:
+        snapshots = self.logdb.list_snapshots(self.cluster_id, self.node_id)
+        if index == 0:
+            if not snapshots:
+                raise NoSnapshotError()
+            return snapshots[-1]
+        for ss in snapshots:
+            if ss.index == index:
+                return ss
+        raise NoSnapshotError()
+
+    def get_most_recent_snapshot(self) -> Optional[Snapshot]:
+        snapshots = self.logdb.list_snapshots(self.cluster_id, self.node_id)
+        return snapshots[-1] if snapshots else None
+
+    def is_no_snapshot_error(self, e: Exception) -> bool:
+        return isinstance(e, NoSnapshotError)
+
+    # ---- retention / GC ----
+
+    def compact(self, keep: int = SNAPSHOTS_TO_KEEP) -> None:
+        """Drop all but the ``keep`` newest snapshot records + dirs
+        (reference ``snapshotter.go`` ``Compact``)."""
+        snapshots = self.logdb.list_snapshots(self.cluster_id, self.node_id)
+        for ss in snapshots[:-keep] if keep else snapshots:
+            self.logdb.delete_snapshot(self.cluster_id, self.node_id, ss.index)
+            self._remove_snapshot_dir(ss.index)
+
+    def shrink(self, shrink_to: int) -> None:
+        """Shrink images older than ``shrink_to`` (reference
+        ``snapshotter.go`` ``Shrink``) — used by on-disk SMs whose old full
+        images are dead weight."""
+        for ss in self.logdb.list_snapshots(self.cluster_id, self.node_id):
+            if ss.index > shrink_to or ss.witness or ss.dummy:
+                continue
+            if not os.path.exists(ss.filepath):
+                continue
+            tmp = ss.filepath + ".shrinking"
+            shrink_snapshot(ss.filepath, tmp)
+            os.replace(tmp, ss.filepath)
+
+    def process_orphans(self) -> None:
+        """Remove temp dirs and unrecorded final dirs left by crashes
+        (reference ``snapshotter.go:393-408`` ``ProcessOrphans``)."""
+        recorded = {
+            ss.index
+            for ss in self.logdb.list_snapshots(self.cluster_id, self.node_id)
+        }
+        try:
+            names = os.listdir(self.root_dir)
+        except OSError:
+            return
+        for name in names:
+            full = os.path.join(self.root_dir, name)
+            if is_temp_snapshot_dir(name):
+                plog.info("removing orphaned temp dir %s", full)
+                _rmtree(full)
+            elif is_final_snapshot_dir(name):
+                if snapshot_index_from_dir(name) not in recorded:
+                    plog.info("removing unrecorded snapshot dir %s", full)
+                    _rmtree(full)
+
+    def _remove_snapshot_dir(self, index: int) -> None:
+        env = SSEnv(self.root_dir, index, self.node_id, SSMode.SNAPSHOT)
+        env.remove_final_dir()
+

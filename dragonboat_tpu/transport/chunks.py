@@ -157,6 +157,13 @@ class Chunks:
             t.file = None
 
     def _finalize(self, t: _Track, last: Chunk) -> Message:
+        if t.file is not None:
+            # streamed transfers don't frame per-file boundaries; close on
+            # the sentinel-marked last chunk
+            t.file.flush()
+            os.fsync(t.file.fileno())
+            t.file.close()
+            t.file = None
         first = t.first_chunk
         final_dir = t.env.get_final_dir()
         main_path = os.path.join(final_dir, os.path.basename(first.filepath))

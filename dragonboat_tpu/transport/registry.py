@@ -15,6 +15,10 @@ class Registry:
     def __init__(self) -> None:
         self._mu = threading.Lock()
         self._addr: Dict[Tuple[int, int], str] = {}
+        # addresses learned from incoming batches' source_address — lets a
+        # joining node reply before membership is applied (reference
+        # nodes.go AddRemoteAddress)
+        self._remote: Dict[Tuple[int, int], str] = {}
 
     def add(self, cluster_id: int, node_id: int, address: str) -> None:
         with self._mu:
@@ -25,6 +29,10 @@ class Registry:
                     f"{existing} vs {address}"
                 )
             self._addr[(cluster_id, node_id)] = address
+
+    def add_remote(self, cluster_id: int, node_id: int, address: str) -> None:
+        with self._mu:
+            self._remote[(cluster_id, node_id)] = address
 
     def remove(self, cluster_id: int, node_id: int) -> None:
         with self._mu:
@@ -37,7 +45,10 @@ class Registry:
 
     def resolve(self, cluster_id: int, node_id: int) -> Optional[str]:
         with self._mu:
-            return self._addr.get((cluster_id, node_id))
+            addr = self._addr.get((cluster_id, node_id))
+            if addr is None:
+                addr = self._remote.get((cluster_id, node_id))
+            return addr
 
     def reverse_resolve(self, address: str) -> List[Tuple[int, int]]:
         with self._mu:

@@ -317,7 +317,13 @@ class Chunk:
     witness: bool = False
 
     def is_last_chunk(self) -> bool:
-        return self.chunk_id + 1 == self.chunk_count
+        # streamed transfers don't know the total count upfront: the final
+        # chunk carries the LAST_CHUNK_COUNT sentinel instead (reference
+        # raftpb/raft.go LastChunkCount)
+        return (
+            self.chunk_id + 1 == self.chunk_count
+            or self.chunk_count == LAST_CHUNK_COUNT
+        )
 
     def is_last_file_chunk(self) -> bool:
         return self.file_chunk_id + 1 == self.file_chunk_count

@@ -1,0 +1,252 @@
+"""End-to-end multi-replica NodeHost tests.
+
+Reference model: ``nodehost_test.go`` — several NodeHosts in one process,
+wired through the in-memory chan transport (the memfs test build's setup),
+exercising propose / linearizable read / membership / snapshot / restart.
+"""
+import os
+import time
+
+import pytest
+
+from dragonboat_tpu import (
+    Config,
+    IStateMachine,
+    NodeHost,
+    NodeHostConfig,
+    Result,
+)
+from dragonboat_tpu.transport import ChanRouter, ChanTransport
+
+RTT_MS = 5
+
+
+class KVSM(IStateMachine):
+    """cmd ``b"k=v"`` sets, lookup returns the value."""
+
+    def __init__(self, cluster_id, node_id):
+        self.kv = {}
+        self.count = 0
+
+    def update(self, cmd):
+        k, v = cmd.decode().split("=", 1)
+        self.kv[k] = v
+        self.count += 1
+        return Result(value=self.count)
+
+    def lookup(self, query):
+        return self.kv.get(query)
+
+    def save_snapshot(self, w, files, done):
+        data = repr(sorted(self.kv.items())).encode()
+        w.write(len(data).to_bytes(8, "little") + data)
+
+    def recover_from_snapshot(self, r, files, done):
+        import ast
+
+        n = int.from_bytes(r.read(8), "little")
+        self.kv = dict(ast.literal_eval(r.read(n).decode()))
+        self.count = len(self.kv)
+
+
+def make_nodehost(addr, router, tmpdir=None, **cfg_kw):
+    def rpc_factory(src, rh, ch):
+        return ChanTransport(src, rh, ch, router=router)
+
+    nhc = NodeHostConfig(
+        node_host_dir=tmpdir or ":memory:",
+        rtt_millisecond=RTT_MS,
+        raft_address=addr,
+        raft_rpc_factory=rpc_factory,
+        **cfg_kw,
+    )
+    return NodeHost(nhc)
+
+
+def group_config(cluster_id, node_id, **kw):
+    defaults = dict(
+        cluster_id=cluster_id,
+        node_id=node_id,
+        election_rtt=10,
+        heartbeat_rtt=1,
+        check_quorum=False,
+        snapshot_entries=0,
+    )
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+def wait_for_leader(nhs, cluster_id, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        for nh in nhs:
+            try:
+                lid, ok = nh.get_leader_id(cluster_id)
+                if ok:
+                    return lid
+            except Exception:
+                pass
+        time.sleep(0.02)
+    raise AssertionError("no leader elected")
+
+
+@pytest.fixture
+def cluster3():
+    router = ChanRouter()
+    addrs = {i: f"nh{i}:1" for i in (1, 2, 3)}
+    nhs = [make_nodehost(addrs[i], router) for i in (1, 2, 3)]
+    sms = {}
+
+    def create_sm_for(nh_idx):
+        def create(cluster_id, node_id):
+            sm = KVSM(cluster_id, node_id)
+            sms[node_id] = sm
+            return sm
+
+        return create
+
+    for i, nh in enumerate(nhs, start=1):
+        nh.start_cluster(addrs, False, create_sm_for(i), group_config(100, i))
+    yield nhs, sms, addrs, router
+    for nh in nhs:
+        nh.stop()
+
+
+def test_single_replica_propose_and_read():
+    router = ChanRouter()
+    nh = make_nodehost("solo:1", router)
+    try:
+        nh.start_cluster(
+            {1: "solo:1"}, False,
+            lambda c, n: KVSM(c, n), group_config(5, 1),
+        )
+        wait_for_leader([nh], 5)
+        s = nh.get_noop_session(5)
+        r = nh.sync_propose(s, b"a=1", timeout=5.0)
+        assert r.value == 1
+        assert nh.sync_read(5, "a", timeout=5.0) == "1"
+        assert nh.stale_read(5, "a") == "1"
+    finally:
+        nh.stop()
+
+
+def test_three_replicas_propose_read(cluster3):
+    nhs, sms, addrs, _ = cluster3
+    wait_for_leader(nhs, 100)
+    s = nhs[0].get_noop_session(100)
+    for i in range(10):
+        nhs[0].sync_propose(s, f"k{i}=v{i}".encode(), timeout=5.0)
+    # linearizable read from every replica
+    for nh in nhs:
+        assert nh.sync_read(100, "k9", timeout=5.0) == "v9"
+    # all replicas converge to the same state
+    time.sleep(0.3)
+    assert sms[1].kv == sms[2].kv == sms[3].kv
+
+
+def test_propose_on_follower_forwards_to_leader(cluster3):
+    nhs, sms, addrs, _ = cluster3
+    lid = wait_for_leader(nhs, 100)
+    follower_nh = nhs[0 if lid != 1 else 1]
+    s = follower_nh.get_noop_session(100)
+    r = follower_nh.sync_propose(s, b"fwd=yes", timeout=5.0)
+    assert r.value >= 1
+    assert follower_nh.sync_read(100, "fwd", timeout=5.0) == "yes"
+
+
+def test_session_exactly_once(cluster3):
+    nhs, sms, addrs, _ = cluster3
+    wait_for_leader(nhs, 100)
+    s = nhs[0].sync_get_session(100, timeout=5.0)
+    r1 = nhs[0].sync_propose(s, b"x=1", timeout=5.0)
+    assert r1.value == 1
+    nhs[0].sync_close_session(s, timeout=5.0)
+
+
+def test_membership_query_and_leader_transfer(cluster3):
+    nhs, sms, addrs, _ = cluster3
+    lid = wait_for_leader(nhs, 100)
+    m = nhs[0].sync_get_cluster_membership(100, timeout=5.0)
+    assert set(m.addresses) == {1, 2, 3}
+    target = 1 if lid != 1 else 2
+    nhs[0].request_leader_transfer(100, target)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        nlid, ok = nhs[target - 1].get_leader_id(100)
+        if ok and nlid == target:
+            break
+        time.sleep(0.02)
+    else:
+        raise AssertionError("leader transfer did not happen")
+
+
+def test_snapshot_and_restart(tmp_path):
+    router = ChanRouter()
+    d = str(tmp_path / "nh")
+    nh = make_nodehost("solo:1", router, tmpdir=d)
+    try:
+        nh.start_cluster(
+            {1: "solo:1"}, False, lambda c, n: KVSM(c, n),
+            group_config(7, 1, snapshot_entries=0, compaction_overhead=2),
+        )
+        wait_for_leader([nh], 7)
+        s = nh.get_noop_session(7)
+        for i in range(20):
+            nh.sync_propose(s, f"k{i}=v{i}".encode(), timeout=5.0)
+        idx = nh.sync_request_snapshot(7, timeout=5.0)
+        assert idx > 0
+        for i in range(20, 30):
+            nh.sync_propose(s, f"k{i}=v{i}".encode(), timeout=5.0)
+    finally:
+        nh.stop()
+    # restart: state must come back from snapshot + log replay
+    router2 = ChanRouter()
+    nh2 = make_nodehost("solo:1", router2, tmpdir=d)
+    try:
+        nh2.start_cluster(
+            {1: "solo:1"}, False, lambda c, n: KVSM(c, n),
+            group_config(7, 1, compaction_overhead=2),
+        )
+        wait_for_leader([nh2], 7)
+        assert nh2.sync_read(7, "k5", timeout=5.0) == "v5"
+        assert nh2.sync_read(7, "k29", timeout=5.0) == "v29"
+    finally:
+        nh2.stop()
+
+
+def test_add_node_membership_change(cluster3):
+    nhs, sms, addrs, router = cluster3
+    wait_for_leader(nhs, 100)
+    # add a 4th replica on a new nodehost
+    nh4 = make_nodehost("nh4:1", router)
+    try:
+        nhs[0].sync_request_add_node(100, 4, "nh4:1", timeout=5.0)
+        m = nhs[0].sync_get_cluster_membership(100, timeout=5.0)
+        assert 4 in m.addresses
+        nh4.start_cluster(
+            {}, True, lambda c, n: KVSM(c, n), group_config(100, 4),
+        )
+        s = nhs[0].get_noop_session(100)
+        nhs[0].sync_propose(s, b"after=add", timeout=5.0)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                if nh4.sync_read(100, "after", timeout=1.0) == "add":
+                    break
+            except Exception:
+                time.sleep(0.05)
+        else:
+            raise AssertionError("new node never caught up")
+    finally:
+        nh4.stop()
+
+
+def test_remove_node_membership_change(cluster3):
+    nhs, sms, addrs, _ = cluster3
+    wait_for_leader(nhs, 100)
+    nhs[0].sync_request_delete_node(100, 3, timeout=5.0)
+    m = nhs[0].sync_get_cluster_membership(100, timeout=5.0)
+    assert 3 not in m.addresses
+    s = nhs[0].get_noop_session(100)
+    nhs[0].sync_propose(s, b"still=works", timeout=5.0)
+    assert nhs[0].sync_read(100, "still", timeout=5.0) == "works"
