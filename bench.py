@@ -1,0 +1,129 @@
+"""Benchmark: batched quorum engine write throughput.
+
+Headline metric (BASELINE.json): writes/sec through the quorum path at 16B
+payload vs active group count.  The reference's published peak is 9M
+writes/sec over 48 groups on a 3-node cluster (README Performance,
+SURVEY.md §6).
+
+Here G concurrent groups each commit one write per engine round
+(leader self-ack + follower ack, quorum 2-of-3).  The host stages R rounds
+of ingested event batches and the device scans them in ONE fused dispatch
+(``quorum_multistep``) — the pipelined operating mode that amortizes
+host↔device latency, mirroring the reference's accept-while-in-flight
+pipelining (``execengine.go:954-966``).  Each dispatch pays the full
+upload → R×step → commit-watermark readback cycle.
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+BASELINE_WRITES_PER_SEC = 9_000_000.0
+
+
+def build_state(n_groups: int, event_cap: int, n_peers: int = 3):
+    from dragonboat_tpu.ops.engine import BatchedQuorumEngine
+
+    eng = BatchedQuorumEngine(n_groups, n_peers, event_cap=event_cap)
+    peers = list(range(1, n_peers + 1))
+    for cid in range(1, n_groups + 1):
+        eng.add_group(cid, node_ids=peers, self_id=1)
+        eng.set_leader(cid, term=1, term_start=1, last_index=1)
+    eng._upload_dirty()
+    return eng
+
+
+def main() -> None:
+    from dragonboat_tpu.ops.kernels import quorum_multistep
+
+    n_groups = int(os.environ.get("BENCH_GROUPS", "8192"))
+    rounds = int(os.environ.get("BENCH_ROUNDS", "64"))      # R per dispatch
+    dispatches = int(os.environ.get("BENCH_DISPATCHES", "20"))
+    warmup = 3
+
+    cap = 2 * n_groups  # self-ack + follower ack per group per round
+    eng = build_state(n_groups, cap)
+    st = eng.dev
+
+    rows = np.arange(n_groups, dtype=np.int32)
+    ack_g = np.broadcast_to(
+        np.concatenate([rows, rows]), (rounds, cap)
+    ).copy()
+    ack_p = np.broadcast_to(
+        np.concatenate([np.zeros(n_groups, np.int32), np.ones(n_groups, np.int32)]),
+        (rounds, cap),
+    ).copy()
+    ack_valid = jnp.asarray(np.ones((rounds, cap), bool))
+    zeros_i32 = jnp.asarray(np.zeros((rounds, cap), np.int32))
+    zeros_i8 = jnp.asarray(np.zeros((rounds, cap), np.int8))
+    zeros_b = jnp.asarray(np.zeros((rounds, cap), bool))
+    ack_g_d = jnp.asarray(ack_g)
+    ack_p_d = jnp.asarray(ack_p)
+
+    def dispatch(st, base_index):
+        # round r acks the entry appended that round: index base+r+1
+        vals = (base_index + 1 + np.arange(rounds, dtype=np.int32))[:, None]
+        ack_val = np.broadcast_to(vals, (rounds, cap)).copy()
+        t0 = time.perf_counter()
+        out = quorum_multistep(
+            st,
+            ack_g_d,
+            ack_p_d,
+            jnp.asarray(ack_val),
+            ack_valid,
+            zeros_i32,
+            zeros_i32,
+            zeros_i8,
+            zeros_b,
+            do_tick=True,
+        )
+        committed = np.asarray(out.committed)  # egress readback (blocks)
+        return out.state, committed, time.perf_counter() - t0
+
+    base = 1  # groups start with noop at index 1 committed? (committed=0, last=1)
+    for _ in range(warmup):
+        st, committed, _ = dispatch(st, base)
+        base += rounds
+    assert committed[0] == base, (committed[:4], base)
+
+    times = []
+    t0 = time.perf_counter()
+    for _ in range(dispatches):
+        st, committed, dt = dispatch(st, base)
+        times.append(dt)
+        base += rounds
+    elapsed = time.perf_counter() - t0
+    assert committed[0] == base
+
+    writes = n_groups * rounds * dispatches
+    writes_per_sec = writes / elapsed
+    p99_dispatch_ms = float(np.percentile(np.array(times) * 1e3, 99))
+    print(
+        json.dumps(
+            {
+                "metric": "quorum_engine_writes_per_sec",
+                "value": round(writes_per_sec, 1),
+                "unit": "writes/s",
+                "vs_baseline": round(writes_per_sec / BASELINE_WRITES_PER_SEC, 4),
+                "detail": {
+                    "groups": n_groups,
+                    "rounds_per_dispatch": rounds,
+                    "dispatches": dispatches,
+                    "dispatch_p99_ms": round(p99_dispatch_ms, 3),
+                    "platform": jax.devices()[0].platform,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -484,6 +484,17 @@ class Node:
                     )
                 return
             if req.exported:
+                # promote tmp → final inside the user's export dir; keep the
+                # flag file — ImportSnapshot reads it (tools/import.go:130)
+                try:
+                    env.finalize_snapshot()
+                except Exception as e:
+                    plog.error("%s export finalize failed: %s", self.describe(), e)
+                    env.remove_tmp_dir()
+                    self.pending_snapshot.notify(
+                        RequestResult(code=RequestResultCode.ABORTED)
+                    )
+                    return
                 self.pending_snapshot.notify(
                     RequestResult(
                         code=RequestResultCode.COMPLETED, snapshot_index=ss.index
@@ -494,11 +505,19 @@ class Node:
                 self.snapshotter.commit(ss, env)
             except FileExistsError:
                 env.remove_tmp_dir()
+                if user_req:
+                    self.pending_snapshot.notify(
+                        RequestResult(code=RequestResultCode.REJECTED)
+                    )
                 return
             try:
                 self.logreader.create_snapshot(ss)
             except Exception as e:
                 plog.warning("%s create_snapshot: %s", self.describe(), e)
+                if user_req:
+                    self.pending_snapshot.notify(
+                        RequestResult(code=RequestResultCode.ABORTED)
+                    )
                 return
             self._compact_log(ss, req)
             self.snapshotter.compact()

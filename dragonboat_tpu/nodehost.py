@@ -125,7 +125,10 @@ class NodeHost:
 
     def _get_nodes(self) -> Tuple[int, Dict[int, Node]]:
         with self._mu:
-            return self._csi, dict(self._clusters)
+            # None entries are in-flight start_cluster reservations
+            return self._csi, {
+                k: v for k, v in self._clusters.items() if v is not None
+            }
 
     def get_node(self, cluster_id: int) -> Node:
         with self._mu:
@@ -182,6 +185,26 @@ class NodeHost:
         with self._mu:
             if cluster_id in self._clusters:
                 raise ClusterAlreadyExistError(str(cluster_id))
+            # reserve the id under the lock so a concurrent start of the
+            # same cluster fails instead of silently double-starting
+            self._clusters[cluster_id] = None
+        try:
+            self._build_and_start_node(
+                initial_members, join, create_sm, config, smtype
+            )
+        except BaseException:
+            self._unreserve_cluster(cluster_id)
+            raise
+
+    def _build_and_start_node(
+        self,
+        initial_members: Dict[int, str],
+        join: bool,
+        create_sm: Callable,
+        config: Config,
+        smtype: StateMachineType,
+    ) -> None:
+        cluster_id, node_id = config.cluster_id, config.node_id
         # bootstrap record (reference bootstrapCluster nodehost.go:1479)
         bs = self.logdb.get_bootstrap_info(cluster_id, node_id)
         new_node = bs is None
@@ -239,12 +262,19 @@ class NodeHost:
             self._csi += 1
         self.engine.set_step_ready(cluster_id)
 
+    def _unreserve_cluster(self, cluster_id: int) -> None:
+        with self._mu:
+            if self._clusters.get(cluster_id) is None:
+                self._clusters.pop(cluster_id, None)
+
     def stop_cluster(self, cluster_id: int) -> None:
         with self._mu:
-            node = self._clusters.pop(cluster_id, None)
+            node = self._clusters.get(cluster_id)
+            if node is None:
+                # absent, or an in-flight start reservation — don't pop it
+                raise ClusterNotFoundError(str(cluster_id))
+            del self._clusters[cluster_id]
             self._csi += 1
-        if node is None:
-            raise ClusterNotFoundError(str(cluster_id))
         node.stop()
 
     def stop_node(self, cluster_id: int, node_id: int) -> None:
@@ -259,7 +289,8 @@ class NodeHost:
             self._clusters.clear()
             self._csi += 1
         for n in nodes:
-            n.stop()
+            if n is not None:
+                n.stop()
         self.engine.stop()
         self.transport.stop()
         self.logdb.close()
@@ -522,7 +553,8 @@ class NodeHost:
             with self._mu:
                 nodes = list(self._clusters.values())
             for n in nodes:
-                n.request_tick()
+                if n is not None:
+                    n.request_tick()
             if ticks % max(1, int(1.0 / max(interval, 0.001))) == 0:
                 self.transport.tick()
 

@@ -1,0 +1,434 @@
+"""Host driver for the batched quorum engine.
+
+Replaces the reference's 16-worker per-group iteration
+(``execengine.go:860-949``) with: host ingest (queues → compact event
+batches) → ONE ``quorum_step`` device dispatch per round → host egress
+(commit advances, election/heartbeat/step-down flags).  Rare transitions
+(membership change, becoming leader/candidate, snapshot restore, index
+rebase) mutate a numpy mirror row and are scattered onto the device arrays
+before the next dispatch.
+
+The group axis is shardable over a ``jax.sharding.Mesh`` (see
+``sharding.py``): every kernel op is row-wise over groups, so XLA partitions
+the whole step with zero collectives — groups are embarrassingly parallel,
+exactly like the reference's ``clusterID % workers`` partitioning but over
+chips instead of goroutines.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .kernels import quorum_step
+from .state import (
+    CANDIDATE,
+    FOLLOWER,
+    LEADER,
+    OBSERVER,
+    VOTE_GRANT,
+    VOTE_NONE,
+    VOTE_REJECT,
+    WITNESS,
+    HostMirror,
+    QuorumState,
+)
+
+# Event batches are padded to fixed sizes so jit compiles once.
+DEFAULT_EVENT_CAP = 4096
+
+# Rebase a row when relative indexes pass this (well clear of int32 max).
+REBASE_THRESHOLD = 1 << 30
+
+
+@dataclass
+class GroupInfo:
+    cluster_id: int
+    row: int
+    slots: Dict[int, int]            # node_id -> peer slot
+    base: int = 0                    # uint64 absolute index of rel 0
+    node_ids: List[int] = field(default_factory=list)
+
+
+class StepResult:
+    """Egress of one dispatch, in absolute-index / cluster-id terms."""
+
+    __slots__ = ("commit", "won", "lost", "elect", "heartbeat", "demote")
+
+    def __init__(self):
+        self.commit: Dict[int, int] = {}   # cluster_id -> new committed (abs)
+        self.won: List[int] = []
+        self.lost: List[int] = []
+        self.elect: List[int] = []
+        self.heartbeat: List[int] = []
+        self.demote: List[int] = []
+
+
+class BatchedQuorumEngine:
+    """Device-resident quorum state for up to ``n_groups`` Raft groups.
+
+    Usage::
+
+        eng = BatchedQuorumEngine(n_groups=1024, n_peers=5)
+        eng.add_group(cid, node_ids=[1,2,3], self_id=1, election_timeout=10)
+        eng.set_leader(cid, term=1, term_start=1, last_index=1)
+        eng.ack(cid, node_id=2, index=5)      # ReplicateResp ingest
+        out = eng.step()                       # one device dispatch
+        out.commit[cid]                        # -> advanced commit index
+    """
+
+    def __init__(
+        self,
+        n_groups: int,
+        n_peers: int,
+        event_cap: int = DEFAULT_EVENT_CAP,
+        sharding=None,
+    ):
+        self.n_peers = n_peers
+        self.event_cap = event_cap
+        self.mirror = HostMirror(n_groups, n_peers)
+        self.sharding = sharding
+        self.dev: QuorumState = self.mirror.to_device(sharding)
+        self.groups: Dict[int, GroupInfo] = {}
+        self.rows: Dict[int, GroupInfo] = {}
+        self._free = list(range(n_groups - 1, -1, -1))
+        self._dirty: set[int] = set()
+        # pending event buffers (grow unbounded host-side; chunked at dispatch)
+        self._acks: List[Tuple[int, int, int]] = []    # row, slot, rel_val
+        self._votes: List[Tuple[int, int, int]] = []   # row, slot, grant
+        self._voted_cells: set[Tuple[int, int]] = set()  # within-buffer dedup
+
+    # ------------------------------------------------------------------
+    # group lifecycle (rare path, host scalar)
+    # ------------------------------------------------------------------
+
+    def add_group(
+        self,
+        cluster_id: int,
+        node_ids: List[int],
+        self_id: int,
+        election_timeout: int = 10,
+        heartbeat_timeout: int = 1,
+        rand_timeout: Optional[int] = None,
+        check_quorum: bool = False,
+        witnesses: Tuple[int, ...] = (),
+        observers: Tuple[int, ...] = (),
+    ) -> GroupInfo:
+        if cluster_id in self.groups:
+            raise ValueError(f"group {cluster_id} already registered")
+        if not self._free:
+            raise RuntimeError("quorum engine full")
+        row = self._free.pop()
+        all_ids = sorted(set(node_ids) | set(witnesses) | set(observers))
+        if len(all_ids) > self.n_peers:
+            raise ValueError("too many peers for tensor width")
+        slots = {nid: i for i, nid in enumerate(all_ids)}
+        gi = GroupInfo(cluster_id, row, slots, node_ids=all_ids)
+        self.groups[cluster_id] = gi
+        self.rows[row] = gi
+
+        a = self.mirror.arrays
+        a["live"][row] = True
+        a["node_state"][row] = FOLLOWER
+        a["term"][row] = 0
+        a["committed"][row] = 0
+        a["last_index"][row] = 0
+        a["term_start"][row] = 0
+        n_voting = len(set(node_ids) | set(witnesses))
+        a["quorum"][row] = n_voting // 2 + 1
+        a["self_slot"][row] = slots[self_id]
+        a["election_tick"][row] = 0
+        a["heartbeat_tick"][row] = 0
+        a["election_timeout"][row] = election_timeout
+        a["heartbeat_timeout"][row] = heartbeat_timeout
+        a["rand_timeout"][row] = (
+            rand_timeout if rand_timeout is not None else election_timeout * 2
+        )
+        is_voter = self_id in node_ids or self_id in witnesses
+        a["electable"][row] = is_voter and self_id not in witnesses
+        a["check_quorum_on"][row] = check_quorum
+        a["match"][row, :] = 0
+        a["next"][row, :] = 1
+        a["voting"][row, :] = False
+        a["present"][row, :] = False
+        a["active"][row, :] = False
+        a["votes"][row, :] = VOTE_NONE
+        for nid, slot in slots.items():
+            a["present"][row, slot] = True
+            a["voting"][row, slot] = nid not in observers
+        self.mirror.base[row] = 0
+        self._dirty.add(row)
+        return gi
+
+    def remove_group(self, cluster_id: int) -> None:
+        gi = self.groups.pop(cluster_id)
+        del self.rows[gi.row]
+        self.mirror.arrays["live"][gi.row] = False
+        self._dirty.add(gi.row)
+        # purge queued events so a future tenant of this row never receives
+        # the dead group's acks/votes
+        self._acks = [e for e in self._acks if e[0] != gi.row]
+        self._votes = [e for e in self._votes if e[0] != gi.row]
+        self._voted_cells = {c for c in self._voted_cells if c[0] != gi.row}
+        self._free.append(gi.row)
+
+    # ------------------------------------------------------------------
+    # rare-path row mutations (host scalar, mask-update tensors)
+    # ------------------------------------------------------------------
+
+    def _rel(self, gi: GroupInfo, index: int) -> int:
+        rel = index - gi.base
+        if rel < 0:
+            raise ValueError(f"index {index} below base {gi.base}")
+        if rel >= REBASE_THRESHOLD:
+            raise ValueError("index needs rebase before ingest")
+        return rel
+
+    def set_leader(
+        self, cluster_id: int, term: int, term_start: int, last_index: int
+    ) -> None:
+        """Promote to leader (twin: ``become_leader`` raft.go:1027-1045)."""
+        gi = self.groups[cluster_id]
+        a = self.mirror.arrays
+        row = gi.row
+        self._sync_row(row)
+        a["node_state"][row] = LEADER
+        a["term"][row] = term
+        a["term_start"][row] = self._rel(gi, term_start)
+        a["last_index"][row] = self._rel(gi, last_index)
+        a["election_tick"][row] = 0
+        a["heartbeat_tick"][row] = 0
+        a["votes"][row, :] = VOTE_NONE
+        # reset_remotes: fresh Remote structs — next = last+1 for all,
+        # self match = last, activity cleared (raft.go:991-1010)
+        a["match"][row, :] = 0
+        a["next"][row, :] = self._rel(gi, last_index) + 1
+        a["match"][row, a["self_slot"][row]] = self._rel(gi, last_index)
+        a["active"][row, :] = False
+        self._dirty.add(row)
+
+    def set_candidate(self, cluster_id: int, term: int) -> None:
+        """Start campaigning (twin: ``become_candidate``); the self-vote is
+        ingested like any other vote event."""
+        gi = self.groups[cluster_id]
+        a = self.mirror.arrays
+        row = gi.row
+        self._sync_row(row)
+        a["node_state"][row] = CANDIDATE
+        a["term"][row] = term
+        a["votes"][row, :] = VOTE_NONE
+        a["election_tick"][row] = 0
+        self._voted_cells = {c for c in self._voted_cells if c[0] != row}
+        self._dirty.add(row)
+
+    def set_follower(self, cluster_id: int, term: int) -> None:
+        gi = self.groups[cluster_id]
+        a = self.mirror.arrays
+        row = gi.row
+        self._sync_row(row)
+        a["node_state"][row] = FOLLOWER
+        a["term"][row] = term
+        a["votes"][row, :] = VOTE_NONE
+        a["election_tick"][row] = 0
+        self._voted_cells = {c for c in self._voted_cells if c[0] != row}
+        self._dirty.add(row)
+
+    def set_randomized_timeout(self, cluster_id: int, timeout: int) -> None:
+        """Host-seeded randomized election timeout (determinism: the PRNG
+        stays host-side and seeded, see raft.py design notes)."""
+        gi = self.groups[cluster_id]
+        self._sync_row(gi.row)
+        self.mirror.arrays["rand_timeout"][gi.row] = timeout
+        self._dirty.add(gi.row)
+
+    def restore_progress(
+        self, cluster_id: int, committed: int, last_index: int
+    ) -> None:
+        """Snapshot-restore / log-truncation repair of the watermarks."""
+        gi = self.groups[cluster_id]
+        a = self.mirror.arrays
+        row = gi.row
+        self._sync_row(row)
+        a["committed"][row] = self._rel(gi, committed)
+        a["last_index"][row] = self._rel(gi, last_index)
+        self._dirty.add(row)
+
+    def rebase(self, cluster_id: int) -> None:
+        """Shift a row's base up to its committed watermark so relative
+        int32 indexes stay far from overflow (state.py design note)."""
+        gi = self.groups[cluster_id]
+        a = self.mirror.arrays
+        row = gi.row
+        self._sync_row(row)
+        shift = int(a["committed"][row])
+        if shift <= 0:
+            return
+        gi.base += shift
+        for f in ("committed", "last_index", "term_start"):
+            a[f][row] = max(0, int(a[f][row]) - shift)
+        a["match"][row, :] = np.maximum(a["match"][row, :] - shift, 0)
+        a["next"][row, :] = np.maximum(a["next"][row, :] - shift, 1)
+        self._dirty.add(row)
+
+    # ------------------------------------------------------------------
+    # dense-path event ingest
+    # ------------------------------------------------------------------
+
+    def ack(self, cluster_id: int, node_id: int, index: int) -> None:
+        """ReplicateResp success / local append (self ack).
+
+        Acks below the rebased floor are legal raft traffic (delayed
+        retransmits); they clamp to rel 0, a scatter-max no-op that still
+        marks the peer active — same outcome as ``remote.try_update`` on a
+        stale index.
+        """
+        gi = self.groups[cluster_id]
+        rel = max(0, index - gi.base)
+        if rel >= REBASE_THRESHOLD:
+            raise ValueError(f"index {index} needs rebase (base {gi.base})")
+        self._acks.append((gi.row, gi.slots[node_id], rel))
+
+    def vote(self, cluster_id: int, node_id: int, granted: bool) -> None:
+        """First vote per (group, peer) wins (twin: ``handle_vote_resp``).
+
+        The kernel's first-wins guard reads pre-batch state, so within-batch
+        duplicates must be deduped here — keep only the first event per cell.
+        """
+        gi = self.groups[cluster_id]
+        cell = (gi.row, gi.slots[node_id])
+        if cell in self._voted_cells:
+            return
+        self._voted_cells.add(cell)
+        self._votes.append(
+            (cell[0], cell[1], VOTE_GRANT if granted else VOTE_REJECT)
+        )
+
+    def heartbeat_resp(self, cluster_id: int, node_id: int) -> None:
+        """Heartbeat response marks the peer active; an ack at index 0 is a
+        no-op for match (scatter-max) but sets the activity bit."""
+        gi = self.groups[cluster_id]
+        self._acks.append((gi.row, gi.slots[node_id], 0))
+
+    # ------------------------------------------------------------------
+    # dispatch
+    # ------------------------------------------------------------------
+
+    def _sync_row(self, row: int) -> None:
+        """Pull one device row into the mirror before mutating it (the
+        dense path may have advanced it since the last upload)."""
+        if row in self._dirty:
+            return
+        for k in self.mirror.arrays:
+            self.mirror.arrays[k][row] = np.asarray(
+                getattr(self.dev, k)[row]
+            )
+
+    def _upload_dirty(self) -> None:
+        if not self._dirty:
+            return
+        rows = np.fromiter(self._dirty, dtype=np.int32)
+        st = self.dev
+        updates = {}
+        for k, host in self.mirror.arrays.items():
+            dev_arr = getattr(st, k)
+            updates[k] = dev_arr.at[rows].set(jnp.asarray(host[rows]))
+        self.dev = QuorumState(**updates)
+        self._dirty.clear()
+
+    def _pad(self, events, width):
+        cap = self.event_cap
+        n = len(events)
+        g = np.zeros((cap,), np.int32)
+        p = np.zeros((cap,), np.int32)
+        v = np.zeros((cap,), np.int32 if width == 3 else np.int8)
+        valid = np.zeros((cap,), bool)
+        if n:
+            cols = np.array(events, dtype=np.int64).T
+            g[:n] = cols[0]
+            p[:n] = cols[1]
+            v[:n] = cols[2]
+            valid[:n] = True
+        return g, p, v, valid
+
+    def step(self, do_tick: bool = True) -> StepResult:
+        """Run one fused device dispatch over all pending events.
+
+        Oversized event backlogs run extra (tickless) dispatches first so
+        the jit program never recompiles for a new batch size.
+        """
+        self._upload_dirty()
+        prev_committed = np.asarray(self.dev.committed)
+
+        while len(self._acks) > self.event_cap or len(self._votes) > self.event_cap:
+            self._dispatch(
+                self._acks[: self.event_cap], self._votes[: self.event_cap], False
+            )
+            del self._acks[: self.event_cap]
+            del self._votes[: self.event_cap]
+        out = self._dispatch(self._acks, self._votes, do_tick)
+        self._acks.clear()
+        self._votes.clear()
+        self._voted_cells.clear()
+
+        res = StepResult()
+        committed = np.asarray(out.committed)
+        changed = np.nonzero(committed != prev_committed)[0]
+        for row in changed:
+            gi = self.rows.get(int(row))
+            if gi is not None:
+                res.commit[gi.cluster_id] = int(gi.base) + int(committed[row])
+        for name, arr in (
+            ("won", out.won),
+            ("lost", out.lost),
+            ("elect", out.flags.elect_due),
+            ("heartbeat", out.flags.hb_due),
+            ("demote", out.flags.checkq_demote),
+        ):
+            idx = np.nonzero(np.asarray(arr))[0]
+            if idx.size:
+                lst = getattr(res, name)
+                for row in idx:
+                    gi = self.rows.get(int(row))
+                    if gi is not None:
+                        lst.append(gi.cluster_id)
+        return res
+
+    def _dispatch(self, acks, votes, do_tick: bool):
+        ag, ap, av, avalid = self._pad(acks, 3)
+        vg, vp, vv, vvalid = self._pad(votes, 1)
+        out = quorum_step(
+            self.dev,
+            jnp.asarray(ag),
+            jnp.asarray(ap),
+            jnp.asarray(av),
+            jnp.asarray(avalid),
+            jnp.asarray(vg),
+            jnp.asarray(vp),
+            jnp.asarray(vv, dtype=jnp.int8),
+            jnp.asarray(vvalid),
+            do_tick=do_tick,
+        )
+        self.dev = out.state
+        return out
+
+    # ------------------------------------------------------------------
+    # introspection (tests / debugging)
+    # ------------------------------------------------------------------
+
+    def _read(self, field_name: str, row: int):
+        """Field value at a row: pending mirror edits win over device."""
+        if row in self._dirty:
+            return self.mirror.arrays[field_name][row]
+        return np.asarray(getattr(self.dev, field_name)[row])
+
+    def committed_index(self, cluster_id: int) -> int:
+        gi = self.groups[cluster_id]
+        return int(gi.base) + int(self._read("committed", gi.row))
+
+    def peer_match(self, cluster_id: int, node_id: int) -> int:
+        gi = self.groups[cluster_id]
+        return int(gi.base) + int(self._read("match", gi.row)[gi.slots[node_id]])

@@ -1,0 +1,264 @@
+"""Pure jit kernels for the batched quorum engine.
+
+Each kernel is the tensorized twin of a scalar hot loop in
+:mod:`dragonboat_tpu.raft.raft`; the differential tests in
+``tests/test_ops_kernels.py`` assert bit-identical outputs against it.
+
+Scalar twin map:
+
+===================  ==================================================
+kernel               scalar twin (reference location)
+===================  ==================================================
+``commit_quorum``    ``Raft.try_commit`` (``raft.go:861-909``)
+``vote_tally``       ``Raft.handle_vote_resp`` (``raft.go:1062-1080``)
+``check_quorum``     ``Raft.leader_has_quorum`` (``raft.go:380-390``)
+``tick_step``        ``Raft.tick`` (``raft.go:553-623``)
+``quorum_step``      one whole ``processSteps`` round (``execengine.go:923``)
+===================  ==================================================
+
+All shapes are static: ``G`` groups × ``P`` peer slots, event batches
+padded to a fixed ``K`` with a validity mask (invalid rows scatter out of
+bounds with ``mode='drop'``).  Everything fuses into one XLA program; on
+TPU the sort/scatter work sits in VMEM with no host round-trips.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .state import (
+    CANDIDATE,
+    INDEX_MIN,
+    LEADER,
+    QuorumState,
+    VOTE_NONE,
+    I32,
+)
+
+
+def _kth_largest(values: jax.Array, mask: jax.Array, k: jax.Array) -> jax.Array:
+    """Row-wise k-th largest of masked values; k is 1-based, (G,)."""
+    masked = jnp.where(mask, values, INDEX_MIN)
+    desc = jnp.flip(jnp.sort(masked, axis=1), axis=1)
+    return jnp.take_along_axis(desc, (k - 1)[:, None], axis=1)[:, 0]
+
+
+def commit_quorum(
+    match: jax.Array, voting: jax.Array, quorum: jax.Array
+) -> jax.Array:
+    """Quorum match index per group (scalar twin: ``Raft.try_commit``).
+
+    The reference sorts each group's match array and picks
+    ``matched[n - quorum]`` (``raft.go:888-909``); that is exactly the
+    quorum-th largest, computed here for all groups at once.
+    """
+    return _kth_largest(match, voting, quorum)
+
+
+def vote_tally(
+    votes: jax.Array, voting: jax.Array, quorum: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """(granted, rejected) counts per group (twin: ``handle_vote_resp``)."""
+    granted = jnp.sum((votes == 1) & voting, axis=1).astype(I32)
+    rejected = jnp.sum((votes == 0) & voting, axis=1).astype(I32)
+    return granted, rejected
+
+
+def check_quorum(
+    active: jax.Array,
+    voting: jax.Array,
+    self_slot: jax.Array,
+    quorum: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """(has_quorum, cleared_active) per group (twin: ``leader_has_quorum``).
+
+    Counts self plus recently-active voters, clearing activity flags as the
+    reference does (``raft.go:380-390``).
+    """
+    p = active.shape[1]
+    self_onehot = jax.nn.one_hot(self_slot, p, dtype=jnp.bool_)
+    count = jnp.sum((active | self_onehot) & voting, axis=1).astype(I32)
+    cleared = active & ~voting  # voting members' activity is consumed
+    return count >= quorum, cleared
+
+
+class TickFlags(NamedTuple):
+    elect_due: jax.Array    # (G,) bool — non-leader election timeout fired
+    hb_due: jax.Array       # (G,) bool — leader heartbeat due
+    checkq_demote: jax.Array  # (G,) bool — CheckQuorum failed, leader must step down
+
+
+class StepOutputs(NamedTuple):
+    state: QuorumState
+    committed: jax.Array    # (G,) i32 rel — post-step commit watermark
+    won: jax.Array          # (G,) bool — candidate reached vote quorum
+    lost: jax.Array         # (G,) bool — candidate rejected by quorum
+    flags: TickFlags
+
+
+def tick_step(st: QuorumState) -> tuple[QuorumState, TickFlags]:
+    """Advance per-group clocks one tick (twin: ``Raft.tick``).
+
+    Emits *flags* for the rare follow-ups (campaign, heartbeat broadcast,
+    leader step-down) which the host executes scalar-side; the dense
+    counter arithmetic and CheckQuorum activity scan stay on device.
+    """
+    live = st.live
+    is_leader = (st.node_state == LEADER) & live
+
+    election_tick = jnp.where(live, st.election_tick + 1, st.election_tick)
+
+    # non-leader: election timeout (raft.go:568-592)
+    elect_due = (
+        live
+        & ~is_leader
+        & st.electable
+        & (election_tick >= st.rand_timeout)
+    )
+    # leader: CheckQuorum window (raft.go:594-623)
+    checkq_due = is_leader & (election_tick >= st.election_timeout)
+    election_tick = jnp.where(elect_due | checkq_due, 0, election_tick)
+
+    has_q, cleared_active = check_quorum(
+        st.active, st.voting, st.self_slot, st.quorum
+    )
+    run_checkq = checkq_due & st.check_quorum_on
+    checkq_demote = run_checkq & ~has_q
+    active = jnp.where(run_checkq[:, None], cleared_active, st.active)
+
+    heartbeat_tick = jnp.where(is_leader, st.heartbeat_tick + 1, st.heartbeat_tick)
+    hb_due = is_leader & (heartbeat_tick >= st.heartbeat_timeout)
+    heartbeat_tick = jnp.where(hb_due, 0, heartbeat_tick)
+
+    st = st._replace(
+        election_tick=election_tick,
+        heartbeat_tick=heartbeat_tick,
+        active=active,
+    )
+    return st, TickFlags(elect_due, hb_due, checkq_demote)
+
+
+def quorum_step_impl(
+    st: QuorumState,
+    ack_g: jax.Array,      # (K,) i32 group row of each ack event
+    ack_p: jax.Array,      # (K,) i32 peer slot
+    ack_val: jax.Array,    # (K,) i32 rel match index acknowledged
+    ack_valid: jax.Array,  # (K,) bool
+    vote_g: jax.Array,     # (K,) i32
+    vote_p: jax.Array,     # (K,) i32
+    vote_grant: jax.Array,  # (K,) i8 — 1 grant / 0 reject
+    vote_valid: jax.Array,  # (K,) bool
+    do_tick: bool = True,
+) -> StepOutputs:
+    """ONE fused dispatch for a whole engine round (SURVEY.md §7).
+
+    Scalar order of operations matches ``processSteps``: ingest acks and
+    votes, tally elections, advance commits, then tick clocks.  Ack
+    ingestion uses scatter-max (``remote.try_update`` keeps only forward
+    progress, so max is exact and order-independent → deterministic).
+    """
+    g_total = st.term.shape[0]
+    # route invalid events out of bounds; XLA drops them
+    ag = jnp.where(ack_valid, ack_g, g_total)
+    vg = jnp.where(vote_valid, vote_g, g_total)
+
+    # --- ack ingestion (twin: handleLeaderReplicateResp raft.go:1671) ---
+    match = st.match.at[ag, ack_p].max(ack_val, mode="drop")
+    next_ = st.next.at[ag, ack_p].max(ack_val + 1, mode="drop")
+    active = st.active.at[ag, ack_p].set(True, mode="drop")
+    # self-acks raise last_index (leader append); followers never exceed it
+    self_match = jnp.take_along_axis(match, st.self_slot[:, None], axis=1)[:, 0]
+    last_index = jnp.maximum(st.last_index, self_match)
+
+    # --- vote ingestion (first vote per peer per term wins) -------------
+    cur = st.votes[vg.clip(0, g_total - 1), vote_p]
+    newv = jnp.where(cur == VOTE_NONE, vote_grant, cur)
+    votes = st.votes.at[vg, vote_p].set(newv, mode="drop")
+
+    # --- election tally (twin: handleVoteResp / campaign) ---------------
+    granted, rejected = vote_tally(votes, st.voting, st.quorum)
+    is_cand = (st.node_state == CANDIDATE) & st.live
+    won = is_cand & (granted >= st.quorum)
+    lost = is_cand & (rejected >= st.quorum)
+
+    # --- commit advancement (twin: try_commit raft.go:888-909) ----------
+    q = commit_quorum(match, st.voting, st.quorum)
+    is_leader = (st.node_state == LEADER) & st.live
+    # raft paper p8: only current-term entries commit by counting; on the
+    # leader q >= term_start ⟺ log.match_term(q, term) (see state.py)
+    can_commit = is_leader & (q > st.committed) & (q >= st.term_start)
+    committed = jnp.where(can_commit, q, st.committed)
+
+    st = st._replace(
+        match=match,
+        next=next_,
+        active=active,
+        votes=votes,
+        committed=committed,
+        last_index=last_index,
+    )
+
+    if do_tick:
+        st, flags = tick_step(st)
+    else:
+        zeros = jnp.zeros_like(won)
+        flags = TickFlags(zeros, zeros, zeros)
+
+    return StepOutputs(st, committed, won, lost, flags)
+
+
+quorum_step = jax.jit(
+    quorum_step_impl, static_argnames=("do_tick",), donate_argnums=(0,)
+)
+
+
+def quorum_multistep_impl(
+    st: QuorumState,
+    ack_g: jax.Array,      # (R,K) — R staged rounds of event batches
+    ack_p: jax.Array,
+    ack_val: jax.Array,
+    ack_valid: jax.Array,
+    vote_g: jax.Array,
+    vote_p: jax.Array,
+    vote_grant: jax.Array,
+    vote_valid: jax.Array,
+    do_tick: bool = True,
+) -> StepOutputs:
+    """R engine rounds in ONE dispatch via ``lax.scan``.
+
+    Host↔device round trips are the latency floor (SURVEY.md §7 hard-part
+    3) — especially over a network-attached TPU.  The host therefore stages
+    R rounds of ingested events and scans them on device, mirroring the
+    reference's pipelining (proposals accepted while prior ones are in
+    flight, ``execengine.go:954-966``).  Outputs carry the final state plus
+    OR-accumulated flags and the final commit watermark; commit
+    notifications are monotone, so the final watermark is sufficient for
+    host egress.
+    """
+
+    def body(carry, ev):
+        out = quorum_step_impl(carry, *ev, do_tick=do_tick)
+        acc = (out.won, out.lost, out.flags)
+        return out.state, acc
+
+    st, (won, lost, flags) = jax.lax.scan(
+        body,
+        st,
+        (ack_g, ack_p, ack_val, ack_valid, vote_g, vote_p, vote_grant, vote_valid),
+    )
+    any_ = lambda x: jnp.any(x, axis=0)  # noqa: E731
+    return StepOutputs(
+        st,
+        st.committed,
+        any_(won),
+        any_(lost),
+        TickFlags(*(any_(f) for f in flags)),
+    )
+
+
+quorum_multistep = jax.jit(
+    quorum_multistep_impl, static_argnames=("do_tick",), donate_argnums=(0,)
+)

@@ -1,0 +1,140 @@
+"""Tensor state layout for the batched quorum engine.
+
+All per-group Raft bookkeeping that the reference keeps in per-node structs
+(``internal/raft/raft.go:198`` ``raft`` struct, ``internal/raft/remote.go:62``
+``remote`` struct) is held here as a struct-of-arrays pytree of
+``(nGroups,)`` and ``(nGroups, nPeers)`` device arrays.
+
+TPU-first design decisions (deltas from the reference):
+
+* **int32 indexes over a host uint64 base.**  The reference uses uint64 log
+  indexes everywhere.  TPUs emulate int64, so device tensors store indexes
+  *relative to a per-group host-side base* (the group's compacted floor).
+  Quorum math (k-th largest, comparisons, maxima) is translation-invariant,
+  so the kernels are exact; the host rebases a group's row when its relative
+  indexes approach 2^31 (see ``BatchedQuorumEngine.rebase``).
+
+* **Term guard without a log probe.**  ``tryCommit`` (reference
+  ``raft.go:888-909``) must check ``log.match_term(q, term)`` before
+  committing.  A Raft leader appends a noop entry at the start of its term
+  (reference ``raft.go:1044`` / thesis p72) and only ever appends entries at
+  its own term, so on the leader ``match_term(q, current_term)`` is exactly
+  ``q >= term_start_index``.  One ``(G,)`` tensor replaces the log lookup.
+
+* **Masks, not ragged shapes.**  Variable membership (3/5 voters, observers,
+  witnesses, mid-change) is expressed by ``voting`` / ``present`` boolean
+  masks over a fixed ``nPeers`` axis (SURVEY.md §7 hard-part 4).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Device-side dtypes.  Indexes are int32 *relative to the group base*;
+# terms are int32 (terms advance only on elections — 2^31 is unreachable).
+I32 = jnp.int32
+I8 = jnp.int8
+BOOL = jnp.bool_
+
+INDEX_MIN = np.iinfo(np.int32).min
+
+# Raft node states — must match raft.RaftState (reference raft.go:64-71).
+FOLLOWER, CANDIDATE, LEADER, OBSERVER, WITNESS = 0, 1, 2, 3, 4
+
+# Vote cell encoding: -1 = no response, 0 = rejected, 1 = granted.
+VOTE_NONE, VOTE_REJECT, VOTE_GRANT = -1, 0, 1
+
+
+class QuorumState(NamedTuple):
+    """Struct-of-arrays state for G groups × P peer slots.
+
+    Group-axis ``(G,)`` arrays mirror the per-``raft`` scalars; peer-axis
+    ``(G, P)`` arrays mirror the per-``remote`` progress tracker columns.
+    """
+
+    # --- per-group scalars ---------------------------------------------
+    node_state: jax.Array      # (G,) i8: FOLLOWER..WITNESS
+    term: jax.Array            # (G,) i32
+    committed: jax.Array       # (G,) i32 rel: log.committed
+    last_index: jax.Array      # (G,) i32 rel: log.last_index()
+    term_start: jax.Array      # (G,) i32 rel: first index of current leader term
+    quorum: jax.Array          # (G,) i32: num_voting//2 + 1
+    self_slot: jax.Array       # (G,) i32: peer-slot of this replica
+    election_tick: jax.Array   # (G,) i32
+    heartbeat_tick: jax.Array  # (G,) i32
+    rand_timeout: jax.Array    # (G,) i32: randomized election timeout (host-seeded)
+    election_timeout: jax.Array   # (G,) i32
+    heartbeat_timeout: jax.Array  # (G,) i32
+    electable: jax.Array       # (G,) bool: voter, not self-removed, not observer/witness
+    check_quorum_on: jax.Array  # (G,) bool: config.check_quorum
+    live: jax.Array            # (G,) bool: row holds a real group
+
+    # --- per-peer columns ----------------------------------------------
+    match: jax.Array           # (G,P) i32 rel: remote.match
+    next: jax.Array            # (G,P) i32 rel: remote.next
+    voting: jax.Array          # (G,P) bool: full member or witness (counts for quorum)
+    present: jax.Array         # (G,P) bool: slot occupied (incl. observers)
+    active: jax.Array          # (G,P) bool: remote.active (CheckQuorum recency)
+    votes: jax.Array           # (G,P) i8: VOTE_NONE / VOTE_REJECT / VOTE_GRANT
+
+
+def make_state(n_groups: int, n_peers: int) -> QuorumState:
+    """All-dead state: rows are claimed by the host as groups start."""
+    g, p = n_groups, n_peers
+    zi = jnp.zeros((g,), I32)
+    return QuorumState(
+        node_state=jnp.zeros((g,), I8),
+        term=zi,
+        committed=zi,
+        last_index=zi,
+        term_start=zi,
+        quorum=jnp.ones((g,), I32),
+        self_slot=zi,
+        election_tick=zi,
+        heartbeat_tick=zi,
+        rand_timeout=jnp.full((g,), 10, I32),
+        election_timeout=jnp.full((g,), 10, I32),
+        heartbeat_timeout=jnp.ones((g,), I32),
+        electable=jnp.zeros((g,), BOOL),
+        check_quorum_on=jnp.zeros((g,), BOOL),
+        live=jnp.zeros((g,), BOOL),
+        match=jnp.zeros((g, p), I32),
+        next=jnp.ones((g, p), I32),
+        voting=jnp.zeros((g, p), BOOL),
+        present=jnp.zeros((g, p), BOOL),
+        active=jnp.zeros((g, p), BOOL),
+        votes=jnp.full((g, p), VOTE_NONE, I8),
+    )
+
+
+class HostMirror:
+    """Numpy twin of :class:`QuorumState` for cheap host-side mutation.
+
+    The host mutates rows scalar-style for rare transitions (membership
+    change, becoming leader, snapshot restore) and uploads only between
+    ticks; dense per-tick updates travel as compact event batches instead
+    (see ``kernels.quorum_step``).
+    """
+
+    def __init__(self, n_groups: int, n_peers: int):
+        self.n_groups = n_groups
+        self.n_peers = n_peers
+        dev = make_state(n_groups, n_peers)
+        self.arrays = {k: np.asarray(v).copy() for k, v in dev._asdict().items()}
+        # host-only: uint64 base per group for index rebasing
+        self.base = np.zeros((n_groups,), np.uint64)
+
+    def to_device(self, sharding=None) -> QuorumState:
+        put = (
+            (lambda a: jax.device_put(a, sharding))
+            if sharding is not None
+            else jax.device_put
+        )
+        return QuorumState(**{k: put(v) for k, v in self.arrays.items()})
+
+    def pull(self, st: QuorumState) -> None:
+        for k, v in st._asdict().items():
+            np.copyto(self.arrays[k], np.asarray(v))

@@ -47,8 +47,16 @@ class Snapshotter:
 
     def save(self, savable, meta: SSMeta) -> Tuple[Snapshot, SSEnv]:
         """Write a snapshot image into a temp dir (reference
-        ``snapshotter.go:103-150`` ``Save``)."""
-        env = SSEnv(self.root_dir, meta.index, self.node_id, SSMode.SNAPSHOT)
+        ``snapshotter.go:103-150`` ``Save``).  Exported snapshots land in
+        the user-provided directory instead of the node's snapshot root
+        (reference custom-SSEnv path for ``Exported`` requests) and are
+        never recorded in the LogDB."""
+        root = self.root_dir
+        if meta.request is not None and meta.request.exported:
+            if not meta.request.path:
+                raise ValueError("exported snapshot request without a path")
+            root = meta.request.path
+        env = SSEnv(root, meta.index, self.node_id, SSMode.SNAPSHOT)
         env.remove_tmp_dir()
         env.create_tmp_dir()
         path = env.get_tmp_filepath()

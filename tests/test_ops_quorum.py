@@ -1,0 +1,409 @@
+"""Differential tests: batched quorum kernels vs the scalar raft oracle.
+
+The north star demands the batched engine's commitIndex outputs be
+bit-identical to the scalar path (SURVEY.md §6); these tests replay the
+exact same event streams through both and compare watermarks after every
+round.  This is the conformance-gate analog of the reference's etcd-ported
+suite (``internal/raft/raft_etcd_test.go``) applied to the tensor path.
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from dragonboat_tpu.ops import BatchedQuorumEngine, commit_quorum, vote_tally
+from dragonboat_tpu.ops.kernels import check_quorum
+from dragonboat_tpu.wire import Message, MessageType
+from raft_harness import new_test_raft
+
+MT = MessageType
+
+
+# ----------------------------------------------------------------------
+# kernel-level randomized differential tests
+# ----------------------------------------------------------------------
+
+
+def scalar_quorum_index(matches, quorum):
+    """The reference's tryCommit pick: sort ascending, take [n - quorum]
+    (raft.go:888-909)."""
+    s = sorted(matches)
+    return s[len(s) - quorum]
+
+
+def test_commit_quorum_matches_scalar_sort():
+    rng = random.Random(7)
+    G, P = 128, 7
+    match = np.zeros((G, P), np.int32)
+    voting = np.zeros((G, P), bool)
+    quorum = np.zeros((G,), np.int32)
+    expected = np.zeros((G,), np.int32)
+    for g in range(G):
+        n = rng.choice([1, 3, 5, 7])
+        slots = rng.sample(range(P), n)
+        vals = [rng.randrange(0, 1000) for _ in range(n)]
+        for s, v in zip(slots, vals):
+            voting[g, s] = True
+            match[g, s] = v
+            # noise in non-voting slots must not affect the result
+        for s in range(P):
+            if not voting[g, s]:
+                match[g, s] = rng.randrange(0, 2000)
+        quorum[g] = n // 2 + 1
+        expected[g] = scalar_quorum_index(vals, int(quorum[g]))
+    got = np.asarray(
+        commit_quorum(jnp.asarray(match), jnp.asarray(voting), jnp.asarray(quorum))
+    )
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_vote_tally_matches_scalar_count():
+    rng = random.Random(11)
+    G, P = 64, 5
+    votes = np.full((G, P), -1, np.int8)
+    voting = np.zeros((G, P), bool)
+    quorum = np.zeros((G,), np.int32)
+    exp_granted = np.zeros((G,), np.int32)
+    exp_rejected = np.zeros((G,), np.int32)
+    for g in range(G):
+        n = rng.choice([3, 5])
+        for s in range(n):
+            voting[g, s] = True
+            v = rng.choice([-1, 0, 1])
+            votes[g, s] = v
+            if v == 1:
+                exp_granted[g] += 1
+            elif v == 0:
+                exp_rejected[g] += 1
+        quorum[g] = n // 2 + 1
+    granted, rejected = vote_tally(
+        jnp.asarray(votes), jnp.asarray(voting), jnp.asarray(quorum)
+    )
+    np.testing.assert_array_equal(np.asarray(granted), exp_granted)
+    np.testing.assert_array_equal(np.asarray(rejected), exp_rejected)
+
+
+def test_check_quorum_matches_leader_has_quorum():
+    # scalar twin: raft.go:380-390 — count self + active voters, clear flags
+    G, P = 8, 5
+    active = np.zeros((G, P), bool)
+    voting = np.zeros((G, P), bool)
+    voting[:, :3] = True
+    self_slot = np.zeros((G,), np.int32)
+    quorum = np.full((G,), 2, np.int32)
+    active[0, 1] = True          # self + 1 active  -> quorum
+    active[1, 1] = active[1, 2] = True  # 3          -> quorum
+    # row 2: only self active                        -> no quorum
+    active[3, 4] = True          # non-voting activity doesn't count
+    has_q, cleared = check_quorum(
+        jnp.asarray(active),
+        jnp.asarray(voting),
+        jnp.asarray(self_slot),
+        jnp.asarray(quorum),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(has_q), [True, True, False, False, False, False, False, False]
+    )
+    # voting members' activity consumed, non-voting preserved
+    assert not np.asarray(cleared)[1, 1]
+    assert np.asarray(cleared)[3, 4]
+
+
+# ----------------------------------------------------------------------
+# engine-level differential: scalar Raft leader vs BatchedQuorumEngine
+# ----------------------------------------------------------------------
+
+
+def make_scalar_leader(peers):
+    """Elect node 1 leader of a fresh group and return the Raft."""
+    r = new_test_raft(1, peers)
+    r.handle(Message(from_=1, to=1, type=MT.ELECTION))
+    for p in peers:
+        if p != 1:
+            r.handle(
+                Message(from_=p, to=1, term=r.term, type=MT.REQUEST_VOTE_RESP)
+            )
+        if r.is_leader():
+            break
+    assert r.is_leader()
+    return r
+
+
+def mirror_leader(eng, cid, r, peers):
+    """Mirror freshly-elected scalar leader state into the engine."""
+    # term_start = the noop appended at promotion (become_leader)
+    eng.set_leader(
+        cid,
+        term=r.term,
+        term_start=r.log.last_index(),
+        last_index=r.log.last_index(),
+    )
+
+
+@pytest.mark.parametrize("peers", [[1, 2, 3], [1, 2, 3, 4, 5]])
+def test_commit_differential_ordered_acks(peers):
+    r = make_scalar_leader(peers)
+    eng = BatchedQuorumEngine(n_groups=4, n_peers=len(peers))
+    eng.add_group(1, node_ids=peers, self_id=1)
+    mirror_leader(eng, 1, r, peers)
+    assert eng.committed_index(1) == r.log.committed == 0
+
+    # propose 10 entries, acking each from a rotating quorum subset
+    rng = random.Random(3)
+    for i in range(10):
+        r.handle(
+            Message(from_=1, to=1, type=MT.PROPOSE, entries=[__propose_entry()])
+        )
+        eng.ack(1, 1, r.log.last_index())  # self append
+        followers = [p for p in peers if p != 1]
+        rng.shuffle(followers)
+        for p in followers[: len(peers) // 2 + rng.randrange(0, 2)]:
+            r.handle(
+                Message(
+                    from_=p,
+                    to=1,
+                    term=r.term,
+                    type=MT.REPLICATE_RESP,
+                    log_index=r.log.last_index(),
+                )
+            )
+            eng.ack(1, p, r.log.last_index())
+        out = eng.step(do_tick=False)
+        assert eng.committed_index(1) == r.log.committed
+        if 1 in out.commit:
+            assert out.commit[1] == r.log.committed
+
+
+def __propose_entry():
+    from dragonboat_tpu.wire import Entry
+
+    return Entry(cmd=b"x")
+
+
+def test_commit_differential_random_stale_acks():
+    """Stale, duplicate, and out-of-order acks must commit identically."""
+    peers = [1, 2, 3, 4, 5]
+    r = make_scalar_leader(peers)
+    eng = BatchedQuorumEngine(n_groups=2, n_peers=5)
+    eng.add_group(1, node_ids=peers, self_id=1)
+    mirror_leader(eng, 1, r, peers)
+
+    rng = random.Random(99)
+    for _ in range(40):
+        for _ in range(rng.randrange(0, 3)):
+            r.handle(
+                Message(from_=1, to=1, type=MT.PROPOSE, entries=[__propose_entry()])
+            )
+            eng.ack(1, 1, r.log.last_index())
+        last = r.log.last_index()
+        for _ in range(rng.randrange(0, 6)):
+            p = rng.choice(peers[1:])
+            idx = rng.randrange(0, last + 1)  # may be stale
+            r.handle(
+                Message(
+                    from_=p,
+                    to=1,
+                    term=r.term,
+                    type=MT.REPLICATE_RESP,
+                    log_index=idx,
+                )
+            )
+            eng.ack(1, p, idx)
+        eng.step(do_tick=False)
+        assert eng.committed_index(1) == r.log.committed
+
+
+def test_commit_differential_many_groups():
+    """64 independent groups with interleaved random ack streams."""
+    G = 64
+    rng = random.Random(42)
+    eng = BatchedQuorumEngine(n_groups=G, n_peers=5)
+    scalars = {}
+    for cid in range(1, G + 1):
+        peers = [1, 2, 3] if cid % 2 else [1, 2, 3, 4, 5]
+        r = make_scalar_leader(peers)
+        scalars[cid] = (r, peers)
+        eng.add_group(cid, node_ids=peers, self_id=1)
+        mirror_leader(eng, cid, r, peers)
+
+    for _ in range(10):
+        for cid, (r, peers) in scalars.items():
+            if rng.random() < 0.7:
+                r.handle(
+                    Message(
+                        from_=1, to=1, type=MT.PROPOSE, entries=[__propose_entry()]
+                    )
+                )
+                eng.ack(cid, 1, r.log.last_index())
+            for p in peers[1:]:
+                if rng.random() < 0.6:
+                    idx = rng.randrange(0, r.log.last_index() + 1)
+                    r.handle(
+                        Message(
+                            from_=p,
+                            to=1,
+                            term=r.term,
+                            type=MT.REPLICATE_RESP,
+                            log_index=idx,
+                        )
+                    )
+                    eng.ack(cid, p, idx)
+        eng.step(do_tick=False)
+        for cid, (r, _) in scalars.items():
+            assert eng.committed_index(cid) == r.log.committed, f"group {cid}"
+
+
+def test_election_differential():
+    """Vote quorum flags fire exactly when the scalar candidate wins."""
+    peers = [1, 2, 3, 4, 5]
+    r = new_test_raft(1, peers)
+    eng = BatchedQuorumEngine(n_groups=2, n_peers=5)
+    eng.add_group(1, node_ids=peers, self_id=1)
+
+    r.handle(Message(from_=1, to=1, type=MT.ELECTION))
+    assert r.is_candidate()
+    eng.set_candidate(1, term=r.term)
+    eng.vote(1, 1, granted=True)  # campaign self-vote (raft.go:1098)
+
+    out = eng.step(do_tick=False)
+    assert not out.won and not out.lost
+
+    r.handle(Message(from_=2, to=1, term=r.term, type=MT.REQUEST_VOTE_RESP))
+    eng.vote(1, 2, granted=True)
+    out = eng.step(do_tick=False)
+    assert not r.is_leader() and not out.won  # 2 of 5: no quorum yet
+
+    r.handle(Message(from_=3, to=1, term=r.term, type=MT.REQUEST_VOTE_RESP))
+    eng.vote(1, 3, granted=True)
+    out = eng.step(do_tick=False)
+    assert r.is_leader()
+    assert out.won == [1]
+
+
+def test_election_rejection_differential():
+    peers = [1, 2, 3]
+    r = new_test_raft(1, peers)
+    eng = BatchedQuorumEngine(n_groups=1, n_peers=3)
+    eng.add_group(1, node_ids=peers, self_id=1)
+    r.handle(Message(from_=1, to=1, type=MT.ELECTION))
+    eng.set_candidate(1, term=r.term)
+    eng.vote(1, 1, granted=True)
+    for p in (2, 3):
+        r.handle(
+            Message(
+                from_=p, to=1, term=r.term, type=MT.REQUEST_VOTE_RESP, reject=True
+            )
+        )
+        eng.vote(1, p, granted=False)
+    out = eng.step(do_tick=False)
+    assert r.is_follower()
+    assert out.lost == [1]
+
+
+def test_tick_election_due_matches_scalar_timing():
+    """elect_due fires on exactly the tick the scalar oracle campaigns."""
+    peers = [1, 2, 3]
+    r = new_test_raft(1, peers)
+    eng = BatchedQuorumEngine(n_groups=1, n_peers=3)
+    eng.add_group(
+        1,
+        node_ids=peers,
+        self_id=1,
+        election_timeout=10,
+        rand_timeout=r.randomized_election_timeout,
+    )
+    fired_scalar = None
+    fired_batched = None
+    for tick in range(1, 30):
+        was_candidate = r.is_candidate()
+        r.tick()
+        if fired_scalar is None and r.is_candidate() and not was_candidate:
+            fired_scalar = tick
+        out = eng.step(do_tick=True)
+        if fired_batched is None and out.elect:
+            fired_batched = tick
+        if fired_scalar is not None:
+            break
+    assert fired_scalar is not None
+    assert fired_batched == fired_scalar
+
+
+def test_heartbeat_due_matches_scalar_timing():
+    peers = [1, 2, 3]
+    r = make_scalar_leader(peers)
+    eng = BatchedQuorumEngine(n_groups=1, n_peers=3)
+    eng.add_group(1, node_ids=peers, self_id=1, heartbeat_timeout=3)
+    mirror_leader(eng, 1, r, peers)
+    # scalar heartbeat_timeout from config: election=10, heartbeat=1; use
+    # a dedicated engine row with timeout 3 and check periodicity instead
+    fires = []
+    for tick in range(1, 10):
+        out = eng.step(do_tick=True)
+        if out.heartbeat:
+            fires.append(tick)
+    assert fires == [3, 6, 9]
+
+
+def test_rebase_preserves_commit_semantics():
+    peers = [1, 2, 3]
+    r = make_scalar_leader(peers)
+    eng = BatchedQuorumEngine(n_groups=1, n_peers=3)
+    eng.add_group(1, node_ids=peers, self_id=1)
+    mirror_leader(eng, 1, r, peers)
+    for i in range(5):
+        r.handle(Message(from_=1, to=1, type=MT.PROPOSE, entries=[__propose_entry()]))
+        eng.ack(1, 1, r.log.last_index())
+        for p in (2, 3):
+            r.handle(
+                Message(
+                    from_=p,
+                    to=1,
+                    term=r.term,
+                    type=MT.REPLICATE_RESP,
+                    log_index=r.log.last_index(),
+                )
+            )
+            eng.ack(1, p, r.log.last_index())
+    eng.step(do_tick=False)
+    assert eng.committed_index(1) == r.log.committed == 6  # noop + 5
+
+    eng.rebase(1)
+    assert eng.committed_index(1) == r.log.committed
+    assert eng.groups[1].base == 6
+
+    # progress continues identically post-rebase
+    r.handle(Message(from_=1, to=1, type=MT.PROPOSE, entries=[__propose_entry()]))
+    eng.ack(1, 1, r.log.last_index())
+    for p in (2, 3):
+        r.handle(
+            Message(
+                from_=p,
+                to=1,
+                term=r.term,
+                type=MT.REPLICATE_RESP,
+                log_index=r.log.last_index(),
+            )
+        )
+        eng.ack(1, p, r.log.last_index())
+    eng.step(do_tick=False)
+    assert eng.committed_index(1) == r.log.committed == 7
+
+
+def test_group_lifecycle_row_reuse():
+    eng = BatchedQuorumEngine(n_groups=2, n_peers=3)
+    eng.add_group(1, node_ids=[1, 2, 3], self_id=1)
+    eng.add_group(2, node_ids=[1, 2, 3], self_id=1)
+    with pytest.raises(RuntimeError):
+        eng.add_group(3, node_ids=[1, 2, 3], self_id=1)
+    eng.remove_group(1)
+    eng.add_group(3, node_ids=[1, 2, 3], self_id=1)
+    eng.set_leader(3, term=1, term_start=1, last_index=1)
+    eng.ack(3, 1, 1)
+    eng.ack(3, 2, 1)
+    eng.step(do_tick=False)
+    assert eng.committed_index(3) == 1
