@@ -179,15 +179,26 @@ def open_logdb(
 
     ``dirname == ""`` selects the in-memory backend (test/bench builds,
     analogous to the reference's memfs Pebble).  Otherwise each shard gets
-    ``dirname/shard-NN`` with a WAL-backed store.
+    ``dirname/shard-NN`` backed by the C++ native segmented-WAL engine
+    (``dragonboat_tpu/native``, the analog of the reference's default
+    Pebble / optional RocksDB cgo backend) — falling back to the Python
+    :class:`WalKV` only where the native library cannot be built.
     """
     n = shards or Hard.logdb_pool_size
+    durable_factory: Optional[Callable[[str], IKVStore]] = None
+    if kv_factory is None and dirname:
+        from .. import native
+
+        if native.available():
+            durable_factory = lambda d: native.NativeKV(d, fsync=fsync)
+        else:
+            durable_factory = lambda d: WalKV(d, fsync=fsync)
     rdbs: List[RDB] = []
     for i in range(n):
         if kv_factory is not None:
             kv = kv_factory(os.path.join(dirname, f"shard-{i:02d}") if dirname else "")
         elif dirname:
-            kv = WalKV(os.path.join(dirname, f"shard-{i:02d}"), fsync=fsync)
+            kv = durable_factory(os.path.join(dirname, f"shard-{i:02d}"))
         else:
             kv = InMemKV()
         rdbs.append(RDB(kv, batched=batched))

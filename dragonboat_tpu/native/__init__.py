@@ -1,0 +1,242 @@
+"""Native (C++) log engine binding.
+
+The reference's only native component is its RocksDB cgo backend
+(``internal/logdb/kv/rocksdb/gorocksdb/gorocksdb.c``, SURVEY.md §2.4).
+This package is the TPU build's equivalent: ``nativekv.cpp`` is a
+segmented-WAL key-value log engine with the ``IKVStore`` contract —
+atomic write batches, range-delete, manual compaction, crash recovery —
+compiled to ``libnativekv.so`` and fronted here over ``ctypes``
+(pybind11 is not available in this image).
+
+The library is compiled on demand via the bundled Makefile the first time
+:func:`available` / :class:`NativeKV` is used and then cached.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from typing import Iterator, Optional, Tuple
+
+from ..logdb.kv import KVWriteBatch
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libnativekv.so")
+_SRC = os.path.join(_DIR, "nativekv.cpp")
+
+_lib = None
+_lib_mu = threading.Lock()
+_build_error: Optional[str] = None
+
+
+def _load():
+    global _lib, _build_error
+    with _lib_mu:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            raise RuntimeError(_build_error)
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
+            _SRC
+        ):
+            proc = subprocess.run(
+                ["make", "-C", _DIR, "libnativekv.so"],
+                capture_output=True,
+                text=True,
+            )
+            if proc.returncode != 0:
+                _build_error = f"nativekv build failed:\n{proc.stderr}"
+                raise RuntimeError(_build_error)
+        lib = ctypes.CDLL(_SO)
+        lib.nkv_open.restype = ctypes.c_void_p
+        lib.nkv_open.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
+        lib.nkv_close.argtypes = [ctypes.c_void_p]
+        lib.nkv_errmsg.restype = ctypes.c_char_p
+        lib.nkv_errmsg.argtypes = [ctypes.c_void_p]
+        lib.nkv_get.restype = ctypes.c_int
+        lib.nkv_get.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.nkv_buf_free.argtypes = [ctypes.c_void_p]
+        lib.nkv_commit.restype = ctypes.c_int
+        lib.nkv_commit.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
+        lib.nkv_bulk_remove.restype = ctypes.c_int
+        lib.nkv_bulk_remove.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
+        lib.nkv_compact_range.restype = ctypes.c_int
+        lib.nkv_compact_range.argtypes = [ctypes.c_void_p]
+        lib.nkv_full_compaction.restype = ctypes.c_int
+        lib.nkv_full_compaction.argtypes = [ctypes.c_void_p]
+        lib.nkv_segment_count.restype = ctypes.c_uint64
+        lib.nkv_segment_count.argtypes = [ctypes.c_void_p]
+        lib.nkv_iter_new.restype = ctypes.c_void_p
+        lib.nkv_iter_new.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_int,
+        ]
+        lib.nkv_iter_next.restype = ctypes.c_int
+        lib.nkv_iter_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.nkv_iter_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    """True when the native engine can be built/loaded on this machine."""
+    try:
+        _load()
+        return True
+    except (RuntimeError, OSError):
+        return False
+
+
+def _encode_batch(wb: KVWriteBatch) -> bytes:
+    buf = bytearray()
+    for op, k, v in wb.ops:
+        buf.append(op)
+        buf += struct.pack("<I", len(k))
+        buf += k
+        buf += struct.pack("<I", len(v))
+        buf += v
+    return bytes(buf)
+
+
+class NativeKV:
+    """``IKVStore`` over the C++ segmented-WAL engine."""
+
+    def __init__(self, dirname: str, fsync: bool = True) -> None:
+        lib = _load()
+        os.makedirs(dirname, exist_ok=True)
+        errbuf = ctypes.create_string_buffer(512)
+        self._h = lib.nkv_open(
+            dirname.encode(), 1 if fsync else 0, errbuf, len(errbuf)
+        )
+        if not self._h:
+            raise IOError(f"nativekv open {dirname!r}: {errbuf.value.decode()}")
+        self._lib = lib
+        self._mu = threading.Lock()
+        self._closed = False
+
+    # -- IKVStore --
+
+    def name(self) -> str:
+        return "nativekv"
+
+    def _check(self, rc: int) -> None:
+        if rc < 0:
+            msg = self._lib.nkv_errmsg(self._h)
+            raise IOError(msg.decode() if msg else "nativekv error")
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        val = ctypes.c_void_p()
+        vlen = ctypes.c_size_t()
+        rc = self._lib.nkv_get(
+            self._h, key, len(key), ctypes.byref(val), ctypes.byref(vlen)
+        )
+        self._check(rc)
+        if rc == 0:
+            return None
+        try:
+            return ctypes.string_at(val.value, vlen.value)
+        finally:
+            self._lib.nkv_buf_free(val)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        wb = self.get_write_batch()
+        wb.put(key, value)
+        self.commit_write_batch(wb)
+
+    def delete(self, key: bytes) -> None:
+        wb = self.get_write_batch()
+        wb.delete(key)
+        self.commit_write_batch(wb)
+
+    def iterate(
+        self, first: bytes, last: bytes, inc_last: bool
+    ) -> Iterator[Tuple[bytes, bytes]]:
+        it = self._lib.nkv_iter_new(
+            self._h, first, len(first), last, len(last), 1 if inc_last else 0
+        )
+        if not it:
+            self._check(-1)
+        k = ctypes.c_void_p()
+        klen = ctypes.c_size_t()
+        v = ctypes.c_void_p()
+        vlen = ctypes.c_size_t()
+        try:
+            while self._lib.nkv_iter_next(
+                it,
+                ctypes.byref(k),
+                ctypes.byref(klen),
+                ctypes.byref(v),
+                ctypes.byref(vlen),
+            ):
+                yield (
+                    ctypes.string_at(k.value, klen.value),
+                    ctypes.string_at(v.value, vlen.value),
+                )
+        finally:
+            self._lib.nkv_iter_free(it)
+
+    def get_write_batch(self) -> KVWriteBatch:
+        return KVWriteBatch()
+
+    def commit_write_batch(self, wb: KVWriteBatch) -> None:
+        payload = _encode_batch(wb)
+        self._check(self._lib.nkv_commit(self._h, payload, len(payload)))
+
+    def bulk_remove_entries(self, first: bytes, last: bytes) -> None:
+        self._check(
+            self._lib.nkv_bulk_remove(self._h, first, len(first), last, len(last))
+        )
+
+    def compact_entries(self, first: bytes, last: bytes) -> None:
+        self._check(self._lib.nkv_compact_range(self._h))
+
+    def full_compaction(self) -> None:
+        self._check(self._lib.nkv_full_compaction(self._h))
+
+    def segment_count(self) -> int:
+        return int(self._lib.nkv_segment_count(self._h))
+
+    def close(self) -> None:
+        with self._mu:
+            if not self._closed:
+                self._closed = True
+                self._lib.nkv_close(self._h)
+
+    def __del__(self) -> None:  # best effort
+        try:
+            self.close()
+        except Exception:
+            pass

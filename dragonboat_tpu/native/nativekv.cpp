@@ -1,0 +1,482 @@
+// nativekv: segmented-WAL key-value log engine for the TPU dragonboat port.
+//
+// Plays the role the reference fills with Pebble/RocksDB behind
+// internal/logdb/kv/kv.go:28 (IKVStore): atomic WriteBatch commits,
+// range-delete (BulkRemoveEntries), manual compaction, crash recovery.
+// The workload is a Raft LogDB: small fixed-size keys, write-mostly,
+// sequential appends, periodic range-deletes of compacted log entries —
+// so the design is a log-structured store (Bitcask-with-ordered-index):
+//
+//   * all writes append framed, crc32-guarded batch records to the active
+//     segment file (seg-%08u.nkv); one optional fdatasync per commit
+//   * an in-memory ordered index (std::map) maps key -> value location
+//     (segment id, offset, length); reads pread() from the segment
+//   * delete/delete-range are logged as tombstone ops in the same records
+//   * per-segment dead-byte accounting drives GC: segments whose live
+//     fraction drops below a threshold are rewritten into the active
+//     segment and unlinked (CompactEntries/FullCompaction)
+//   * recovery replays segments in id order; a torn tail in the newest
+//     segment is truncated, torn records elsewhere abort the open
+//
+// Exposed as a flat C ABI (extern "C") consumed from Python over ctypes
+// (dragonboat_tpu/native/__init__.py).
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdarg>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+namespace {
+
+// ---------------------------------------------------------------- crc32
+// Standard IEEE 802.3 crc32 (same polynomial as zlib.crc32).
+uint32_t crc32_table[256];
+struct Crc32Init {
+  Crc32Init() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      crc32_table[i] = c;
+    }
+  }
+} crc32_init_;
+
+uint32_t crc32(const uint8_t* data, size_t n, uint32_t crc = 0) {
+  crc = ~crc;
+  for (size_t i = 0; i < n; i++)
+    crc = crc32_table[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+// ------------------------------------------------------------- framing
+// Record: u32 crc32(payload) | u32 payload_len | i32 nops | payload.
+// Payload per op: u8 op | u32 klen | key | u32 vlen | value.
+// Identical shape to the Python WalKV framing so the formats stay
+// mutually intelligible for debugging (not interchanged in practice).
+constexpr size_t kHdrSize = 12;
+constexpr uint8_t kOpPut = 0;
+constexpr uint8_t kOpDelete = 1;
+constexpr uint8_t kOpDeleteRange = 2;
+
+constexpr uint64_t kSegmentLimit = 64ull << 20;  // rotate at 64 MiB
+constexpr double kGcLiveThreshold = 0.40;        // rewrite below 40% live
+
+void put_u32(std::string& out, uint32_t v) {
+  char b[4] = {char(v), char(v >> 8), char(v >> 16), char(v >> 24)};
+  out.append(b, 4);
+}
+uint32_t get_u32(const uint8_t* p) {
+  return uint32_t(p[0]) | uint32_t(p[1]) << 8 | uint32_t(p[2]) << 16 |
+         uint32_t(p[3]) << 24;
+}
+
+struct Loc {
+  uint32_t seg;
+  uint32_t len;
+  uint64_t off;
+};
+
+struct SegInfo {
+  int fd = -1;
+  uint64_t size = 0;       // bytes written (valid length)
+  uint64_t live = 0;       // bytes of values still referenced
+  uint64_t total = 0;      // bytes of values ever written
+};
+
+class NativeKV;
+
+struct IterOut {
+  std::vector<std::pair<std::string, std::string>> pairs;
+  size_t pos = 0;
+};
+
+class NativeKV {
+ public:
+  std::string err;
+
+  int Open(const std::string& dir, bool fsync) {
+    dir_ = dir;
+    fsync_ = fsync;
+    if (::mkdir(dir.c_str(), 0755) != 0 && errno != EEXIST)
+      return Fail("mkdir %s: %s", dir.c_str(), strerror(errno));
+    std::vector<uint32_t> ids;
+    DIR* d = ::opendir(dir.c_str());
+    if (!d) return Fail("opendir %s: %s", dir.c_str(), strerror(errno));
+    while (dirent* e = ::readdir(d)) {
+      unsigned id;
+      if (sscanf(e->d_name, "seg-%08u.nkv", &id) == 1) ids.push_back(id);
+    }
+    ::closedir(d);
+    std::sort(ids.begin(), ids.end());
+    for (size_t i = 0; i < ids.size(); i++) {
+      if (Replay(ids[i], i + 1 == ids.size()) != 0) return -1;
+    }
+    active_ = ids.empty() ? 1 : ids.back();
+    if (ids.empty() || segs_[active_].size >= kSegmentLimit) {
+      if (!ids.empty()) active_++;
+      if (OpenSegment(active_, /*create=*/true) != 0) return -1;
+    }
+    return 0;
+  }
+
+  ~NativeKV() {
+    for (auto& [id, s] : segs_)
+      if (s.fd >= 0) ::close(s.fd);
+  }
+
+  int Get(const uint8_t* k, size_t klen, std::string* out, bool* found) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = index_.find(std::string((const char*)k, klen));
+    if (it == index_.end()) {
+      *found = false;
+      return 0;
+    }
+    *found = true;
+    return ReadValue(it->second, out);
+  }
+
+  // batch: sequence of ops in the payload format described above.
+  int Commit(const uint8_t* batch, size_t blen) {
+    std::lock_guard<std::mutex> g(mu_);
+    return CommitLocked(batch, blen);
+  }
+
+  int BulkRemove(const uint8_t* f, size_t fl, const uint8_t* l, size_t ll) {
+    std::string payload;
+    payload.push_back((char)kOpDeleteRange);
+    put_u32(payload, fl);
+    payload.append((const char*)f, fl);
+    put_u32(payload, ll);
+    payload.append((const char*)l, ll);
+    return Commit((const uint8_t*)payload.data(), payload.size());
+  }
+
+  // GC segments whose live fraction fell below threshold.  first/last kept
+  // for interface parity (the dead bytes already tell us what to do).
+  int CompactRange() {
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<uint32_t> victims;
+    for (auto& [id, s] : segs_) {
+      if (id == active_) continue;
+      double live = s.total ? double(s.live) / double(s.total) : 0.0;
+      if (live < kGcLiveThreshold) victims.push_back(id);
+    }
+    for (uint32_t id : victims)
+      if (Rewrite(id) != 0) return -1;
+    return 0;
+  }
+
+  int FullCompaction() {
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<uint32_t> victims;
+    for (auto& [id, s] : segs_)
+      if (id != active_) victims.push_back(id);
+    for (uint32_t id : victims)
+      if (Rewrite(id) != 0) return -1;
+    // roll the active segment too so its garbage is collectable next round
+    if (segs_[active_].live < segs_[active_].total) {
+      uint32_t old = active_;
+      if (OpenSegment(++active_, true) != 0) return -1;
+      if (Rewrite(old) != 0) return -1;
+    }
+    return 0;
+  }
+
+  IterOut* NewIter(const uint8_t* f, size_t fl, const uint8_t* l, size_t ll,
+                   bool inc_last) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto out = std::make_unique<IterOut>();
+    std::string first((const char*)f, fl), last((const char*)l, ll);
+    auto it = index_.lower_bound(first);
+    for (; it != index_.end(); ++it) {
+      if (it->first > last || (it->first == last && !inc_last)) break;
+      std::string v;
+      if (ReadValue(it->second, &v) != 0) return nullptr;
+      out->pairs.emplace_back(it->first, std::move(v));
+    }
+    return out.release();
+  }
+
+  uint64_t SegmentCount() {
+    std::lock_guard<std::mutex> g(mu_);
+    return segs_.size();
+  }
+
+ private:
+  int Fail(const char* fmt, ...) {
+    char buf[512];
+    va_list ap;
+    va_start(ap, fmt);
+    vsnprintf(buf, sizeof buf, fmt, ap);
+    va_end(ap);
+    err = buf;
+    return -1;
+  }
+
+  std::string SegPath(uint32_t id) {
+    char name[64];
+    snprintf(name, sizeof name, "seg-%08u.nkv", id);
+    return dir_ + "/" + name;
+  }
+
+  int OpenSegment(uint32_t id, bool create) {
+    int flags = O_RDWR | O_APPEND | (create ? O_CREAT : 0);
+    int fd = ::open(SegPath(id).c_str(), flags, 0644);
+    if (fd < 0) return Fail("open seg %u: %s", id, strerror(errno));
+    segs_[id].fd = fd;
+    if (create && fsync_) SyncDir();
+    return 0;
+  }
+
+  void SyncDir() {
+    int dfd = ::open(dir_.c_str(), O_RDONLY | O_DIRECTORY);
+    if (dfd >= 0) {
+      ::fsync(dfd);
+      ::close(dfd);
+    }
+  }
+
+  int ReadValue(const Loc& loc, std::string* out) {
+    out->resize(loc.len);
+    if (loc.len == 0) return 0;
+    int fd = segs_[loc.seg].fd;
+    ssize_t n = ::pread(fd, &(*out)[0], loc.len, (off_t)loc.off);
+    if (n != (ssize_t)loc.len)
+      return Fail("pread seg %u off %llu: %s", loc.seg,
+                  (unsigned long long)loc.off, strerror(errno));
+    return 0;
+  }
+
+  int CommitLocked(const uint8_t* payload, size_t plen) {
+    SegInfo& si = segs_[active_];
+    if (si.size >= kSegmentLimit) {
+      if (OpenSegment(++active_, true) != 0) return -1;
+    }
+    SegInfo& seg = segs_[active_];
+    int nops = CountOps(payload, plen);
+    if (nops < 0) return Fail("malformed batch payload");
+    std::string hdr;
+    put_u32(hdr, crc32(payload, plen));
+    put_u32(hdr, (uint32_t)plen);
+    put_u32(hdr, (uint32_t)nops);
+    iovec iov[2] = {{(void*)hdr.data(), hdr.size()},
+                    {(void*)payload, plen}};
+    ssize_t want = (ssize_t)(hdr.size() + plen);
+    if (::writev(seg.fd, iov, 2) != want)
+      return Fail("writev: %s", strerror(errno));
+    if (fsync_ && ::fdatasync(seg.fd) != 0)
+      return Fail("fdatasync: %s", strerror(errno));
+    uint64_t base = seg.size + kHdrSize;
+    seg.size += (uint64_t)want;
+    return ApplyPayloadWithOverwriteAccounting(payload, plen, active_, base);
+  }
+
+  // Like ApplyPayload but discounts overwritten values' live bytes.
+  int ApplyPayloadWithOverwriteAccounting(const uint8_t* p, size_t n,
+                                          uint32_t seg, uint64_t base) {
+    size_t pos = 0;
+    while (pos < n) {
+      uint8_t op = p[pos];
+      uint32_t klen = get_u32(p + pos + 1);
+      pos += 5;
+      std::string key((const char*)p + pos, klen);
+      pos += klen;
+      uint32_t vlen = get_u32(p + pos);
+      pos += 4;
+      if (op == kOpPut) {
+        auto it = index_.find(key);
+        if (it != index_.end()) segs_[it->second.seg].live -= it->second.len;
+        index_[key] = Loc{seg, vlen, base + pos};
+        segs_[seg].total += vlen;
+        segs_[seg].live += vlen;
+      } else if (op == kOpDelete) {
+        auto it = index_.find(key);
+        if (it != index_.end()) {
+          segs_[it->second.seg].live -= it->second.len;
+          index_.erase(it);
+        }
+      } else {  // kOpDeleteRange
+        std::string last((const char*)p + pos, vlen);
+        auto lo = index_.lower_bound(key);
+        auto hi = index_.lower_bound(last);
+        for (auto it = lo; it != hi; ++it)
+          segs_[it->second.seg].live -= it->second.len;
+        index_.erase(lo, hi);
+      }
+      pos += vlen;
+    }
+    return 0;
+  }
+
+  static int CountOps(const uint8_t* p, size_t n) {
+    size_t pos = 0;
+    int count = 0;
+    while (pos < n) {
+      if (pos + 5 > n) return -1;
+      uint32_t klen = get_u32(p + pos + 1);
+      pos += 5 + klen;
+      if (pos + 4 > n) return -1;
+      uint32_t vlen = get_u32(p + pos);
+      pos += 4 + vlen;
+      count++;
+    }
+    return pos == n ? count : -1;
+  }
+
+  int Replay(uint32_t id, bool is_last) {
+    if (OpenSegment(id, /*create=*/false) != 0) return -1;
+    SegInfo& seg = segs_[id];
+    struct stat st;
+    if (::fstat(seg.fd, &st) != 0) return Fail("fstat: %s", strerror(errno));
+    uint64_t n = (uint64_t)st.st_size;
+    std::vector<uint8_t> buf(n);
+    if (n && ::pread(seg.fd, buf.data(), n, 0) != (ssize_t)n)
+      return Fail("replay pread: %s", strerror(errno));
+    uint64_t pos = 0, valid_to = 0;
+    while (pos + kHdrSize <= n) {
+      uint32_t crc = get_u32(&buf[pos]);
+      uint32_t plen = get_u32(&buf[pos + 4]);
+      uint64_t body = pos + kHdrSize;
+      if (body + plen > n) break;
+      if (crc32(&buf[body], plen) != crc) break;
+      if (ApplyPayloadWithOverwriteAccounting(&buf[body], plen, id, body) != 0)
+        return -1;
+      pos = body + plen;
+      valid_to = pos;
+    }
+    if (valid_to < n) {
+      if (!is_last)
+        return Fail("corrupt record in segment %u at %llu", id,
+                    (unsigned long long)valid_to);
+      if (::ftruncate(seg.fd, (off_t)valid_to) != 0)
+        return Fail("ftruncate: %s", strerror(errno));
+    }
+    seg.size = valid_to;
+    return 0;
+  }
+
+  // Move segment `id`'s live values into the active segment, then drop it.
+  // Re-putting an existing key never inserts or erases map nodes, so the
+  // range-for stays valid across the embedded CommitLocked calls.
+  int Rewrite(uint32_t id) {
+    std::string payload;
+    for (auto& [k, loc] : index_) {
+      if (loc.seg != id) continue;
+      std::string v;
+      if (ReadValue(loc, &v) != 0) return -1;
+      payload.push_back((char)kOpPut);
+      put_u32(payload, k.size());
+      payload += k;
+      put_u32(payload, v.size());
+      payload += v;
+      if (payload.size() >= (8u << 20)) {  // bounded batches
+        if (CommitLocked((const uint8_t*)payload.data(), payload.size()) != 0)
+          return -1;
+        payload.clear();
+      }
+    }
+    if (!payload.empty() &&
+        CommitLocked((const uint8_t*)payload.data(), payload.size()) != 0)
+      return -1;
+    SegInfo& s = segs_[id];
+    if (s.fd >= 0) ::close(s.fd);
+    ::unlink(SegPath(id).c_str());
+    segs_.erase(id);
+    if (fsync_) SyncDir();
+    return 0;
+  }
+
+  std::string dir_;
+  bool fsync_ = true;
+  std::mutex mu_;
+  std::map<std::string, Loc> index_;
+  std::unordered_map<uint32_t, SegInfo> segs_;
+  uint32_t active_ = 1;
+};
+
+}  // namespace
+
+// ------------------------------------------------------------- C ABI
+extern "C" {
+
+NativeKV* nkv_open(const char* dir, int do_fsync, char* errbuf,
+                   size_t errlen) {
+  auto kv = std::make_unique<NativeKV>();
+  if (kv->Open(dir, do_fsync != 0) != 0) {
+    if (errbuf && errlen) snprintf(errbuf, errlen, "%s", kv->err.c_str());
+    return nullptr;
+  }
+  return kv.release();
+}
+
+void nkv_close(NativeKV* kv) { delete kv; }
+
+const char* nkv_errmsg(NativeKV* kv) { return kv->err.c_str(); }
+
+// returns 1 found, 0 not found, -1 error; *val is malloc'd, free with
+// nkv_buf_free
+int nkv_get(NativeKV* kv, const uint8_t* k, size_t klen, uint8_t** val,
+            size_t* vlen) {
+  std::string out;
+  bool found = false;
+  if (kv->Get(k, klen, &out, &found) != 0) return -1;
+  if (!found) return 0;
+  *vlen = out.size();
+  *val = (uint8_t*)malloc(out.size() ? out.size() : 1);
+  memcpy(*val, out.data(), out.size());
+  return 1;
+}
+
+void nkv_buf_free(uint8_t* p) { free(p); }
+
+int nkv_commit(NativeKV* kv, const uint8_t* batch, size_t blen) {
+  return kv->Commit(batch, blen);
+}
+
+int nkv_bulk_remove(NativeKV* kv, const uint8_t* f, size_t fl,
+                    const uint8_t* l, size_t ll) {
+  return kv->BulkRemove(f, fl, l, ll);
+}
+
+int nkv_compact_range(NativeKV* kv) { return kv->CompactRange(); }
+
+int nkv_full_compaction(NativeKV* kv) { return kv->FullCompaction(); }
+
+uint64_t nkv_segment_count(NativeKV* kv) { return kv->SegmentCount(); }
+
+IterOut* nkv_iter_new(NativeKV* kv, const uint8_t* f, size_t fl,
+                      const uint8_t* l, size_t ll, int inc_last) {
+  return kv->NewIter(f, fl, l, ll, inc_last != 0);
+}
+
+// returns 1 and fills pointers while pairs remain; 0 at end.  Pointers are
+// valid until the next nkv_iter_next / nkv_iter_free call.
+int nkv_iter_next(IterOut* it, const uint8_t** k, size_t* klen,
+                  const uint8_t** v, size_t* vlen) {
+  if (!it || it->pos >= it->pairs.size()) return 0;
+  auto& p = it->pairs[it->pos++];
+  *k = (const uint8_t*)p.first.data();
+  *klen = p.first.size();
+  *v = (const uint8_t*)p.second.data();
+  *vlen = p.second.size();
+  return 1;
+}
+
+void nkv_iter_free(IterOut* it) { delete it; }
+
+}  // extern "C"

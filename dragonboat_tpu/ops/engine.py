@@ -160,9 +160,18 @@ class BatchedQuorumEngine:
         for nid, slot in slots.items():
             a["present"][row, slot] = True
             a["voting"][row, slot] = nid not in observers
-        self.mirror.base[row] = 0
         self._dirty.add(row)
         return gi
+
+    def _purge_row_events(self, row: int) -> None:
+        """Drop queued acks/votes for a row.  Called on every state
+        transition (and removal): events staged before the transition
+        belong to the old term and must never reach the new term's tally
+        (the scalar twin drops mismatched-term responses in
+        ``handle_vote_resp`` / ``handle_replicate_resp``)."""
+        self._acks = [e for e in self._acks if e[0] != row]
+        self._votes = [e for e in self._votes if e[0] != row]
+        self._voted_cells = {c for c in self._voted_cells if c[0] != row}
 
     def remove_group(self, cluster_id: int) -> None:
         gi = self.groups.pop(cluster_id)
@@ -171,9 +180,7 @@ class BatchedQuorumEngine:
         self._dirty.add(gi.row)
         # purge queued events so a future tenant of this row never receives
         # the dead group's acks/votes
-        self._acks = [e for e in self._acks if e[0] != gi.row]
-        self._votes = [e for e in self._votes if e[0] != gi.row]
-        self._voted_cells = {c for c in self._voted_cells if c[0] != gi.row}
+        self._purge_row_events(gi.row)
         self._free.append(gi.row)
 
     # ------------------------------------------------------------------
@@ -209,6 +216,7 @@ class BatchedQuorumEngine:
         a["next"][row, :] = self._rel(gi, last_index) + 1
         a["match"][row, a["self_slot"][row]] = self._rel(gi, last_index)
         a["active"][row, :] = False
+        self._purge_row_events(row)
         self._dirty.add(row)
 
     def set_candidate(self, cluster_id: int, term: int) -> None:
@@ -222,7 +230,7 @@ class BatchedQuorumEngine:
         a["term"][row] = term
         a["votes"][row, :] = VOTE_NONE
         a["election_tick"][row] = 0
-        self._voted_cells = {c for c in self._voted_cells if c[0] != row}
+        self._purge_row_events(row)
         self._dirty.add(row)
 
     def set_follower(self, cluster_id: int, term: int) -> None:
@@ -234,7 +242,7 @@ class BatchedQuorumEngine:
         a["term"][row] = term
         a["votes"][row, :] = VOTE_NONE
         a["election_tick"][row] = 0
-        self._voted_cells = {c for c in self._voted_cells if c[0] != row}
+        self._purge_row_events(row)
         self._dirty.add(row)
 
     def set_randomized_timeout(self, cluster_id: int, timeout: int) -> None:
@@ -375,18 +383,29 @@ class BatchedQuorumEngine:
         self._voted_cells.clear()
 
         res = StepResult()
-        committed = np.asarray(out.committed)
+        # one batched device→host transfer for the whole egress set (a
+        # network-attached chip pays the full round trip per readback)
+        committed, won, lost, elect, hb, demote = jax.device_get(
+            (
+                out.committed,
+                out.won,
+                out.lost,
+                out.flags.elect_due,
+                out.flags.hb_due,
+                out.flags.checkq_demote,
+            )
+        )
         changed = np.nonzero(committed != prev_committed)[0]
         for row in changed:
             gi = self.rows.get(int(row))
             if gi is not None:
                 res.commit[gi.cluster_id] = int(gi.base) + int(committed[row])
         for name, arr in (
-            ("won", out.won),
-            ("lost", out.lost),
-            ("elect", out.flags.elect_due),
-            ("heartbeat", out.flags.hb_due),
-            ("demote", out.flags.checkq_demote),
+            ("won", won),
+            ("lost", lost),
+            ("elect", elect),
+            ("heartbeat", hb),
+            ("demote", demote),
         ):
             idx = np.nonzero(np.asarray(arr))[0]
             if idx.size:

@@ -124,8 +124,6 @@ class HostMirror:
         self.n_peers = n_peers
         dev = make_state(n_groups, n_peers)
         self.arrays = {k: np.asarray(v).copy() for k, v in dev._asdict().items()}
-        # host-only: uint64 base per group for index rebasing
-        self.base = np.zeros((n_groups,), np.uint64)
 
     def to_device(self, sharding=None) -> QuorumState:
         put = (

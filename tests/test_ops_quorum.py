@@ -407,3 +407,42 @@ def test_group_lifecycle_row_reuse():
     eng.ack(3, 2, 1)
     eng.step(do_tick=False)
     assert eng.committed_index(3) == 1
+
+
+def test_stale_queued_votes_purged_on_new_campaign():
+    """Votes queued before a state transition belong to the old term and
+    must not count toward the new term's tally (scalar twin drops
+    mismatched-term responses, raft.go:1062-1080)."""
+    peers = [1, 2, 3, 4, 5]
+    eng = BatchedQuorumEngine(n_groups=1, n_peers=5)
+    eng.add_group(1, node_ids=peers, self_id=1)
+    eng.set_candidate(1, term=1)
+    eng.vote(1, 2, granted=True)  # queued, never stepped — term-1 vote
+    # campaign restarts at term 2 before the engine ever dispatched
+    eng.set_candidate(1, term=2)
+    eng.vote(1, 1, granted=True)
+    eng.vote(1, 3, granted=True)
+    out = eng.step(do_tick=False)
+    # only 2 of quorum-3 granted in term 2: must NOT have won
+    assert out.won == []
+    # peer 2's real term-2 vote still lands (first-vote guard was purged)
+    eng.vote(1, 2, granted=True)
+    out = eng.step(do_tick=False)
+    assert out.won == [1]
+
+
+def test_stale_queued_acks_purged_on_leader_transition():
+    peers = [1, 2, 3]
+    eng = BatchedQuorumEngine(n_groups=1, n_peers=3)
+    eng.add_group(1, node_ids=peers, self_id=1)
+    eng.set_leader(1, term=1, term_start=1, last_index=4)
+    eng.ack(1, 2, 3)  # queued old-term ack, never stepped
+    eng.set_follower(1, term=2)
+    eng.set_leader(1, term=3, term_start=5, last_index=5)
+    eng.ack(1, 1, 5)
+    out = eng.step(do_tick=False)
+    # without peer 2's (purged) stale ack nothing past term_start commits
+    assert eng.committed_index(1) == 0
+    eng.ack(1, 2, 5)
+    eng.step(do_tick=False)
+    assert eng.committed_index(1) == 5
