@@ -12,6 +12,7 @@ import threading
 import time
 from typing import Callable, Dict, Tuple
 
+from . import vfs
 from .client import Session
 from .config import Config, NodeHostConfig
 from .engine import Engine
@@ -63,6 +64,11 @@ class NodeHost:
         self._clusters: Dict[int, Node] = {}
         self._csi = 0  # cluster-set change counter (reference clusterMu.csi)
         self._stopped = threading.Event()
+        # filesystem the snapshot paths go through (ExpertConfig.fs lets
+        # tests run diskless via vfs.MemFS or inject faults via vfs.ErrorFS,
+        # which is auto-detected like the reference nodehost.go:321-327)
+        self._fs = nhconfig.expert.fs or vfs.DEFAULT
+        self._capture_panics = vfs.is_error_fs(self._fs)
         # storage
         in_memory = nhconfig.node_host_dir == ":memory:"
         if nhconfig.logdb_factory is not None:
@@ -224,7 +230,7 @@ class NodeHost:
         logreader = LogReader.load(cluster_id, node_id, self.logdb)
         snapshotter = Snapshotter(
             self.snapshot_dir(cluster_id, node_id), cluster_id, node_id,
-            self.logdb,
+            self.logdb, fs=self._fs,
         )
         usersm = create_sm(cluster_id, node_id)
         if smtype == StateMachineType.REGULAR:

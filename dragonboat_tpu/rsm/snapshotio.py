@@ -21,6 +21,7 @@ import struct
 import zlib
 from typing import BinaryIO, List, Tuple
 
+from .. import vfs
 from ..settings import Hard
 from ..wire import Snapshot, SnapshotFile
 
@@ -114,9 +115,10 @@ class BlockReader:
 class SnapshotWriter:
     """Reference ``snapshotio.go:163`` ``SnapshotWriter``."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, fs: vfs.IFS = vfs.DEFAULT):
         self.path = path
-        self._f = open(path, "wb")
+        self._fs = fs
+        self._f = fs.open(path, "wb")
         self._f.write(b"\0" * Hard.snapshot_header_size)  # placeholder
         self._bw = BlockWriter(self._f)
         self.session_size = 0
@@ -140,15 +142,17 @@ class SnapshotWriter:
         self._f.flush()
         self._f.seek(0)
         self._f.write(bytes(header))
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        self._fs.fsync(self._f)
         self._f.close()
         self._closed = True
 
     def abort(self) -> None:
         if not self._closed:
             self._f.close()
-            os.unlink(self.path)
+            try:
+                self._fs.remove(self.path)
+            except OSError:
+                pass
             self._closed = True
 
 
@@ -174,9 +178,9 @@ def read_header(f: BinaryIO) -> Tuple[int, int, int, int]:
 class SnapshotReader:
     """Reference ``snapshotio.go:272`` ``SnapshotReader``."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, fs: vfs.IFS = vfs.DEFAULT):
         self.path = path
-        self._f = open(path, "rb")
+        self._f = fs.open(path, "rb")
         (
             self.session_size,
             self.payload_crc,
@@ -203,10 +207,10 @@ class SnapshotReader:
         self._f.close()
 
 
-def validate_snapshot_file(path: str) -> bool:
+def validate_snapshot_file(path: str, fs: vfs.IFS = vfs.DEFAULT) -> bool:
     """Reference ``snapshotio.go:392`` ``SnapshotValidator``."""
     try:
-        r = SnapshotReader(path)
+        r = SnapshotReader(path, fs)
         try:
             r.validate_payload()
         finally:
@@ -216,24 +220,24 @@ def validate_snapshot_file(path: str) -> bool:
         return False
 
 
-def shrink_snapshot(src: str, dst: str) -> None:
+def shrink_snapshot(src: str, dst: str, fs: vfs.IFS = vfs.DEFAULT) -> None:
     """Strip the payload, keep sessions-empty image (reference
     ``snapshotio.go:443-516`` ``ShrinkSnapshot``): used when an on-disk SM
     restarts — its state needs no replay, only valid metadata."""
-    r = SnapshotReader(src)
+    r = SnapshotReader(src, fs)
     try:
         r.validate_payload()
     finally:
         r.close()
-    w = SnapshotWriter(dst)
+    w = SnapshotWriter(dst, fs)
     w.write_session(b"")
     w.finalize()
 
 
-def write_witness_snapshot(path: str) -> None:
+def write_witness_snapshot(path: str, fs: vfs.IFS = vfs.DEFAULT) -> None:
     """Tiny dummy image for witness replicas (reference
     ``snapshotio.go:133``)."""
-    w = SnapshotWriter(path)
+    w = SnapshotWriter(path, fs)
     w.write_session(b"")
     w.finalize()
 
@@ -242,8 +246,9 @@ class FileCollection:
     """External snapshot file collection (reference ``internal/rsm/files.go``
     implementing ``sm.ISnapshotFileCollection``)."""
 
-    def __init__(self, tmpdir: str):
+    def __init__(self, tmpdir: str, fs: vfs.IFS = vfs.DEFAULT):
         self.tmpdir = tmpdir
+        self._fs = fs
         self.files: List[SnapshotFile] = []
         self._ids = set()
 
@@ -263,9 +268,9 @@ class FileCollection:
                 os.path.dirname(ss.filepath) or self.tmpdir,
                 f"external-file-{f.file_id}",
             )
-            if os.path.exists(f.filepath):
-                os.replace(f.filepath, final)
-            size = os.path.getsize(final) if os.path.exists(final) else 0
+            if self._fs.exists(f.filepath):
+                self._fs.replace(f.filepath, final)
+            size = self._fs.getsize(final) if self._fs.exists(final) else 0
             ss.files.append(
                 SnapshotFile(
                     filepath=final,

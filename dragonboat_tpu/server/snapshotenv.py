@@ -14,6 +14,7 @@ import os
 import re
 from typing import Optional
 
+from .. import vfs
 from ..wire import Snapshot
 from ..wire.codec import decode_snapshot, encode_snapshot
 
@@ -35,15 +36,11 @@ def snapshot_dir_name(index: int) -> str:
     return f"snapshot-{index:016X}"
 
 
-def _fsync_dir(path: str) -> None:
+def _fsync_dir(path: str, fs: vfs.IFS = vfs.DEFAULT) -> None:
     try:
-        fd = os.open(path, os.O_RDONLY)
+        fs.fsync_dir(path)
     except OSError:
         return
-    try:
-        os.fsync(fd)
-    finally:
-        os.close(fd)
 
 
 class SSEnv:
@@ -55,7 +52,9 @@ class SSEnv:
         index: int,
         from_node_id: int,
         mode: SSMode,
+        fs: vfs.IFS = vfs.DEFAULT,
     ):
+        self.fs = fs
         self.root_dir = root_dir
         self.index = index
         final = snapshot_dir_name(index)
@@ -69,8 +68,8 @@ class SSEnv:
     # ---- temp stage ----
 
     def create_tmp_dir(self) -> None:
-        os.makedirs(self.tmp_dir, exist_ok=False)
-        _fsync_dir(self.root_dir)
+        self.fs.makedirs(self.tmp_dir, exist_ok=False)
+        _fsync_dir(self.root_dir, self.fs)
 
     def get_tmp_dir(self) -> str:
         return self.tmp_dir
@@ -89,12 +88,11 @@ class SSEnv:
         ``fileutil.CreateFlagFile``)."""
         flag = os.path.join(self.tmp_dir, SNAPSHOT_FLAG_FILE)
         data = encode_snapshot(ss)
-        with open(flag, "wb") as f:
+        with self.fs.open(flag, "wb") as f:
             f.write(len(data).to_bytes(8, "little"))
             f.write(data)
-            f.flush()
-            os.fsync(f.fileno())
-        _fsync_dir(self.tmp_dir)
+            self.fs.fsync(f)
+        _fsync_dir(self.tmp_dir, self.fs)
 
     # ---- finalize ----
 
@@ -102,28 +100,30 @@ class SSEnv:
         """Atomically promote temp → final (reference
         ``finalizeSnapshot``); raises FileExistsError if another replica
         already installed this index."""
-        if os.path.exists(self.final_dir):
+        if self.fs.exists(self.final_dir):
             raise FileExistsError(self.final_dir)
-        os.rename(self.tmp_dir, self.final_dir)
-        _fsync_dir(self.root_dir)
+        self.fs.replace(self.tmp_dir, self.final_dir)
+        _fsync_dir(self.root_dir, self.fs)
 
     def has_flag_file(self) -> bool:
-        return os.path.exists(os.path.join(self.final_dir, SNAPSHOT_FLAG_FILE))
+        return self.fs.exists(os.path.join(self.final_dir, SNAPSHOT_FLAG_FILE))
 
     def remove_flag_file(self) -> None:
-        os.unlink(os.path.join(self.final_dir, SNAPSHOT_FLAG_FILE))
+        self.fs.remove(os.path.join(self.final_dir, SNAPSHOT_FLAG_FILE))
 
     def remove_tmp_dir(self) -> None:
-        _rmtree(self.tmp_dir)
+        _rmtree(self.tmp_dir, self.fs)
 
     def remove_final_dir(self) -> None:
-        _rmtree(self.final_dir)
+        _rmtree(self.final_dir, self.fs)
 
 
-def read_ss_metadata(dirname: str) -> Optional[Snapshot]:
+def read_ss_metadata(
+    dirname: str, fs: vfs.IFS = vfs.DEFAULT
+) -> Optional[Snapshot]:
     flag = os.path.join(dirname, SNAPSHOT_FLAG_FILE)
     try:
-        with open(flag, "rb") as f:
+        with fs.open(flag, "rb") as f:
             n = int.from_bytes(f.read(8), "little")
             return decode_snapshot(f.read(n))
     except (OSError, ValueError):
@@ -145,7 +145,8 @@ def snapshot_index_from_dir(name: str) -> int:
     return int(m.group(1), 16)
 
 
-def _rmtree(path: str) -> None:
-    import shutil
-
-    shutil.rmtree(path, ignore_errors=True)
+def _rmtree(path: str, fs: vfs.IFS = vfs.DEFAULT) -> None:
+    try:
+        fs.rmtree(path)
+    except OSError:
+        pass

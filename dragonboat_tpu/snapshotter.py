@@ -11,6 +11,7 @@ from __future__ import annotations
 import os
 from typing import Optional, Tuple
 
+from . import vfs
 from .logger import get_logger
 from .rsm.snapshotio import SnapshotReader, SnapshotWriter, shrink_snapshot
 from .rsm.statemachine import SSMeta
@@ -36,12 +37,20 @@ class NoSnapshotError(Exception):
 class Snapshotter:
     """Reference ``snapshotter.go:57``."""
 
-    def __init__(self, root_dir: str, cluster_id: int, node_id: int, logdb):
+    def __init__(
+        self,
+        root_dir: str,
+        cluster_id: int,
+        node_id: int,
+        logdb,
+        fs: vfs.IFS = vfs.DEFAULT,
+    ):
         self.root_dir = root_dir
         self.cluster_id = cluster_id
         self.node_id = node_id
         self.logdb = logdb
-        os.makedirs(root_dir, exist_ok=True)
+        self.fs = fs
+        fs.makedirs(root_dir, exist_ok=True)
 
     # ---- ISnapshotter ----
 
@@ -56,11 +65,11 @@ class Snapshotter:
             if not meta.request.path:
                 raise ValueError("exported snapshot request without a path")
             root = meta.request.path
-        env = SSEnv(root, meta.index, self.node_id, SSMode.SNAPSHOT)
+        env = SSEnv(root, meta.index, self.node_id, SSMode.SNAPSHOT, self.fs)
         env.remove_tmp_dir()
         env.create_tmp_dir()
         path = env.get_tmp_filepath()
-        w = SnapshotWriter(path)
+        w = SnapshotWriter(path, self.fs)
         try:
             savable.save_snapshot_payload(meta, w)
             w.finalize()
@@ -70,7 +79,7 @@ class Snapshotter:
             raise
         ss = Snapshot(
             filepath=env.get_filepath(),
-            file_size=os.path.getsize(path),
+            file_size=self.fs.getsize(path),
             index=meta.index,
             term=meta.term,
             membership=meta.membership,
@@ -91,7 +100,7 @@ class Snapshotter:
     def recover(self, recoverable, ss: Snapshot) -> None:
         """Reference ``snapshotter.go`` recover path: open + validate the
         image and hand the payload to the RSM."""
-        r = SnapshotReader(ss.filepath)
+        r = SnapshotReader(ss.filepath, self.fs)
         try:
             recoverable.recover_from_payload(ss, r)
         finally:
@@ -145,11 +154,11 @@ class Snapshotter:
         for ss in self.logdb.list_snapshots(self.cluster_id, self.node_id):
             if ss.index > shrink_to or ss.witness or ss.dummy:
                 continue
-            if not os.path.exists(ss.filepath):
+            if not self.fs.exists(ss.filepath):
                 continue
             tmp = ss.filepath + ".shrinking"
-            shrink_snapshot(ss.filepath, tmp)
-            os.replace(tmp, ss.filepath)
+            shrink_snapshot(ss.filepath, tmp, self.fs)
+            self.fs.replace(tmp, ss.filepath)
 
     def process_orphans(self) -> None:
         """Remove temp dirs and unrecorded final dirs left by crashes
@@ -159,20 +168,20 @@ class Snapshotter:
             for ss in self.logdb.list_snapshots(self.cluster_id, self.node_id)
         }
         try:
-            names = os.listdir(self.root_dir)
+            names = self.fs.listdir(self.root_dir)
         except OSError:
             return
         for name in names:
             full = os.path.join(self.root_dir, name)
             if is_temp_snapshot_dir(name):
                 plog.info("removing orphaned temp dir %s", full)
-                _rmtree(full)
+                _rmtree(full, self.fs)
             elif is_final_snapshot_dir(name):
                 if snapshot_index_from_dir(name) not in recorded:
                     plog.info("removing unrecorded snapshot dir %s", full)
-                    _rmtree(full)
+                    _rmtree(full, self.fs)
 
     def _remove_snapshot_dir(self, index: int) -> None:
-        env = SSEnv(self.root_dir, index, self.node_id, SSMode.SNAPSHOT)
+        env = SSEnv(self.root_dir, index, self.node_id, SSMode.SNAPSHOT, self.fs)
         env.remove_final_dir()
 

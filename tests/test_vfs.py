@@ -1,0 +1,168 @@
+"""vfs abstraction + ErrorFS fault-injection tests.
+
+Reference surface: ``internal/vfs/vfs.go`` / ``memfs.go`` / ``error.go``
+and the discipline of §4.7 (SURVEY.md): inject I/O errors into the
+snapshot save path and prove no partial state survives.
+"""
+import pytest
+
+from dragonboat_tpu import vfs
+from dragonboat_tpu.rsm.snapshotio import (
+    SnapshotReader,
+    SnapshotWriter,
+    validate_snapshot_file,
+    write_witness_snapshot,
+)
+from dragonboat_tpu.server.snapshotenv import SSEnv, SSMode, read_ss_metadata
+from dragonboat_tpu.wire import Snapshot
+
+
+@pytest.fixture(params=["os", "mem"])
+def fs(request, tmp_path):
+    if request.param == "os":
+        return vfs.OSFS(), str(tmp_path)
+    return vfs.MemFS(), "/vroot"
+
+
+def test_fs_file_roundtrip(fs):
+    f, root = fs
+    f.makedirs(root + "/d")
+    with f.open(root + "/d/a.bin", "wb") as h:
+        h.write(b"hello")
+        f.fsync(h)
+    assert f.exists(root + "/d/a.bin")
+    assert f.getsize(root + "/d/a.bin") == 5
+    with f.open(root + "/d/a.bin", "rb") as h:
+        assert h.read() == b"hello"
+    f.replace(root + "/d/a.bin", root + "/d/b.bin")
+    assert not f.exists(root + "/d/a.bin")
+    assert f.listdir(root + "/d") == ["b.bin"]
+    f.remove(root + "/d/b.bin")
+    assert not f.exists(root + "/d/b.bin")
+
+
+def test_memfs_dir_rename_moves_subtree():
+    f = vfs.MemFS()
+    f.makedirs("/r/snapshot-01.generating")
+    with f.open("/r/snapshot-01.generating/img.ss", "wb") as h:
+        h.write(b"payload")
+    f.replace("/r/snapshot-01.generating", "/r/snapshot-01")
+    assert f.exists("/r/snapshot-01/img.ss")
+    assert not f.exists("/r/snapshot-01.generating")
+    with f.open("/r/snapshot-01/img.ss", "rb") as h:
+        assert h.read() == b"payload"
+
+
+def test_memfs_rmtree():
+    f = vfs.MemFS()
+    f.makedirs("/a/b/c")
+    with f.open("/a/b/c/x", "wb") as h:
+        h.write(b"1")
+    f.rmtree("/a/b")
+    assert not f.exists("/a/b/c/x")
+    assert f.exists("/a")
+
+
+def test_snapshot_io_on_memfs():
+    f = vfs.MemFS()
+    f.makedirs("/ss")
+    w = SnapshotWriter("/ss/img.ss", f)
+    w.write_session(b"sessions")
+    w.write(b"x" * (3 * 1024 * 1024 + 17))  # multi-block payload
+    w.finalize()
+    assert validate_snapshot_file("/ss/img.ss", f)
+    r = SnapshotReader("/ss/img.ss", f)
+    assert r.read_session() == b"sessions"
+    assert len(r.read(-1)) == 3 * 1024 * 1024 + 17
+    r.close()
+    write_witness_snapshot("/ss/w.ss", f)
+    assert validate_snapshot_file("/ss/w.ss", f)
+
+
+def test_ssenv_lifecycle_on_memfs():
+    f = vfs.MemFS()
+    f.makedirs("/root")
+    env = SSEnv("/root", 7, 1, SSMode.SNAPSHOT, f)
+    env.create_tmp_dir()
+    with f.open(env.get_tmp_filepath(), "wb") as h:
+        h.write(b"img")
+    ss = Snapshot(filepath=env.get_filepath(), index=7, term=3)
+    env.save_ss_metadata(ss)
+    env.finalize_snapshot()
+    assert f.exists(env.get_filepath())
+    assert env.has_flag_file()
+    meta = read_ss_metadata(env.get_final_dir(), f)
+    assert meta is not None and meta.index == 7 and meta.term == 3
+
+
+def test_errorfs_injects_on_write(tmp_path):
+    inj = vfs.Injector.after_n(2, ops={"write"})
+    f = vfs.ErrorFS(vfs.OSFS(), inj)
+    h = f.open(str(tmp_path / "x"), "wb")
+    h.write(b"1")
+    h.write(b"2")
+    with pytest.raises(OSError, match="injected"):
+        h.write(b"3")
+    h.close()
+    assert inj.injected == 1
+
+
+def test_snapshotter_save_failure_leaves_no_partial_state(tmp_path):
+    """An injected failure mid-save must leave neither a final dir nor a
+    temp image behind, and a retry with the fault cleared must succeed
+    (reference ErrorFS discipline, vfs/error.go + snapshotter tests)."""
+    from dragonboat_tpu.logdb import open_logdb
+    from dragonboat_tpu.rsm.statemachine import SSMeta
+    from dragonboat_tpu.snapshotter import Snapshotter
+    from dragonboat_tpu.wire import Membership
+
+    class FailEverySave:
+        def __init__(self):
+            self.data = b"snapshot-payload" * 1000
+
+        def save_snapshot_payload(self, meta, w):
+            w.write_session(b"")
+            w.write(self.data)
+
+    db = open_logdb("", shards=1)
+    try:
+        inj = vfs.Injector.after_n(1, ops={"write"}, substr=".generating")
+        efs = vfs.ErrorFS(vfs.OSFS(), inj)
+        root = str(tmp_path / "snapdir")
+        snapper = Snapshotter(root, 1, 1, db, fs=efs)
+        meta = SSMeta(
+            index=10, term=2, membership=Membership(addresses={1: "a"}),
+        )
+        with pytest.raises(OSError, match="injected"):
+            snapper.save(FailEverySave(), meta)
+        # nothing but the (empty) root dir may exist
+        leftover = [
+            n for n in efs.fs.listdir(root)
+        ]
+        assert leftover == [], leftover
+        # retry without faults succeeds and is committable
+        ok_snapper = Snapshotter(root, 1, 1, db)
+        ss, env = ok_snapper.save(FailEverySave(), meta)
+        ok_snapper.commit(ss, env)
+        assert validate_snapshot_file(ss.filepath)
+        assert ok_snapper.get_snapshot().index == 10
+    finally:
+        db.close()
+
+
+def test_nodehost_detects_errorfs(tmp_path):
+    from dragonboat_tpu.config import ExpertConfig, NodeHostConfig
+    from dragonboat_tpu.nodehost import NodeHost
+
+    cfg = NodeHostConfig(
+        node_host_dir=":memory:",
+        raft_address="127.0.0.1:26000",
+        expert=ExpertConfig(
+            fs=vfs.ErrorFS(vfs.OSFS(), vfs.Injector(lambda op, p: False))
+        ),
+    )
+    nh = NodeHost(cfg)
+    try:
+        assert nh._capture_panics
+    finally:
+        nh.stop()
