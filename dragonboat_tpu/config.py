@@ -147,6 +147,9 @@ class NodeHostConfig:
     # factories (reference config/config.go:298-305)
     logdb_factory: Optional[Callable] = None
     raft_rpc_factory: Optional[Callable] = None
+    # user event listeners (reference raftio/listener.go:33,59)
+    raft_event_listener: Optional[object] = None
+    system_event_listener: Optional[object] = None
     fs: Optional[object] = None  # vfs override for tests
 
     def validate(self) -> None:

@@ -1,0 +1,302 @@
+"""Event/metrics plumbing.
+
+Reference: ``event.go`` — ``raftEventListener`` feeding Prometheus
+counters/gauges (metric names ``event.go:65-88``) and forwarding
+``LeaderUpdated`` to the user's ``IRaftEventListener``
+(``raftio/listener.go:33``); ``sysEventListener`` serializing the 15
+system event types (``internal/server/event.go:86-123``) to the user's
+``ISystemEventListener`` (``raftio/listener.go:59-75``) on a dedicated
+delivery thread (``nodehost.go:1748-1769``); ``WriteHealthMetrics``
+(``event.go:31``) exposing Prometheus text.
+
+The reference leans on VictoriaMetrics; here a tiny dependency-free
+registry provides the same counter/gauge + text-exposition surface.
+"""
+from __future__ import annotations
+
+import enum
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple
+
+from .logger import get_logger
+
+plog = get_logger("events")
+
+
+# ---------------------------------------------------------------------------
+# metrics registry (Prometheus text exposition)
+# ---------------------------------------------------------------------------
+
+
+class MetricsRegistry:
+    """Counters and gauges keyed by name + label set."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+        self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+
+    @staticmethod
+    def _key(name: str, labels: Optional[Dict[str, str]]):
+        return (name, tuple(sorted((labels or {}).items())))
+
+    def counter_add(
+        self, name: str, value: float = 1, labels: Optional[Dict[str, str]] = None
+    ) -> None:
+        k = self._key(name, labels)
+        with self._mu:
+            self._counters[k] = self._counters.get(k, 0) + value
+
+    def gauge_set(
+        self, name: str, value: float, labels: Optional[Dict[str, str]] = None
+    ) -> None:
+        with self._mu:
+            self._gauges[self._key(name, labels)] = value
+
+    def counter_value(
+        self, name: str, labels: Optional[Dict[str, str]] = None
+    ) -> float:
+        with self._mu:
+            return self._counters.get(self._key(name, labels), 0)
+
+    def gauge_value(
+        self, name: str, labels: Optional[Dict[str, str]] = None
+    ) -> float:
+        with self._mu:
+            return self._gauges.get(self._key(name, labels), 0)
+
+    @staticmethod
+    def _fmt(name: str, label_items, value: float) -> str:
+        if label_items:
+            body = ",".join(f'{k}="{v}"' for k, v in label_items)
+            return f"{name}{{{body}}} {value:g}"
+        return f"{name} {value:g}"
+
+    def write_health_metrics(self, out) -> None:
+        """Prometheus text format (reference ``WriteHealthMetrics``
+        ``event.go:31``)."""
+        with self._mu:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+        for (name, labels), v in counters:
+            out.write(f"# TYPE {name} counter\n{self._fmt(name, labels, v)}\n")
+        for (name, labels), v in gauges:
+            out.write(f"# TYPE {name} gauge\n{self._fmt(name, labels, v)}\n")
+
+    def reset(self) -> None:
+        with self._mu:
+            self._counters.clear()
+            self._gauges.clear()
+
+
+DEFAULT_REGISTRY = MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# raft event listener (per-node metrics + LeaderUpdated forwarding)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class LeaderInfo:
+    """Reference ``raftio.LeaderInfo``."""
+
+    cluster_id: int
+    node_id: int
+    term: int
+    leader_id: int
+
+
+class RaftEventListener:
+    """Implements the raft core's ``events`` hook surface
+    (``raft.py`` emission sites; reference ``event.go:37-91``): updates the
+    metric family the reference exports and forwards leader changes to the
+    user listener's ``leader_updated``."""
+
+    def __init__(
+        self,
+        user_listener=None,
+        registry: Optional[MetricsRegistry] = None,
+        enabled: bool = True,
+    ):
+        self.user_listener = user_listener
+        self.registry = registry or DEFAULT_REGISTRY
+        self.enabled = enabled
+
+    def _labels(self, cluster_id: int, node_id: int) -> Dict[str, str]:
+        return {"cluster_id": str(cluster_id), "node_id": str(node_id)}
+
+    # -- hook surface consumed by raft.py --
+
+    def leader_updated(
+        self, cluster_id: int, node_id: int, leader_id: int, term: int
+    ) -> None:
+        if self.enabled:
+            labels = self._labels(cluster_id, node_id)
+            self.registry.gauge_set(
+                "dragonboat_raftnode_has_leader", 1 if leader_id else 0, labels
+            )
+            self.registry.gauge_set("dragonboat_raftnode_term", term, labels)
+        if self.user_listener is not None:
+            try:
+                self.user_listener.leader_updated(
+                    LeaderInfo(cluster_id, node_id, term, leader_id)
+                )
+            except Exception:  # user callback must never hurt the node
+                plog.exception("user leader_updated callback failed")
+
+    def campaign_launched(self, cluster_id: int, node_id: int, term: int) -> None:
+        if self.enabled:
+            self.registry.counter_add(
+                "dragonboat_raftnode_campaign_launched_total",
+                labels=self._labels(cluster_id, node_id),
+            )
+
+    def campaign_skipped(self, cluster_id: int, node_id: int, term: int) -> None:
+        if self.enabled:
+            self.registry.counter_add(
+                "dragonboat_raftnode_campaign_skipped_total",
+                labels=self._labels(cluster_id, node_id),
+            )
+
+    def snapshot_rejected(
+        self, cluster_id: int, node_id: int, ss_index: int, ss_term: int,
+        from_node: int,
+    ) -> None:
+        if self.enabled:
+            self.registry.counter_add(
+                "dragonboat_raftnode_snapshot_rejected_total",
+                labels=self._labels(cluster_id, node_id),
+            )
+
+    def replication_rejected(
+        self, cluster_id: int, node_id: int, log_index: int, log_term: int,
+        from_node: int,
+    ) -> None:
+        if self.enabled:
+            self.registry.counter_add(
+                "dragonboat_raftnode_replication_rejected_total",
+                labels=self._labels(cluster_id, node_id),
+            )
+
+    def proposal_dropped(self, cluster_id: int, node_id: int, entries) -> None:
+        if self.enabled:
+            self.registry.counter_add(
+                "dragonboat_raftnode_proposal_dropped_total",
+                value=max(1, len(entries)),
+                labels=self._labels(cluster_id, node_id),
+            )
+
+    def read_index_dropped(self, cluster_id: int, node_id: int) -> None:
+        if self.enabled:
+            self.registry.counter_add(
+                "dragonboat_raftnode_read_index_dropped_total",
+                labels=self._labels(cluster_id, node_id),
+            )
+
+
+# ---------------------------------------------------------------------------
+# system events
+# ---------------------------------------------------------------------------
+
+
+class SystemEventType(enum.Enum):
+    """Reference ``internal/server/event.go:86-123`` (15 types)."""
+
+    NODE_HOST_SHUTTING_DOWN = "node_host_shutting_down"
+    NODE_UNLOADED = "node_unloaded"
+    NODE_READY = "node_ready"
+    MEMBERSHIP_CHANGED = "membership_changed"
+    CONNECTION_ESTABLISHED = "connection_established"
+    CONNECTION_FAILED = "connection_failed"
+    SEND_SNAPSHOT_STARTED = "send_snapshot_started"
+    SEND_SNAPSHOT_COMPLETED = "send_snapshot_completed"
+    SEND_SNAPSHOT_ABORTED = "send_snapshot_aborted"
+    SNAPSHOT_RECEIVED = "snapshot_received"
+    SNAPSHOT_RECOVERED = "snapshot_recovered"
+    SNAPSHOT_CREATED = "snapshot_created"
+    SNAPSHOT_COMPACTED = "snapshot_compacted"
+    LOG_COMPACTED = "log_compacted"
+    LOGDB_COMPACTED = "logdb_compacted"
+
+
+@dataclass(frozen=True)
+class SystemEvent:
+    """Reference ``server.SystemEvent``."""
+
+    type: SystemEventType
+    cluster_id: int = 0
+    node_id: int = 0
+    from_: int = 0
+    index: int = 0
+    address: str = ""
+
+
+class SysEventListener:
+    """Serializes system events to the user's ``ISystemEventListener`` on a
+    dedicated thread (reference ``event.go:146-207`` + delivery goroutine
+    ``nodehost.go:1748-1769``): raft worker threads only enqueue; a slow or
+    crashing user callback can never stall the engine."""
+
+    _STOP = object()
+
+    def __init__(self, user_listener=None, registry=None):
+        self.user_listener = user_listener
+        self.registry = registry or DEFAULT_REGISTRY
+        self._q: "queue.Queue" = queue.Queue(maxsize=4096)
+        self._thread: Optional[threading.Thread] = None
+        if user_listener is not None:
+            self._thread = threading.Thread(
+                target=self._main, name="sys-events", daemon=True
+            )
+            self._thread.start()
+
+    def publish(self, ev: SystemEvent) -> None:
+        self.registry.counter_add(
+            "dragonboat_system_event_total", labels={"type": ev.type.value}
+        )
+        if self._thread is None:
+            return
+        try:
+            self._q.put_nowait(ev)
+        except queue.Full:
+            plog.warning("system event queue full, dropping %s", ev.type)
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._q.put(self._STOP)
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _main(self) -> None:
+        # method names follow raftio/listener.go:59-75, snake_cased
+        dispatch: Dict[SystemEventType, str] = {
+            SystemEventType.NODE_HOST_SHUTTING_DOWN: "node_host_shutting_down",
+            SystemEventType.NODE_UNLOADED: "node_unloaded",
+            SystemEventType.NODE_READY: "node_ready",
+            SystemEventType.MEMBERSHIP_CHANGED: "membership_changed",
+            SystemEventType.CONNECTION_ESTABLISHED: "connection_established",
+            SystemEventType.CONNECTION_FAILED: "connection_failed",
+            SystemEventType.SEND_SNAPSHOT_STARTED: "send_snapshot_started",
+            SystemEventType.SEND_SNAPSHOT_COMPLETED: "send_snapshot_completed",
+            SystemEventType.SEND_SNAPSHOT_ABORTED: "send_snapshot_aborted",
+            SystemEventType.SNAPSHOT_RECEIVED: "snapshot_received",
+            SystemEventType.SNAPSHOT_RECOVERED: "snapshot_recovered",
+            SystemEventType.SNAPSHOT_CREATED: "snapshot_created",
+            SystemEventType.SNAPSHOT_COMPACTED: "snapshot_compacted",
+            SystemEventType.LOG_COMPACTED: "log_compacted",
+            SystemEventType.LOGDB_COMPACTED: "logdb_compacted",
+        }
+        while True:
+            ev = self._q.get()
+            if ev is self._STOP:
+                return
+            fn = getattr(self.user_listener, dispatch[ev.type], None)
+            if fn is None:
+                continue
+            try:
+                fn(ev)
+            except Exception:
+                plog.exception("user system event callback failed for %s", ev.type)

@@ -27,6 +27,9 @@ class ShardedDB:
     def __init__(self, shards: List[RDB], batched: bool = False):
         self._shards = shards
         self._batched = batched
+        # invoked after each async compaction round (cluster_id, node_id);
+        # nodehost publishes LOGDB_COMPACTED through it
+        self.on_compaction = None
         self._compaction_q: "queue.Queue" = queue.Queue()
         self._compaction_worker = threading.Thread(
             target=self._compaction_main, name="logdb-compaction", daemon=True
@@ -157,6 +160,8 @@ class ShardedDB:
                 self._shard(cluster_id).compact_entries_to(
                     cluster_id, node_id, index
                 )
+                if self.on_compaction is not None:
+                    self.on_compaction(cluster_id, node_id)
             finally:
                 if len(item) > 3:
                     item[3].set()

@@ -13,6 +13,7 @@ from typing import Dict, List, Optional
 
 from .client import Session
 from .config import Config
+from .events import SystemEvent, SystemEventType
 from .logdb import LogReader
 from .logger import get_logger
 from .queue import EntryQueue
@@ -119,6 +120,8 @@ class Node:
             self.config, self.logreader, None, addresses, initial, new_node,
             seed=seed,
         )
+        # metrics + LeaderUpdated forwarding (reference event.go:37)
+        self.peer.raft.events = getattr(self, "peer_raft_events", None)
         # queue initial recovery so the apply worker restores the newest
         # local snapshot before any new entries apply
         self.to_apply.enqueue(
@@ -131,6 +134,19 @@ class Node:
             )
         )
         self.nh.engine.set_apply_ready(self.cluster_id)
+
+    def _publish_event(
+        self, type: SystemEventType, index: int = 0, from_: int = 0
+    ) -> None:
+        self.nh.sys_events.publish(
+            SystemEvent(
+                type=type,
+                cluster_id=self.cluster_id,
+                node_id=self.node_id,
+                index=index,
+                from_=from_,
+            )
+        )
 
     def initialized(self) -> bool:
         return self._initialized.is_set()
@@ -503,6 +519,7 @@ class Node:
                 return
             try:
                 self.snapshotter.commit(ss, env)
+                self._publish_event(SystemEventType.SNAPSHOT_CREATED, index=ss.index)
             except FileExistsError:
                 env.remove_tmp_dir()
                 if user_req:
@@ -521,6 +538,7 @@ class Node:
                 return
             self._compact_log(ss, req)
             self.snapshotter.compact()
+            self._publish_event(SystemEventType.SNAPSHOT_COMPACTED, index=ss.index)
             if req.type == SSReqType.USER_REQUESTED:
                 self.pending_snapshot.notify(
                     RequestResult(
@@ -547,6 +565,7 @@ class Node:
         except Exception:
             return
         self.logdb.remove_entries_to(self.cluster_id, self.node_id, compact_to)
+        self._publish_event(SystemEventType.LOG_COMPACTED, index=compact_to)
 
     def _recover_from_snapshot(self, t: Task) -> None:
         if t.initial:
@@ -560,9 +579,13 @@ class Node:
                     ss=ss,
                 )
                 self.sm.recover(t)
+                self._publish_event(
+                    SystemEventType.SNAPSHOT_RECOVERED, index=ss.index
+                )
             if self.sm.on_disk:
                 self.sm.open()
             self._initialized.set()
+            self._publish_event(SystemEventType.NODE_READY)
             self.nh.engine.set_step_ready(self.cluster_id)
             return
         try:
@@ -570,6 +593,10 @@ class Node:
         except Exception as e:
             plog.error("%s recover failed: %s", self.describe(), e)
             raise
+        if t.ss is not None:
+            self._publish_event(
+                SystemEventType.SNAPSHOT_RECOVERED, index=t.ss.index
+            )
         applied = self.sm.get_last_applied()
         with self.raft_mu:
             if self.peer is not None:
@@ -606,6 +633,9 @@ class Node:
             else:
                 self.peer.apply_config_change(cc)
                 self._on_config_change_applied(cc)
+                self._publish_event(
+                    SystemEventType.MEMBERSHIP_CHANGED, from_=cc.node_id
+                )
         rs = self.pending_config_change.pending()
         if rs is not None and rs.key == key and key != 0:
             code = (

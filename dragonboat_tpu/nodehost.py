@@ -14,6 +14,12 @@ from typing import Callable, Dict, Tuple
 
 from . import vfs
 from .client import Session
+from .events import (
+    RaftEventListener,
+    SysEventListener,
+    SystemEvent,
+    SystemEventType,
+)
 from .config import Config, NodeHostConfig
 from .engine import Engine
 from .logdb import LogReader, open_logdb
@@ -67,8 +73,14 @@ class NodeHost:
         # filesystem the snapshot paths go through (ExpertConfig.fs lets
         # tests run diskless via vfs.MemFS or inject faults via vfs.ErrorFS,
         # which is auto-detected like the reference nodehost.go:321-327)
-        self._fs = nhconfig.expert.fs or vfs.DEFAULT
+        self._fs = nhconfig.expert.fs or nhconfig.fs or vfs.DEFAULT
         self._capture_panics = vfs.is_error_fs(self._fs)
+        # event/metrics plumbing (reference event.go; delivery thread
+        # nodehost.go:1748-1769)
+        self.sys_events = SysEventListener(nhconfig.system_event_listener)
+        self.raft_events = RaftEventListener(
+            nhconfig.raft_event_listener, enabled=nhconfig.enable_metrics
+        )
         # storage
         in_memory = nhconfig.node_host_dir == ":memory:"
         if nhconfig.logdb_factory is not None:
@@ -89,6 +101,12 @@ class NodeHost:
             self._snapshot_status,
             unreachable_handler=self._unreachable,
             snapshot_dir_fn=self.snapshot_dir,
+            sys_events=self.sys_events,
+        )
+        self.logdb.on_compaction = lambda cid, nid: self.sys_events.publish(
+            SystemEvent(
+                type=SystemEventType.LOGDB_COMPACTED, cluster_id=cid, node_id=nid
+            )
         )
         # engine
         expert = nhconfig.expert
@@ -262,6 +280,7 @@ class NodeHost:
         addresses = [
             PeerAddress(node_id=nid, address=a) for nid, a in (members or {}).items()
         ]
+        node.peer_raft_events = self.raft_events
         node.start(addresses, initial=not join and new_node, new_node=new_node)
         with self._mu:
             self._clusters[cluster_id] = node
@@ -282,6 +301,13 @@ class NodeHost:
             del self._clusters[cluster_id]
             self._csi += 1
         node.stop()
+        self.sys_events.publish(
+            SystemEvent(
+                type=SystemEventType.NODE_UNLOADED,
+                cluster_id=cluster_id,
+                node_id=node.node_id,
+            )
+        )
 
     def stop_node(self, cluster_id: int, node_id: int) -> None:
         self.stop_cluster(cluster_id)
@@ -290,6 +316,9 @@ class NodeHost:
         if self._stopped.is_set():
             return
         self._stopped.set()
+        self.sys_events.publish(
+            SystemEvent(type=SystemEventType.NODE_HOST_SHUTTING_DOWN)
+        )
         with self._mu:
             nodes = list(self._clusters.values())
             self._clusters.clear()
@@ -300,6 +329,7 @@ class NodeHost:
         self.engine.stop()
         self.transport.stop()
         self.logdb.close()
+        self.sys_events.stop()
 
     # ---- proposals / reads (reference SyncPropose :523, SyncRead :548) ----
 

@@ -42,11 +42,13 @@ class Chunks:
         snapshot_dir_fn: Callable[[int, int], str],
         message_handler: Callable[[MessageBatch], None],
         source_address: str = "",
+        on_received: Optional[Callable[[int, int, int], None]] = None,
     ):
         self.deployment_id = deployment_id
         self.snapshot_dir_fn = snapshot_dir_fn
         self.message_handler = message_handler
         self.source_address = source_address
+        self.on_received = on_received
         self._mu = threading.Lock()
         self._tracked: Dict[str, _Track] = {}
         self._tick = 0
@@ -104,6 +106,8 @@ class Chunks:
                 self._drop(k)
                 return False
             del self._tracked[k]
+            if self.on_received is not None:
+                self.on_received(c.cluster_id, c.node_id, c.index)
             self.message_handler(
                 MessageBatch(
                     requests=[msg],

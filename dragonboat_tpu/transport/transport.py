@@ -67,6 +67,7 @@ class Transport:
         message_handler: Callable[[MessageBatch], None],
         snapshot_status_handler: Callable[[int, int, bool], None],
         unreachable_handler: Optional[Callable[[int, int], None]] = None,
+        sys_events=None,
         snapshot_dir_fn: Optional[Callable[[int, int], str]] = None,
         max_send_queue_size: int = 0,
     ):
@@ -76,6 +77,7 @@ class Transport:
         self.message_handler = message_handler
         self.snapshot_status_handler = snapshot_status_handler
         self.unreachable_handler = unreachable_handler
+        self.sys_events = sys_events
         self._mu = threading.Lock()
         self._queues: Dict[str, SendQueue] = {}
         self._breakers: Dict[str, CircuitBreaker] = {}
@@ -85,11 +87,25 @@ class Transport:
         self._snapshot_jobs = 0
         from .chunks import Chunks
 
+        def _snapshot_received(cluster_id, node_id, index):
+            if self.sys_events is not None:
+                from ..events import SystemEvent, SystemEventType
+
+                self.sys_events.publish(
+                    SystemEvent(
+                        type=SystemEventType.SNAPSHOT_RECEIVED,
+                        cluster_id=cluster_id,
+                        node_id=node_id,
+                        index=index,
+                    )
+                )
+
         self.chunks = Chunks(
             deployment_id=deployment_id,
             snapshot_dir_fn=snapshot_dir_fn or (lambda c, n: ""),
             message_handler=message_handler,
             source_address=source_address,
+            on_received=_snapshot_received,
         )
         self.rpc = raft_rpc_factory(
             source_address, self.handle_request, self.chunks.add_chunk
@@ -141,6 +157,7 @@ class Transport:
         try:
             conn = self.rpc.get_connection(addr)
             b.success()
+            self._publish_conn_event(addr, failed=False)
             while not self._stopped.is_set():
                 try:
                     m = sq.q.get(timeout=1.0)
@@ -168,12 +185,32 @@ class Transport:
         except (TransportError, OSError) as e:
             plog.warning("sender to %s failed: %s", addr, e)
             b.fail()
+            self._publish_conn_event(addr, failed=True)
             self._notify_unreachable(addr)
         finally:
             if conn is not None:
                 conn.close()
             with self._mu:
                 self._queues.pop(addr, None)
+
+    def _publish_conn_event(self, addr: str, failed: bool, snapshot: bool = False) -> None:
+        if self.sys_events is None:
+            return
+        from ..events import SystemEvent, SystemEventType
+
+        if snapshot:
+            t = (
+                SystemEventType.SEND_SNAPSHOT_ABORTED
+                if failed
+                else SystemEventType.SEND_SNAPSHOT_COMPLETED
+            )
+        else:
+            t = (
+                SystemEventType.CONNECTION_FAILED
+                if failed
+                else SystemEventType.CONNECTION_ESTABLISHED
+            )
+        self.sys_events.publish(SystemEvent(type=t, address=addr))
 
     def _notify_unreachable(self, addr: str) -> None:
         if self.unreachable_handler is None:
@@ -209,6 +246,17 @@ class Transport:
 
         failed = False
         conn = None
+        if self.sys_events is not None:
+            from ..events import SystemEvent, SystemEventType
+
+            self.sys_events.publish(
+                SystemEvent(
+                    type=SystemEventType.SEND_SNAPSHOT_STARTED,
+                    cluster_id=m.cluster_id,
+                    node_id=m.to,
+                    address=addr,
+                )
+            )
         try:
             chunks = split_snapshot_message(
                 m, self.deployment_id, Soft.snapshot_chunk_size
@@ -223,6 +271,7 @@ class Transport:
                 conn.close()
             with self._snapshot_count_mu:
                 self._snapshot_jobs -= 1
+        self._publish_conn_event(addr, failed=failed, snapshot=True)
         self.snapshot_status_handler(m.cluster_id, m.to, failed)
 
     # ---- receive path ----
@@ -266,6 +315,7 @@ def create_transport(
     snapshot_status_handler,
     unreachable_handler=None,
     snapshot_dir_fn=None,
+    sys_events=None,
 ) -> Transport:
     """Reference ``nodehost.go:1677`` ``createTransport``: pick the RPC module
     from config (factory override, else TCP; chan under in-memory test runs)."""
@@ -295,4 +345,5 @@ def create_transport(
         unreachable_handler=unreachable_handler,
         snapshot_dir_fn=snapshot_dir_fn,
         max_send_queue_size=nhconfig.max_send_queue_size,
+        sys_events=sys_events,
     )

@@ -1,0 +1,212 @@
+"""Event/metrics plumbing tests.
+
+Reference surface: ``event.go`` (raftEventListener metrics +
+LeaderUpdated forwarding, sysEventListener serialization,
+WriteHealthMetrics) and ``raftio/listener.go`` interfaces.
+"""
+import io
+import threading
+import time
+
+import pytest
+
+from dragonboat_tpu import Config, NodeHostConfig, Result
+from dragonboat_tpu.events import (
+    MetricsRegistry,
+    RaftEventListener,
+    SysEventListener,
+    SystemEvent,
+    SystemEventType,
+)
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.transport import ChanRouter, ChanTransport
+
+RTT_MS = 5
+
+
+def test_metrics_registry_counter_gauge_and_exposition():
+    reg = MetricsRegistry()
+    reg.counter_add("x_total", labels={"cluster_id": "1"})
+    reg.counter_add("x_total", 2, labels={"cluster_id": "1"})
+    reg.gauge_set("y", 7.5)
+    assert reg.counter_value("x_total", {"cluster_id": "1"}) == 3
+    assert reg.gauge_value("y") == 7.5
+    out = io.StringIO()
+    reg.write_health_metrics(out)
+    text = out.getvalue()
+    assert '# TYPE x_total counter\nx_total{cluster_id="1"} 3' in text
+    assert "# TYPE y gauge\ny 7.5" in text
+
+
+def test_raft_event_listener_metrics_and_forwarding():
+    reg = MetricsRegistry()
+    seen = []
+
+    class UserListener:
+        def leader_updated(self, info):
+            seen.append(info)
+
+    lst = RaftEventListener(UserListener(), registry=reg, enabled=True)
+    lst.campaign_launched(5, 1, 2)
+    lst.leader_updated(5, 1, leader_id=1, term=2)
+    lst.proposal_dropped(5, 1, [object(), object()])
+    labels = {"cluster_id": "5", "node_id": "1"}
+    assert (
+        reg.counter_value("dragonboat_raftnode_campaign_launched_total", labels)
+        == 1
+    )
+    assert reg.gauge_value("dragonboat_raftnode_has_leader", labels) == 1
+    assert reg.gauge_value("dragonboat_raftnode_term", labels) == 2
+    assert (
+        reg.counter_value("dragonboat_raftnode_proposal_dropped_total", labels)
+        == 2
+    )
+    assert len(seen) == 1 and seen[0].leader_id == 1 and seen[0].term == 2
+
+
+def test_raft_event_listener_survives_user_exception():
+    class Bad:
+        def leader_updated(self, info):
+            raise RuntimeError("boom")
+
+    lst = RaftEventListener(Bad(), registry=MetricsRegistry())
+    lst.leader_updated(1, 1, 1, 1)  # must not raise
+
+
+def test_sys_event_listener_serialized_delivery():
+    got = []
+    done = threading.Event()
+
+    class UserListener:
+        def node_ready(self, ev):
+            got.append(ev)
+
+        def membership_changed(self, ev):
+            raise RuntimeError("user bug")  # must not kill delivery
+
+        def snapshot_created(self, ev):
+            got.append(ev)
+            done.set()
+
+    lst = SysEventListener(UserListener())
+    lst.publish(SystemEvent(type=SystemEventType.NODE_READY, cluster_id=9))
+    lst.publish(SystemEvent(type=SystemEventType.MEMBERSHIP_CHANGED))
+    lst.publish(
+        SystemEvent(type=SystemEventType.SNAPSHOT_CREATED, cluster_id=9, index=4)
+    )
+    assert done.wait(5)
+    lst.stop()
+    assert [e.type for e in got] == [
+        SystemEventType.NODE_READY,
+        SystemEventType.SNAPSHOT_CREATED,
+    ]
+    assert got[1].index == 4
+    # counters track all publishes regardless of listener
+    assert (
+        lst.registry.counter_value(
+            "dragonboat_system_event_total", {"type": "node_ready"}
+        )
+        >= 1
+    )
+
+
+class _CountSM:
+    def __init__(self, cluster_id, node_id):
+        self.count = 0
+
+    def update(self, cmd):
+        self.count += 1
+        return Result(value=self.count)
+
+    def lookup(self, query):
+        return self.count
+
+    def save_snapshot(self, w, files, done):
+        w.write(self.count.to_bytes(8, "little"))
+
+    def recover_from_snapshot(self, r, files, done):
+        self.count = int.from_bytes(r.read(8), "little")
+
+    def close(self):
+        pass
+
+
+def test_nodehost_end_to_end_events(tmp_path):
+    """NODE_READY, LeaderUpdated, snapshot + log-compaction events and
+    shutdown events all fire across a real single-replica lifecycle."""
+    events = []
+    leaders = []
+    ready = threading.Event()
+    created = threading.Event()
+
+    class SysListener:
+        def __getattr__(self, name):  # record everything
+            def cb(ev):
+                events.append(ev)
+                if ev.type is SystemEventType.NODE_READY:
+                    ready.set()
+                if ev.type is SystemEventType.SNAPSHOT_CREATED:
+                    created.set()
+
+            return cb
+
+    class RaftListener:
+        def leader_updated(self, info):
+            leaders.append(info)
+
+    router = ChanRouter()
+
+    def rpc_factory(src, rh, ch):
+        return ChanTransport(src, rh, ch, router=router)
+
+    nh = NodeHost(
+        NodeHostConfig(
+            node_host_dir=":memory:",
+            rtt_millisecond=RTT_MS,
+            raft_address="ev:1",
+            raft_rpc_factory=rpc_factory,
+            enable_metrics=True,
+            system_event_listener=SysListener(),
+            raft_event_listener=RaftListener(),
+        )
+    )
+    try:
+        nh.start_cluster(
+            {1: "ev:1"},
+            False,
+            lambda c, n: _CountSM(c, n),
+            Config(
+                cluster_id=11,
+                node_id=1,
+                election_rtt=10,
+                heartbeat_rtt=1,
+                compaction_overhead=2,
+            ),
+        )
+        assert ready.wait(5)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            _, ok = nh.get_leader_id(11)
+            if ok:
+                break
+            time.sleep(0.01)
+        s = nh.get_noop_session(11)
+        for _ in range(5):
+            nh.sync_propose(s, b"x", timeout=5.0)
+        nh.sync_request_snapshot(11, timeout=5.0)
+        assert created.wait(5)
+    finally:
+        nh.stop()
+    types = {e.type for e in events}
+    assert SystemEventType.NODE_READY in types
+    assert SystemEventType.SNAPSHOT_CREATED in types
+    assert SystemEventType.NODE_HOST_SHUTTING_DOWN in types
+    assert any(li.leader_id for li in leaders)
+    # metrics populated under enable_metrics
+    assert (
+        nh.raft_events.registry.gauge_value(
+            "dragonboat_raftnode_has_leader",
+            {"cluster_id": "11", "node_id": "1"},
+        )
+        == 1
+    )
