@@ -584,6 +584,17 @@ class Node:
                 )
             if self.sm.on_disk:
                 self.sm.open()
+            # reference node.go:1382-1410 setInitialStatus: raft must learn
+            # the recovered applied index or has_config_change_to_apply()
+            # (committed > applied) suppresses elections forever on a node
+            # whose log tail is empty (e.g. after ImportSnapshot repair)
+            applied = self.sm.get_last_applied()
+            if applied:
+                with self.raft_mu:
+                    if self.peer is not None:
+                        self.peer.notify_raft_last_applied(applied)
+                self.sm.set_batched_last_applied(applied)
+                self.pending_reads.applied(applied)
             self._initialized.set()
             self._publish_event(SystemEventType.NODE_READY)
             self.nh.engine.set_step_ready(self.cluster_id)

@@ -1,0 +1,7 @@
+"""Operational tools.
+
+Reference: ``tools/`` — ``ImportSnapshot`` quorum-loss repair
+(``tools/import.go:130``) and the ``checkdisk`` write-throughput probe
+(``tools/checkdisk/main.go``).
+"""
+from .importsnap import import_snapshot  # noqa: F401

@@ -1,0 +1,159 @@
+"""checkdisk: write-throughput probe for the LogDB + pipeline.
+
+Reference: ``tools/checkdisk/main.go:98`` — spins many single-replica raft
+groups on ONE NodeHost and measures sustained proposal throughput, telling
+you what the local disk + engine pipeline can do before any networking is
+involved.
+
+Usage:
+    python -m dragonboat_tpu.tools.checkdisk --groups 48 --seconds 5 \
+        --payload 16 [--dir /path/on/target/disk]
+
+Omitting ``--dir`` probes the in-memory backend (pipeline ceiling).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+from ..config import Config, NodeHostConfig
+from ..nodehost import NodeHost
+from ..statemachine import IStateMachine, Result
+from ..transport import ChanRouter, ChanTransport
+
+
+class _NoopSM(IStateMachine):
+    """Counting no-op SM (plays the reference's tests.NoOP role)."""
+
+    def __init__(self, cluster_id, node_id):
+        self.count = 0
+
+    def update(self, cmd):
+        self.count += 1
+        return Result(value=self.count)
+
+    def lookup(self, query):
+        return self.count
+
+    def save_snapshot(self, w, files, done):
+        w.write(self.count.to_bytes(8, "little"))
+
+    def recover_from_snapshot(self, r, files, done):
+        self.count = int.from_bytes(r.read(8), "little")
+
+    def close(self):
+        pass
+
+
+def run(
+    groups: int = 48,
+    seconds: float = 5.0,
+    payload: int = 16,
+    dirname: str = "",
+    client_threads: int = 8,
+) -> dict:
+    router = ChanRouter()
+    addr = "checkdisk:1"
+    nhc = NodeHostConfig(
+        node_host_dir=dirname or ":memory:",
+        rtt_millisecond=50,
+        raft_address=addr,
+        raft_rpc_factory=lambda src, rh, ch: ChanTransport(
+            src, rh, ch, router=router
+        ),
+    )
+    nh = NodeHost(nhc)
+    results = {"writes": 0}
+    try:
+        for cid in range(1, groups + 1):
+            nh.start_cluster(
+                {1: addr},
+                False,
+                _NoopSM,
+                Config(
+                    cluster_id=cid,
+                    node_id=1,
+                    election_rtt=10,
+                    heartbeat_rtt=1,
+                    snapshot_entries=0,
+                ),
+            )
+        # wait for every group to elect itself
+        deadline = time.time() + 10
+        for cid in range(1, groups + 1):
+            while time.time() < deadline:
+                _, ok = nh.get_leader_id(cid)
+                if ok:
+                    break
+                time.sleep(0.005)
+        cmd = b"x" * payload
+        stop_at = time.time() + seconds
+        counts = [0] * client_threads
+        errors = [0] * client_threads
+
+        def client(tid: int) -> None:
+            # each thread round-robins its own slice of groups
+            my = [c for c in range(1, groups + 1) if c % client_threads == tid % client_threads]
+            if not my:
+                my = [1]
+            sessions = {c: nh.get_noop_session(c) for c in my}
+            i = 0
+            while time.time() < stop_at:
+                cid = my[i % len(my)]
+                i += 1
+                try:
+                    nh.sync_propose(sessions[cid], cmd, timeout=5.0)
+                    counts[tid] += 1
+                except Exception:
+                    errors[tid] += 1
+
+        threads = [
+            threading.Thread(target=client, args=(t,), daemon=True)
+            for t in range(client_threads)
+        ]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=seconds + 30)
+        elapsed = time.time() - t0
+        writes = sum(counts)
+        results = {
+            "metric": "checkdisk_writes_per_sec",
+            "value": round(writes / elapsed, 1),
+            "unit": "writes/s",
+            "writes": writes,
+            "errors": sum(errors),
+            "elapsed_s": round(elapsed, 3),
+            "groups": groups,
+            "payload": payload,
+            "backend": nh.logdb.name(),
+            "client_threads": client_threads,
+        }
+    finally:
+        nh.stop()
+    return results
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--groups", type=int, default=48)
+    p.add_argument("--seconds", type=float, default=5.0)
+    p.add_argument("--payload", type=int, default=16)
+    p.add_argument("--dir", default="")
+    p.add_argument("--threads", type=int, default=8)
+    args = p.parse_args()
+    out = run(
+        groups=args.groups,
+        seconds=args.seconds,
+        payload=args.payload,
+        dirname=args.dir,
+        client_threads=args.threads,
+    )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

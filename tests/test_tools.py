@@ -1,0 +1,156 @@
+"""Tools tests: ImportSnapshot quorum repair + checkdisk probe.
+
+Reference model: ``tools/import.go`` (+ its tests) and
+``tools/checkdisk/main.go``.
+"""
+import time
+
+import pytest
+
+from dragonboat_tpu import Config, NodeHostConfig, Result
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.statemachine import IStateMachine
+from dragonboat_tpu.tools import import_snapshot
+from dragonboat_tpu.transport import ChanRouter, ChanTransport
+
+RTT_MS = 5
+
+
+class KVSM(IStateMachine):
+    def __init__(self, cluster_id, node_id):
+        self.kv = {}
+        self.count = 0
+
+    def update(self, cmd):
+        k, v = cmd.decode().split("=", 1)
+        self.kv[k] = v
+        self.count += 1
+        return Result(value=self.count)
+
+    def lookup(self, query):
+        return self.kv.get(query)
+
+    def save_snapshot(self, w, files, done):
+        data = repr(sorted(self.kv.items())).encode()
+        w.write(len(data).to_bytes(8, "little") + data)
+
+    def recover_from_snapshot(self, r, files, done):
+        import ast
+
+        n = int.from_bytes(r.read(8), "little")
+        self.kv = dict(ast.literal_eval(r.read(n).decode()))
+        self.count = len(self.kv)
+
+    def close(self):
+        pass
+
+
+def _mk_nh(addr, router, tmpdir):
+    return NodeHost(
+        NodeHostConfig(
+            node_host_dir=str(tmpdir),
+            rtt_millisecond=RTT_MS,
+            raft_address=addr,
+            raft_rpc_factory=lambda src, rh, ch: ChanTransport(
+                src, rh, ch, router=router
+            ),
+        )
+    )
+
+
+def _wait_leader(nh, cid, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        _, ok = nh.get_leader_id(cid)
+        if ok:
+            return
+        time.sleep(0.01)
+    raise TimeoutError("no leader")
+
+
+def test_import_snapshot_quorum_repair(tmp_path):
+    """Full disaster-recovery round trip: run a group, export a snapshot,
+    destroy the NodeHost dir (quorum loss), import into a fresh dir with a
+    single-member map, restart, and read the old data back."""
+    router = ChanRouter()
+    cid = 7
+    addr = "orig:1"
+    nh = _mk_nh(addr, router, tmp_path / "orig")
+    export_dir = tmp_path / "export"
+    export_dir.mkdir()
+    try:
+        nh.start_cluster(
+            {1: addr}, False, KVSM,
+            Config(cluster_id=cid, node_id=1, election_rtt=10, heartbeat_rtt=1),
+        )
+        _wait_leader(nh, cid)
+        s = nh.get_noop_session(cid)
+        for i in range(8):
+            nh.sync_propose(s, f"k{i}=v{i}".encode(), timeout=5.0)
+        rs = nh.request_snapshot(cid, export_path=str(export_dir), timeout=5.0)
+        r = rs.wait(5.0)
+        idx = r.snapshot_index
+        assert idx > 0
+    finally:
+        nh.stop()
+
+    # the exported image lives in export_dir/snapshot-XXXX/
+    from dragonboat_tpu.server.snapshotenv import snapshot_dir_name
+
+    src = export_dir / snapshot_dir_name(idx)
+    assert src.is_dir()
+
+    # quorum lost: bring up a REPLACEMENT host at a new address/dir,
+    # membership shrunk to just it
+    new_addr = "repair:1"
+    new_dir = tmp_path / "repair"
+    nhc = NodeHostConfig(
+        node_host_dir=str(new_dir),
+        rtt_millisecond=RTT_MS,
+        raft_address=new_addr,
+        raft_rpc_factory=lambda src_, rh, ch: ChanTransport(
+            src_, rh, ch, router=router
+        ),
+    )
+    members = {1: new_addr}
+    ss = import_snapshot(nhc, str(src), members, 1)
+    assert ss.imported and ss.index == idx
+    assert ss.membership.addresses == members
+    assert ss.membership.config_change_id == idx
+
+    nh2 = NodeHost(nhc)
+    try:
+        nh2.start_cluster(members, False, KVSM, Config(
+            cluster_id=cid, node_id=1, election_rtt=10, heartbeat_rtt=1,
+        ))
+        _wait_leader(nh2, cid)
+        for i in range(8):
+            assert nh2.sync_read(cid, f"k{i}", timeout=5.0) == f"v{i}"
+        # and the repaired group accepts new writes
+        s = nh2.get_noop_session(cid)
+        nh2.sync_propose(s, b"new=1", timeout=5.0)
+        assert nh2.sync_read(cid, "new", timeout=5.0) == "1"
+    finally:
+        nh2.stop()
+
+
+def test_import_snapshot_validations(tmp_path):
+    nhc = NodeHostConfig(
+        node_host_dir=str(tmp_path),
+        raft_address="a:1",
+    )
+    with pytest.raises(ValueError, match="not in the new membership"):
+        import_snapshot(nhc, str(tmp_path), {2: "b:1"}, 1)
+    with pytest.raises(ValueError, match="address"):
+        import_snapshot(nhc, str(tmp_path), {1: "wrong:1"}, 1)
+    with pytest.raises(ValueError, match="no exported snapshot"):
+        import_snapshot(nhc, str(tmp_path), {1: "a:1"}, 1)
+
+
+def test_checkdisk_probe_runs():
+    from dragonboat_tpu.tools.checkdisk import run
+
+    out = run(groups=4, seconds=1.0, payload=16, client_threads=2)
+    assert out["metric"] == "checkdisk_writes_per_sec"
+    assert out["writes"] > 0
+    assert out["errors"] == 0
