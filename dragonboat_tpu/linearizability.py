@@ -1,0 +1,140 @@
+"""Linearizability checker for KV operation histories.
+
+Reference context: the reference library is verified externally with
+Jepsen Knossos and porcupine over histories produced by its monkey-test
+harness (``docs/test.md:6,11-36``).  This module brings that capability
+in-tree: a Wing & Gong style search with memoization (the algorithm
+family porcupine implements) over a per-key register model, so the chaos
+tests (``tests/test_chaos.py``) can assert histories collected under
+partitions/crashes are linearizable.
+
+Model: independent keys, each a last-writer-wins register.  ``put``
+operations with unknown outcome (client timeout) are treated as
+*possibly applied*: their response time is +inf, which lets the checker
+linearize them after every observed read — equivalent to "never took
+effect" for all observations — or anywhere after their invocation.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+INF = math.inf
+
+
+@dataclass
+class Op:
+    """One client operation."""
+
+    client: int
+    kind: str  # "put" | "get"
+    key: str
+    value: Optional[str]  # put: value written; get: value observed
+    invoke: float  # invocation timestamp
+    ret: float  # response timestamp; INF when the outcome is unknown
+    ok: bool = True  # False = unknown outcome (treated as maybe-applied)
+
+
+def _check_register(ops: List[Op], initial: Optional[str] = None) -> bool:
+    """Wing & Gong search over one key's history."""
+    n = len(ops)
+    if n == 0:
+        return True
+    order = sorted(range(n), key=lambda i: ops[i].invoke)
+    ops = [ops[i] for i in order]
+    full = (1 << n) - 1
+    seen: set = set()
+    budget = [5_000_000]  # visited-state cap: fail loudly, never hang
+
+    def search(done_mask: int, state: Optional[str]) -> bool:
+        if done_mask == full:
+            return True
+        if (done_mask, state) in seen:
+            return False
+        seen.add((done_mask, state))
+        budget[0] -= 1
+        if budget[0] < 0:
+            raise RuntimeError("linearizability search budget exhausted")
+        # an op may linearize next only if no other pending op RETURNED
+        # before this op was INVOKED (returned-before implies
+        # linearized-before)
+        min_ret = INF
+        for i in range(n):
+            if not done_mask & (1 << i):
+                min_ret = min(min_ret, ops[i].ret)
+        for i in range(n):
+            bit = 1 << i
+            if done_mask & bit:
+                continue
+            op = ops[i]
+            if op.invoke > min_ret:
+                continue
+            if op.kind == "put":
+                if search(done_mask | bit, op.value):
+                    return True
+            else:  # get
+                # a get with unknown outcome observed nothing: any state fits
+                if (not op.ok or op.value == state) and search(
+                    done_mask | bit, state
+                ):
+                    return True
+        return False
+
+    return search(0, initial)
+
+
+def check_linearizable(
+    history: List[Op], initial: Optional[Dict[str, str]] = None
+) -> Tuple[bool, List[str]]:
+    """Check a multi-key history; returns (ok, offending_keys).
+
+    Keys are independent registers, so the history factors per key — the
+    same decomposition porcupine's KV model uses.
+    """
+    by_key: Dict[str, List[Op]] = {}
+    for op in history:
+        by_key.setdefault(op.key, []).append(op)
+    bad: List[str] = []
+    for key, ops in by_key.items():
+        init = (initial or {}).get(key)
+        if not _check_register(ops, init):
+            bad.append(key)
+    return (not bad, bad)
+
+
+class HistoryRecorder:
+    """Thread-safe invoke/response recorder used by chaos test clients."""
+
+    def __init__(self) -> None:
+        import threading
+        import time
+
+        self._mu = threading.Lock()
+        self._clock = time.monotonic
+        self.ops: List[Op] = []
+
+    def invoke(self, client: int, kind: str, key: str, value: Optional[str]):
+        """Returns a completion callback: call with the observed value (get)
+        or True (put success); call with ``unknown=True`` on timeout."""
+        t0 = self._clock()
+
+        def complete(value_seen=None, unknown: bool = False) -> None:
+            t1 = self._clock()
+            op = Op(
+                client=client,
+                kind=kind,
+                key=key,
+                value=value if kind == "put" else value_seen,
+                invoke=t0,
+                ret=INF if unknown else t1,
+                ok=not unknown,
+            )
+            with self._mu:
+                self.ops.append(op)
+
+        return complete
+
+    def history(self) -> List[Op]:
+        with self._mu:
+            return list(self.ops)

@@ -1,0 +1,101 @@
+"""Monkey/chaos-test hooks.
+
+Reference: ``monkey.go`` (build-tag-gated introspection: partition
+injection :184-213, transport drop hooks :82, SM/session/membership
+hashes :110-144) — the instrumentation surface the external Drummer
+harness drives.  Here the hooks are a plain module (no build tags needed:
+nothing below mutates production behavior unless invoked) used by
+``tests/test_chaos.py``.
+"""
+from __future__ import annotations
+
+import random
+from typing import Dict, Iterable, List, Optional
+
+from .nodehost import NodeHost
+
+# ---------------------------------------------------------------------------
+# cross-replica consistency hashes (reference monkey.go:110-144)
+# ---------------------------------------------------------------------------
+
+
+def get_state_hash(nh: NodeHost, cluster_id: int) -> int:
+    """Combined sessions+applied+membership hash (reference rsm.GetHash)."""
+    return nh.get_node(cluster_id).sm.get_hash()
+
+
+def get_session_hash(nh: NodeHost, cluster_id: int) -> int:
+    return nh.get_node(cluster_id).sm.get_session_hash()
+
+
+def get_membership_hash(nh: NodeHost, cluster_id: int) -> int:
+    return nh.get_node(cluster_id).sm.get_membership_hash()
+
+
+def get_applied_index(nh: NodeHost, cluster_id: int) -> int:
+    return nh.get_node(cluster_id).sm.get_last_applied()
+
+
+def assert_replicas_converged(
+    nhs: Iterable[NodeHost], cluster_id: int
+) -> Dict[str, int]:
+    """Raises AssertionError unless every replica reports the same state
+    hash at the same applied index; returns {address: hash}."""
+    snap = {}
+    applied = set()
+    for nh in nhs:
+        snap[nh.raft_address()] = get_state_hash(nh, cluster_id)
+        applied.add(get_applied_index(nh, cluster_id))
+    if len(applied) != 1 or len(set(snap.values())) != 1:
+        raise AssertionError(
+            f"replicas diverged: applied={applied} hashes={snap}"
+        )
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# partition / drop injection over the chan transport
+# ---------------------------------------------------------------------------
+
+
+class PartitionInjector:
+    """Drives ChanRouter partitions the way the reference's monkey harness
+    partitions NodeHosts (``monkey.go:184-213``): pick a random minority,
+    cut it off, heal later."""
+
+    def __init__(self, router, addresses: List[str], seed: int = 0):
+        self.router = router
+        self.addresses = list(addresses)
+        self.rng = random.Random(seed)
+        self.active: List[tuple] = []
+
+    def partition_random_minority(self) -> List[str]:
+        n = len(self.addresses)
+        k = self.rng.randrange(1, max(2, (n + 1) // 2))
+        minority = self.rng.sample(self.addresses, k)
+        majority = [a for a in self.addresses if a not in minority]
+        for a in minority:
+            for b in majority:
+                self.router.partition(a, b)
+                self.active.append((a, b))
+        return minority
+
+    def isolate(self, addr: str) -> None:
+        for b in self.addresses:
+            if b != addr:
+                self.router.partition(addr, b)
+                self.active.append((addr, b))
+
+    def heal_all(self) -> None:
+        self.router.heal()
+        self.active.clear()
+
+
+def set_drop_rate(router, rate: float, seed: int = 0) -> None:
+    """Probabilistically drop message batches (reference
+    SetTransportDropBatchHook ``monkey.go:82``).  ``rate=0`` clears."""
+    if rate <= 0:
+        router.set_drop_hook(None)
+        return
+    rng = random.Random(seed)
+    router.set_drop_hook(lambda batch: rng.random() < rate)

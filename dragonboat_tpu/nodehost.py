@@ -205,7 +205,12 @@ class NodeHost:
         if join and initial_members:
             raise ValueError("addresses given for a joining node")
         if not join and not initial_members:
-            raise ValueError("addresses not given for an initial member")
+            # the reference only rejects this for NEW nodes
+            # (nodehost.go:1509 startCluster): a restarting node passes
+            # empty members + join=False and resumes from its bootstrap
+            # record
+            if self.logdb.get_bootstrap_info(cluster_id, node_id) is None:
+                raise ValueError("addresses not given for an initial member")
         with self._mu:
             if cluster_id in self._clusters:
                 raise ClusterAlreadyExistError(str(cluster_id))

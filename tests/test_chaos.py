@@ -1,0 +1,320 @@
+"""Chaos/monkey tests: partitions + restarts under concurrent clients,
+verified by cross-replica state hashes and a linearizability check.
+
+Reference model: the monkey-test harness described in SURVEY.md §4.5
+(partition injection, kill/restart, Jepsen Knossos/porcupine history
+checking, cross-replica hash comparison via rsm.GetHash).
+"""
+import threading
+import time
+
+import pytest
+
+from dragonboat_tpu import Config, NodeHostConfig, Result
+from dragonboat_tpu import monkey
+from dragonboat_tpu.linearizability import (
+    INF,
+    HistoryRecorder,
+    Op,
+    check_linearizable,
+)
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.statemachine import IStateMachine
+from dragonboat_tpu.transport import ChanRouter, ChanTransport
+
+RTT_MS = 5
+CID = 42
+
+
+# ---------------------------------------------------------------------------
+# checker unit tests (hand-built histories)
+# ---------------------------------------------------------------------------
+
+
+def test_checker_accepts_sequential_history():
+    h = [
+        Op(1, "put", "k", "1", 0.0, 1.0),
+        Op(1, "get", "k", "1", 2.0, 3.0),
+        Op(1, "put", "k", "2", 4.0, 5.0),
+        Op(1, "get", "k", "2", 6.0, 7.0),
+    ]
+    ok, bad = check_linearizable(h)
+    assert ok, bad
+
+
+def test_checker_accepts_concurrent_overlap():
+    # get overlapping a put may see either value
+    h = [
+        Op(1, "put", "k", "1", 0.0, 10.0),
+        Op(2, "get", "k", None, 1.0, 2.0),
+        Op(3, "get", "k", "1", 3.0, 4.0),
+    ]
+    ok, bad = check_linearizable(h)
+    assert ok, bad
+
+
+def test_checker_rejects_stale_read():
+    # put completed before the get started, but the get saw the old value
+    h = [
+        Op(1, "put", "k", "1", 0.0, 1.0),
+        Op(2, "get", "k", None, 2.0, 3.0),
+    ]
+    ok, bad = check_linearizable(h)
+    assert not ok and bad == ["k"]
+
+
+def test_checker_rejects_value_from_nowhere():
+    h = [
+        Op(1, "put", "k", "1", 0.0, 1.0),
+        Op(2, "get", "k", "99", 2.0, 3.0),
+    ]
+    ok, _ = check_linearizable(h)
+    assert not ok
+
+
+def test_checker_allows_unknown_put_to_be_unapplied():
+    # timed-out put (ret=INF) may never take effect
+    h = [
+        Op(1, "put", "k", "1", 0.0, 1.0),
+        Op(2, "put", "k", "2", 2.0, INF, ok=False),
+        Op(3, "get", "k", "1", 3.0, 4.0),
+    ]
+    ok, bad = check_linearizable(h)
+    assert ok, bad
+
+
+def test_checker_allows_unknown_put_to_be_applied():
+    h = [
+        Op(1, "put", "k", "1", 0.0, 1.0),
+        Op(2, "put", "k", "2", 2.0, INF, ok=False),
+        Op(3, "get", "k", "2", 3.0, 4.0),
+    ]
+    ok, bad = check_linearizable(h)
+    assert ok, bad
+
+
+def test_checker_rejects_read_reordering():
+    # two sequential gets observing values in an order no serialization of
+    # the two sequential puts can produce
+    h = [
+        Op(1, "put", "k", "1", 0.0, 1.0),
+        Op(1, "put", "k", "2", 2.0, 3.0),
+        Op(2, "get", "k", "2", 4.0, 5.0),
+        Op(2, "get", "k", "1", 6.0, 7.0),
+    ]
+    ok, _ = check_linearizable(h)
+    assert not ok
+
+
+# ---------------------------------------------------------------------------
+# live chaos run
+# ---------------------------------------------------------------------------
+
+
+class KVSM(IStateMachine):
+    def __init__(self, cluster_id, node_id):
+        self.kv = {}
+        self.count = 0
+
+    def update(self, cmd):
+        k, v = cmd.decode().split("=", 1)
+        self.kv[k] = v
+        self.count += 1
+        return Result(value=self.count)
+
+    def lookup(self, query):
+        return self.kv.get(query)
+
+    def save_snapshot(self, w, files, done):
+        data = repr(sorted(self.kv.items())).encode()
+        w.write(len(data).to_bytes(8, "little") + data)
+
+    def recover_from_snapshot(self, r, files, done):
+        import ast
+
+        n = int.from_bytes(r.read(8), "little")
+        self.kv = dict(ast.literal_eval(r.read(n).decode()))
+        self.count = len(self.kv)
+
+    def close(self):
+        pass
+
+
+def _mk_nh(addr, router):
+    return NodeHost(
+        NodeHostConfig(
+            node_host_dir=":memory:",
+            rtt_millisecond=RTT_MS,
+            raft_address=addr,
+            raft_rpc_factory=lambda src, rh, ch: ChanTransport(
+                src, rh, ch, router=router
+            ),
+        )
+    )
+
+
+def _wait_leader(nhs, cid, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        for nh in nhs:
+            _, ok = nh.get_leader_id(cid)
+            if ok:
+                return
+        time.sleep(0.01)
+    raise TimeoutError("no leader")
+
+
+@pytest.mark.slow
+def test_chaos_partitions_with_linearizability():
+    """Random minority partitions + drop-rate churn under concurrent
+    clients; afterwards replicas must converge to identical hashes and the
+    recorded history must be linearizable."""
+    router = ChanRouter()
+    addrs = {i: f"cn{i}:1" for i in (1, 2, 3)}
+    nhs = [_mk_nh(addrs[i], router) for i in (1, 2, 3)]
+    rec = HistoryRecorder()
+    stop = threading.Event()
+    try:
+        for nh in nhs:
+            nh.start_cluster(
+                addrs, False, KVSM,
+                Config(
+                    cluster_id=CID,
+                    node_id=int(nh.raft_address()[2]),
+                    election_rtt=10,
+                    heartbeat_rtt=1,
+                    check_quorum=True,
+                ),
+            )
+        _wait_leader(nhs, CID)
+
+        def client(tid: int) -> None:
+            nh = nhs[tid % len(nhs)]
+            session = nh.get_noop_session(CID)
+            i = 0
+            while not stop.is_set():
+                key = f"key-{tid}-{i % 64}"
+                i += 1
+                if i % 3 == 0:
+                    done = rec.invoke(tid, "get", key, None)
+                    try:
+                        v = nh.sync_read(CID, key, timeout=2.0)
+                        done(v)
+                    except Exception:
+                        done(unknown=True)
+                else:
+                    val = str(i)
+                    done = rec.invoke(tid, "put", key, val)
+                    try:
+                        nh.sync_propose(session, f"{key}={val}".encode(), 2.0)
+                        done(True)
+                    except Exception:
+                        done(unknown=True)
+
+        clients = [
+            threading.Thread(target=client, args=(t,), daemon=True)
+            for t in range(4)
+        ]
+        for c in clients:
+            c.start()
+
+        inj = monkey.PartitionInjector(router, list(addrs.values()), seed=7)
+        t_end = time.time() + 6.0
+        while time.time() < t_end:
+            minority = inj.partition_random_minority()
+            time.sleep(0.4)
+            inj.heal_all()
+            monkey.set_drop_rate(router, 0.05, seed=13)
+            time.sleep(0.3)
+            monkey.set_drop_rate(router, 0.0)
+            assert minority  # chaos actually ran
+
+        stop.set()
+        for c in clients:
+            c.join(timeout=10)
+        # settle: heal, one barrier write, wait replicas to catch up
+        inj.heal_all()
+        monkey.set_drop_rate(router, 0.0)
+        _wait_leader(nhs, CID)
+        barrier_done = rec.invoke(99, "put", "barrier", "1")
+        for attempt in range(20):
+            try:
+                s = nhs[0].get_noop_session(CID)
+                nhs[0].sync_propose(s, b"barrier=1", timeout=3.0)
+                barrier_done(True)
+                break
+            except Exception:
+                time.sleep(0.3)
+        else:
+            barrier_done(unknown=True)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                monkey.assert_replicas_converged(nhs, CID)
+                break
+            except AssertionError:
+                time.sleep(0.2)
+        monkey.assert_replicas_converged(nhs, CID)
+
+        history = rec.history()
+        assert len(history) > 50, "chaos produced too little history"
+        ok, bad = check_linearizable(history)
+        assert ok, f"non-linearizable keys: {bad}"
+    finally:
+        stop.set()
+        for nh in nhs:
+            nh.stop()
+
+
+@pytest.mark.slow
+def test_chaos_node_restart_rejoins_and_converges():
+    """Kill one replica's node (stop_cluster) mid-traffic, restart it, and
+    require convergence — the restart path under load."""
+    router = ChanRouter()
+    addrs = {i: f"rn{i}:1" for i in (1, 2, 3)}
+    nhs = [_mk_nh(addrs[i], router) for i in (1, 2, 3)]
+    try:
+        for i, nh in enumerate(nhs, start=1):
+            nh.start_cluster(
+                addrs, False, KVSM,
+                Config(
+                    cluster_id=CID, node_id=i,
+                    election_rtt=10, heartbeat_rtt=1,
+                ),
+            )
+        _wait_leader(nhs, CID)
+        s = nhs[0].get_noop_session(CID)
+
+        def propose_ok(cmd, tries=10):
+            for _ in range(tries):
+                try:
+                    nhs[0].sync_propose(s, cmd, timeout=3.0)
+                    return
+                except Exception:
+                    time.sleep(0.2)
+            raise TimeoutError(f"could not commit {cmd!r}")
+
+        for i in range(10):
+            propose_ok(f"a{i}=1".encode())
+        # stop replica 3 (may be the leader: the survivors must re-elect),
+        # keep writing through the remaining quorum
+        nhs[2].stop_cluster(CID)
+        for i in range(10):
+            propose_ok(f"b{i}=1".encode())
+        # restart replica 3: bootstrap record exists, so empty initial
+        # members + join=False is the reference restart idiom
+        nhs[2].start_cluster(
+            {}, False, KVSM,
+            Config(cluster_id=CID, node_id=3, election_rtt=10, heartbeat_rtt=1),
+        )
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                monkey.assert_replicas_converged(nhs, CID)
+                break
+            except Exception:
+                time.sleep(0.2)
+        monkey.assert_replicas_converged(nhs, CID)
+    finally:
+        for nh in nhs:
+            nh.stop()
