@@ -17,6 +17,7 @@ Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 from __future__ import annotations
 
+import functools
 import json
 import os
 import time
@@ -44,47 +45,56 @@ def build_state(n_groups: int, event_cap: int, n_peers: int = 3):
 def main() -> None:
     from dragonboat_tpu.ops.kernels import quorum_multistep
 
-    n_groups = int(os.environ.get("BENCH_GROUPS", "8192"))
-    rounds = int(os.environ.get("BENCH_ROUNDS", "64"))      # R per dispatch
-    dispatches = int(os.environ.get("BENCH_DISPATCHES", "20"))
+    n_groups = int(os.environ.get("BENCH_GROUPS", "131072"))
+    rounds = int(os.environ.get("BENCH_ROUNDS", "128"))      # R per dispatch
+    dispatches = int(os.environ.get("BENCH_DISPATCHES", "5"))
     warmup = 3
 
     cap = 2 * n_groups  # self-ack + follower ack per group per round
     eng = build_state(n_groups, cap)
     st = eng.dev
 
-    rows = np.arange(n_groups, dtype=np.int32)
-    ack_g = np.broadcast_to(
-        np.concatenate([rows, rows]), (rounds, cap)
-    ).copy()
-    ack_p = np.broadcast_to(
-        np.concatenate([np.zeros(n_groups, np.int32), np.ones(n_groups, np.int32)]),
-        (rounds, cap),
-    ).copy()
-    ack_valid = jnp.asarray(np.ones((rounds, cap), bool))
-    zeros_i32 = jnp.asarray(np.zeros((rounds, cap), np.int32))
-    zeros_i8 = jnp.asarray(np.zeros((rounds, cap), np.int8))
-    zeros_b = jnp.asarray(np.zeros((rounds, cap), bool))
-    ack_g_d = jnp.asarray(ack_g)
-    ack_p_d = jnp.asarray(ack_p)
-
-    def dispatch(st, base_index):
-        # round r acks the entry appended that round: index base+r+1
-        vals = (base_index + 1 + np.arange(rounds, dtype=np.int32))[:, None]
-        ack_val = np.broadcast_to(vals, (rounds, cap)).copy()
-        t0 = time.perf_counter()
-        out = quorum_multistep(
+    # host ingest cost model: the real engine uploads compact event batches;
+    # here the staged batches are regular (every group commits one entry per
+    # round: self-ack + follower ack), so ALL event tensors are derived on
+    # device from the scalar `base` — the persistent-state + delta-upload
+    # design SURVEY.md §7 calls for, and nothing big crosses the host
+    # boundary or lands in the program as a constant
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def staged_multistep(st, base_index):
+        rows = jnp.arange(n_groups, dtype=jnp.int32)
+        ack_g = jnp.broadcast_to(
+            jnp.concatenate([rows, rows]), (rounds, cap)
+        )
+        ack_p = jnp.broadcast_to(
+            jnp.concatenate(
+                [
+                    jnp.zeros((n_groups,), jnp.int32),
+                    jnp.ones((n_groups,), jnp.int32),
+                ]
+            ),
+            (rounds, cap),
+        )
+        vals = base_index + 1 + jnp.arange(rounds, dtype=jnp.int32)
+        ack_val = jnp.broadcast_to(vals[:, None], (rounds, cap))
+        ack_valid = jnp.ones((rounds, cap), bool)
+        zeros_i32 = jnp.zeros((rounds, cap), jnp.int32)
+        return quorum_multistep(
             st,
-            ack_g_d,
-            ack_p_d,
-            jnp.asarray(ack_val),
+            ack_g,
+            ack_p,
+            ack_val,
             ack_valid,
             zeros_i32,
             zeros_i32,
-            zeros_i8,
-            zeros_b,
+            jnp.zeros((rounds, cap), jnp.int8),
+            jnp.zeros((rounds, cap), bool),
             do_tick=True,
         )
+
+    def dispatch(st, base_index):
+        t0 = time.perf_counter()
+        out = staged_multistep(st, jnp.int32(base_index))
         committed = np.asarray(out.committed)  # egress readback (blocks)
         return out.state, committed, time.perf_counter() - t0
 
