@@ -122,6 +122,12 @@ class Node:
         )
         # metrics + LeaderUpdated forwarding (reference event.go:37)
         self.peer.raft.events = getattr(self, "peer_raft_events", None)
+        # TPU quorum plugin (ExpertConfig.quorum_engine): stage hot-path
+        # tallying to the device engine and register this group's row
+        coord = getattr(self, "quorum_coordinator", None)
+        if coord is not None:
+            self.peer.raft.offload = coord
+            coord.register(self)
         # queue initial recovery so the apply worker restores the newest
         # local snapshot before any new entries apply
         self.to_apply.enqueue(
@@ -134,6 +140,45 @@ class Node:
             )
         )
         self.nh.engine.set_apply_ready(self.cluster_id)
+
+    # ---- TPU quorum plugin appliers (called from the coordinator round
+    # thread; every effect re-checked under raftMu with scalar guards) ----
+
+    def offload_commit(self, q: int) -> None:
+        """Apply a device-computed commit watermark.  ``log.try_commit``
+        re-applies the current-term rule (raft paper p8), so a stale result
+        from before a leadership change is rejected, keeping commit outputs
+        bit-identical to the scalar path."""
+        advanced = False
+        with self.raft_mu:
+            if self.peer is None:
+                return
+            r = self.peer.raft
+            if r.is_leader() and r.log.try_commit(q, r.term):
+                r.broadcast_replicate_message()
+                advanced = True
+        if advanced:
+            self.nh.engine.set_step_ready(self.cluster_id)
+
+    def offload_election(self, won: bool, term: int) -> None:
+        """Apply a device-tallied election outcome (twin of the scalar
+        promotion in ``handle_candidate_request_vote_resp``).  ``term``
+        pins the outcome to the campaign it tallied: a flag staged before
+        the campaign restarted at a higher term is discarded."""
+        changed = False
+        with self.raft_mu:
+            if self.peer is None:
+                return
+            r = self.peer.raft
+            if r.is_candidate() and r.term == term:
+                if won:
+                    r.become_leader()
+                    r.broadcast_replicate_message()
+                else:
+                    r.become_follower(r.term, 0)
+                changed = True
+        if changed:
+            self.nh.engine.set_step_ready(self.cluster_id)
 
     def _publish_event(
         self, type: SystemEventType, index: int = 0, from_: int = 0
@@ -371,7 +416,16 @@ class Node:
 
     def process_dropped(self, ud: Update) -> None:
         for e in ud.dropped_entries:
-            self.pending_proposals.dropped(e.key)
+            if e.is_config_change():
+                # reference node.go: dropped config changes notify their
+                # own single-slot tracker so Sync* wrappers can retry
+                rs = self.pending_config_change.pending()
+                if rs is not None and rs.key == e.key:
+                    self.pending_config_change.notify(
+                        RequestResult(code=RequestResultCode.DROPPED)
+                    )
+            else:
+                self.pending_proposals.dropped(e.key)
         if ud.dropped_read_indexes:
             self.pending_reads.dropped(ud.dropped_read_indexes)
 

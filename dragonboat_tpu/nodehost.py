@@ -34,6 +34,7 @@ from .requests import (
     RequestState,
     TimeoutError_,
 )
+from .settings import Soft
 from .rsm import (
     SSReqType,
     SSRequest,
@@ -108,8 +109,19 @@ class NodeHost:
                 type=SystemEventType.LOGDB_COMPACTED, cluster_id=cid, node_id=nid
             )
         )
-        # engine
+        # TPU quorum plugin (the north star's plugin/tpuquorum boundary):
+        # "tpu"/"auto" route hot-path tallying through the batched device
+        # engine; "scalar" leaves the pure-host path untouched
         expert = nhconfig.expert
+        self.quorum_coordinator = None
+        if expert.quorum_engine in ("tpu", "auto"):
+            from .tpuquorum import TpuQuorumCoordinator
+
+            self.quorum_coordinator = TpuQuorumCoordinator(
+                capacity=expert.engine_block_groups
+                or Soft.quorum_engine_block_groups,
+            )
+        # engine
         workers = expert.step_worker_count or 4
         self.engine = Engine(
             self._get_nodes,
@@ -286,6 +298,7 @@ class NodeHost:
             PeerAddress(node_id=nid, address=a) for nid, a in (members or {}).items()
         ]
         node.peer_raft_events = self.raft_events
+        node.quorum_coordinator = self.quorum_coordinator
         node.start(addresses, initial=not join and new_node, new_node=new_node)
         with self._mu:
             self._clusters[cluster_id] = node
@@ -305,6 +318,8 @@ class NodeHost:
                 raise ClusterNotFoundError(str(cluster_id))
             del self._clusters[cluster_id]
             self._csi += 1
+        if self.quorum_coordinator is not None:
+            self.quorum_coordinator.unregister(cluster_id)
         node.stop()
         self.sys_events.publish(
             SystemEvent(
@@ -332,6 +347,8 @@ class NodeHost:
             if n is not None:
                 n.stop()
         self.engine.stop()
+        if self.quorum_coordinator is not None:
+            self.quorum_coordinator.stop()
         self.transport.stop()
         self.logdb.close()
         self.sys_events.stop()
@@ -473,17 +490,43 @@ class NodeHost:
 
     def sync_request_add_node(self, cluster_id, node_id, address,
                               config_change_index=0, timeout=5.0) -> None:
-        rs = self.request_add_node(
-            cluster_id, node_id, address, config_change_index, timeout
+        r = self._sync_retry(
+            lambda t: self.request_add_node(
+                cluster_id, node_id, address, config_change_index, t
+            ),
+            timeout,
         )
-        _raise_on_failure(rs.wait(timeout))
+        _raise_on_failure(r)
 
     def sync_request_delete_node(self, cluster_id, node_id,
                                  config_change_index=0, timeout=5.0) -> None:
-        rs = self.request_delete_node(
-            cluster_id, node_id, config_change_index, timeout
+        r = self._sync_retry(
+            lambda t: self.request_delete_node(
+                cluster_id, node_id, config_change_index, t
+            ),
+            timeout,
         )
-        _raise_on_failure(rs.wait(timeout))
+        _raise_on_failure(r)
+
+    def sync_request_add_observer(self, cluster_id, node_id, address,
+                                  config_change_index=0, timeout=5.0) -> None:
+        r = self._sync_retry(
+            lambda t: self.request_add_observer(
+                cluster_id, node_id, address, config_change_index, t
+            ),
+            timeout,
+        )
+        _raise_on_failure(r)
+
+    def sync_request_add_witness(self, cluster_id, node_id, address,
+                                 config_change_index=0, timeout=5.0) -> None:
+        r = self._sync_retry(
+            lambda t: self.request_add_witness(
+                cluster_id, node_id, address, config_change_index, t
+            ),
+            timeout,
+        )
+        _raise_on_failure(r)
 
     def sync_get_cluster_membership(
         self, cluster_id: int, timeout: float = 5.0

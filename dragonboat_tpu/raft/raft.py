@@ -157,6 +157,10 @@ class Raft:
         self.randomized_election_timeout = 0
         self.matched: List[int] = []
         self.events = None  # IRaftEventListener
+        # TPU quorum plugin (tpuquorum.TpuQuorumCoordinator); None = pure
+        # scalar path.  When set, ack/vote tallying and commit advancement
+        # are staged to the batched device engine instead of computed here
+        self.offload = None
         self.has_not_applied_config_change: Optional[Callable[[], bool]] = None
         # deterministic, seedable randomness (design delta; see module docstring)
         self.prng = _random.Random(
@@ -341,6 +345,8 @@ class Raft:
                 match = next_ - 1
             self.set_witness(nid, match, next_)
         self.reset_match_value_array()
+        if self.offload is not None:
+            self.offload.membership_changed(self.cluster_id)
 
     # ------------------------------------------------------------------
     # tick
@@ -585,7 +591,11 @@ class Raft:
             e.index = last_index + 1 + i
         self.log.append(entries)
         self.remotes[self.node_id].try_update(self.log.last_index())
-        if self.is_single_node_quorum():
+        if self.offload is not None:
+            self.offload.ack(
+                self.cluster_id, self.node_id, self.log.last_index()
+            )
+        elif self.is_single_node_quorum():
             self.try_commit()
 
     # ------------------------------------------------------------------
@@ -597,12 +607,16 @@ class Raft:
             raise RuntimeError("transitioning to observer from non-observer")
         self.reset(term)
         self.set_leader_id(leader_id)
+        if self.offload is not None:
+            self.offload.set_follower(self.cluster_id, term)
 
     def become_witness(self, term: int, leader_id: int) -> None:
         if not self.is_witness():
             raise RuntimeError("transitioning to witness from non-witness")
         self.reset(term)
         self.set_leader_id(leader_id)
+        if self.offload is not None:
+            self.offload.set_follower(self.cluster_id, term)
 
     def become_follower(self, term: int, leader_id: int) -> None:
         if self.is_witness():
@@ -610,6 +624,8 @@ class Raft:
         self.state = RaftState.FOLLOWER
         self.reset(term)
         self.set_leader_id(leader_id)
+        if self.offload is not None:
+            self.offload.set_follower(self.cluster_id, term)
 
     def become_candidate(self) -> None:
         if self.is_leader():
@@ -623,6 +639,8 @@ class Raft:
         self.reset(self.term + 1)
         self.set_leader_id(NO_LEADER)
         self.vote = self.node_id
+        if self.offload is not None:
+            self.offload.set_candidate(self.cluster_id, self.term)
 
     def become_leader(self) -> None:
         if not self.is_leader() and not self.is_candidate():
@@ -633,6 +651,14 @@ class Raft:
         self.pre_leader_promotion_handle_config_change()
         # p72 of the raft thesis: commit a noop entry at the start of the term
         self.append_entries([Entry(type=EntryType.APPLICATION, cmd=b"")])
+        if self.offload is not None:
+            # term_start = the noop's index: the floor for counting commits
+            self.offload.set_leader(
+                self.cluster_id,
+                self.term,
+                self.log.last_index(),
+                self.log.last_index(),
+            )
 
     def reset(self, term: int) -> None:
         # reference raft.go:991-1010
@@ -700,6 +726,8 @@ class Raft:
         if self.is_single_node_quorum():
             self.become_leader()
             return
+        if self.offload is not None:
+            self.offload.vote(self.cluster_id, self.node_id, True)
         hint = 0
         if self.is_leader_transfer_target:
             hint = self.node_id
@@ -746,6 +774,8 @@ class Raft:
             raise RuntimeError("could not promote witness to full member")
         else:
             self.set_remote(node_id, 0, self.log.last_index() + 1)
+        if self.offload is not None:
+            self.offload.membership_changed(self.cluster_id)
 
     def add_observer(self, node_id: int) -> None:
         self.clear_pending_config_change()
@@ -754,6 +784,8 @@ class Raft:
         if node_id in self.observers:
             return
         self.set_observer(node_id, 0, self.log.last_index() + 1)
+        if self.offload is not None:
+            self.offload.membership_changed(self.cluster_id)
 
     def add_witness(self, node_id: int) -> None:
         self.clear_pending_config_change()
@@ -762,6 +794,8 @@ class Raft:
         if node_id in self.witnesses:
             return
         self.set_witness(node_id, 0, self.log.last_index() + 1)
+        if self.offload is not None:
+            self.offload.membership_changed(self.cluster_id)
 
     def remove_node(self, node_id: int) -> None:
         # reference raft.go:1189-1208
@@ -773,7 +807,11 @@ class Raft:
             self.become_follower(self.term, NO_LEADER)
         if self.leader_transfering() and self.leader_transfer_target == node_id:
             self.abort_leader_transfer()
-        if self.is_leader() and self.num_voting_members() > 0:
+        if self.offload is not None:
+            # quorum may have shrunk: resync the row; the next round
+            # recomputes the commit watermark over the new membership
+            self.offload.membership_changed(self.cluster_id)
+        elif self.is_leader() and self.num_voting_members() > 0:
             if self.try_commit():
                 self.broadcast_replicate_message()
 
@@ -1066,7 +1104,14 @@ class Raft:
             paused = rp.is_paused()
             if rp.try_update(m.log_index):
                 rp.responded_to()
-                if self.try_commit():
+                if self.offload is not None:
+                    # north-star hot path: the quorum reduction runs on
+                    # device over all groups; commit lands via
+                    # node.offload_commit with the term guard re-applied
+                    self.offload.ack(self.cluster_id, m.from_, rp.match)
+                    if paused:
+                        self.send_replicate_message(m.from_)
+                elif self.try_commit():
                     self.broadcast_replicate_message()
                 elif paused:
                     self.send_replicate_message(m.from_)
@@ -1239,6 +1284,10 @@ class Raft:
         if m.from_ in self.observers:
             return
         count = self.handle_vote_resp(m.from_, m.reject)
+        if self.offload is not None:
+            # the device tallies; won/lost lands via node.offload_election
+            self.offload.vote(self.cluster_id, m.from_, not m.reject)
+            return
         # 3rd paragraph section 5.2 of the raft paper
         if count == self.quorum():
             self.become_leader()

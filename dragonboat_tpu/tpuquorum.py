@@ -1,0 +1,293 @@
+"""TPU quorum plugin: routes the live runtime's hot path through the
+batched device engine.
+
+This is the plugin boundary BASELINE.json's north star calls
+``plugin/tpuquorum`` (selected via ``ExpertConfig.quorum_engine``): with it
+enabled, the per-group scalar work the reference does inside
+``processSteps`` — ReplicateResp ack tallying, matchIndex quorum reduction
+(``raft.go:888-909`` ``tryCommit``) and candidate vote tallying
+(``raft.go:1062-1080``) — is staged as compact event batches and computed
+for ALL groups in one fused device dispatch per coordinator round
+(:mod:`dragonboat_tpu.ops`).  With it disabled, nothing below runs and the
+scalar path is untouched.
+
+Division of labor (SURVEY.md §7 design pivot):
+- dense 99% paths on device: ack ingest (scatter-max), per-group
+  kth-largest commit reduction, vote tally vs quorum
+- rare paths stay scalar on host and re-sync their row: leadership
+  transitions, membership change, snapshot restore, index rebase
+- commit/election *effects* are applied back under each node's raftMu
+  with the scalar guards intact (``log.try_commit(q, term)`` re-checks the
+  term rule), so a stale device result is rejected, never applied
+
+Determinism: the device commit index is the same ``kth_largest(match)``
+the scalar sort computes, and the term guard is re-applied scalar-side —
+commit outputs are bit-identical to the pure-scalar path (differential
+tests in ``tests/test_tpuquorum.py`` + ``tests/test_ops_quorum.py``).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, TYPE_CHECKING
+
+from .logger import get_logger
+
+if TYPE_CHECKING:
+    from .node import Node
+
+plog = get_logger("tpuquorum")
+
+
+class TpuQuorumCoordinator:
+    """Owns the device engine; one round = one fused dispatch.
+
+    All staging methods are called from raft under the owning node's
+    raftMu; the coordinator serializes engine access with its own lock.
+    The round thread applies commit/election results back through
+    ``Node.offload_commit`` / ``Node.offload_election``.
+    """
+
+    def __init__(
+        self,
+        capacity: int = 1024,
+        n_peers: int = 8,
+        interval_s: float = 0.002,
+    ):
+        from .ops.engine import BatchedQuorumEngine
+
+        self.eng = BatchedQuorumEngine(
+            capacity, n_peers, event_cap=max(4 * capacity, 4096)
+        )
+        self.capacity = capacity
+        self._nodes: Dict[int, "Node"] = {}
+        self._mu = threading.RLock()
+        # staging is decoupled from the engine lock: raft step workers only
+        # append under this micro-lock and NEVER wait on an in-flight
+        # device dispatch — a blocked step worker delays heartbeats and
+        # provokes spurious elections (the same reason the reference sends
+        # Replicate before fsync, execengine.go:954-961)
+        self._stage_mu = threading.Lock()
+        self._staged: list = []
+        self._pending = threading.Event()
+        self._stopped = threading.Event()
+        self._interval = interval_s
+        self._thread = threading.Thread(
+            target=self._round_main, name="tpuquorum", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    # node lifecycle
+    # ------------------------------------------------------------------
+
+    def register(self, node: "Node") -> None:
+        """Add the node's group and sync its current raft state into the
+        row.  Called after Peer.launch with the raft lock held."""
+        with self._mu:
+            self._nodes[node.cluster_id] = node
+            self._sync_row_locked(node)
+
+    def unregister(self, cluster_id: int) -> None:
+        with self._mu:
+            self._nodes.pop(cluster_id, None)
+            if cluster_id in self.eng.groups:
+                self.eng.remove_group(cluster_id)
+
+    def _sync_row_locked(self, node: "Node") -> None:
+        """(Re)build the group's row from scalar raft state — the rare-path
+        resync used at registration and after membership changes."""
+        r = node.peer.raft
+        cid = r.cluster_id
+        if cid in self.eng.groups:
+            self.eng.remove_group(cid)
+        voters = sorted(set(r.remotes))
+        witnesses = tuple(sorted(r.witnesses))
+        observers = tuple(sorted(r.observers))
+        if r.node_id not in r.remotes and r.node_id not in r.witnesses and (
+            r.node_id not in r.observers
+        ):
+            # a joining node knows no membership yet (it learns from the
+            # log); register a self-only row until membership_changed
+            # resyncs it
+            voters = sorted(set(voters) | {r.node_id})
+        self.eng.add_group(
+            cid,
+            node_ids=voters,
+            self_id=r.node_id,
+            election_timeout=r.election_timeout,
+            heartbeat_timeout=r.heartbeat_timeout,
+            check_quorum=r.check_quorum,
+            witnesses=witnesses,
+            observers=observers,
+        )
+        if r.is_leader():
+            self.eng.set_leader(
+                cid,
+                term=r.term,
+                term_start=self._term_start(r),
+                last_index=r.log.last_index(),
+            )
+            # replay known match state so commit picks up where scalar was
+            for nid, rp in list(r.remotes.items()) + list(r.witnesses.items()):
+                if rp.match > 0:
+                    self.eng.ack(cid, nid, rp.match)
+        elif r.is_candidate():
+            self.eng.set_candidate(cid, term=r.term)
+            for nid, granted in r.votes.items():
+                self.eng.vote(cid, nid, granted)
+        else:
+            self.eng.set_follower(cid, term=r.term)
+
+    @staticmethod
+    def _term_start(r) -> int:
+        """First index of the leader's current term — the floor below which
+        counting-based commit is forbidden (raft paper p8).  The leader
+        appends a noop on promotion, so scanning back from the tail for the
+        first entry of the current term is bounded and exact."""
+        idx = r.log.last_index()
+        first = r.log.first_index()
+        while idx >= first:
+            try:
+                if r.log.term(idx) != r.term:
+                    return idx + 1
+            except Exception:
+                return idx + 1
+            idx -= 1
+        return idx + 1
+
+    # ------------------------------------------------------------------
+    # staging hooks (called from raft under the node's raftMu)
+    # ------------------------------------------------------------------
+
+    def _stage(self, op) -> None:
+        with self._stage_mu:
+            self._staged.append(op)
+        self._pending.set()
+
+    def ack(self, cluster_id: int, node_id: int, index: int) -> None:
+        self._stage(("ack", cluster_id, node_id, index))
+
+    def vote(self, cluster_id: int, node_id: int, granted: bool) -> None:
+        self._stage(("vote", cluster_id, node_id, granted))
+
+    def set_leader(
+        self, cluster_id: int, term: int, term_start: int, last_index: int
+    ) -> None:
+        self._stage(("leader", cluster_id, term, term_start, last_index))
+
+    def set_candidate(self, cluster_id: int, term: int) -> None:
+        self._stage(("candidate", cluster_id, term))
+
+    def set_follower(self, cluster_id: int, term: int) -> None:
+        self._stage(("follower", cluster_id, term))
+
+    def membership_changed(self, cluster_id: int) -> None:
+        self._stage(("resync", cluster_id))
+
+    def _drain_locked(self) -> None:
+        """Apply staged ops to the engine in staging order (so a
+        transition's queued-event purge still covers exactly the events
+        staged before it)."""
+        with self._stage_mu:
+            ops, self._staged = self._staged, []
+        for op in ops:
+            kind, cid = op[0], op[1]
+            if cid not in self.eng.groups:
+                continue
+            try:
+                if kind == "ack":
+                    self.eng.ack(cid, op[2], op[3])
+                elif kind == "vote":
+                    self.eng.vote(cid, op[2], op[3])
+                elif kind == "leader":
+                    self.eng.set_leader(
+                        cid, term=op[2], term_start=op[3], last_index=op[4]
+                    )
+                elif kind == "candidate":
+                    self.eng.set_candidate(cid, term=op[2])
+                elif kind == "follower":
+                    self.eng.set_follower(cid, term=op[2])
+                else:  # resync
+                    self._recover_row(cid)
+            except (ValueError, KeyError):
+                # unknown peer slot / index past the rebase window: rebuild
+                # the row from scalar state (rare)
+                self._recover_row(cid)
+
+    def _recover_row(self, cluster_id: int) -> None:
+        node = self._nodes.get(cluster_id)
+        if node is None:
+            return
+        with node.raft_mu:
+            if node.peer is None:
+                return
+            try:
+                self.eng.rebase(cluster_id)
+            except Exception:
+                pass
+            self._sync_row_locked(node)
+
+    # ------------------------------------------------------------------
+    # the round
+    # ------------------------------------------------------------------
+
+    def _round_main(self) -> None:
+        while not self._stopped.is_set():
+            fired = self._pending.wait(timeout=self._interval)
+            if self._stopped.is_set():
+                return
+            if fired:
+                self._pending.clear()
+            try:
+                self._round()
+            except Exception:
+                plog.exception("tpu quorum round failed")
+
+    def _round(self) -> None:
+        with self._mu:
+            self._drain_locked()
+            if not (
+                self.eng._acks or self.eng._votes or self.eng._dirty
+            ):
+                return
+            # ticks stay scalar in the integrated path: node.tick drives
+            # elections/heartbeats; the engine round only ingests events
+            # and advances commit/tally state
+            res = self.eng.step(do_tick=False)
+        for cid, q in res.commit.items():
+            node = self._nodes.get(cid)
+            if node is not None:
+                node.offload_commit(q)
+        # tag election outcomes with the term the row held when the round
+        # ran: during long dispatches (first jit compile, busy host) the
+        # scalar side may have restarted the campaign at a higher term, and
+        # a stale won-flag must never promote a later-term candidate that
+        # lacks a quorum at that term
+        won_terms = {}
+        lost_terms = {}
+        with self._mu:
+            for cid in res.won:
+                gi = self.eng.groups.get(cid)
+                if gi is not None:
+                    won_terms[cid] = int(self.eng._read("term", gi.row))
+            for cid in res.lost:
+                gi = self.eng.groups.get(cid)
+                if gi is not None:
+                    lost_terms[cid] = int(self.eng._read("term", gi.row))
+        for cid, term in won_terms.items():
+            node = self._nodes.get(cid)
+            if node is not None:
+                node.offload_election(True, term)
+        for cid, term in lost_terms.items():
+            node = self._nodes.get(cid)
+            if node is not None:
+                node.offload_election(False, term)
+
+    def flush(self) -> None:
+        """Run one round synchronously (tests)."""
+        self._round()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._pending.set()
+        self._thread.join(timeout=5)

@@ -3,18 +3,33 @@
 Multi-chip hardware is not available in CI; sharding tests run on a virtual
 8-device CPU mesh (same XLA collectives, same GSPMD partitioner) — the driver
 separately dry-run-compiles the multi-chip path via ``__graft_entry__``.
-Must run before jax is imported anywhere.
+
+The environment ships a tunneled TPU backend ("axon") registered by a
+``sitecustomize`` at interpreter startup — i.e. jax is already imported and
+configured for the tunnel before this file runs.  Tests must neither run on
+the tunnel (slow remote compiles) nor hang when it is down, so the platform
+is forced to cpu via ``jax.config`` and the axon backend factory is
+deregistered outright.
 """
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-# the axon TPU plugin ignores JAX_PLATFORMS; PLATFORM_NAME still wins
-os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (already imported by sitecustomize anyway)
+
+jax.config.update("jax_platforms", "cpu")
+try:  # drop the tunneled backend so no code path can dial it
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

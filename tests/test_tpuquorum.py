@@ -1,0 +1,272 @@
+"""TPU quorum plugin integration tests.
+
+The north-star plugin boundary (BASELINE.json): with
+``ExpertConfig.quorum_engine="tpu"``, the live runtime's ack tallying,
+commit advancement and vote tallying run through the batched device
+engine; with "scalar" the pure-host path is untouched.  These tests run
+real multi-replica clusters in both modes and require identical outcomes.
+"""
+import threading
+import time
+
+import pytest
+
+from dragonboat_tpu import Config, NodeHostConfig, Result
+from dragonboat_tpu.config import ExpertConfig
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.statemachine import IStateMachine
+from dragonboat_tpu.transport import ChanRouter, ChanTransport
+
+RTT_MS = 5
+CID = 21
+
+
+class KVSM(IStateMachine):
+    def __init__(self, cluster_id, node_id):
+        self.kv = {}
+        self.count = 0
+
+    def update(self, cmd):
+        k, v = cmd.decode().split("=", 1)
+        self.kv[k] = v
+        self.count += 1
+        return Result(value=self.count)
+
+    def lookup(self, query):
+        return self.kv.get(query)
+
+    def save_snapshot(self, w, files, done):
+        data = repr(sorted(self.kv.items())).encode()
+        w.write(len(data).to_bytes(8, "little") + data)
+
+    def recover_from_snapshot(self, r, files, done):
+        import ast
+
+        n = int.from_bytes(r.read(8), "little")
+        self.kv = dict(ast.literal_eval(r.read(n).decode()))
+        self.count = len(self.kv)
+
+    def close(self):
+        pass
+
+
+def _mk_nh(addr, router, engine="tpu"):
+    return NodeHost(
+        NodeHostConfig(
+            node_host_dir=":memory:",
+            rtt_millisecond=RTT_MS,
+            raft_address=addr,
+            raft_rpc_factory=lambda src, rh, ch: ChanTransport(
+                src, rh, ch, router=router
+            ),
+            expert=ExpertConfig(quorum_engine=engine, engine_block_groups=64),
+        )
+    )
+
+
+def _wait_leader(nhs, cid, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        for nh in nhs:
+            _, ok = nh.get_leader_id(cid)
+            if ok:
+                return
+        time.sleep(0.01)
+    raise TimeoutError("no leader")
+
+
+def _cluster(router, engine, n=3, prefix="tq"):
+    addrs = {i: f"{prefix}{i}:1" for i in range(1, n + 1)}
+    nhs = [_mk_nh(addrs[i], router, engine) for i in range(1, n + 1)]
+    for i, nh in enumerate(nhs, start=1):
+        nh.start_cluster(
+            addrs, False, KVSM,
+            Config(cluster_id=CID, node_id=i, election_rtt=10, heartbeat_rtt=1),
+        )
+    return nhs, addrs
+
+
+def test_tpu_engine_propose_and_read():
+    """3 replicas, device-tallied commits: propose/read round trip."""
+    router = ChanRouter()
+    nhs, _ = _cluster(router, "tpu")
+    try:
+        _wait_leader(nhs, CID)
+        assert nhs[0].quorum_coordinator is not None
+        s = nhs[0].get_noop_session(CID)
+        for i in range(20):
+            r = nhs[0].sync_propose(s, f"k{i}=v{i}".encode(), timeout=5.0)
+            assert r.value == i + 1
+        for i in range(20):
+            assert nhs[0].sync_read(CID, f"k{i}", timeout=5.0) == f"v{i}"
+        # the engine actually owns the group rows
+        eng = nhs[0].quorum_coordinator.eng
+        assert CID in eng.groups
+    finally:
+        for nh in nhs:
+            nh.stop()
+
+
+def test_tpu_engine_single_replica():
+    router = ChanRouter()
+    nh = _mk_nh("solo:1", router, "tpu")
+    try:
+        nh.start_cluster(
+            {1: "solo:1"}, False, KVSM,
+            Config(cluster_id=CID, node_id=1, election_rtt=10, heartbeat_rtt=1),
+        )
+        _wait_leader([nh], CID)
+        s = nh.get_noop_session(CID)
+        for i in range(5):
+            nh.sync_propose(s, f"a{i}=1".encode(), timeout=5.0)
+        assert nh.sync_read(CID, "a4", timeout=5.0) == "1"
+    finally:
+        nh.stop()
+
+
+def test_tpu_engine_leader_failover():
+    """Stop the leader; the device-tallied election elects a successor and
+    writes continue."""
+    router = ChanRouter()
+    nhs, addrs = _cluster(router, "tpu", prefix="fo")
+    try:
+        _wait_leader(nhs, CID)
+        lid = 0
+        deadline = time.time() + 10
+        while not lid and time.time() < deadline:
+            for nh in nhs:
+                l, ok = nh.get_leader_id(CID)
+                if ok:
+                    lid = l
+                    break
+            else:
+                time.sleep(0.05)
+        assert lid
+        leader_nh = nhs[lid - 1]
+        leader_nh.stop_cluster(CID)
+        survivors = [nh for nh in nhs if nh is not leader_nh]
+        _wait_leader(survivors, CID)
+        s = survivors[0].get_noop_session(CID)
+        committed = False
+        for _ in range(20):
+            try:
+                survivors[0].sync_propose(s, b"after=failover", timeout=3.0)
+                committed = True
+                break
+            except Exception:
+                time.sleep(0.2)
+        assert committed
+        assert survivors[0].sync_read(CID, "after", timeout=5.0) == "failover"
+    finally:
+        for nh in nhs:
+            nh.stop()
+
+
+def test_tpu_engine_membership_change():
+    """Add a 4th member and remove it again with the device engine on —
+    the row resync path."""
+    router = ChanRouter()
+    nhs, addrs = _cluster(router, "tpu", prefix="mc")
+    nh4 = _mk_nh("mc4:1", router, "tpu")
+    try:
+        _wait_leader(nhs, CID)
+        nhs[0].sync_request_add_node(CID, 4, "mc4:1", timeout=10.0)
+        nh4.start_cluster(
+            {}, True, KVSM,
+            Config(cluster_id=CID, node_id=4, election_rtt=10, heartbeat_rtt=1),
+        )
+        s = nhs[0].get_noop_session(CID)
+        for i in range(5):
+            nhs[0].sync_propose(s, f"m{i}=1".encode(), timeout=5.0)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            m = nhs[0].sync_get_cluster_membership(CID, timeout=5.0)
+            if 4 in m.addresses:
+                break
+            time.sleep(0.1)
+        assert 4 in m.addresses
+        nhs[0].sync_request_delete_node(CID, 4, timeout=10.0)
+        for i in range(5):
+            nhs[0].sync_propose(s, f"n{i}=1".encode(), timeout=5.0)
+        m = nhs[0].sync_get_cluster_membership(CID, timeout=5.0)
+        assert 4 not in m.addresses
+    finally:
+        for nh in nhs + [nh4]:
+            nh.stop()
+
+
+def test_scalar_vs_tpu_differential():
+    """Same workload in both modes: identical SM results and final state —
+    the bit-identical commit discipline at the cluster level."""
+    results = {}
+    for engine in ("scalar", "tpu"):
+        router = ChanRouter()
+        nhs, _ = _cluster(router, engine, prefix=f"d{engine[:1]}")
+        try:
+            _wait_leader(nhs, CID)
+            s = nhs[0].get_noop_session(CID)
+            vals = []
+            for i in range(30):
+                r = nhs[0].sync_propose(s, f"k{i % 7}=v{i}".encode(), 5.0)
+                vals.append(r.value)
+            reads = [
+                nhs[0].sync_read(CID, f"k{j}", timeout=5.0) for j in range(7)
+            ]
+            results[engine] = (vals, reads)
+        finally:
+            for nh in nhs:
+                nh.stop()
+    assert results["scalar"] == results["tpu"], results
+
+
+def test_tpu_engine_snapshot_and_restart(tmp_path):
+    """Snapshot + restart with the plugin enabled (row re-registration on
+    restart)."""
+    router = ChanRouter()
+    nh = NodeHost(
+        NodeHostConfig(
+            node_host_dir=str(tmp_path),
+            rtt_millisecond=RTT_MS,
+            raft_address="sr:1",
+            raft_rpc_factory=lambda src, rh, ch: ChanTransport(
+                src, rh, ch, router=router
+            ),
+            expert=ExpertConfig(quorum_engine="tpu", engine_block_groups=64),
+        )
+    )
+    try:
+        nh.start_cluster(
+            {1: "sr:1"}, False, KVSM,
+            Config(cluster_id=CID, node_id=1, election_rtt=10, heartbeat_rtt=1),
+        )
+        _wait_leader([nh], CID)
+        s = nh.get_noop_session(CID)
+        for i in range(8):
+            nh.sync_propose(s, f"k{i}=v{i}".encode(), timeout=5.0)
+        assert nh.sync_request_snapshot(CID, timeout=5.0) > 0
+    finally:
+        nh.stop()
+    nh2 = NodeHost(
+        NodeHostConfig(
+            node_host_dir=str(tmp_path),
+            rtt_millisecond=RTT_MS,
+            raft_address="sr:1",
+            raft_rpc_factory=lambda src, rh, ch: ChanTransport(
+                src, rh, ch, router=ChanRouter()
+            ),
+            expert=ExpertConfig(quorum_engine="tpu", engine_block_groups=64),
+        )
+    )
+    try:
+        nh2.start_cluster(
+            {}, False, KVSM,
+            Config(cluster_id=CID, node_id=1, election_rtt=10, heartbeat_rtt=1),
+        )
+        _wait_leader([nh2], CID)
+        for i in range(8):
+            assert nh2.sync_read(CID, f"k{i}", timeout=5.0) == f"v{i}"
+        s = nh2.get_noop_session(CID)
+        nh2.sync_propose(s, b"post=restart", timeout=5.0)
+        assert nh2.sync_read(CID, "post", timeout=5.0) == "restart"
+    finally:
+        nh2.stop()
