@@ -1,0 +1,62 @@
+"""Host-side jax platform helpers shared by driver hooks and benches.
+
+The environment registers a tunneled TPU backend ("axon") via sitecustomize;
+its init can hang (not just fail) when the tunnel is down, so anything that
+must run reliably (tests, the multichip dryrun, bench fallback paths) forces
+the CPU platform *before* first backend use and drops the tunneled factory.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_cpu() -> None:
+    """Force the CPU platform and drop the tunneled backend factory.
+
+    Safe to call before or after ``import jax`` but must run before the
+    first backend init in this process.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["JAX_PLATFORM_NAME"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+
+
+def set_host_device_count(n: int) -> None:
+    """Ensure XLA_FLAGS requests >= n virtual host (CPU) devices.
+
+    Replaces any existing smaller ``--xla_force_host_platform_device_count``
+    value instead of substring-checking, so a stale count from the caller's
+    environment cannot survive.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m:
+        if int(m.group(1)) >= n:
+            return
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            f"--xla_force_host_platform_device_count={n}",
+            flags,
+        )
+    else:
+        flags = (flags + f" --xla_force_host_platform_device_count={n}").strip()
+    os.environ["XLA_FLAGS"] = flags
+
+
+def clear_backends() -> None:
+    """Best-effort reset of jax's backend cache (e.g. after flag changes)."""
+    try:
+        import jax.extend.backend as _eb
+
+        _eb.clear_backends()
+    except Exception:
+        pass
