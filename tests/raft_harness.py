@@ -114,8 +114,7 @@ class Network:
                 queue.extend(self.filter(self.take_msgs(p)))
 
     def take_msgs(self, r: Raft) -> List[Message]:
-        msgs = r.msgs
-        r.msgs = []
+        msgs = read_messages(r)
         for m in msgs:
             m.cluster_id = 1
         return msgs
@@ -174,14 +173,93 @@ def tick_until_election(r: Raft) -> None:
         r.tick()
 
 
+def ids_by_size(size: int) -> List[int]:
+    return list(range(1, size + 1))
+
+
+def read_messages(r: Raft) -> List[Message]:
+    """Drain a raft node's outbox (reference etcd readMessages)."""
+    msgs = r.msgs
+    r.msgs = []
+    return msgs
+
+
+def accept_and_reply(m: Message) -> Message:
+    """Acknowledge a Replicate as fully appended (etcd acceptAndReply)."""
+    assert m.type == MT.REPLICATE, m.type
+    return Message(
+        from_=m.to,
+        to=m.from_,
+        term=m.term,
+        type=MT.REPLICATE_RESP,
+        log_index=m.log_index + len(m.entries),
+    )
+
+
+def commit_noop_entry(r: Raft, s: InMemLogDB) -> None:
+    """Replicate + commit the noop the leader appended on promotion, then
+    mark it saved/processed (etcd commitNoopEntry)."""
+    from dragonboat_tpu.wire import UpdateCommit
+
+    assert r.is_leader(), "commit_noop_entry requires a leader"
+    r.broadcast_replicate_message()
+    for m in read_messages(r):
+        assert (
+            m.type == MT.REPLICATE
+            and len(m.entries) == 1
+            and not m.entries[0].cmd
+        ), "not a noop append"
+        r.handle(accept_and_reply(m))
+    read_messages(r)  # drop commit-refresh broadcasts
+    s.append(r.log.entries_to_save())
+    r.log.commit_update(
+        UpdateCommit(
+            processed=r.log.committed,
+            stable_log_to=r.log.last_index(),
+            stable_log_term=r.log.last_term(),
+        )
+    )
+
+
+NO_LIMIT = 1 << 62
+
+
+def get_all_entries(log) -> List:
+    """Every entry currently in the log view (etcd getAllEntries)."""
+    if log.last_index() < log.first_index():
+        return []
+    return log.get_entries(log.first_index(), log.last_index() + 1, NO_LIMIT)
+
+
+def ent_sig(entries) -> List[Tuple[int, int]]:
+    """(term, index) signature list for log-content comparisons."""
+    return [(e.term, e.index) for e in entries]
+
+
+def logs_equal(a, b) -> bool:
+    """Full log-view equality: committed watermark + entry signatures
+    (the etcd ltoa/diffu check)."""
+    return (
+        a.committed == b.committed
+        and ent_sig(get_all_entries(a)) == ent_sig(get_all_entries(b))
+    )
+
+
 __all__ = [
     "BlackHole",
     "Network",
     "RaftState",
+    "accept_and_reply",
     "campaign",
+    "commit_noop_entry",
+    "ent_sig",
+    "get_all_entries",
+    "ids_by_size",
+    "logs_equal",
     "new_test_config",
     "new_test_raft",
     "propose",
+    "read_messages",
     "readindex",
     "tick_until_election",
 ]

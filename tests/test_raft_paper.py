@@ -1,0 +1,601 @@
+"""Raft-paper conformance tests, ported from the reference's etcd suite.
+
+Each test reproduces the scenario of the same-named test in
+``/root/reference/internal/raft/raft_etcd_paper_test.go`` (itself the etcd
+raft-paper suite): init state, drive via ``Raft.handle``, check outgoing
+messages and state.  Section numbers refer to the raft paper
+(https://raft.github.io/raft.pdf).
+"""
+import pytest
+
+from raft_harness import (
+    BlackHole,
+    Network,
+    RaftState,
+    accept_and_reply,
+    commit_noop_entry,
+    ent_sig,
+    get_all_entries,
+    ids_by_size,
+    logs_equal,
+    new_test_raft,
+    read_messages,
+)
+from dragonboat_tpu.raft import InMemLogDB
+from dragonboat_tpu.wire import Entry, Message, MessageType, State
+
+MT = MessageType
+F, C, L = RaftState.FOLLOWER, RaftState.CANDIDATE, RaftState.LEADER
+
+
+def _enter_state(r, state, term=1, leader=2):
+    if state == F:
+        r.become_follower(term, leader)
+    elif state == C:
+        r.become_candidate()
+    elif state == L:
+        r.become_candidate()
+        r.become_leader()
+
+
+# ---------------------------------------------------------------------------
+# §5.1 term handling
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("state", [F, C, L])
+def test_update_term_from_message(state):
+    """§5.1: a server seeing a larger term adopts it and reverts to
+    follower (reference testUpdateTermFromMessage)."""
+    r = new_test_raft(1, [1, 2, 3])
+    _enter_state(r, state)
+    r.handle(Message(type=MT.REPLICATE, term=2))
+    assert r.term == 2
+    assert r.state == F
+
+
+def test_reject_stale_term_message():
+    """§5.1: requests with a stale term are ignored (the implementation
+    drops them before any per-state handler runs)."""
+    r = new_test_raft(1, [1, 2, 3])
+    r.load_state(State(term=2))
+    r.handle(Message(type=MT.REPLICATE, term=r.term - 1))
+    # no response, no state change
+    assert read_messages(r) == []
+    assert r.term == 2
+    assert r.state == F
+
+
+# ---------------------------------------------------------------------------
+# §5.2 leader election
+# ---------------------------------------------------------------------------
+
+
+def test_start_as_follower():
+    r = new_test_raft(1, [1, 2, 3])
+    assert r.state == F
+
+
+def test_leader_bcast_beat():
+    """§5.2: on a heartbeat tick the leader broadcasts heartbeats."""
+    hi = 1
+    r = new_test_raft(1, [1, 2, 3], election=10, heartbeat=hi)
+    r.become_candidate()
+    r.become_leader()
+    for i in range(10):
+        r.append_entries([Entry(index=i + 1)])
+    read_messages(r)
+    for _ in range(hi):
+        r.tick()
+    msgs = sorted(read_messages(r), key=lambda m: m.to)
+    assert [(m.from_, m.to, m.term, m.type) for m in msgs] == [
+        (1, 2, 1, MT.HEARTBEAT),
+        (1, 3, 1, MT.HEARTBEAT),
+    ]
+
+
+@pytest.mark.parametrize("state", [F, C])
+def test_nonleader_start_election(state):
+    """§5.2: without leader contact past the election timeout, a
+    follower/candidate campaigns: term+1, votes for itself, RequestVote
+    fan-out."""
+    et = 10
+    r = new_test_raft(1, [1, 2, 3], election=et, heartbeat=1)
+    if state == F:
+        r.become_follower(1, 2)
+    else:
+        r.become_candidate()
+    read_messages(r)
+    for _ in range(1, 2 * et):
+        r.tick()
+    assert r.term == 2
+    assert r.state == C
+    assert r.votes[r.node_id]
+    msgs = sorted(
+        [m for m in read_messages(r) if m.type == MT.REQUEST_VOTE],
+        key=lambda m: m.to,
+    )
+    assert [(m.from_, m.to, m.term) for m in msgs] == [(1, 2, 2), (1, 3, 2)]
+
+
+@pytest.mark.parametrize(
+    "size, votes, want",
+    [
+        (1, {}, L),
+        (3, {2: True, 3: True}, L),
+        (3, {2: True}, L),
+        (5, {2: True, 3: True, 4: True, 5: True}, L),
+        (5, {2: True, 3: True, 4: True}, L),
+        (5, {2: True, 3: True}, L),
+        (3, {2: False, 3: False}, F),
+        (5, {2: False, 3: False, 4: False, 5: False}, F),
+        (5, {2: True, 3: False, 4: False, 5: False}, F),
+        (3, {}, C),
+        (5, {2: True}, C),
+        (5, {2: False, 3: False}, C),
+        (5, {}, C),
+    ],
+)
+def test_leader_election_in_one_round_rpc(size, votes, want):
+    """§5.2: win with a majority, lose on majority denial, else stay
+    candidate."""
+    r = new_test_raft(1, ids_by_size(size))
+    r.handle(Message(from_=1, to=1, type=MT.ELECTION))
+    for nid, granted in votes.items():
+        r.handle(
+            Message(
+                from_=nid, to=1, term=r.term,
+                type=MT.REQUEST_VOTE_RESP, reject=not granted,
+            )
+        )
+    assert r.state == want
+    assert r.term == 1
+
+
+@pytest.mark.parametrize(
+    "vote, nvote, wreject",
+    [
+        (0, 1, False),
+        (0, 2, False),
+        (1, 1, False),
+        (2, 2, False),
+        (1, 2, True),
+        (2, 1, True),
+    ],
+)
+def test_follower_vote(vote, nvote, wreject):
+    """§5.2: at most one vote per term, first-come-first-served."""
+    r = new_test_raft(1, [1, 2, 3])
+    r.load_state(State(term=1, vote=vote))
+    r.handle(Message(from_=nvote, to=1, term=1, type=MT.REQUEST_VOTE))
+    msgs = read_messages(r)
+    assert [(m.from_, m.to, m.term, m.type, m.reject) for m in msgs] == [
+        (1, nvote, 1, MT.REQUEST_VOTE_RESP, wreject)
+    ]
+
+
+@pytest.mark.parametrize("term", [1, 2])
+def test_candidate_fallback(term):
+    """§5.2: a candidate receiving Replicate at >= its term recognizes the
+    leader and falls back to follower."""
+    r = new_test_raft(1, [1, 2, 3])
+    r.handle(Message(from_=1, to=1, type=MT.ELECTION))
+    assert r.state == C
+    r.handle(Message(from_=2, to=1, term=term, type=MT.REPLICATE))
+    assert r.state == F
+    assert r.term == term
+
+
+@pytest.mark.parametrize("state", [F, C])
+def test_nonleader_election_timeout_randomized(state):
+    """§5.2: the election timeout is randomized within [et, 2*et)."""
+    et = 10
+    r = new_test_raft(1, [1, 2, 3], election=et, heartbeat=1)
+    fire_times = set()
+    for _ in range(50 * et):
+        if state == F:
+            r.become_follower(r.term + 1, 2)
+        else:
+            r.become_candidate()
+        read_messages(r)
+        time = 0
+        while not read_messages(r):
+            r.tick()
+            time += 1
+        fire_times.add(time)
+    assert all(et <= t <= 2 * et + 1 for t in fire_times), fire_times
+    # randomization must actually spread: most of the window is hit
+    assert len(fire_times) >= et - 2, fire_times
+
+
+@pytest.mark.parametrize("state", [F, C])
+def test_nonleaders_election_timeout_nonconflict(state):
+    """§5.2: randomized timeouts make simultaneous campaigns rare."""
+    et = 10
+    size = 5
+    ids = ids_by_size(size)
+    rs = [new_test_raft(nid, ids, election=et, heartbeat=1) for nid in ids]
+    conflicts = 0
+    rounds = 300
+    for _ in range(rounds):
+        for r in rs:
+            if state == F:
+                r.become_follower(r.term + 1, 0)
+            else:
+                r.become_candidate()
+            read_messages(r)
+        fired = 0
+        while fired == 0:
+            for r in rs:
+                r.tick()
+                if read_messages(r):
+                    fired += 1
+        if fired > 1:
+            conflicts += 1
+    assert conflicts / rounds <= 0.3
+
+
+# ---------------------------------------------------------------------------
+# §5.3 log replication
+# ---------------------------------------------------------------------------
+
+
+def test_leader_start_replication():
+    """§5.3: the leader appends a proposal and fans out Replicate carrying
+    it, without committing yet."""
+    s = InMemLogDB()
+    r = new_test_raft(1, [1, 2, 3], logdb=s)
+    r.become_candidate()
+    r.become_leader()
+    commit_noop_entry(r, s)
+    li = r.log.last_index()
+    r.handle(
+        Message(
+            from_=1, to=1, type=MT.PROPOSE,
+            entries=[Entry(cmd=b"some data")],
+        )
+    )
+    assert r.log.last_index() == li + 1
+    assert r.log.committed == li
+    msgs = sorted(read_messages(r), key=lambda m: m.to)
+    assert [
+        (m.from_, m.to, m.term, m.type, m.log_index, m.log_term, m.commit)
+        for m in msgs
+    ] == [
+        (1, 2, 1, MT.REPLICATE, li, 1, li),
+        (1, 3, 1, MT.REPLICATE, li, 1, li),
+    ]
+    for m in msgs:
+        assert ent_sig(m.entries) == [(1, li + 1)]
+        assert m.entries[0].cmd == b"some data"
+    assert ent_sig(r.log.entries_to_save()) == [(1, li + 1)]
+
+
+def test_leader_commit_entry():
+    """§5.3: once safely replicated, the leader commits and exposes the
+    entry to apply, and advertises the commit index."""
+    s = InMemLogDB()
+    r = new_test_raft(1, [1, 2, 3], logdb=s)
+    r.become_candidate()
+    r.become_leader()
+    commit_noop_entry(r, s)
+    li = r.log.last_index()
+    r.handle(
+        Message(
+            from_=1, to=1, type=MT.PROPOSE,
+            entries=[Entry(cmd=b"some data")],
+        )
+    )
+    for m in read_messages(r):
+        r.handle(accept_and_reply(m))
+    assert r.log.committed == li + 1
+    ents = r.log.entries_to_apply()
+    assert ent_sig(ents) == [(1, li + 1)]
+    assert ents[0].cmd == b"some data"
+    msgs = sorted(read_messages(r), key=lambda m: m.to)
+    for i, m in enumerate(msgs):
+        assert m.to == i + 2
+        assert m.type == MT.REPLICATE
+        assert m.commit == li + 1
+
+
+@pytest.mark.parametrize(
+    "size, acceptors, wack",
+    [
+        (1, {}, True),
+        (3, {}, False),
+        (3, {2}, True),
+        (3, {2, 3}, True),
+        (5, {}, False),
+        (5, {2}, False),
+        (5, {2, 3}, True),
+        (5, {2, 3, 4}, True),
+        (5, {2, 3, 4, 5}, True),
+    ],
+)
+def test_leader_acknowledge_commit(size, acceptors, wack):
+    """§5.3: an entry commits once a majority has replicated it."""
+    s = InMemLogDB()
+    r = new_test_raft(1, ids_by_size(size), logdb=s)
+    r.become_candidate()
+    r.become_leader()
+    commit_noop_entry(r, s)
+    li = r.log.last_index()
+    r.handle(
+        Message(
+            from_=1, to=1, type=MT.PROPOSE,
+            entries=[Entry(cmd=b"some data")],
+        )
+    )
+    for m in read_messages(r):
+        if m.to in acceptors:
+            r.handle(accept_and_reply(m))
+    assert (r.log.committed > li) == wack
+
+
+@pytest.mark.parametrize(
+    "prev",
+    [
+        [],
+        [(2, 1)],
+        [(1, 1), (2, 2)],
+        [(1, 1)],
+    ],
+)
+def test_leader_commit_preceding_entries(prev):
+    """§5.3: committing an entry commits all preceding entries, including
+    ones from previous terms."""
+    s = InMemLogDB()
+    s.append([Entry(term=t, index=i) for t, i in prev])
+    r = new_test_raft(1, [1, 2, 3], logdb=s)
+    r.load_state(State(term=2))
+    r.become_candidate()
+    r.become_leader()
+    r.handle(
+        Message(
+            from_=1, to=1, type=MT.PROPOSE,
+            entries=[Entry(cmd=b"some data")],
+        )
+    )
+    for m in read_messages(r):
+        r.handle(accept_and_reply(m))
+    li = len(prev)
+    want = prev + [(3, li + 1), (3, li + 2)]
+    assert ent_sig(r.log.entries_to_apply()) == want
+
+
+@pytest.mark.parametrize(
+    "ents, commit",
+    [
+        ([(1, 1)], 1),
+        ([(1, 1), (1, 2)], 2),
+        ([(1, 1), (1, 2)], 1),
+    ],
+)
+def test_follower_commit_entry(ents, commit):
+    """§5.3: a follower applies entries once it learns they are
+    committed."""
+    r = new_test_raft(1, [1, 2, 3])
+    r.become_follower(1, 2)
+    r.handle(
+        Message(
+            from_=2, to=1, type=MT.REPLICATE, term=1,
+            entries=[Entry(term=t, index=i, cmd=b"d%d" % i) for t, i in ents],
+            commit=commit,
+        )
+    )
+    assert r.log.committed == commit
+    assert ent_sig(r.log.entries_to_apply()) == ents[:commit]
+
+
+@pytest.mark.parametrize(
+    "logterm, index, windex, wreject, whint",
+    [
+        # match with committed entries
+        (0, 0, 1, False, 0),
+        (1, 1, 1, False, 0),
+        # match with uncommitted entries
+        (2, 2, 2, False, 0),
+        # mismatch with an existing entry
+        (1, 2, 2, True, 2),
+        # nonexistent entry
+        (3, 3, 3, True, 2),
+    ],
+)
+def test_follower_check_replicate(logterm, index, windex, wreject, whint):
+    """§5.3: the follower rejects Replicate whose (prev index, prev term)
+    doesn't match its log."""
+    ents = [Entry(term=1, index=1), Entry(term=2, index=2)]
+    s = InMemLogDB()
+    s.append(ents)
+    r = new_test_raft(1, [1, 2, 3], logdb=s)
+    r.load_state(State(commit=1))
+    r.become_follower(2, 2)
+    r.handle(
+        Message(
+            from_=2, to=1, type=MT.REPLICATE, term=2,
+            log_term=logterm, log_index=index,
+        )
+    )
+    msgs = read_messages(r)
+    assert [
+        (m.from_, m.to, m.type, m.term, m.log_index, m.reject, m.hint)
+        for m in msgs
+    ] == [(1, 2, MT.REPLICATE_RESP, 2, windex, wreject, whint)]
+
+
+@pytest.mark.parametrize(
+    "index, term, ents, wents, wunstable",
+    [
+        (2, 2, [(3, 3)], [(1, 1), (2, 2), (3, 3)], [(3, 3)]),
+        (1, 1, [(3, 2), (4, 3)], [(1, 1), (3, 2), (4, 3)], [(3, 2), (4, 3)]),
+        (0, 0, [(1, 1)], [(1, 1), (2, 2)], []),
+        (0, 0, [(3, 1)], [(3, 1)], [(3, 1)]),
+    ],
+)
+def test_follower_append_entries(index, term, ents, wents, wunstable):
+    """§5.3: on a valid Replicate the follower deletes conflicting
+    entries and appends the new ones."""
+    s = InMemLogDB()
+    s.append([Entry(term=1, index=1), Entry(term=2, index=2)])
+    r = new_test_raft(1, [1, 2, 3], logdb=s)
+    r.become_follower(2, 2)
+    r.handle(
+        Message(
+            from_=2, to=1, type=MT.REPLICATE, term=2,
+            log_term=term, log_index=index,
+            entries=[Entry(term=t, index=i) for t, i in ents],
+        )
+    )
+    assert ent_sig(get_all_entries(r.log)) == wents
+    assert ent_sig(r.log.entries_to_save()) == wunstable
+
+
+# the six follower log shapes of raft paper figure 7 (a)-(f)
+_FIGURE7_LEADER = (
+    [(1, 1), (1, 2), (1, 3), (4, 4), (4, 5), (5, 6), (5, 7), (6, 8),
+     (6, 9), (6, 10)]
+)
+_FIGURE7_FOLLOWERS = [
+    [(1, 1), (1, 2), (1, 3), (4, 4), (4, 5), (5, 6), (5, 7), (6, 8), (6, 9)],
+    [(1, 1), (1, 2), (1, 3), (4, 4)],
+    [(1, 1), (1, 2), (1, 3), (4, 4), (4, 5), (5, 6), (5, 7), (6, 8),
+     (6, 9), (6, 10), (6, 11)],
+    [(1, 1), (1, 2), (1, 3), (4, 4), (4, 5), (5, 6), (5, 7), (6, 8),
+     (6, 9), (6, 10), (7, 11), (7, 12)],
+    [(1, 1), (1, 2), (1, 3), (4, 4), (4, 5), (4, 6), (4, 7)],
+    [(1, 1), (1, 2), (1, 3), (2, 4), (2, 5), (2, 6), (3, 7), (3, 8),
+     (3, 9), (3, 10), (3, 11)],
+]
+
+
+@pytest.mark.parametrize("fidx", range(len(_FIGURE7_FOLLOWERS)))
+def test_leader_sync_follower_log(fidx):
+    """§5.3 figure 7: the leader reconciles every divergent follower log
+    shape back to its own."""
+    term = 8
+    lead_s = InMemLogDB()
+    lead_s.append([Entry(term=t, index=i) for t, i in _FIGURE7_LEADER])
+    lead = new_test_raft(1, [1, 2, 3], logdb=lead_s)
+    lead.load_state(State(commit=lead.log.last_index(), term=term))
+    fol_s = InMemLogDB()
+    fol_s.append(
+        [Entry(term=t, index=i) for t, i in _FIGURE7_FOLLOWERS[fidx]]
+    )
+    follower = new_test_raft(2, [1, 2, 3], logdb=fol_s)
+    follower.load_state(State(term=term - 1))
+    # three-node cluster: the leader needs node 3's vote since the
+    # follower's log may be more up-to-date
+    nt = Network(lead, follower, BlackHole())
+    nt.send(Message(from_=1, to=1, type=MT.ELECTION))
+    nt.send(
+        Message(
+            from_=3, to=1, term=term + 1, type=MT.REQUEST_VOTE_RESP,
+        )
+    )
+    nt.send(
+        Message(from_=1, to=1, type=MT.PROPOSE, entries=[Entry()])
+    )
+    assert logs_equal(lead.log, follower.log)
+
+
+# ---------------------------------------------------------------------------
+# §5.4 safety
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "ents, wterm",
+    [
+        ([(1, 1)], 2),
+        ([(1, 1), (2, 2)], 3),
+    ],
+)
+def test_vote_request(ents, wterm):
+    """§5.4.1: RequestVote carries the candidate's last log (term, index)
+    and goes to every other node."""
+    r = new_test_raft(1, [1, 2, 3])
+    r.handle(
+        Message(
+            from_=2, to=1, type=MT.REPLICATE, term=wterm - 1,
+            log_term=0, log_index=0,
+            entries=[Entry(term=t, index=i) for t, i in ents],
+        )
+    )
+    read_messages(r)
+    for _ in range(1, r.election_timeout * 2):
+        r.non_leader_tick()
+    msgs = sorted(
+        [m for m in read_messages(r) if m.type == MT.REQUEST_VOTE],
+        key=lambda m: m.to,
+    )
+    assert len(msgs) == 2
+    wlogterm, windex = ents[-1]
+    for i, m in enumerate(msgs):
+        assert m.to == i + 2
+        assert m.term == wterm
+        assert m.log_index == windex
+        assert m.log_term == wlogterm
+
+
+@pytest.mark.parametrize(
+    "ents, logterm, index, wreject",
+    [
+        # same logterm
+        ([(1, 1)], 1, 1, False),
+        ([(1, 1)], 1, 2, False),
+        ([(1, 1), (1, 2)], 1, 1, True),
+        # candidate higher logterm
+        ([(1, 1)], 2, 1, False),
+        ([(1, 1)], 2, 2, False),
+        ([(1, 1), (1, 2)], 2, 1, False),
+        # voter higher logterm
+        ([(2, 1)], 1, 1, True),
+        ([(2, 1)], 1, 2, True),
+        ([(2, 1), (1, 2)], 1, 1, True),
+    ],
+)
+def test_voter(ents, logterm, index, wreject):
+    """§5.4.1: deny the vote if the voter's own log is more up-to-date."""
+    s = InMemLogDB()
+    s.append([Entry(term=t, index=i) for t, i in ents])
+    r = new_test_raft(1, [1, 2], logdb=s)
+    r.handle(
+        Message(
+            from_=2, to=1, type=MT.REQUEST_VOTE, term=3,
+            log_term=logterm, log_index=index,
+        )
+    )
+    msgs = read_messages(r)
+    assert len(msgs) == 1
+    assert msgs[0].type == MT.REQUEST_VOTE_RESP
+    assert msgs[0].reject == wreject
+
+
+@pytest.mark.parametrize(
+    "index, wcommit",
+    [
+        # entries from previous terms never commit by counting
+        (1, 0),
+        (2, 0),
+        # current-term entry commits (and everything before it)
+        (3, 3),
+    ],
+)
+def test_leader_only_commits_log_from_current_term(index, wcommit):
+    """§5.4.2: only current-term entries commit by counting replicas."""
+    s = InMemLogDB()
+    s.append([Entry(term=1, index=1), Entry(term=2, index=2)])
+    r = new_test_raft(1, [1, 2], logdb=s)
+    r.load_state(State(term=2))
+    r.become_candidate()  # term 3
+    r.become_leader()
+    read_messages(r)
+    r.handle(Message(from_=1, to=1, type=MT.PROPOSE, entries=[Entry()]))
+    r.handle(
+        Message(
+            from_=2, to=1, term=r.term,
+            type=MT.REPLICATE_RESP, log_index=index,
+        )
+    )
+    assert r.log.committed == wcommit
