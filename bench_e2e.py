@@ -2,23 +2,35 @@
 
 Drives the REAL runtime — NodeHost facade, step/apply engine, LogDB
 persistence (C++ native segmented-WAL engine with fsync when durable),
-chan transport between three in-process NodeHosts, and the TPU batched
-quorum plugin (``ExpertConfig.quorum_engine="tpu"``) — with G Raft groups
-× 3 replicas, measuring:
+transport between three NodeHosts, and the TPU batched quorum plugin
+(``ExpertConfig.quorum_engine="tpu"``) — with G Raft groups × 3 replicas,
+measuring:
 
 * **writes/sec**: completed proposals (propose → user SM applied → future
   notified) per second at 16B payload
 * **commit latency**: per-request propose→applied wall time, p50/p99
 
+Two deployment modes:
+
+* **multiprocess (default, E2E_PROCS=3)**: one OS process per NodeHost,
+  framed-TCP transport on localhost — the same 3-server shape as the
+  reference's published benchmark (``docs/test.md:40-53``) and, for a
+  GIL-bound host runtime, the honest one: a single process hosting all
+  three replicas serializes leader, follower and client work on one
+  interpreter lock.  Leaders are placed deterministically via explicit
+  campaigns (etcd ``raft.Campaign``) so setup converges in seconds.
+* **single process (E2E_PROCS=1)**: all three NodeHosts in-process over
+  the chan transport (the reference's memfs test build shape) — used by
+  tests and as a fallback.
+
 This is the honest companion to bench.py's kernel-only number: it includes
 proposal ingest, host scheduling, log persistence, transport, apply and
 request completion, exactly like the reference's published 9M writes/s
 (which is measured through its full stack — ``tools/checkdisk/main.go:98``).
-The Python host path is the bottleneck here, not the device engine; the
-number is reported as its own metric, never conflated with the kernel one.
 
-Run standalone:  python bench_e2e.py            (env: E2E_GROUPS, E2E_DURATION,
-                 E2E_WINDOW, E2E_RTT_MS, E2E_ENGINE, E2E_DURABLE, E2E_THREADS)
+Run standalone:  python bench_e2e.py     (env: E2E_GROUPS, E2E_DURATION,
+                 E2E_WINDOW, E2E_RTT_MS, E2E_ENGINE, E2E_DURABLE,
+                 E2E_THREADS, E2E_PROCS, E2E_LEADER_MODE, E2E_DEADLINE)
 From bench.py:   bench_e2e.run_quick() → dict for the JSON detail field.
 """
 from __future__ import annotations
@@ -27,12 +39,12 @@ import collections
 import json
 import os
 import shutil
+import socket
+import subprocess
 import sys
 import tempfile
 import threading
 import time
-
-import numpy as np
 
 
 def _force_cpu_for_engine() -> None:
@@ -71,74 +83,25 @@ class CounterSM:
         pass
 
 
-def _mk_nodehosts(n_hosts, groups, rtt_ms, engine, dirs):
-    from dragonboat_tpu import NodeHostConfig
-    from dragonboat_tpu.config import ExpertConfig
-    from dragonboat_tpu.nodehost import NodeHost
-    from dragonboat_tpu.transport import ChanRouter, ChanTransport
-
-    router = ChanRouter()
-    nhs = []
-    for i in range(1, n_hosts + 1):
-        nhs.append(
-            NodeHost(
-                NodeHostConfig(
-                    node_host_dir=dirs[i - 1] if dirs else ":memory:",
-                    rtt_millisecond=rtt_ms,
-                    raft_address=f"e2e{i}:1",
-                    raft_rpc_factory=lambda src, rh, ch: ChanTransport(
-                        src, rh, ch, router=router
-                    ),
-                    expert=ExpertConfig(
-                        quorum_engine=engine,
-                        engine_block_groups=max(groups, 64),
-                    ),
-                )
-            )
-        )
-    return nhs
+BASE_CID = 1000
 
 
-def _start_groups(nhs, groups, base_cid=1000):
-    from dragonboat_tpu import Config
+def _percentiles(lats):
+    if not lats:
+        return None
+    import numpy as np
 
-    addrs = {i: f"e2e{i}:1" for i in range(1, len(nhs) + 1)}
-    for g in range(groups):
-        cid = base_cid + g
-        for i, nh in enumerate(nhs, start=1):
-            nh.start_cluster(
-                addrs,
-                False,
-                CounterSM,
-                Config(
-                    cluster_id=cid,
-                    node_id=i,
-                    election_rtt=10,
-                    heartbeat_rtt=1,
-                    snapshot_entries=0,
-                ),
-            )
-    return [base_cid + g for g in range(groups)]
+    a = np.asarray(lats)
+    return {
+        "p50": round(float(np.percentile(a, 50)) * 1e3, 2),
+        "p99": round(float(np.percentile(a, 99)) * 1e3, 2),
+        "mean": round(float(a.mean()) * 1e3, 2),
+    }
 
 
-def _wait_leaders(nhs, cids, timeout):
-    """Wait until every group has an elected leader; return cid→NodeHost."""
-    deadline = time.time() + timeout
-    leaders = {}
-    remaining = set(cids)
-    while remaining and time.time() < deadline:
-        for cid in list(remaining):
-            for nh in nhs:
-                lid, ok = nh.get_leader_id(cid)
-                if ok and 1 <= lid <= len(nhs):
-                    leaders[cid] = nhs[lid - 1]
-                    remaining.discard(cid)
-                    break
-        if remaining:
-            time.sleep(0.05)
-    if remaining:
-        raise TimeoutError(f"{len(remaining)}/{len(cids)} groups leaderless")
-    return leaders
+# ======================================================================
+# load generation (shared by both modes)
+# ======================================================================
 
 
 def _load_worker(nh_by_cid, cids, payload, window, stop_at, out):
@@ -152,7 +115,12 @@ def _load_worker(nh_by_cid, cids, payload, window, stop_at, out):
     try:
         sessions = {cid: nh_by_cid[cid].get_noop_session(cid) for cid in cids}
         cap = window * len(cids)
-        cid_cycle = list(cids)
+        # group-major proposal order: a group's window arrives as one burst,
+        # so the runtime's entry queue coalesces it into a single step round
+        # (the reference's benchmark clients are pipelined per-group streams
+        # too); round-robin order would hand the step path one entry at a
+        # time and pay the full per-step cost per write
+        cid_cycle = [cid for cid in cids for _ in range(window)]
         i = 0
         while time.time() < stop_at:
             while len(inflight) < cap and time.time() < stop_at:
@@ -193,17 +161,17 @@ def _load_worker(nh_by_cid, cids, payload, window, stop_at, out):
     out.append((done, errors, lat))
 
 
-def _measure(leaders, cids, payload, window, duration, threads) -> dict:
-    nthreads = min(threads, len(cids))
+def _measure(leaders, cids, payload, window, stop_at, threads) -> dict:
+    nthreads = max(1, min(threads, len(cids)))
     slices = [cids[i::nthreads] for i in range(nthreads)]
     out = []
-    stop_at = time.time() + duration
     ts = [
         threading.Thread(
             target=_load_worker,
             args=(leaders, s, payload, window, stop_at, out),
         )
         for s in slices
+        if s
     ]
     t0 = time.perf_counter()
     for t in ts:
@@ -213,24 +181,98 @@ def _measure(leaders, cids, payload, window, duration, threads) -> dict:
     elapsed = time.perf_counter() - t0
     done = sum(d for d, _, _ in out)
     errors = sum(e for _, e, _ in out)
-    if any(l for _, _, l in out):
-        lats = np.concatenate([np.asarray(l) for _, _, l in out if l])
-        latency = {
-            "p50": round(float(np.percentile(lats, 50)) * 1e3, 2),
-            "p99": round(float(np.percentile(lats, 99)) * 1e3, 2),
-            "mean": round(float(lats.mean()) * 1e3, 2),
-        }
-    else:  # no completions: keep the JSON strict (no NaN tokens)
-        latency = None
+    lats = [l for _, _, ls in out for l in ls]
     return {
-        "writes_per_sec": round(done / elapsed, 1),
+        "writes_per_sec": round(done / elapsed, 1) if elapsed > 0 else 0.0,
         "completed": done,
         "errors": errors,
         "elapsed_s": round(elapsed, 2),
         "proposing_groups": len(cids),
         "window": window,
-        "latency_ms": latency,
+        "latency_ms": _percentiles(lats),
+        "_lats": lats,
     }
+
+
+# ======================================================================
+# single-process mode (chan transport; tests + fallback)
+# ======================================================================
+
+
+def _mk_nodehosts(n_hosts, groups, rtt_ms, engine, dirs):
+    from dragonboat_tpu import NodeHostConfig
+    from dragonboat_tpu.config import ExpertConfig
+    from dragonboat_tpu.nodehost import NodeHost
+    from dragonboat_tpu.transport import ChanRouter, ChanTransport
+
+    router = ChanRouter()
+    nhs = []
+    for i in range(1, n_hosts + 1):
+        nhs.append(
+            NodeHost(
+                NodeHostConfig(
+                    node_host_dir=dirs[i - 1] if dirs else ":memory:",
+                    rtt_millisecond=rtt_ms,
+                    raft_address=f"e2e{i}:1",
+                    raft_rpc_factory=lambda src, rh, ch: ChanTransport(
+                        src, rh, ch, router=router
+                    ),
+                    expert=ExpertConfig(
+                        quorum_engine=engine,
+                        engine_block_groups=max(groups, 64),
+                        logdb_shards=4,
+                    ),
+                )
+            )
+        )
+    return nhs
+
+
+def _start_groups(nhs, groups, base_cid=BASE_CID, election_rtt=20):
+    from dragonboat_tpu import Config
+
+    addrs = {i: f"e2e{i}:1" for i in range(1, len(nhs) + 1)}
+    for g in range(groups):
+        cid = base_cid + g
+        for i, nh in enumerate(nhs, start=1):
+            nh.start_cluster(
+                addrs,
+                False,
+                CounterSM,
+                Config(
+                    cluster_id=cid,
+                    node_id=i,
+                    election_rtt=election_rtt,
+                    heartbeat_rtt=1,
+                    snapshot_entries=0,
+                ),
+            )
+    return [base_cid + g for g in range(groups)]
+
+
+def _campaign_and_wait(nhs, cids, timeout):
+    """Deterministic leader placement: replica ``cid % n_hosts`` campaigns
+    explicitly (etcd raft.Campaign), spreading leaders evenly without
+    waiting out randomized election timeouts."""
+    n = len(nhs)
+    for cid in cids:
+        nhs[cid % n].get_node(cid).request_campaign()
+    deadline = time.time() + timeout
+    leaders = {}
+    remaining = set(cids)
+    while remaining and time.time() < deadline:
+        for cid in list(remaining):
+            for nh in nhs:
+                lid, ok = nh.get_leader_id(cid)
+                if ok and 1 <= lid <= len(nhs):
+                    leaders[cid] = nhs[lid - 1]
+                    remaining.discard(cid)
+                    break
+        if remaining:
+            time.sleep(0.05)
+    if remaining:
+        raise TimeoutError(f"{len(remaining)}/{len(cids)} groups leaderless")
+    return leaders
 
 
 def run(
@@ -242,10 +284,10 @@ def run(
     durable: bool = True,
     threads: int = 16,
     n_hosts: int = 3,
-    leader_timeout: float = 300.0,
+    leader_timeout: float = 120.0,
     latency_groups: int = 64,
 ) -> dict:
-    """Two measurement phases over one live 1024-group cluster:
+    """Single-process run; two measurement phases over one live cluster:
 
     1. *throughput*: every group proposes with `window` in flight — the
        sustained writes/s number.  Per-request latency in this phase is
@@ -265,21 +307,27 @@ def run(
     nhs = _mk_nodehosts(n_hosts, groups, rtt_ms, engine, dirs)
     try:
         cids = _start_groups(nhs, groups)
-        leaders = _wait_leaders(nhs, cids, leader_timeout)
+        leaders = _campaign_and_wait(nhs, cids, leader_timeout)
         setup_s = time.perf_counter() - t_setup
+        print(f"e2e setup_s={setup_s:.1f}", file=sys.stderr)
 
-        tput = _measure(leaders, cids, payload, window, duration, threads)
+        tput = _measure(
+            leaders, cids, payload, window, time.time() + duration, threads
+        )
         lat = _measure(
             leaders,
             cids[: min(latency_groups, groups)],
             payload,
             1,
-            min(duration, 5.0),
+            time.time() + min(duration, 5.0),
             threads,
         )
+        tput.pop("_lats", None)
+        lat.pop("_lats", None)
         return {
             "groups": groups,
             "hosts": n_hosts,
+            "procs": 1,
             "engine": engine,
             "durable": durable,
             "payload_bytes": len(payload),
@@ -299,19 +347,428 @@ def run(
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+# ======================================================================
+# multiprocess mode: one process per NodeHost over framed TCP
+# ======================================================================
+
+
+def _rank_env_int(name, default):
+    return int(os.environ.get(name, str(default)))
+
+
+def rank_main() -> int:
+    """Child body: one NodeHost + this rank's share of the load threads.
+
+    Line protocol on stdio (parent drives):
+      child → parent:  READY {...}   then   RESULT {...}
+      parent → child:  RUN {"t0":…, "duration":…, "lat_t0":…,
+                            "lat_duration":…, "lat_cids":[…]}
+    """
+    rank = _rank_env_int("E2E_RANK", 0)
+    if os.environ.get("DBTPU_CPROFILE_STEP_DIR"):
+        os.environ["DBTPU_CPROFILE_STEP"] = os.path.join(
+            os.environ["DBTPU_CPROFILE_STEP_DIR"], f"step_rank{rank}.prof"
+        )
+    procs = _rank_env_int("E2E_PROCS", 3)
+    groups = _rank_env_int("E2E_GROUPS", 1024)
+    rtt_ms = _rank_env_int("E2E_RTT_MS", 500)
+    window = _rank_env_int("E2E_WINDOW", 16)
+    threads = _rank_env_int("E2E_THREADS", 8)
+    durable = os.environ.get("E2E_DURABLE", "1") == "1"
+    engine = os.environ.get("E2E_ENGINE", "tpu")
+    leader_mode = os.environ.get("E2E_LEADER_MODE", "spread")
+    leader_timeout = float(os.environ.get("E2E_LEADER_TIMEOUT", "120"))
+    ports = [int(p) for p in os.environ["E2E_PORTS"].split(",")]
+    base_dir = os.environ.get("E2E_DIR", "")
+
+    # engine per rank: the device engine lives where the leaders it serves
+    # live; with one TPU chip only rank 0 attaches to it (leader_mode
+    # "rank0" puts every leader there so ALL commit tallying runs through
+    # the device).  Other ranks never import jax.
+    my_engine = engine if (engine != "tpu" or rank == 0) else "scalar"
+    if my_engine == "tpu":
+        _force_cpu_for_engine()
+
+    from dragonboat_tpu import Config, NodeHostConfig
+    from dragonboat_tpu.config import ExpertConfig
+    from dragonboat_tpu.nodehost import NodeHost
+
+    t_setup = time.perf_counter()
+    addr = f"127.0.0.1:{ports[rank]}"
+    from dragonboat_tpu.config import LogDBConfig
+
+    ldb = LogDBConfig()
+    ldb.fsync = os.environ.get("E2E_FSYNC", "1") == "1"
+    nh = NodeHost(
+        NodeHostConfig(
+            node_host_dir=(
+                os.path.join(base_dir, f"nh{rank}") if durable else ":memory:"
+            ),
+            rtt_millisecond=rtt_ms,
+            raft_address=addr,
+            logdb_config=ldb,
+            expert=ExpertConfig(
+                quorum_engine=my_engine,
+                engine_block_groups=max(groups, 64),
+                logdb_shards=4,
+            ),
+        )
+    )
+    addrs = {i + 1: f"127.0.0.1:{ports[i]}" for i in range(procs)}
+    cids = [BASE_CID + g for g in range(groups)]
+    for cid in cids:
+        nh.start_cluster(
+            addrs,
+            False,
+            CounterSM,
+            Config(
+                cluster_id=cid,
+                node_id=rank + 1,
+                election_rtt=20,
+                heartbeat_rtt=1,
+                snapshot_entries=0,
+            ),
+        )
+
+    def preferred(cid):
+        return 0 if leader_mode == "rank0" else cid % procs
+
+    mine = [cid for cid in cids if preferred(cid) == rank]
+    for cid in mine:
+        nh.get_node(cid).request_campaign()
+    deadline = time.time() + leader_timeout
+    led = set()
+    next_retry = time.time() + 2.0
+    while len(led) < len(mine) and time.time() < deadline:
+        for cid in mine:
+            if cid not in led and nh.get_node(cid).is_leader():
+                led.add(cid)
+        if len(led) < len(mine):
+            # early campaigns race with peers still start_cluster-ing their
+            # replicas (vote requests to an unknown group are dropped);
+            # re-campaign stragglers instead of waiting out a 10s timeout
+            if time.time() >= next_retry:
+                for cid in mine:
+                    if cid not in led:
+                        nh.get_node(cid).request_campaign()
+                next_retry = time.time() + 2.0
+            time.sleep(0.05)
+    leaders = {cid: nh for cid in led}
+    setup_s = time.perf_counter() - t_setup
+
+    platform = ""
+    if my_engine == "tpu":
+        try:
+            import jax
+
+            platform = jax.devices()[0].platform
+        except Exception:
+            platform = "unknown"
+    sys.stdout.write(
+        "READY "
+        + json.dumps(
+            {
+                "rank": rank,
+                "led": len(led),
+                "mine": len(mine),
+                "setup_s": round(setup_s, 1),
+                "engine": my_engine,
+                "platform": platform,
+            }
+        )
+        + "\n"
+    )
+    sys.stdout.flush()
+
+    sampler = None
+    prof_dir = os.environ.get("E2E_PROFILE_DIR", "")
+    if prof_dir:
+        from profile_e2e import Sampler
+
+        sampler = Sampler()
+        sampler.start()
+
+    line = sys.stdin.readline()
+    rc = 0
+    try:
+        if line.startswith("RUN "):
+            plan = json.loads(line[4:])
+            payload = b"0123456789abcdef"
+            # phase 1: throughput — every led group, window in flight
+            while time.time() < plan["t0"]:
+                time.sleep(0.005)
+            tput = _measure(
+                leaders, sorted(led), payload, window,
+                plan["t0"] + plan["duration"], threads,
+            )
+            # phase 2: latency — window=1 on the designated subset
+            lat_cids = [c for c in plan["lat_cids"] if c in led]
+            while time.time() < plan["lat_t0"]:
+                time.sleep(0.005)
+            lat = _measure(
+                leaders, lat_cids, payload, 1,
+                plan["lat_t0"] + plan["lat_duration"], threads,
+            )
+            tput_lats = tput.pop("_lats")
+            lat_lats = lat.pop("_lats")
+            sys.stdout.write(
+                "RESULT "
+                + json.dumps(
+                    {
+                        "rank": rank,
+                        "tput": tput,
+                        "lat": lat,
+                        "engine_stats": nh.engine.stats(),
+                        # raw seconds, stride-sampled to a cap so the merged
+                        # percentiles aren't biased toward warmup completions
+                        "tput_lats": tput_lats[:: max(1, len(tput_lats) // 20000)],
+                        "lat_lats": lat_lats[:: max(1, len(lat_lats) // 20000)],
+                    }
+                )
+                + "\n"
+            )
+            sys.stdout.flush()
+    except Exception as e:  # noqa: BLE001 — report, don't die silently
+        sys.stdout.write("RESULT " + json.dumps({"rank": rank, "error": str(e)}) + "\n")
+        sys.stdout.flush()
+        rc = 1
+    finally:
+        if sampler is not None:
+            sampler.stop()
+            with open(os.path.join(prof_dir, f"rank{rank}.txt"), "w") as f:
+                f.write(sampler.report() + "\n")
+        try:
+            nh.stop()
+        except Exception:
+            pass
+    return rc
+
+
+def _free_ports(n):
+    socks = []
+    ports = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    return ports
+
+
+def run_mp(
+    groups: int = 1024,
+    duration: float = 10.0,
+    window: int = 16,
+    rtt_ms: int = 500,
+    engine: str = "tpu",
+    durable: bool = True,
+    threads: int = 8,
+    procs: int = 3,
+    leader_mode: str = "",
+    leader_timeout: float = 120.0,
+    latency_groups: int = 64,
+    deadline_s: float = 420.0,
+) -> dict:
+    """Parent orchestration: spawn one rank per NodeHost, coordinate the
+    two measurement phases by wall clock, aggregate."""
+    if not leader_mode:
+        # one TPU chip → put every leader (and thus every commit decision)
+        # on the rank that owns the device; scalar spreads leaders evenly
+        leader_mode = "rank0" if engine == "tpu" else "spread"
+    t_start = time.time()
+    hard_deadline = t_start + deadline_s
+    ports = _free_ports(procs)
+    tmp = tempfile.mkdtemp(prefix="dbtpu-e2e-") if durable else ""
+    env = dict(os.environ)
+    env.update(
+        {
+            "E2E_PROCS": str(procs),
+            "E2E_GROUPS": str(groups),
+            "E2E_RTT_MS": str(rtt_ms),
+            "E2E_WINDOW": str(window),
+            "E2E_THREADS": str(threads),
+            "E2E_DURABLE": "1" if durable else "0",
+            "E2E_ENGINE": engine,
+            "E2E_LEADER_MODE": leader_mode,
+            "E2E_LEADER_TIMEOUT": str(leader_timeout),
+            "E2E_PORTS": ",".join(str(p) for p in ports),
+            "E2E_DIR": tmp,
+        }
+    )
+    children = []
+    try:
+        for rank in range(procs):
+            cenv = dict(env)
+            cenv["E2E_RANK"] = str(rank)
+            children.append(
+                subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__), "--rank"],
+                    stdin=subprocess.PIPE,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.DEVNULL,
+                    env=cenv,
+                    text=True,
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                )
+            )
+
+        import queue as _queue
+
+        # one reader thread per child: readline() can't be timed out
+        # directly, so a hung rank must not wedge the parent past deadline_s
+        rank_lines = [_queue.Queue() for _ in children]
+
+        def _reader(proc, q):
+            for line in proc.stdout:
+                q.put(line)
+            q.put(None)
+
+        for c, q in zip(children, rank_lines):
+            threading.Thread(target=_reader, args=(c, q), daemon=True).start()
+
+        def read_tagged(idx, tag, deadline):
+            """Read lines until one starts with tag; enforce deadline."""
+            q = rank_lines[idx]
+            while True:
+                timeout = deadline - time.time()
+                if timeout <= 0:
+                    raise TimeoutError(f"deadline waiting for {tag}")
+                try:
+                    line = q.get(timeout=min(timeout, 1.0))
+                except _queue.Empty:
+                    continue
+                if line is None:
+                    raise RuntimeError(f"rank died before {tag}")
+                if line.startswith(tag + " "):
+                    return json.loads(line[len(tag) + 1 :])
+
+        readies = [
+            read_tagged(i, "READY", hard_deadline - 10)
+            for i in range(len(children))
+        ]
+        setup_s = time.time() - t_start
+        print(f"e2e mp setup_s={setup_s:.1f} readies={readies}", file=sys.stderr)
+        led_total = sum(r["led"] for r in readies)
+
+        lat_cids = [BASE_CID + g for g in range(min(latency_groups, groups))]
+        t0 = time.time() + 0.5
+        plan = {
+            "t0": t0,
+            "duration": duration,
+            "lat_t0": t0 + duration + 1.0,
+            "lat_duration": min(duration, 5.0),
+            "lat_cids": lat_cids,
+        }
+        for c in children:
+            c.stdin.write("RUN " + json.dumps(plan) + "\n")
+            c.stdin.flush()
+        results = [
+            read_tagged(i, "RESULT", hard_deadline)
+            for i in range(len(children))
+        ]
+        errors = [r for r in results if "error" in r]
+        oks = [r for r in results if "tput" in r]
+        tput_done = sum(r["tput"]["completed"] for r in oks)
+        tput_errs = sum(r["tput"]["errors"] for r in oks)
+        lat_done = sum(r["lat"]["completed"] for r in oks)
+        tput_lats = [l for r in oks for l in r["tput_lats"]]
+        lat_lats = [l for r in oks for l in r["lat_lats"]]
+        writes_per_sec = round(tput_done / duration, 1)
+        out = {
+            "groups": groups,
+            "hosts": procs,
+            "procs": procs,
+            "engine": engine,
+            "leader_mode": leader_mode,
+            "durable": durable,
+            "payload_bytes": 16,
+            "setup_s": round(setup_s, 1),
+            "led_groups": led_total,
+            "writes_per_sec": writes_per_sec,
+            "commit_latency_ms": _percentiles(lat_lats),
+            "throughput_phase": {
+                "writes_per_sec": writes_per_sec,
+                "completed": tput_done,
+                "errors": tput_errs,
+                "latency_ms": _percentiles(tput_lats),
+                "window": window,
+            },
+            "latency_phase": {
+                "completed": lat_done,
+                "proposing_groups": len(lat_cids),
+                "latency_ms": _percentiles(lat_lats),
+            },
+            "ranks": [
+                {k: r[k] for k in ("rank", "engine", "platform", "led", "setup_s")}
+                for r in readies
+            ],
+        }
+        if os.environ.get("E2E_KEEP_STATS") == "1":
+            out["rank_engine_stats"] = [r.get("engine_stats") for r in oks]
+        if errors:
+            out["rank_errors"] = errors
+        return out
+    finally:
+        for c in children:
+            # let ranks finish their own cleanup (NodeHost.stop, profile
+            # dumps) before the hard kill
+            try:
+                c.stdin.close()
+            except Exception:
+                pass
+        deadline = time.time() + 8
+        for c in children:
+            try:
+                c.wait(timeout=max(0.1, deadline - time.time()))
+            except Exception:
+                pass
+        for c in children:
+            try:
+                c.kill()
+            except Exception:
+                pass
+        if tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_quick() -> dict:
     """Bounded run for bench.py's detail field (driver time budget)."""
+    groups = int(os.environ.get("E2E_GROUPS", "1024"))
+    duration = float(os.environ.get("E2E_DURATION", "10"))
+    window = int(os.environ.get("E2E_WINDOW", "16"))
+    rtt_ms = int(os.environ.get("E2E_RTT_MS", "500"))
+    engine = os.environ.get("E2E_ENGINE", "tpu")
+    durable = os.environ.get("E2E_DURABLE", "1") == "1"
+    threads = int(os.environ.get("E2E_THREADS", "8"))
+    procs = int(os.environ.get("E2E_PROCS", "3"))
+    deadline = float(os.environ.get("E2E_DEADLINE", "420"))
+    if procs > 1:
+        return run_mp(
+            groups=groups,
+            duration=duration,
+            window=window,
+            rtt_ms=rtt_ms,
+            engine=engine,
+            durable=durable,
+            threads=threads,
+            procs=procs,
+            deadline_s=deadline,
+        )
     return run(
-        groups=int(os.environ.get("E2E_GROUPS", "1024")),
-        duration=float(os.environ.get("E2E_DURATION", "10")),
-        window=int(os.environ.get("E2E_WINDOW", "16")),
-        rtt_ms=int(os.environ.get("E2E_RTT_MS", "500")),
-        engine=os.environ.get("E2E_ENGINE", "tpu"),
-        durable=os.environ.get("E2E_DURABLE", "1") == "1",
-        threads=int(os.environ.get("E2E_THREADS", "16")),
+        groups=groups,
+        duration=duration,
+        window=window,
+        rtt_ms=rtt_ms,
+        engine=engine,
+        durable=durable,
+        threads=threads,
     )
 
 
 if __name__ == "__main__":
+    if "--rank" in sys.argv:
+        sys.exit(rank_main())
     _force_cpu_for_engine()
     print(json.dumps(run_quick()), file=sys.stdout)

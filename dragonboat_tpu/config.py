@@ -111,6 +111,10 @@ class LogDBConfig:
     kv_max_background_compactions: int = 2
     segment_file_size: int = 1024 * 1024 * 1024
     shards: int = 16
+    # fsync every committed write batch (the reference always does; turning
+    # this off trades durability of the last instants for throughput and is
+    # only for benchmarks/tests — results must report it)
+    fsync: bool = True
 
     @staticmethod
     def default() -> "LogDBConfig":

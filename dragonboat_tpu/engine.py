@@ -55,6 +55,92 @@ class _WorkReady:
         return self.ready[idx].get_ready()
 
 
+class _Committer:
+    """Per-step-worker LogDB commit pipeline (one per shard when shards are
+    worker-aligned).
+
+    The reference's step worker blocks in ``SaveRaftState``
+    (``execengine.go:966``) — affordable with Go's goroutine count and an
+    Optane fsync; here a synchronous fsync in the step loop serializes every
+    group on the worker behind every commit.  Instead the worker hands
+    ``(pairs, updates)`` off and keeps stepping other groups; this thread
+    **coalesces everything queued into one fsynced write batch** (classic
+    group commit — same effect as the reference's one-WriteBatch-per-round
+    geometry, ``rdb.go:187-210``) and then runs the post-fsync half of the
+    round (non-Replicate messages out, committed entries to apply,
+    ``Peer.Commit``) in submission order.  Per-group ordering is preserved
+    by the node's ``commit_inflight`` flag: a group is never stepped again
+    until its previous update has been committed.
+    """
+
+    def __init__(self, engine: "Engine", idx: int):
+        self.engine = engine
+        self.idx = idx
+        self._q: List = []
+        self._cv = threading.Condition()
+        # diagnostics (read by Engine.stats)
+        self.cycles = 0
+        self.merged = 0
+        self.commit_s = 0.0
+        self.post_s = 0.0
+        self._thread = threading.Thread(
+            target=self._main, name=f"committer-{idx}", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, pairs, updates) -> None:
+        with self._cv:
+            self._q.append((pairs, updates))
+            self._cv.notify()
+
+    def _main(self) -> None:
+        stopped = self.engine._stopped
+        while True:
+            with self._cv:
+                while not self._q and not stopped.is_set():
+                    self._cv.wait(0.2)
+                if stopped.is_set() and not self._q:
+                    return
+                batch, self._q = self._q, []
+            try:
+                self._commit(batch)
+            except Exception:
+                plog.exception("committer %d failed", self.idx)
+                # clear flags AND re-arm the groups (their ready bits were
+                # consumed before the submit) so they retry immediately
+                # instead of stalling until the next tick
+                for pairs, _ in batch:
+                    for n, _ in pairs:
+                        n.commit_inflight = False
+                        self.engine.set_step_ready(n.cluster_id)
+
+    def _commit(self, batch) -> None:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        merged = [ud for _, updates in batch for ud in updates]
+        if merged:
+            self.engine.logdb.save_raft_state(merged)
+        t1 = _time.perf_counter()
+        for pairs, _ in batch:
+            for n, ud in pairs:
+                n.process_raft_update(ud)
+                n.commit_raft_update(ud)
+                n.commit_inflight = False
+                # re-check inputs that arrived while the commit was in
+                # flight (the step worker skipped this group meanwhile)
+                self.engine.set_step_ready(n.cluster_id)
+        self.cycles += 1
+        self.merged += len(merged)
+        self.commit_s += t1 - t0
+        self.post_s += _time.perf_counter() - t1
+
+    def join(self, timeout: float = 2.0) -> None:
+        with self._cv:
+            self._cv.notify()
+        self._thread.join(timeout=timeout)
+
+
 class Engine:
     """Reference ``execengine.go:637`` ``execEngine``."""
 
@@ -75,6 +161,10 @@ class Engine:
         # changes (reference loadBucketNodes execengine.go:889)
         self._step_cache: List = [(-1, {}) for _ in range(step_workers)]
         self._apply_cache: List = [(-1, {}) for _ in range(apply_workers)]
+        # diagnostics per step worker: [rounds, groups_stepped, skipped,
+        # step_s, inline_s]
+        self._step_stats = [[0, 0, 0, 0.0, 0.0] for _ in range(step_workers)]
+        self._committers = [_Committer(self, i) for i in range(step_workers)]
         for i in range(step_workers):
             t = threading.Thread(
                 target=self._step_worker_main, args=(i,),
@@ -122,6 +212,14 @@ class Engine:
     # ---- step path (reference stepWorkerMain/processSteps :860-1010) ----
 
     def _step_worker_main(self, idx: int) -> None:
+        import os
+
+        if idx == 0 and os.environ.get("DBTPU_CPROFILE_STEP"):
+            # diagnostics: profile one step worker, dump on engine stop
+            import cProfile
+
+            self._prof = cProfile.Profile()
+            self._prof.enable()
         while not self._stopped.is_set():
             self.step_ready.wait(idx)
             if self._stopped.is_set():
@@ -133,30 +231,99 @@ class Engine:
             active = [nodes[cid] for cid in ready if cid in nodes]
             if active:
                 try:
-                    self.process_steps(active)
+                    import time as _time
+
+                    st = self._step_stats[idx]
+                    t0 = _time.perf_counter()
+                    stepped, skipped = self.process_steps(
+                        active, self._committers[idx]
+                    )
+                    st[0] += 1
+                    st[1] += stepped
+                    st[2] += skipped
+                    st[3] += _time.perf_counter() - t0
                 except Exception:
                     plog.exception("step worker %d failed", idx)
 
-    def process_steps(self, active: List["Node"]) -> None:
+    def process_steps(
+        self, active: List["Node"], committer: Optional[_Committer] = None
+    ) -> None:
         """The hot loop (reference ``processSteps`` ``execengine.go:923``):
-        step → send replicates → one batched fsync → execute → commit."""
+        step → send replicates → one batched fsync → execute → commit.
+
+        The fsync + post-fsync half is pipelined through the worker's
+        committer (see :class:`_Committer`); groups whose previous update is
+        still being committed are skipped and re-scheduled by the committer,
+        so per-group round ordering is untouched.  Message-only updates
+        (heartbeats) bypass the committer entirely — nothing to persist, no
+        reason to ride behind an fsync.
+        """
         pairs = []
+        skipped = 0
         for n in active:
+            if n.commit_inflight:
+                skipped += 1
+                continue
             ud = n.step_node()
             if ud is not None:
                 pairs.append((n, ud))
         if not pairs:
-            return
+            return len(pairs), skipped
         for n, ud in pairs:
             n.process_dropped(ud)
             n.send_replicate_messages(ud)  # before fsync (thesis §10.2.1)
-        updates = [ud for _, ud in pairs if ud.has_update()]
-        if updates:
-            self.logdb.save_raft_state(updates)
+        # only updates that can put a record on disk need the committer;
+        # the rest complete inline
+        persist = []
+        updates = []
+        inline = []
         for n, ud in pairs:
+            if (
+                ud.entries_to_save
+                or not ud.state.is_empty()
+                or (ud.snapshot is not None and not ud.snapshot.is_empty())
+            ):
+                persist.append((n, ud))
+                updates.append(ud)
+            else:
+                inline.append((n, ud))
+        for n, ud in inline:
             n.process_raft_update(ud)
-        for n, ud in pairs:
             n.commit_raft_update(ud)
+        if persist:
+            if committer is not None:
+                for n, _ in persist:
+                    n.commit_inflight = True
+                committer.submit(persist, updates)
+            else:
+                self.logdb.save_raft_state(updates)
+                for n, ud in persist:
+                    n.process_raft_update(ud)
+                    n.commit_raft_update(ud)
+        return len(pairs), skipped
+
+    def stats(self) -> dict:
+        """Diagnostic counters (benchmarks; not part of the public API)."""
+        return {
+            "step_workers": [
+                {
+                    "rounds": s[0],
+                    "groups_stepped": s[1],
+                    "skipped_inflight": s[2],
+                    "step_s": round(s[3], 3),
+                }
+                for s in self._step_stats
+            ],
+            "committers": [
+                {
+                    "cycles": c.cycles,
+                    "merged_updates": c.merged,
+                    "commit_s": round(c.commit_s, 3),
+                    "post_s": round(c.post_s, 3),
+                }
+                for c in self._committers
+            ],
+        }
 
     # ---- apply path (reference applyWorkerMain/processApplies :794-858) ----
 
@@ -179,7 +346,19 @@ class Engine:
                     plog.exception("apply worker %d failed on %d", idx, cid)
 
     def stop(self) -> None:
+        import os
+
+        if getattr(self, "_prof", None) is not None:
+            self._prof.disable()
+            path = os.environ.get("DBTPU_CPROFILE_STEP")
+            try:
+                self._prof.dump_stats(path)
+            except Exception:
+                pass
+            self._prof = None
         self._stopped.set()
         self.notify_all()
+        for c in self._committers:
+            c.join()
         for t in self._threads:
             t.join(timeout=2)

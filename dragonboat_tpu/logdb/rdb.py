@@ -128,7 +128,11 @@ class RDB:
                 self._record_max_index(
                     wb, ud.cluster_id, ud.node_id, ud.snapshot.index
                 )
-        self.kv.commit_write_batch(wb)
+        # rounds where every record was suppressed (heartbeat traffic with
+        # unchanged State) must not pay a WAL append + fsync for an empty
+        # batch — the rdbcache exists precisely to elide these writes
+        if wb.ops:
+            self.kv.commit_write_batch(wb)
 
     def _record_state(self, ud: Update, wb: KVWriteBatch) -> None:
         if ud.state.is_empty():

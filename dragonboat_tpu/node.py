@@ -101,6 +101,10 @@ class Node:
         self._stopped = threading.Event()
         self._initialized = threading.Event()
         self.current_tick = 0
+        # True while this group's latest update sits in the engine's commit
+        # pipeline; the step worker skips the group until the committer
+        # clears it (per-group round ordering, see engine._Committer)
+        self.commit_inflight = False
         self._tick_count_pending = 0
         self._snapshotting = threading.Lock()
         self.leader_id = 0
@@ -275,6 +279,15 @@ class Node:
         self.mq.add(Message(type=MT.LOCAL_TICK))
         self.nh.engine.set_step_ready(self.cluster_id)
 
+    def request_campaign(self) -> None:
+        """Immediately start an election on this replica (etcd's
+        ``raft.Campaign`` / MsgHup; our ``MT.ELECTION`` is the same local
+        message ``raft.go:395`` injects on election timeout).  Used by
+        benchmarks/tests for deterministic, fast leader placement instead of
+        waiting out a randomized election timeout."""
+        self.mq.add(Message(type=MT.ELECTION, from_=self.node_id))
+        self.nh.engine.set_step_ready(self.cluster_id)
+
     def handle_snapshot_status(self, node_id: int, failed: bool) -> None:
         self.mq.add(
             Message(type=MT.SNAPSHOT_STATUS, from_=node_id, reject=failed)
@@ -321,6 +334,11 @@ class Node:
                 self.peer.report_unreachable_node(m.from_)
             elif m.type == MT.SNAPSHOT_STATUS:
                 self.peer.report_snapshot_status(m.from_, m.reject)
+            elif m.type == MT.ELECTION:
+                # local campaign request (request_campaign); must go through
+                # Peer.campaign — Peer.handle rejects local message types
+                self.quiesce_mgr.record_activity(m.type)
+                self.peer.campaign()
             else:
                 if self.quiesce_mgr.enabled:
                     self.quiesce_mgr.record_activity(m.type)

@@ -84,14 +84,20 @@ class NodeHost:
         )
         # storage
         in_memory = nhconfig.node_host_dir == ":memory:"
+        # shard-count priority: expert override > logdb config.  Aligning
+        # shards with the step-worker count reproduces the reference's
+        # DoubleFixedPartitioner geometry (server/partition.go:59): one
+        # worker round → one shard → one fsynced write batch
+        shards = nhconfig.expert.logdb_shards or nhconfig.logdb_config.shards
         if nhconfig.logdb_factory is not None:
             self.logdb = nhconfig.logdb_factory(nhconfig)
         elif in_memory:
-            self.logdb = open_logdb("", shards=nhconfig.logdb_config.shards)
+            self.logdb = open_logdb("", shards=shards)
         else:
             self.logdb = open_logdb(
                 os.path.join(self._host_dir(), "logdb"),
-                shards=nhconfig.logdb_config.shards,
+                shards=shards,
+                fsync=nhconfig.logdb_config.fsync,
             )
         # transport
         self.node_registry = Registry()

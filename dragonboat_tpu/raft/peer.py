@@ -180,6 +180,12 @@ class Peer:
     def tick(self) -> None:
         self.raft.handle(Message(type=MT.LOCAL_TICK, reject=False))
 
+    def campaign(self) -> None:
+        """Start an election immediately (etcd ``raft.Campaign`` — the
+        same local ELECTION message ``raft.go:395`` injects when the
+        randomized election timeout fires)."""
+        self.raft.handle(Message(type=MT.ELECTION, from_=self.raft.node_id))
+
     def quiesced_tick(self) -> None:
         self.raft.handle(Message(type=MT.LOCAL_TICK, reject=True))
 

@@ -589,6 +589,7 @@ class Raft:
         for i, e in enumerate(entries):
             e.term = self.term
             e.index = last_index + 1 + i
+            e._enc = None  # invalidate cached encoding (codec.py)
         self.log.append(entries)
         self.remotes[self.node_id].try_update(self.log.last_index())
         if self.offload is not None:

@@ -171,6 +171,17 @@ class PendingProposal:
         self._clock = _LogicalClock()
         self._rng = rng or random.Random()
         self._stopped = False
+        # earliest-deadline tracking: tick() skips the full scan until
+        # something could actually have expired (it runs once per RTT for
+        # EVERY group, so the scan-always version is hot-path cost).
+        # Two fields to make the propose/tick race safe: _min_deadline is
+        # owned by the scan; _pending_min accumulates deadlines published by
+        # propose() since the last scan and is merged (never dropped) there.
+        # A proposal inserted into an already-scanned shard mid-scan thus
+        # stays visible to the fast-path check either way.
+        self._min_deadline = 1 << 62
+        self._pending_min = 1 << 62
+        self._min_mu = threading.Lock()
 
     def _next_key(self) -> int:
         return self._rng.getrandbits(64) or 1
@@ -181,12 +192,17 @@ class PendingProposal:
         if self._stopped:
             raise ClusterClosedError()
         key = self._next_key()
-        rs = RequestState(key=key, deadline=self._clock.tick + timeout_ticks)
+        deadline = self._clock.tick + timeout_ticks
+        rs = RequestState(key=key, deadline=deadline)
         rs.client_id = client_id
         rs.series_id = series_id
         shard = key % self.nshards
         with self._locks[shard]:
             self._shards[shard][key] = rs
+        if deadline < self._pending_min:
+            with self._min_mu:
+                if deadline < self._pending_min:
+                    self._pending_min = deadline
         entry = Entry(
             key=key, client_id=client_id, series_id=series_id, cmd=cmd
         )
@@ -232,15 +248,26 @@ class PendingProposal:
     def tick(self) -> None:
         self._clock.advance()
         now = self._clock.tick
+        if now <= self._min_deadline and now <= self._pending_min:
+            return
+        new_min = 1 << 62
+        timed_out = []
         for shard, lock in zip(self._shards, self._locks):
-            timed_out = []
             with lock:
                 for key, rs in list(shard.items()):
                     if rs.deadline < now:
                         timed_out.append(rs)
                         del shard[key]
-            for rs in timed_out:
-                rs.notify(RequestResult(code=RequestResultCode.TIMEOUT))
+                    elif rs.deadline < new_min:
+                        new_min = rs.deadline
+        with self._min_mu:
+            # merge the scan result with anything propose() published since;
+            # _pending_min is folded in (never discarded), so a proposal the
+            # scan raced past cannot lose its timeout
+            self._min_deadline = min(new_min, self._pending_min)
+            self._pending_min = 1 << 62
+        for rs in timed_out:
+            rs.notify(RequestResult(code=RequestResultCode.TIMEOUT))
 
 
 class PendingReadIndex:
@@ -339,6 +366,9 @@ class PendingReadIndex:
     def tick(self) -> None:
         self._clock.advance()
         now = self._clock.tick
+        # fast path: nothing tracked (idle groups tick every RTT)
+        if not (self._pending or self._batches or self._confirmed):
+            return
         timed_out: List[RequestState] = []
         with self._mu:
             self._pending, expired = (
@@ -414,6 +444,8 @@ class _SingleSlot:
 
     def tick(self) -> None:
         self._clock.advance()
+        if self._pending is None:
+            return
         with self._mu:
             rs = self._pending
             if rs is not None and rs.deadline < self._clock.tick:

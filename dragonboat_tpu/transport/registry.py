@@ -31,8 +31,13 @@ class Registry:
             self._addr[(cluster_id, node_id)] = address
 
     def add_remote(self, cluster_id: int, node_id: int, address: str) -> None:
+        # skip the write (and the lock) when the entry is unchanged — this
+        # runs once per received message batch on the hot path
+        key = (cluster_id, node_id)
+        if self._remote.get(key) == address:
+            return
         with self._mu:
-            self._remote[(cluster_id, node_id)] = address
+            self._remote[key] = address
 
     def remove(self, cluster_id: int, node_id: int) -> None:
         with self._mu:
@@ -44,11 +49,13 @@ class Registry:
                 del self._addr[k]
 
     def resolve(self, cluster_id: int, node_id: int) -> Optional[str]:
-        with self._mu:
-            addr = self._addr.get((cluster_id, node_id))
-            if addr is None:
-                addr = self._remote.get((cluster_id, node_id))
-            return addr
+        # lock-free read: dict get is atomic under the GIL and both maps are
+        # only ever mutated to newer values; the send path calls this once
+        # per message so a mutex here is measurable contention
+        addr = self._addr.get((cluster_id, node_id))
+        if addr is None:
+            addr = self._remote.get((cluster_id, node_id))
+        return addr
 
     def reverse_resolve(self, address: str) -> List[Tuple[int, int]]:
         with self._mu:

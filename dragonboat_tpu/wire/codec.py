@@ -96,17 +96,27 @@ def _read_str(data: bytes, pos: int) -> Tuple[str, int]:
 # ---------------------------------------------------------------------------
 
 def encode_entry_into(buf: bytearray, e: Entry) -> None:
-    _write_uvarint(buf, e.term)
-    _write_uvarint(buf, e.index)
-    _write_uvarint(buf, int(e.type))
-    _write_uvarint(buf, e.key)
-    _write_uvarint(buf, e.client_id)
-    _write_uvarint(buf, e.series_id)
-    _write_uvarint(buf, e.responded_to)
-    _write_bytes(buf, e.cmd)
+    # entries are immutable once term/index are assigned (raft
+    # append_entries clears the cache when it assigns them), so the wire
+    # bytes are computed once and reused across Replicate fan-out + WAL
+    enc = e._enc
+    if enc is None:
+        tmp = bytearray()
+        _write_uvarint(tmp, e.term)
+        _write_uvarint(tmp, e.index)
+        _write_uvarint(tmp, int(e.type))
+        _write_uvarint(tmp, e.key)
+        _write_uvarint(tmp, e.client_id)
+        _write_uvarint(tmp, e.series_id)
+        _write_uvarint(tmp, e.responded_to)
+        _write_bytes(tmp, e.cmd)
+        enc = bytes(tmp)
+        e._enc = enc
+    buf += enc
 
 
 def decode_entry_from(data: bytes, pos: int) -> Tuple[Entry, int]:
+    start = pos
     term, pos = _read_uvarint(data, pos)
     index, pos = _read_uvarint(data, pos)
     etype, pos = _read_uvarint(data, pos)
@@ -115,19 +125,20 @@ def decode_entry_from(data: bytes, pos: int) -> Tuple[Entry, int]:
     series_id, pos = _read_uvarint(data, pos)
     responded_to, pos = _read_uvarint(data, pos)
     cmd, pos = _read_bytes(data, pos)
-    return (
-        Entry(
-            term=term,
-            index=index,
-            type=EntryType(etype),
-            key=key,
-            client_id=client_id,
-            series_id=series_id,
-            responded_to=responded_to,
-            cmd=cmd,
-        ),
-        pos,
+    e = Entry(
+        term=term,
+        index=index,
+        type=EntryType(etype),
+        key=key,
+        client_id=client_id,
+        series_id=series_id,
+        responded_to=responded_to,
+        cmd=cmd,
     )
+    # the wire slice IS the canonical encoding — seed the cache so the
+    # follower's WAL write doesn't re-encode
+    e._enc = data[start:pos]
+    return e, pos
 
 
 def encode_entry(e: Entry) -> bytes:

@@ -101,6 +101,14 @@ class Entry:
     series_id: int = 0
     responded_to: int = 0
     cmd: bytes = b""
+    # cached wire encoding (codec.encode_entry_into).  An entry is encoded
+    # up to 3× on the leader (one Replicate per follower + the WAL record)
+    # and once more on each follower; the bytes are identical every time.
+    # Populated lazily by the codec, pre-populated from the wire slice on
+    # decode, and cleared by raft.append_entries when term/index are
+    # assigned.  Excluded from init/compare/repr — it is not part of the
+    # value.
+    _enc: Optional[bytes] = field(default=None, init=False, repr=False, compare=False)
 
     def is_config_change(self) -> bool:
         return self.type == EntryType.CONFIG_CHANGE
