@@ -104,14 +104,18 @@ def _percentiles(lats):
 # ======================================================================
 
 
-def _load_worker(nh_by_cid, cids, payload, window, stop_at, out):
+def _load_worker(nh_by_cid, cids, payload, window, stop_at, drain_deadline, out):
     """Drive a slice of groups: keep `window` proposals in flight per group,
     FIFO-wait completions (apply order is FIFO per group, so the oldest
-    future completes first)."""
+    future completes first).  The throughput claim counts only completions
+    inside [start, stop_at]; the drain afterwards is bounded and excluded
+    so a deep window can't dilute the rate or wedge the phase schedule."""
     inflight = collections.deque()  # (t0, rs)
     lat = []
+    in_window = 0
     done = 0
     errors = 0
+    abandoned = 0
     try:
         sessions = {cid: nh_by_cid[cid].get_noop_session(cid) for cid in cids}
         cap = window * len(cids)
@@ -129,7 +133,7 @@ def _load_worker(nh_by_cid, cids, payload, window, stop_at, out):
                 t0 = time.perf_counter()
                 try:
                     rs = nh_by_cid[cid].propose(
-                        sessions[cid], payload, timeout=10.0
+                        sessions[cid], payload, timeout=30.0
                     )
                 except Exception:
                     errors += 1
@@ -139,54 +143,64 @@ def _load_worker(nh_by_cid, cids, payload, window, stop_at, out):
             if not inflight:
                 continue
             t0, rs = inflight.popleft()
-            r = rs.wait(10.0)
+            r = rs.wait(30.0)
             t1 = time.perf_counter()
             if r.completed:
                 lat.append(t1 - t0)
                 done += 1
+                if time.time() <= stop_at:
+                    in_window += 1
             else:
                 errors += 1
-        # drain what's left so the tally is exact
-        while inflight:
+        # bounded drain (not counted toward the rate)
+        while inflight and time.time() < drain_deadline:
             t0, rs = inflight.popleft()
-            r = rs.wait(10.0)
+            r = rs.wait(max(0.1, min(10.0, drain_deadline - time.time())))
             t1 = time.perf_counter()
             if r.completed:
                 lat.append(t1 - t0)
                 done += 1
             else:
                 errors += 1
+        abandoned = len(inflight)
     except Exception:
         errors += 1 + len(inflight)
-    out.append((done, errors, lat))
+    out.append((in_window, done, errors, abandoned, lat))
 
 
-def _measure(leaders, cids, payload, window, stop_at, threads) -> dict:
+def _measure(
+    leaders, cids, payload, window, stop_at, threads, drain_budget=30.0
+) -> dict:
     nthreads = max(1, min(threads, len(cids)))
     slices = [cids[i::nthreads] for i in range(nthreads)]
     out = []
+    t_begin = time.time()
+    duration = max(stop_at - t_begin, 0.001)
+    drain_deadline = stop_at + drain_budget
     ts = [
         threading.Thread(
             target=_load_worker,
-            args=(leaders, s, payload, window, stop_at, out),
+            args=(leaders, s, payload, window, stop_at, drain_deadline, out),
         )
         for s in slices
         if s
     ]
-    t0 = time.perf_counter()
     for t in ts:
         t.start()
     for t in ts:
         t.join()
-    elapsed = time.perf_counter() - t0
-    done = sum(d for d, _, _ in out)
-    errors = sum(e for _, e, _ in out)
-    lats = [l for _, _, ls in out for l in ls]
+    in_window = sum(w for w, _, _, _, _ in out)
+    done = sum(d for _, d, _, _, _ in out)
+    errors = sum(e for _, _, e, _, _ in out)
+    abandoned = sum(a for _, _, _, a, _ in out)
+    lats = [l for _, _, _, _, ls in out for l in ls]
     return {
-        "writes_per_sec": round(done / elapsed, 1) if elapsed > 0 else 0.0,
+        "writes_per_sec": round(in_window / duration, 1),
+        "completed_in_window": in_window,
         "completed": done,
         "errors": errors,
-        "elapsed_s": round(elapsed, 2),
+        "abandoned": abandoned,
+        "duration_s": round(duration, 2),
         "proposing_groups": len(cids),
         "window": window,
         "latency_ms": _percentiles(lats),
@@ -434,27 +448,7 @@ def rank_main() -> int:
         return 0 if leader_mode == "rank0" else cid % procs
 
     mine = [cid for cid in cids if preferred(cid) == rank]
-    for cid in mine:
-        nh.get_node(cid).request_campaign()
-    deadline = time.time() + leader_timeout
-    led = set()
-    next_retry = time.time() + 2.0
-    while len(led) < len(mine) and time.time() < deadline:
-        for cid in mine:
-            if cid not in led and nh.get_node(cid).is_leader():
-                led.add(cid)
-        if len(led) < len(mine):
-            # early campaigns race with peers still start_cluster-ing their
-            # replicas (vote requests to an unknown group are dropped);
-            # re-campaign stragglers instead of waiting out a 10s timeout
-            if time.time() >= next_retry:
-                for cid in mine:
-                    if cid not in led:
-                        nh.get_node(cid).request_campaign()
-                next_retry = time.time() + 2.0
-            time.sleep(0.05)
-    leaders = {cid: nh for cid in led}
-    setup_s = time.perf_counter() - t_setup
+    started_s = time.perf_counter() - t_setup
 
     platform = ""
     if my_engine == "tpu":
@@ -464,21 +458,54 @@ def rank_main() -> int:
             platform = jax.devices()[0].platform
         except Exception:
             platform = "unknown"
-    sys.stdout.write(
-        "READY "
-        + json.dumps(
-            {
-                "rank": rank,
-                "led": len(led),
-                "mine": len(mine),
-                "setup_s": round(setup_s, 1),
-                "engine": my_engine,
-                "platform": platform,
-            }
-        )
-        + "\n"
+
+    def emit(tag, obj):
+        sys.stdout.write(tag + " " + json.dumps(obj) + "\n")
+        sys.stdout.flush()
+
+    def expect(tag):
+        line = sys.stdin.readline()
+        if not line.startswith(tag + " ") and line.strip() != tag:
+            raise RuntimeError(f"expected {tag}, got {line!r}")
+        rest = line[len(tag) :].strip()
+        return json.loads(rest) if rest else None
+
+    # barrier 1: every rank has started all replicas before anyone
+    # campaigns — campaigning into a peer that hasn't started the group
+    # yet just drops the vote request and burns a retry cycle
+    emit("STARTED", {"rank": rank, "started_s": round(started_s, 1)})
+    expect("CAMPAIGN")
+
+    for cid in mine:
+        nh.get_node(cid).request_campaign()
+    deadline = time.time() + leader_timeout
+    led = set()
+    next_retry = time.time() + 3.0
+    while len(led) < len(mine) and time.time() < deadline:
+        for cid in mine:
+            if cid not in led and nh.get_node(cid).is_leader():
+                led.add(cid)
+        if len(led) < len(mine):
+            if time.time() >= next_retry:
+                for cid in mine:
+                    if cid not in led:
+                        nh.get_node(cid).request_campaign()
+                next_retry = time.time() + 3.0
+            time.sleep(0.05)
+    leaders = {cid: nh for cid in led}
+    setup_s = time.perf_counter() - t_setup
+
+    emit(
+        "READY",
+        {
+            "rank": rank,
+            "led": len(led),
+            "mine": len(mine),
+            "setup_s": round(setup_s, 1),
+            "engine": my_engine,
+            "platform": platform,
+        },
     )
-    sys.stdout.flush()
 
     sampler = None
     prof_dir = os.environ.get("E2E_PROFILE_DIR", "")
@@ -488,49 +515,60 @@ def rank_main() -> int:
         sampler = Sampler()
         sampler.start()
 
-    line = sys.stdin.readline()
     rc = 0
+    stage = "TPUT"  # tag the parent is blocked on; errors must carry it
     try:
-        if line.startswith("RUN "):
-            plan = json.loads(line[4:])
-            payload = b"0123456789abcdef"
-            # phase 1: throughput — every led group, window in flight
-            while time.time() < plan["t0"]:
-                time.sleep(0.005)
-            tput = _measure(
-                leaders, sorted(led), payload, window,
-                plan["t0"] + plan["duration"], threads,
-            )
-            # phase 2: latency — window=1 on the designated subset
-            lat_cids = [c for c in plan["lat_cids"] if c in led]
-            while time.time() < plan["lat_t0"]:
-                time.sleep(0.005)
-            lat = _measure(
-                leaders, lat_cids, payload, 1,
-                plan["lat_t0"] + plan["lat_duration"], threads,
-            )
-            tput_lats = tput.pop("_lats")
-            lat_lats = lat.pop("_lats")
-            sys.stdout.write(
-                "RESULT "
-                + json.dumps(
-                    {
-                        "rank": rank,
-                        "tput": tput,
-                        "lat": lat,
-                        "engine_stats": nh.engine.stats(),
-                        # raw seconds, stride-sampled to a cap so the merged
-                        # percentiles aren't biased toward warmup completions
-                        "tput_lats": tput_lats[:: max(1, len(tput_lats) // 20000)],
-                        "lat_lats": lat_lats[:: max(1, len(lat_lats) // 20000)],
-                    }
-                )
-                + "\n"
-            )
-            sys.stdout.flush()
+        payload = b"0123456789abcdef"
+        # phase 1: throughput — every led group, window in flight
+        plan = expect("RUN")
+        while time.time() < plan["t0"]:
+            time.sleep(0.005)
+        tput = _measure(
+            leaders, sorted(led), payload, window,
+            plan["t0"] + plan["duration"], threads,
+            drain_budget=plan.get("drain_budget", 30.0),
+        )
+        tput_lats = tput.pop("_lats")
+        emit(
+            "TPUT",
+            {
+                "rank": rank,
+                "tput": tput,
+                "tput_lats": tput_lats[:: max(1, len(tput_lats) // 20000)],
+            },
+        )
+        # phase 2 (own barrier — starts only after every rank drained):
+        # latency — window=1 on the designated subset
+        stage = "RESULT"
+        plan = expect("LAT")
+        lat_cids = [c for c in plan["lat_cids"] if c in led]
+        while time.time() < plan["t0"]:
+            time.sleep(0.005)
+        lat = _measure(
+            leaders, lat_cids, payload, 1,
+            plan["t0"] + plan["duration"], threads,
+        )
+        lat_lats = lat.pop("_lats")
+        emit(
+            "RESULT",
+            {
+                "rank": rank,
+                "lat": lat,
+                "engine_stats": nh.engine.stats(),
+                "lat_lats": lat_lats[:: max(1, len(lat_lats) // 20000)],
+            },
+        )
+        # final barrier: a rank with no leaders finishes its phases
+        # instantly — it must NOT stop its NodeHost (killing quorum for
+        # the others) until every rank is done measuring
+        expect("EXIT")
     except Exception as e:  # noqa: BLE001 — report, don't die silently
-        sys.stdout.write("RESULT " + json.dumps({"rank": rank, "error": str(e)}) + "\n")
-        sys.stdout.flush()
+        # emit the error under the tag the parent is currently waiting for,
+        # plus every later tag, so the parent never hangs or drops it
+        err = {"rank": rank, "error": str(e)}
+        emit(stage, err)
+        if stage == "TPUT":
+            emit("RESULT", err)
         rc = 1
     finally:
         if sampler is not None:
@@ -644,37 +682,52 @@ def run_mp(
                 if line.startswith(tag + " "):
                     return json.loads(line[len(tag) + 1 :])
 
+        def broadcast(tag, obj=None):
+            line = tag + (" " + json.dumps(obj) if obj is not None else "") + "\n"
+            for c in children:
+                try:
+                    c.stdin.write(line)
+                    c.stdin.flush()
+                except (BrokenPipeError, OSError):
+                    pass  # an errored rank may already have exited
+
+        # barrier 1: all ranks started → campaign
+        for i in range(len(children)):
+            read_tagged(i, "STARTED", hard_deadline - 30)
+        broadcast("CAMPAIGN", {})
         readies = [
-            read_tagged(i, "READY", hard_deadline - 10)
+            read_tagged(i, "READY", hard_deadline - 20)
             for i in range(len(children))
         ]
         setup_s = time.time() - t_start
         print(f"e2e mp setup_s={setup_s:.1f} readies={readies}", file=sys.stderr)
         led_total = sum(r["led"] for r in readies)
 
+        # phase 1: throughput
+        broadcast("RUN", {"t0": time.time() + 0.5, "duration": duration,
+                          "drain_budget": 30.0})
+        tputs = [
+            read_tagged(i, "TPUT", hard_deadline) for i in range(len(children))
+        ]
+        # phase 2: latency (after every rank drained)
         lat_cids = [BASE_CID + g for g in range(min(latency_groups, groups))]
-        t0 = time.time() + 0.5
-        plan = {
-            "t0": t0,
-            "duration": duration,
-            "lat_t0": t0 + duration + 1.0,
-            "lat_duration": min(duration, 5.0),
-            "lat_cids": lat_cids,
-        }
-        for c in children:
-            c.stdin.write("RUN " + json.dumps(plan) + "\n")
-            c.stdin.flush()
+        broadcast("LAT", {"t0": time.time() + 0.5,
+                          "duration": min(duration, 5.0),
+                          "lat_cids": lat_cids})
         results = [
             read_tagged(i, "RESULT", hard_deadline)
             for i in range(len(children))
         ]
-        errors = [r for r in results if "error" in r]
-        oks = [r for r in results if "tput" in r]
-        tput_done = sum(r["tput"]["completed"] for r in oks)
-        tput_errs = sum(r["tput"]["errors"] for r in oks)
-        lat_done = sum(r["lat"]["completed"] for r in oks)
-        tput_lats = [l for r in oks for l in r["tput_lats"]]
-        lat_lats = [l for r in oks for l in r["lat_lats"]]
+        broadcast("EXIT", {})
+        errors = [r for r in tputs + results if "error" in r]
+        tput_oks = [r for r in tputs if "tput" in r]
+        lat_oks = [r for r in results if "lat" in r]
+        tput_done = sum(r["tput"]["completed_in_window"] for r in tput_oks)
+        tput_errs = sum(r["tput"]["errors"] for r in tput_oks)
+        abandoned = sum(r["tput"]["abandoned"] for r in tput_oks)
+        lat_done = sum(r["lat"]["completed"] for r in lat_oks)
+        tput_lats = [l for r in tput_oks for l in r["tput_lats"]]
+        lat_lats = [l for r in lat_oks for l in r["lat_lats"]]
         writes_per_sec = round(tput_done / duration, 1)
         out = {
             "groups": groups,
@@ -690,8 +743,9 @@ def run_mp(
             "commit_latency_ms": _percentiles(lat_lats),
             "throughput_phase": {
                 "writes_per_sec": writes_per_sec,
-                "completed": tput_done,
+                "completed_in_window": tput_done,
                 "errors": tput_errs,
+                "abandoned": abandoned,
                 "latency_ms": _percentiles(tput_lats),
                 "window": window,
             },
@@ -706,7 +760,7 @@ def run_mp(
             ],
         }
         if os.environ.get("E2E_KEEP_STATS") == "1":
-            out["rank_engine_stats"] = [r.get("engine_stats") for r in oks]
+            out["rank_engine_stats"] = [r.get("engine_stats") for r in lat_oks]
         if errors:
             out["rank_errors"] = errors
         return out
@@ -737,8 +791,8 @@ def run_quick() -> dict:
     """Bounded run for bench.py's detail field (driver time budget)."""
     groups = int(os.environ.get("E2E_GROUPS", "1024"))
     duration = float(os.environ.get("E2E_DURATION", "10"))
-    window = int(os.environ.get("E2E_WINDOW", "16"))
-    rtt_ms = int(os.environ.get("E2E_RTT_MS", "500"))
+    window = int(os.environ.get("E2E_WINDOW", "32"))
+    rtt_ms = int(os.environ.get("E2E_RTT_MS", "1000"))
     engine = os.environ.get("E2E_ENGINE", "tpu")
     durable = os.environ.get("E2E_DURABLE", "1") == "1"
     threads = int(os.environ.get("E2E_THREADS", "8"))

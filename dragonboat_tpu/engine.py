@@ -10,7 +10,7 @@ device dispatch per tick (SURVEY.md §7).
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, TYPE_CHECKING
+from typing import Dict, List, Optional, Tuple, TYPE_CHECKING
 
 from .logger import get_logger
 from .queue import ReadyCluster
@@ -162,8 +162,8 @@ class Engine:
         self._step_cache: List = [(-1, {}) for _ in range(step_workers)]
         self._apply_cache: List = [(-1, {}) for _ in range(apply_workers)]
         # diagnostics per step worker: [rounds, groups_stepped, skipped,
-        # step_s, inline_s]
-        self._step_stats = [[0, 0, 0, 0.0, 0.0] for _ in range(step_workers)]
+        # step_s]
+        self._step_stats = [[0, 0, 0, 0.0] for _ in range(step_workers)]
         self._committers = [_Committer(self, i) for i in range(step_workers)]
         for i in range(step_workers):
             t = threading.Thread(
@@ -247,7 +247,7 @@ class Engine:
 
     def process_steps(
         self, active: List["Node"], committer: Optional[_Committer] = None
-    ) -> None:
+    ) -> Tuple[int, int]:
         """The hot loop (reference ``processSteps`` ``execengine.go:923``):
         step → send replicates → one batched fsync → execute → commit.
 

@@ -265,14 +265,18 @@ class Node:
 
     # ---- inbound messages ----
 
-    def handle_message_batch(self, m: Message) -> None:
+    def enqueue_message(self, m: Message) -> bool:
+        """Queue an inbound message WITHOUT the step-ready ping; the router
+        signals once per touched group after draining the whole batch."""
         if self._stopped.is_set():
-            return
+            return False
         if m.type == MT.INSTALL_SNAPSHOT:
-            self.mq.must_add(m)
-        else:
-            self.mq.add(m)
-        self.nh.engine.set_step_ready(self.cluster_id)
+            return self.mq.must_add(m)
+        return self.mq.add(m)
+
+    def handle_message_batch(self, m: Message) -> None:
+        if self.enqueue_message(m):
+            self.nh.engine.set_step_ready(self.cluster_id)
 
     def request_tick(self) -> None:
         """Reference ``nodehost.go`` sendTickMessage: one LocalTick per RTT."""
@@ -336,9 +340,13 @@ class Node:
                 self.peer.report_snapshot_status(m.from_, m.reject)
             elif m.type == MT.ELECTION:
                 # local campaign request (request_campaign); must go through
-                # Peer.campaign — Peer.handle rejects local message types
-                self.quiesce_mgr.record_activity(m.type)
-                self.peer.campaign()
+                # Peer.campaign — Peer.handle rejects local message types.
+                # Only honored when locally injected: a wire message must
+                # not be able to force a follower to campaign against a
+                # healthy leader (reference treats ELECTION as local-only)
+                if m.from_ == self.node_id:
+                    self.quiesce_mgr.record_activity(m.type)
+                    self.peer.campaign()
             else:
                 if self.quiesce_mgr.enabled:
                     self.quiesce_mgr.record_activity(m.type)

@@ -610,18 +610,26 @@ class NodeHost:
             self._snapshot_status(m.cluster_id, m.to, True)
 
     def _message_router(self, batch: MessageBatch) -> None:
-        """Reference ``messageHandler`` ``nodehost.go:2013``."""
+        """Reference ``messageHandler`` ``nodehost.go:2013``.
+
+        Messages are queued first and step-readiness is signalled once per
+        touched group — a batch regularly carries several messages for the
+        same group and per-message wakeups are measurable overhead."""
+        touched = {}
+        src = batch.source_address
         for m in batch.requests:
             node = self._clusters.get(m.cluster_id)
             if node is None or node.node_id != m.to:
                 continue
-            if batch.source_address:
+            if src:
                 # learn the sender's address so replies route before
                 # membership is applied locally (reference nodes.go)
-                self.node_registry.add_remote(
-                    m.cluster_id, m.from_, batch.source_address
-                )
-            node.handle_message_batch(m)
+                self.node_registry.add_remote(m.cluster_id, m.from_, src)
+            if node.enqueue_message(m):
+                touched[m.cluster_id] = None
+        engine = self.engine
+        for cid in touched:
+            engine.set_step_ready(cid)
 
     def _snapshot_status(self, cluster_id: int, node_id: int, failed: bool):
         node = self._clusters.get(cluster_id)

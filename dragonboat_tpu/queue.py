@@ -39,6 +39,10 @@ class EntryQueue:
             return True
 
     def get(self, paused: bool = False) -> List[Entry]:
+        # lock-free empty fast path (hot: every step round polls this);
+        # only valid when the pause flag isn't changing
+        if paused == self._paused and not self._left and not self._right:
+            return []
         with self._mu:
             self._paused = paused
             q = self._active()
@@ -74,8 +78,8 @@ class ReadIndexQueue:
             return out
 
     def peep(self) -> bool:
-        with self._mu:
-            return bool(self._reqs)
+        # GIL-atomic read; hot-path poll (node._handle_read_index)
+        return bool(self._reqs)
 
     def close(self) -> None:
         with self._mu:

@@ -294,8 +294,8 @@ class PendingReadIndex:
         return rs
 
     def peep(self) -> bool:
-        with self._mu:
-            return bool(self._pending)
+        # GIL-atomic read; polled every step round for every group
+        return bool(self._pending)
 
     def next_ctx(self) -> SystemCtx:
         return SystemCtx(
@@ -423,11 +423,19 @@ class _SingleSlot:
             return rs
 
     def take(self):
+        # lock-free empty check: this runs in every step round for every
+        # group (node._handle_events) and is almost always empty; a plain
+        # read is GIL-atomic and a racing request() just gets picked up on
+        # the next round
+        if self._payload is None:
+            return None
         with self._mu:
             p, self._payload = self._payload, None
             return p
 
     def pending(self) -> Optional[RequestState]:
+        if self._pending is None:
+            return None
         with self._mu:
             return self._pending
 

@@ -54,6 +54,11 @@ class MessageQueue:
 
     def get(self) -> List[Message]:
         """Swap buffers and return everything queued."""
+        # lock-free empty fast path: the step loop polls this for every
+        # group every round; list truthiness is GIL-atomic and a racing
+        # add() is followed by a step_ready ping that triggers another round
+        if not self._left and not self._right:
+            return []
         with self._mu:
             q = self._active()
             self._use_left = not self._use_left
