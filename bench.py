@@ -73,7 +73,7 @@ def _probe_tpu(timeout: float = 90.0, tries: int = 2):
     return None
 
 
-def _resolve_platform() -> str:
+def _resolve_platform(probed=None) -> str:
     from dragonboat_tpu import hostplatform
 
     forced = os.environ.get("BENCH_PLATFORM")
@@ -82,7 +82,8 @@ def _resolve_platform() -> str:
     else:
         if forced is not None:
             _note(f"ignoring BENCH_PLATFORM={forced!r} (only 'cpu' supported)")
-        probed = _probe_tpu()
+        if probed is None:
+            probed = _probe_tpu()
         if probed is None or probed == "cpu":
             _note("TPU backend probe failed; falling back to cpu")
             hostplatform.force_cpu()
@@ -247,8 +248,8 @@ def main() -> None:
     else:
         e2e_ok = None  # deliberately skipped ≠ failed
 
-    # ---- kernel benches (parent now takes the device)
-    platform = _resolve_platform()
+    # ---- kernel benches (parent now takes the device; reuse the probe)
+    platform = _resolve_platform(probed)
     on_tpu = platform not in ("cpu",)
     detail["platform"] = platform
 

@@ -709,9 +709,16 @@ def run_mp(
         tputs = [
             read_tagged(i, "TPUT", hard_deadline) for i in range(len(children))
         ]
-        # phase 2: latency (after every rank drained)
+        # phase 2: latency (after every rank drained).  If any rank
+        # abandoned in-flight proposals at the drain deadline the runtime is
+        # still chewing on them — give it a bounded quiesce window so the
+        # window=1 latency probes don't queue behind leftover backlog
+        abandoned_now = sum(
+            r["tput"]["abandoned"] for r in tputs if "tput" in r
+        )
+        quiesce = 0.5 if not abandoned_now else min(20.0, 2.0 + abandoned_now / 1000.0)
         lat_cids = [BASE_CID + g for g in range(min(latency_groups, groups))]
-        broadcast("LAT", {"t0": time.time() + 0.5,
+        broadcast("LAT", {"t0": time.time() + quiesce,
                           "duration": min(duration, 5.0),
                           "lat_cids": lat_cids})
         results = [
@@ -719,7 +726,11 @@ def run_mp(
             for i in range(len(children))
         ]
         broadcast("EXIT", {})
-        errors = [r for r in tputs + results if "error" in r]
+        # one entry per failed rank (a TPUT-stage error is re-emitted under
+        # RESULT so the parent never hangs — don't double-count it)
+        errors = list(
+            {r["rank"]: r for r in tputs + results if "error" in r}.values()
+        )
         tput_oks = [r for r in tputs if "tput" in r]
         lat_oks = [r for r in results if "lat" in r]
         tput_done = sum(r["tput"]["completed_in_window"] for r in tput_oks)

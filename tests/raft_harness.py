@@ -58,6 +58,29 @@ def new_test_raft(
     return r
 
 
+def ents_with_config(terms: List[int], node_id: int = 1) -> Raft:
+    """Raft whose stable log holds one entry per term in ``terms``
+    (reference ``entsWithConfig`` raft_etcd_test.go:2790)."""
+    storage = InMemLogDB()
+    for i, term in enumerate(terms):
+        storage.append([Entry(index=i + 1, term=term)])
+    r = Raft(new_test_config(node_id, 5, 1), storage, seed=node_id)
+    r.reset(terms[-1])
+    return r
+
+
+def voted_with_config(vote: int, term: int, node_id: int = 1) -> Raft:
+    """Raft that voted in ``term`` but has an empty log (reference
+    ``votedWithConfig`` raft_etcd_test.go:2809)."""
+    from dragonboat_tpu.wire import State
+
+    storage = InMemLogDB()
+    storage.set_state(State(vote=vote, term=term))
+    r = Raft(new_test_config(node_id, 5, 1), storage, seed=node_id)
+    r.reset(term)
+    return r
+
+
 class BlackHole:
     """Drops everything (etcd's nopStepper)."""
 
@@ -75,6 +98,8 @@ class Network:
     """Reference etcd `network` harness."""
 
     def __init__(self, *peers, election: int = 10, heartbeat: int = 1):
+        from dragonboat_tpu.raft.remote import Remote
+
         self.peers: Dict[int, object] = {}
         self.storage: Dict[int, InMemLogDB] = {}
         self.dropm: Dict[Tuple[int, int], float] = {}
@@ -92,7 +117,25 @@ class Network:
             elif isinstance(p, BlackHole):
                 self.peers[nid] = p
             elif isinstance(p, Raft):
+                # reference newNetworkWithConfig's *raft branch
+                # (raft_etcd_test.go:2858-2881): rebuild the peer sets for
+                # this network's id space, keeping observer/witness marks
+                observers = set(p.observers)
+                witnesses = set(p.witnesses)
                 p.node_id = nid
+                p.remotes = {}
+                p.observers = {}
+                p.witnesses = {}
+                for pid in ids:
+                    if pid in observers:
+                        p.observers[pid] = Remote()
+                    elif pid in witnesses:
+                        p.witnesses[pid] = Remote()
+                    else:
+                        p.remotes[pid] = Remote()
+                p.reset(p.term)
+                if isinstance(p.log.logdb, InMemLogDB):
+                    self.storage[nid] = p.log.logdb
                 self.peers[nid] = p
             else:
                 raise TypeError(f"unexpected peer type {type(p)}")

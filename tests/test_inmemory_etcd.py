@@ -1,0 +1,146 @@
+"""Port of the reference's etcd-derived in-memory log tests.
+
+Reference: ``/root/reference/internal/raft/inmemory_etcd_test.go`` — same
+test names and case tables, against :mod:`dragonboat_tpu.raft.inmemory`.
+"""
+from __future__ import annotations
+
+from dragonboat_tpu.raft.inmemory import InMemory
+from dragonboat_tpu.wire import Entry, Snapshot
+
+
+def E(index, term=0):
+    return Entry(index=index, term=term)
+
+
+def mk(entries, marker, snap=None):
+    u = InMemory(marker - 1 if marker else 0)
+    u.entries = list(entries)
+    u.marker_index = marker
+    u.snapshot = snap
+    return u
+
+
+def sig(ents):
+    return [(e.term, e.index) for e in ents]
+
+
+def test_unstable_maybe_first_index():
+    cases = [
+        ([E(5, 1)], 5, None, False, 0),
+        ([], 0, None, False, 0),
+        ([E(5, 1)], 5, Snapshot(index=4, term=1), True, 5),
+        ([], 5, Snapshot(index=4, term=1), True, 5),
+    ]
+    for i, (entries, offset, snap, wok, windex) in enumerate(cases):
+        u = mk(entries, offset, snap)
+        index, ok = u.get_snapshot_index()
+        assert ok == wok, f"#{i}"
+        if ok:
+            assert index + 1 == windex, f"#{i}"
+
+
+def test_maybe_last_index():
+    cases = [
+        ([E(5, 1)], 5, None, True, 5),
+        ([E(5, 1)], 5, Snapshot(index=4, term=1), True, 5),
+        ([], 5, Snapshot(index=4, term=1), True, 4),
+        ([], 0, None, False, 0),
+    ]
+    for i, (entries, offset, snap, wok, windex) in enumerate(cases):
+        u = mk(entries, offset, snap)
+        index, ok = u.get_last_index()
+        assert ok == wok, f"#{i}"
+        assert index == windex, f"#{i}"
+
+
+def test_unstable_maybe_term():
+    cases = [
+        ([E(5, 1)], 5, None, 5, True, 1),
+        ([E(5, 1)], 5, None, 6, False, 0),
+        ([E(5, 1)], 5, None, 4, False, 0),
+        ([E(5, 1)], 5, Snapshot(index=4, term=1), 5, True, 1),
+        ([E(5, 1)], 5, Snapshot(index=4, term=1), 6, False, 0),
+        ([E(5, 1)], 5, Snapshot(index=4, term=1), 4, True, 1),
+        ([E(5, 1)], 5, Snapshot(index=4, term=1), 3, False, 0),
+        ([], 5, Snapshot(index=4, term=1), 5, False, 0),
+        ([], 5, Snapshot(index=4, term=1), 4, True, 1),
+        ([], 0, None, 5, False, 0),
+    ]
+    for i, (entries, offset, snap, index, wok, wterm) in enumerate(cases):
+        u = mk(entries, offset, snap)
+        term, ok = u.get_term(index)
+        assert ok == wok, f"#{i}"
+        assert term == wterm, f"#{i}"
+
+
+def test_unstable_restore():
+    u = mk([E(5, 1)], 5, Snapshot(index=4, term=1))
+    s = Snapshot(index=6, term=2)
+    u.restore(s)
+    assert u.marker_index == s.index + 1
+    assert len(u.entries) == 0
+    assert u.snapshot == s
+
+
+def test_unstable_truncate_and_append():
+    cases = [
+        # append to the end
+        ([E(5, 1)], 5, None, [E(6, 1), E(7, 1)],
+         5, [(1, 5), (1, 6), (1, 7)]),
+        # replace the in-memory entries
+        ([E(5, 1)], 5, None, [E(5, 2), E(6, 2)],
+         5, [(2, 5), (2, 6)]),
+        ([E(5, 1)], 5, None, [E(4, 2), E(5, 2), E(6, 2)],
+         4, [(2, 4), (2, 5), (2, 6)]),
+        # truncate existing entries and append
+        ([E(5, 1), E(6, 1), E(7, 1)], 5, None, [E(6, 2)],
+         5, [(1, 5), (2, 6)]),
+        ([E(5, 1), E(6, 1), E(7, 1)], 5, None, [E(7, 2), E(8, 2)],
+         5, [(1, 5), (1, 6), (2, 7), (2, 8)]),
+    ]
+    for i, (entries, offset, snap, to_append, woffset, wentries) in enumerate(cases):
+        u = mk(entries, offset, snap)
+        u.merge(list(to_append))
+        assert u.marker_index == woffset, f"#{i}"
+        assert sig(u.entries) == wentries, f"#{i}"
+
+
+def test_entry_merge_thread_safety():
+    cases = [
+        ([E(5, 1), E(6, 1), E(7, 1)], 5, [E(7, 2), E(7, 2)], 7, 1),
+        ([E(5, 1), E(6, 1), E(7, 1)], 5, [E(4, 2), E(5, 2)], 5, 1),
+        ([E(5, 1), E(6, 1), E(7, 1)], 5, [E(5, 2), E(6, 2)], 5, 1),
+    ]
+    for idx, (entries, marker, merge, exp_index, exp_term) in enumerate(cases):
+        im = mk(entries, marker)
+        old = im.entries[0:]
+        im.merge(list(merge))
+        for e in old:
+            if e.index == exp_index:
+                assert e.term == exp_term, f"#{idx}: entry term changed"
+
+
+def test_unstable_stable_to():
+    cases = [
+        ([], 0, None, 5, 1, 0, 0, 0),
+        ([E(5, 1)], 5, None, 5, 1, 5, 6, 0),
+        ([E(5, 1), E(6, 1)], 5, None, 5, 1, 5, 6, 1),
+        ([E(6, 2)], 6, None, 6, 1, 0, 7, 0),
+        ([E(5, 1)], 5, None, 4, 1, 0, 5, 1),
+        ([E(5, 1)], 5, None, 4, 2, 0, 5, 1),
+        # with snapshot
+        ([E(5, 1)], 5, Snapshot(index=4, term=1), 5, 1, 5, 6, 0),
+        ([E(5, 1), E(6, 1)], 5, Snapshot(index=4, term=1), 5, 1, 5, 6, 1),
+        ([E(6, 2)], 6, Snapshot(index=5, term=1), 6, 1, 0, 7, 0),
+        ([E(5, 1)], 5, Snapshot(index=4, term=1), 4, 1, 0, 5, 1),
+        ([E(5, 2)], 5, Snapshot(index=4, term=2), 4, 1, 0, 5, 1),
+    ]
+    for i, (entries, offset, snap, index, term, saved_to, woffset, wlen) in enumerate(cases):
+        u = mk(entries, offset, snap)
+        u.saved_to = 0
+        u.saved_log_to(index, term)
+        u.applied_log_to(index)
+        assert u.saved_to == saved_to, f"#{i}: saved_to {u.saved_to}"
+        assert u.marker_index == woffset, f"#{i}: marker {u.marker_index}"
+        assert len(u.entries) == wlen, f"#{i}: len {len(u.entries)}"

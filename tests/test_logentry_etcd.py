@@ -1,0 +1,433 @@
+"""Port of the reference's etcd-derived entry-log conformance tests.
+
+Reference: ``/root/reference/internal/raft/logentry_etcd_test.go`` — same
+test names and case tables, against :mod:`dragonboat_tpu.raft.log`'s
+``EntryLog`` (the reference's three-stage ``entryLog``).
+"""
+from __future__ import annotations
+
+import pytest
+
+from dragonboat_tpu.raft import InMemLogDB
+from dragonboat_tpu.raft.log import CompactedError, EntryLog
+from dragonboat_tpu.wire import Entry, Snapshot, UpdateCommit
+
+NO_LIMIT = 1 << 62
+
+
+def E(index, term=0):
+    return Entry(index=index, term=term)
+
+
+def sig(ents):
+    return [(e.term, e.index) for e in ents]
+
+
+def get_all_entries(l: EntryLog):
+    try:
+        return l.entries(l.first_index(), NO_LIMIT)
+    except CompactedError:
+        return get_all_entries(l)
+
+
+def must_term(l: EntryLog, index: int) -> int:
+    return l.term(index)
+
+
+def test_find_conflict():
+    previous = [E(1, 1), E(2, 2), E(3, 3)]
+    cases = [
+        ([], 0),
+        ([E(1, 1), E(2, 2), E(3, 3)], 0),
+        ([E(2, 2), E(3, 3)], 0),
+        ([E(3, 3)], 0),
+        ([E(1, 1), E(2, 2), E(3, 3), E(4, 4), E(5, 4)], 4),
+        ([E(2, 2), E(3, 3), E(4, 4), E(5, 4)], 4),
+        ([E(3, 3), E(4, 4), E(5, 4)], 4),
+        ([E(4, 4), E(5, 4)], 4),
+        ([E(1, 4), E(2, 4)], 1),
+        ([E(2, 1), E(3, 4), E(4, 4)], 2),
+        ([E(3, 1), E(4, 2), E(5, 4), E(6, 4)], 3),
+    ]
+    for i, (ents, wconflict) in enumerate(cases):
+        l = EntryLog(InMemLogDB())
+        l.append(list(previous))
+        assert l.get_conflict_index(ents) == wconflict, f"#{i}"
+
+
+def test_is_up_to_date():
+    previous = [E(1, 1), E(2, 2), E(3, 3)]
+    l = EntryLog(InMemLogDB())
+    l.append(previous)
+    last = l.last_index()
+    cases = [
+        (last - 1, 4, True),
+        (last, 4, True),
+        (last + 1, 4, True),
+        (last - 1, 2, False),
+        (last, 2, False),
+        (last + 1, 2, False),
+        (last - 1, 3, False),
+        (last, 3, True),
+        (last + 1, 3, True),
+    ]
+    for i, (idx, term, w) in enumerate(cases):
+        assert l.up_to_date(idx, term) == w, f"#{i}"
+
+
+def test_append():
+    previous = [E(1, 1), E(2, 2)]
+    cases = [
+        ([], 2, [(1, 1), (2, 2)], 3),
+        ([E(3, 2)], 3, [(1, 1), (2, 2), (2, 3)], 3),
+        # conflicts with index 1
+        ([E(1, 2)], 1, [(2, 1)], 1),
+        # conflicts with index 2
+        ([E(2, 3), E(3, 3)], 3, [(1, 1), (3, 2), (3, 3)], 2),
+    ]
+    for i, (ents, windex, wents, wunstable) in enumerate(cases):
+        storage = InMemLogDB()
+        storage.append(list(previous))
+        l = EntryLog(storage)
+        l.append(list(ents))
+        assert l.last_index() == windex, f"#{i}"
+        assert sig(l.entries(1, NO_LIMIT)) == wents, f"#{i}"
+        assert l.inmem.marker_index == wunstable, f"#{i}"
+
+
+def test_log_maybe_append():
+    previous = [E(1, 1), E(2, 2), E(3, 3)]
+    lastindex, lastterm, commit = 3, 3, 1
+    cases = [
+        # not match: different term
+        (lastterm - 1, lastindex, lastindex, [E(lastindex + 1, 4)], 0, False, commit, False),
+        # not match: index out of bound
+        (lastterm, lastindex + 1, lastindex, [E(lastindex + 2, 4)], 0, False, commit, False),
+        # match with the last existing entry
+        (lastterm, lastindex, lastindex, [], lastindex, True, lastindex, False),
+        (lastterm, lastindex, lastindex + 1, [], lastindex, True, lastindex, False),
+        (lastterm, lastindex, lastindex - 1, [], lastindex, True, lastindex - 1, False),
+        (lastterm, lastindex, 0, [], lastindex, True, commit, False),
+        (0, 0, lastindex, [], 0, True, commit, False),
+        (lastterm, lastindex, lastindex, [E(lastindex + 1, 4)], lastindex + 1, True, lastindex, False),
+        (lastterm, lastindex, lastindex + 1, [E(lastindex + 1, 4)], lastindex + 1, True, lastindex + 1, False),
+        (lastterm, lastindex, lastindex + 2, [E(lastindex + 1, 4)], lastindex + 1, True, lastindex + 1, False),
+        (lastterm, lastindex, lastindex + 2, [E(lastindex + 1, 4), E(lastindex + 2, 4)], lastindex + 2, True, lastindex + 2, False),
+        # match with an entry in the middle
+        (lastterm - 1, lastindex - 1, lastindex, [E(lastindex, 4)], lastindex, True, lastindex, False),
+        (lastterm - 2, lastindex - 2, lastindex, [E(lastindex - 1, 4)], lastindex - 1, True, lastindex - 1, False),
+        (lastterm - 3, lastindex - 3, lastindex, [E(lastindex - 2, 4)], lastindex - 2, True, lastindex - 2, True),
+        (lastterm - 2, lastindex - 2, lastindex, [E(lastindex - 1, 4), E(lastindex, 4)], lastindex, True, lastindex, False),
+    ]
+    for i, (log_term, index, committed, ents, wlasti, wappend, wcommit, wpanic) in enumerate(cases):
+        l = EntryLog(InMemLogDB())
+        l.append(list(previous))
+        l.committed = commit
+        try:
+            glasti = 0
+            gappend = False
+            if l.match_term(index, log_term):
+                gappend = True
+                l.try_append(index, list(ents))
+                glasti = index + len(ents)
+                l.commit_to(min(glasti, committed))
+        except Exception:
+            assert wpanic, f"#{i}: unexpected panic"
+            continue
+        assert not wpanic or glasti == wlasti, f"#{i}"
+        assert glasti == wlasti, f"#{i}: lasti {glasti}"
+        assert gappend == wappend, f"#{i}"
+        assert l.committed == wcommit, f"#{i}: commit {l.committed}"
+        if gappend and ents:
+            gents = l.get_entries(
+                l.last_index() - len(ents) + 1, l.last_index() + 1, NO_LIMIT
+            )
+            assert sig(gents) == sig(ents), f"#{i}"
+
+
+def test_has_next_ents():
+    snap = Snapshot(term=1, index=3)
+    ents = [E(4, 1), E(5, 1), E(6, 1)]
+    cases = [(0, True), (3, True), (4, True), (5, False)]
+    for i, (applied, has_next) in enumerate(cases):
+        storage = InMemLogDB()
+        storage.apply_snapshot(snap)
+        l = EntryLog(storage)
+        l.append(list(ents))
+        l.try_commit(5, 1)
+        l.commit_update(UpdateCommit(processed=applied))
+        assert l.has_entries_to_apply() == has_next, f"#{i}"
+
+
+def test_next_ents():
+    snap = Snapshot(term=1, index=3)
+    ents = [E(4, 1), E(5, 1), E(6, 1)]
+    cases = [
+        (0, sig(ents[:2])),
+        (3, sig(ents[:2])),
+        (4, sig(ents[1:2])),
+        (5, []),
+    ]
+    for i, (applied, wents) in enumerate(cases):
+        storage = InMemLogDB()
+        storage.apply_snapshot(snap)
+        l = EntryLog(storage)
+        l.append(list(ents))
+        l.try_commit(5, 1)
+        l.commit_update(UpdateCommit(processed=applied))
+        assert sig(l.entries_to_apply()) == wents, f"#{i}"
+
+
+def test_commit_to():
+    previous = [E(1, 1), E(2, 2), E(3, 3)]
+    commit = 2
+    cases = [(3, 3, False), (1, 2, False), (4, 0, True)]
+    for i, (to, wcommit, wpanic) in enumerate(cases):
+        l = EntryLog(InMemLogDB())
+        l.append(list(previous))
+        l.committed = commit
+        try:
+            l.commit_to(to)
+        except Exception:
+            assert wpanic, f"#{i}"
+            continue
+        assert not wpanic, f"#{i}"
+        assert l.committed == wcommit, f"#{i}"
+
+
+def test_compaction():
+    cases = [
+        (1000, [1001], [-1], False),
+        (1000, [300, 500, 800, 900], [700, 500, 200, 100], True),
+        (1000, [300, 299], [700, -1], False),
+    ]
+    for i, (last_index, compacts, wleft, wallow) in enumerate(cases):
+        storage = InMemLogDB()
+        for j in range(1, last_index + 1):
+            storage.append([E(j)])
+        l = EntryLog(storage)
+        l.try_commit(last_index, 0)
+        l.commit_update(UpdateCommit(processed=l.committed))
+        for j, c in enumerate(compacts):
+            try:
+                storage.compact(c)
+            except Exception:
+                assert not wallow, f"#{i}.{j}"
+                continue
+            assert len(get_all_entries(l)) == wleft[j], f"#{i}.{j}"
+
+
+def test_log_restore():
+    index, term = 1000, 1000
+    storage = InMemLogDB()
+    storage.apply_snapshot(Snapshot(index=index, term=term))
+    l = EntryLog(storage)
+    assert len(get_all_entries(l)) == 0
+    assert l.first_index() == index + 1
+    assert l.committed == index
+    assert l.inmem.marker_index == index + 1
+    assert must_term(l, index) == term
+
+
+def test_is_out_of_bounds():
+    offset, num = 100, 100
+    storage = InMemLogDB()
+    storage.apply_snapshot(Snapshot(index=offset))
+    l = EntryLog(storage)
+    for i in range(1, num + 1):
+        l.append([E(i + offset)])
+    first = offset + 1
+    cases = [
+        (first - 2, first + 1, False, True),
+        (first - 1, first + 1, False, True),
+        (first, first, False, False),
+        (first + num // 2, first + num // 2, False, False),
+        (first + num - 1, first + num - 1, False, False),
+        (first + num, first + num, False, False),
+        (first + num, first + num + 1, True, False),
+        (first + num + 1, first + num + 1, True, False),
+    ]
+    for i, (lo, hi, wpanic, wcompacted) in enumerate(cases):
+        try:
+            l._check_bound(lo, hi)
+        except CompactedError:
+            assert wcompacted, f"#{i}"
+            continue
+        except RuntimeError:
+            assert wpanic, f"#{i}"
+            continue
+        assert not wpanic and not wcompacted, f"#{i}"
+
+
+def test_term():
+    offset, num = 100, 100
+    storage = InMemLogDB()
+    storage.apply_snapshot(Snapshot(index=offset, term=1))
+    l = EntryLog(storage)
+    for i in range(1, num):
+        l.append([E(offset + i, i)])
+    cases = [
+        (offset - 1, 0),
+        (offset, 1),
+        (offset + num // 2, num // 2),
+        (offset + num - 1, num - 1),
+        (offset + num, 0),
+    ]
+    for j, (index, w) in enumerate(cases):
+        assert must_term(l, index) == w, f"#{j}"
+
+
+def test_term_with_unstable_snapshot():
+    storagesnapi = 100
+    unstablesnapi = storagesnapi + 5
+    storage = InMemLogDB()
+    storage.apply_snapshot(Snapshot(index=storagesnapi, term=1))
+    l = EntryLog(storage)
+    l.restore(Snapshot(index=unstablesnapi, term=1))
+    cases = [
+        (storagesnapi, 0),
+        (storagesnapi + 1, 0),
+        (unstablesnapi - 1, 0),
+        (unstablesnapi, 1),
+    ]
+    for i, (index, w) in enumerate(cases):
+        assert must_term(l, index) == w, f"#{i}"
+
+
+def test_slice():
+    offset, num = 100, 100
+    last = offset + num
+    half = offset + num // 2
+    halfe_size = E(half, half).size()
+
+    storage = InMemLogDB()
+    storage.apply_snapshot(Snapshot(index=offset))
+    for i in range(1, num // 2):
+        storage.append([E(offset + i, offset + i)])
+    l = EntryLog(storage)
+    for i in range(num // 2, num):
+        l.append([E(offset + i, offset + i)])
+
+    cases = [
+        (offset - 1, offset + 1, NO_LIMIT, [], False),
+        (offset, offset + 1, NO_LIMIT, [], False),
+        (half - 1, half + 1, NO_LIMIT, [(half - 1, half - 1), (half, half)], False),
+        (half, half + 1, NO_LIMIT, [(half, half)], False),
+        (last - 1, last, NO_LIMIT, [(last - 1, last - 1)], False),
+        (last, last + 1, NO_LIMIT, [], True),
+        (half - 1, half + 1, 0, [(half - 1, half - 1)], False),
+        (half - 1, half + 1, halfe_size + 1, [(half - 1, half - 1)], False),
+        (half - 2, half + 1, halfe_size + 1, [(half - 2, half - 2)], False),
+        (half - 1, half + 1, halfe_size * 2, [(half - 1, half - 1), (half, half)], False),
+        (half - 1, half + 2, halfe_size * 3, [(half - 1, half - 1), (half, half), (half + 1, half + 1)], False),
+        (half, half + 2, halfe_size, [(half, half)], False),
+        (half, half + 2, halfe_size * 2, [(half, half), (half + 1, half + 1)], False),
+    ]
+    for j, (frm, to, limit, w, wpanic) in enumerate(cases):
+        try:
+            g = l.get_entries(frm, to, limit)
+        except CompactedError:
+            assert frm <= offset, f"#{j}"
+            continue
+        except RuntimeError:
+            assert wpanic, f"#{j}"
+            continue
+        assert not wpanic, f"#{j}"
+        assert sig(g) == w, f"#{j}: got {sig(g)} want {w}"
+
+
+def test_compaction_side_effects():
+    last_index = 1000
+    unstable_index = 750
+    last_term = last_index
+    storage = InMemLogDB()
+    for i in range(1, unstable_index + 1):
+        storage.append([E(i, i)])
+    l = EntryLog(storage)
+    for i in range(unstable_index, last_index):
+        l.append([E(i + 1, i + 1)])
+    assert l.try_commit(last_index, last_term)
+    offset = 500
+    storage.compact(offset)
+    assert l.last_index() == last_index
+    for j in range(offset, l.last_index() + 1):
+        assert must_term(l, j) == j, f"term({j})"
+        assert l.match_term(j, j), f"match_term({j})"
+    unstable = l.entries_to_save()
+    assert len(unstable) == 250
+    assert unstable[0].index == 751
+    prev = l.last_index()
+    l.append([E(l.last_index() + 1, l.last_index() + 1)])
+    assert l.last_index() == prev + 1
+    ents = l.entries(l.last_index(), NO_LIMIT)
+    assert len(ents) == 1
+
+
+def test_unstable_ents():
+    previous = [E(1, 1), E(2, 2)]
+    cases = [(3, []), (1, sig(previous))]
+    for i, (unstable, wents) in enumerate(cases):
+        storage = InMemLogDB()
+        storage.append(list(previous[: unstable - 1]))
+        l = EntryLog(storage)
+        l.append(list(previous[unstable - 1 :]))
+        ents = l.entries_to_save()
+        if ents:
+            last = ents[-1]
+            l.try_commit(last.index, last.term)
+            l.commit_update(
+                UpdateCommit(
+                    processed=last.index,
+                    last_applied=last.index,
+                    stable_log_to=last.index,
+                    stable_log_term=last.term,
+                )
+            )
+        assert sig(ents) == wents, f"#{i}"
+        if ents:
+            assert l.inmem.marker_index == ents[-1].index + 1, f"#{i}"
+
+
+def test_stable_to():
+    cases = [
+        (1, 1, 1, 1),
+        (2, 2, 1, 1),
+        (2, 1, 0, 1),  # bad term
+        (3, 1, 0, 1),  # bad index
+    ]
+    for i, (stablei, stablet, saved_to, wunstable) in enumerate(cases):
+        l = EntryLog(InMemLogDB())
+        l.append([E(1, 1), E(2, 2)])
+        l.commit_update(
+            UpdateCommit(stable_log_to=stablei, stable_log_term=stablet)
+        )
+        if saved_to > 0:
+            assert l.inmem.saved_to == stablei, f"#{i}"
+        assert l.inmem.marker_index == wunstable, f"#{i}"
+
+
+def test_stable_to_with_snap():
+    snapi, snapt = 5, 2
+    cases = [
+        (snapi + 1, snapt, [], snapi + 1),
+        (snapi, snapt, [], snapi + 1),
+        (snapi - 1, snapt, [], snapi + 1),
+        (snapi + 1, snapt + 1, [], snapi + 1),
+        (snapi, snapt + 1, [], snapi + 1),
+        (snapi - 1, snapt + 1, [], snapi + 1),
+        (snapi + 1, snapt, [E(snapi + 1, snapt)], snapi + 2),
+        (snapi, snapt, [E(snapi + 1, snapt)], snapi + 1),
+        (snapi - 1, snapt, [E(snapi + 1, snapt)], snapi + 1),
+        (snapi + 1, snapt + 1, [E(snapi + 1, snapt)], snapi + 1),
+        (snapi, snapt + 1, [E(snapi + 1, snapt)], snapi + 1),
+        (snapi - 1, snapt + 1, [E(snapi + 1, snapt)], snapi + 1),
+    ]
+    for i, (stablei, stablet, new_ents, wunstable) in enumerate(cases):
+        s = InMemLogDB()
+        s.apply_snapshot(Snapshot(index=snapi, term=snapt))
+        l = EntryLog(s)
+        l.append(list(new_ents))
+        l.commit_update(
+            UpdateCommit(stable_log_to=stablei, stable_log_term=stablet)
+        )
+        assert l.inmem.saved_to == wunstable - 1, f"#{i}"
