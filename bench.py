@@ -248,7 +248,11 @@ def main() -> None:
     else:
         e2e_ok = None  # deliberately skipped ≠ failed
 
-    # ---- kernel benches (parent now takes the device; reuse the probe)
+    # ---- kernel benches (parent now takes the device; reuse the probe —
+    # unless the e2e run errored, in which case its rank-0 child may have
+    # wedged the tunnel and a fresh probe is the cheap safety check)
+    if detail.get("e2e", {}).get("error"):
+        probed = None
     platform = _resolve_platform(probed)
     on_tpu = platform not in ("cpu",)
     detail["platform"] = platform

@@ -38,6 +38,7 @@ from .rsm import (
     Task,
     TaskQueue,
 )
+from .rsm.encoded import get_encoded_payload, to_dio_compression_type
 from .rsm.statemachine import SnapshotIgnored
 from .raft.peer import Peer, PeerAddress
 from .server.message import MessageQueue
@@ -48,6 +49,7 @@ from .wire import (
     ConfigChange,
     ConfigChangeType,
     Entry,
+    EntryType,
     Membership,
     Message,
     MessageType,
@@ -82,6 +84,7 @@ class Node:
         self.snapshotter = snapshotter
         self.sm = sm
         self.tick_millisecond = tick_millisecond
+        self._entry_ct = to_dio_compression_type(config.entry_compression)
         self.raft_mu = threading.RLock()
         self.peer: Optional[Peer] = None
         # input queues
@@ -212,10 +215,18 @@ class Node:
     def propose(
         self, session: Session, cmd: bytes, timeout_s: float
     ) -> RequestState:
+        # non-empty commands are stored as ENCODED entries: 1-byte
+        # version/compression header (+ snappy when configured) — reference
+        # requests.go:1038-1042 + rsm/encoded.go
+        entry_type = EntryType.APPLICATION
+        if cmd:
+            cmd = get_encoded_payload(self._entry_ct, cmd)
+            entry_type = EntryType.ENCODED
         rs, entry = self.pending_proposals.propose(
             session.client_id, session.series_id, cmd,
             self._timeout_ticks(timeout_s),
         )
+        entry.type = entry_type
         entry.responded_to = session.responded_to
         if not self.entry_q.add(entry):
             self.pending_proposals.dropped(entry.key)

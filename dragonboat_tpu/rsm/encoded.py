@@ -1,0 +1,84 @@
+"""Encoded entry payloads: versioned 1-byte header + optional compression.
+
+Reference: ``internal/rsm/encoded.go:47-176``.  Entries proposed with a
+non-empty command are stored as ``EntryType.ENCODED`` whose cmd is:
+
+    |Version|CompressionFlag|SessionFlag|
+    | 4Bits |     3Bits     |   1Bit    |      (1 header byte)
+
+followed by the payload — raw bytes for no-compression, a snappy block
+(which embeds its own uvarint uncompressed length) for snappy.
+"""
+from __future__ import annotations
+
+from .. import dio
+from ..wire import Entry, EntryType
+
+EE_HEADER_SIZE = 1
+EE_V0 = 0 << 4
+
+EE_NO_COMPRESSION = 0 << 1
+EE_SNAPPY = 1 << 1
+
+EE_NO_SESSION = 0
+EE_HAS_SESSION = 1
+
+_VER_MASK = 15 << 4
+_CT_MASK = 7 << 1
+_SES_MASK = 1
+
+
+def to_dio_compression_type(ct: int) -> dio.CompressionType:
+    """config.CompressionType value → dio.CompressionType."""
+    if ct == 0:
+        return dio.CompressionType.NO_COMPRESSION
+    if ct == 1:
+        return dio.CompressionType.SNAPPY
+    raise ValueError(f"unknown compression type {ct}")
+
+
+def get_max_block_size(ct: int) -> int:
+    return dio.max_block_len(to_dio_compression_type(ct))
+
+
+def _header(version: int, cf: int, session: bool) -> int:
+    return version | cf | (EE_HAS_SESSION if session else EE_NO_SESSION)
+
+
+def parse_header(cmd) -> tuple:
+    h = cmd[0]
+    return h & _VER_MASK, h & _CT_MASK, bool(h & _SES_MASK)
+
+
+def get_encoded_payload(ct: dio.CompressionType, cmd) -> bytes:
+    """Reference ``GetEncodedPayload`` (v0)."""
+    if not cmd:
+        raise ValueError("empty payload")
+    if ct == dio.CompressionType.NO_COMPRESSION:
+        return bytes([_header(EE_V0, EE_NO_COMPRESSION, False)]) + bytes(cmd)
+    if ct == dio.CompressionType.SNAPPY:
+        return bytes([_header(EE_V0, EE_SNAPPY, False)]) + dio.compress_snappy_block(cmd)
+    raise ValueError(f"unknown compression type {ct}")
+
+
+def get_decoded_payload(cmd) -> bytes:
+    """Reference ``getDecodedPayload``."""
+    ver, ct, has_session = parse_header(cmd)
+    if ver != EE_V0:
+        raise ValueError(f"unknown encoded entry version {ver >> 4}")
+    if has_session:
+        raise ValueError("v0 cmd has session info")
+    if ct == EE_NO_COMPRESSION:
+        return bytes(cmd[EE_HEADER_SIZE:])
+    if ct == EE_SNAPPY:
+        return dio.decompress_snappy_block(cmd[EE_HEADER_SIZE:])
+    raise ValueError(f"unknown compression flag {ct >> 1}")
+
+
+def get_entry_payload(e: Entry) -> bytes:
+    """Payload ready for the user SM (reference ``getEntryPayload``)."""
+    if e.type in (EntryType.APPLICATION, EntryType.CONFIG_CHANGE):
+        return e.cmd
+    if e.type == EntryType.ENCODED:
+        return get_decoded_payload(e.cmd)
+    raise ValueError(f"unknown entry type {e.type}")

@@ -113,29 +113,46 @@ class BlockReader:
 
 
 class SnapshotWriter:
-    """Reference ``snapshotio.go:163`` ``SnapshotWriter``."""
+    """Reference ``snapshotio.go:163`` ``SnapshotWriter``.
 
-    def __init__(self, path: str, fs: vfs.IFS = vfs.DEFAULT):
+    With ``compression`` set (dio.CompressionType value, recorded in the
+    header's compression_type field) the payload stream — session image and
+    user SM image — is compressed before blocking; ``session_size`` always
+    refers to UNCOMPRESSED bytes so recovery splits after decompression.
+    """
+
+    def __init__(self, path: str, fs: vfs.IFS = vfs.DEFAULT, compression: int = 0):
+        from .. import dio
+
         self.path = path
         self._fs = fs
+        self.compression = int(compression)
         self._f = fs.open(path, "wb")
         self._f.write(b"\0" * Hard.snapshot_header_size)  # placeholder
         self._bw = BlockWriter(self._f)
+        self._out = (
+            dio.Compressor(dio.CompressionType(self.compression), self._bw)
+            if self.compression
+            else self._bw
+        )
         self.session_size = 0
         self._closed = False
 
     def write_session(self, data: bytes) -> None:
         self.session_size = len(data)
-        self._bw.write(data)
+        self._out.write(data)
 
     def write(self, data: bytes) -> int:
-        return self._bw.write(data)
+        self._out.write(data)
+        return len(data)
 
     def finalize(self) -> None:
+        if self._out is not self._bw:
+            self._out.close()  # flush the final compressed block
         payload_crc = self._bw.flush()
         header = bytearray(Hard.snapshot_header_size)
         _HEADER_FMT.pack_into(
-            header, 0, MAGIC, V2, 0, 0, self.session_size, payload_crc
+            header, 0, MAGIC, V2, 0, self.compression, self.session_size, payload_crc
         )
         hcrc = zlib.crc32(bytes(header[:_HEADER_CRC_OFF]))
         struct.pack_into("<I", header, _HEADER_CRC_OFF, hcrc)
@@ -156,13 +173,13 @@ class SnapshotWriter:
             self._closed = True
 
 
-def read_header(f: BinaryIO) -> Tuple[int, int, int, int]:
-    """Returns (session_size, payload_crc, version, checksum_type);
-    validates the header crc."""
+def read_header(f: BinaryIO) -> Tuple[int, int, int, int, int]:
+    """Returns (session_size, payload_crc, version, checksum_type,
+    compression_type); validates the header crc."""
     header = f.read(Hard.snapshot_header_size)
     if len(header) != Hard.snapshot_header_size:
         raise SnapshotFormatError("truncated snapshot header")
-    magic, ver, cks, _comp, session_size, payload_crc = _HEADER_FMT.unpack_from(
+    magic, ver, cks, comp, session_size, payload_crc = _HEADER_FMT.unpack_from(
         header, 0
     )
     if magic != MAGIC:
@@ -172,13 +189,15 @@ def read_header(f: BinaryIO) -> Tuple[int, int, int, int]:
     (hcrc,) = struct.unpack_from("<I", header, _HEADER_CRC_OFF)
     if zlib.crc32(header[:_HEADER_CRC_OFF]) != hcrc:
         raise SnapshotFormatError("corrupted snapshot header")
-    return session_size, payload_crc, ver, cks
+    return session_size, payload_crc, ver, cks, comp
 
 
 class SnapshotReader:
     """Reference ``snapshotio.go:272`` ``SnapshotReader``."""
 
     def __init__(self, path: str, fs: vfs.IFS = vfs.DEFAULT):
+        from .. import dio
+
         self.path = path
         self._f = fs.open(path, "rb")
         (
@@ -186,14 +205,26 @@ class SnapshotReader:
             self.payload_crc,
             self.version,
             self.checksum_type,
+            self.compression,
         ) = read_header(self._f)
         self._br = BlockReader(self._f)
+        try:
+            ct = dio.CompressionType(self.compression)
+        except ValueError as e:
+            # malformed-header class of error: callers (the snapshot
+            # validator, recovery) expect SnapshotFormatError
+            raise SnapshotFormatError(
+                f"unknown compression type {self.compression}"
+            ) from e
+        self._in = (
+            dio.Decompressor(ct, self._br) if self.compression else self._br
+        )
 
     def read_session(self) -> bytes:
-        return self._br.read(self.session_size)
+        return self._in.read(self.session_size)
 
     def read(self, n: int = -1) -> bytes:
-        return self._br.read(n)
+        return self._in.read(n)
 
     def validate_payload(self) -> None:
         self._br.read(-1)  # drain; per-block crcs verified as a side effect

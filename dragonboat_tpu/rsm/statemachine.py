@@ -29,6 +29,7 @@ from ..wire import (
 )
 from .adapters import IManagedStateMachine
 from .membership import MembershipState
+from .encoded import get_entry_payload
 from .session import SessionManager
 
 plog = get_logger("rsm")
@@ -274,7 +275,7 @@ class StateMachine:
                     self._flush_batch(batch)
                     self._advance(e, Result(), False, True, True)
                 else:
-                    batch.append((e, SMEntry(index=e.index, cmd=e.cmd)))
+                    batch.append((e, SMEntry(index=e.index, cmd=get_entry_payload(e))))
             else:
                 self._flush_batch(batch)
                 self._handle_session_entry(e)
@@ -331,7 +332,7 @@ class StateMachine:
         if ok:
             self._advance(e, cached, False, False, True)
             return
-        results = self.managed.update([SMEntry(index=e.index, cmd=e.cmd)])
+        results = self.managed.update([SMEntry(index=e.index, cmd=get_entry_payload(e))])
         result = results[0].result
         session.add_response(e.series_id, result)
         if e.responded_to > 0:

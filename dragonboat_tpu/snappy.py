@@ -1,0 +1,197 @@
+"""Pure-Python snappy block-format codec.
+
+The reference compresses entry payloads and snapshot streams with google
+snappy (``internal/utils/dio/io.go:26-36``, ``internal/rsm/encoded.go``).
+No snappy binding is available in this image, so this module implements the
+snappy *block format* directly from the public format description
+(github.com/google/snappy, format_description.txt):
+
+  preamble: uvarint length of the UNCOMPRESSED data, then a sequence of
+  elements, each starting with a tag byte whose low 2 bits select:
+    00  literal: len-1 in tag bits 2-7; 60/61/62/63 mean 1/2/3/4
+        little-endian extra length bytes follow
+    01  copy, 1-byte offset: length = 4 + ((tag>>2) & 0x7)  (4..11),
+        offset = ((tag>>5) << 8) | next byte  (<= 2047)
+    10  copy, 2-byte offset: length = 1 + (tag>>2) (1..64),
+        offset = next two bytes little-endian
+    11  copy, 4-byte offset: length = 1 + (tag>>2),
+        offset = next four bytes little-endian
+
+The compressor is a greedy single-pass matcher with a 4-byte hash table —
+the same scheme as the C++ reference — emitting 2-byte-offset copies; the
+decompressor accepts every tag form.  Output decompresses with any
+conformant snappy implementation.
+"""
+from __future__ import annotations
+
+import struct
+
+_U16 = struct.Struct("<H")
+
+MAX_BLOCK_LEN = (1 << 32) - 1
+
+
+class SnappyError(ValueError):
+    pass
+
+
+def _write_uvarint(buf: bytearray, v: int) -> None:
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            buf.append(b | 0x80)
+        else:
+            buf.append(b)
+            return
+
+
+def _read_uvarint(data, pos: int):
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise SnappyError("truncated uvarint")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise SnappyError("uvarint overflow")
+
+
+def max_encoded_len(n: int) -> int:
+    """Worst-case compressed size (mirrors snappy's MaxEncodedLen)."""
+    return 32 + n + n // 6
+
+
+def _emit_literal(out: bytearray, data, start: int, end: int) -> None:
+    length = end - start
+    while length > 0:
+        chunk = min(length, 1 << 16)  # keep extra-length bytes at <= 2
+        n = chunk - 1
+        if n < 60:
+            out.append(n << 2)
+        elif n < (1 << 8):
+            out.append(60 << 2)
+            out.append(n)
+        else:
+            out.append(61 << 2)
+            out += _U16.pack(n)
+        out += data[start : start + chunk]
+        start += chunk
+        length -= chunk
+
+
+def _emit_copy(out: bytearray, offset: int, length: int) -> None:
+    # 2-byte-offset copies, length 4..64 per op (len 1..3 tail folded into
+    # the final op by shrinking the previous one, as the C++ encoder does)
+    while length >= 4:
+        chunk = min(length, 64)
+        if length - chunk in (1, 2, 3):
+            chunk -= 4 - (length - chunk)  # leave >= 4 for the last op
+        out.append(((chunk - 1) << 2) | 0x02)
+        out += _U16.pack(offset)
+        length -= chunk
+
+
+def compress(data) -> bytes:
+    """Snappy block-format compression."""
+    data = bytes(data)
+    n = len(data)
+    out = bytearray()
+    _write_uvarint(out, n)
+    if n == 0:
+        return bytes(out)
+    if n < 4:
+        _emit_literal(out, data, 0, n)
+        return bytes(out)
+    table = {}
+    i = 0
+    lit_start = 0
+    limit = n - 3
+    while i < limit:
+        key = data[i : i + 4]
+        j = table.get(key)
+        table[key] = i
+        if j is not None and i - j <= 0xFFFF:
+            # extend the match forward
+            length = 4
+            max_len = n - i
+            while (
+                length < max_len and data[j + length] == data[i + length]
+            ):
+                length += 1
+            _emit_literal(out, data, lit_start, i)
+            _emit_copy(out, i - j, length)
+            i += length
+            lit_start = i
+        else:
+            i += 1
+    _emit_literal(out, data, lit_start, n)
+    return bytes(out)
+
+
+def uncompressed_length(data) -> int:
+    n, _ = _read_uvarint(data, 0)
+    return n
+
+
+def decompress(data) -> bytes:
+    """Snappy block-format decompression (all tag forms)."""
+    data = bytes(data)
+    n, pos = _read_uvarint(data, 0)
+    if n > MAX_BLOCK_LEN:
+        raise SnappyError("declared length too large")
+    out = bytearray()
+    dlen = len(data)
+    while pos < dlen:
+        tag = data[pos]
+        kind = tag & 0x03
+        pos += 1
+        if kind == 0:  # literal
+            length = tag >> 2
+            if length >= 60:
+                extra = length - 59  # 1..4 bytes
+                if pos + extra > dlen:
+                    raise SnappyError("truncated literal length")
+                length = int.from_bytes(data[pos : pos + extra], "little")
+                pos += extra
+            length += 1
+            if pos + length > dlen:
+                raise SnappyError("truncated literal")
+            out += data[pos : pos + length]
+            pos += length
+            continue
+        if kind == 1:  # copy, 1-byte offset
+            length = 4 + ((tag >> 2) & 0x07)
+            if pos >= dlen:
+                raise SnappyError("truncated copy1")
+            offset = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif kind == 2:  # copy, 2-byte offset
+            length = 1 + (tag >> 2)
+            if pos + 2 > dlen:
+                raise SnappyError("truncated copy2")
+            offset = _U16.unpack_from(data, pos)[0]
+            pos += 2
+        else:  # copy, 4-byte offset
+            length = 1 + (tag >> 2)
+            if pos + 4 > dlen:
+                raise SnappyError("truncated copy4")
+            offset = int.from_bytes(data[pos : pos + 4], "little")
+            pos += 4
+        if offset == 0 or offset > len(out):
+            raise SnappyError("invalid copy offset")
+        # overlapping copies are byte-at-a-time semantics
+        start = len(out) - offset
+        if offset >= length:
+            out += out[start : start + length]
+        else:
+            for k in range(length):
+                out.append(out[start + k])
+    if len(out) != n:
+        raise SnappyError(f"length mismatch: got {len(out)}, want {n}")
+    return bytes(out)

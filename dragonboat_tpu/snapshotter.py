@@ -69,7 +69,7 @@ class Snapshotter:
         env.remove_tmp_dir()
         env.create_tmp_dir()
         path = env.get_tmp_filepath()
-        w = SnapshotWriter(path, self.fs)
+        w = SnapshotWriter(path, self.fs, compression=meta.compression)
         try:
             savable.save_snapshot_payload(meta, w)
             w.finalize()

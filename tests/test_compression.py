@@ -1,0 +1,315 @@
+"""Entry/snapshot compression tests.
+
+Covers: the pure-Python snappy block codec (roundtrip, format-level decode
+of hand-built streams for every tag form, random fuzz), the dio
+Compressor/Decompressor stream pair + CountedWriter
+(``internal/utils/dio/io.go``), the v0 encoded-entry payloads
+(``internal/rsm/encoded.go:47-176``), snapshot-file compression honored via
+the header's compression field, and the end-to-end claim from VERDICT r2
+item 4: proposing with ``entry_compression=SNAPPY`` stores smaller entries.
+"""
+from __future__ import annotations
+
+import io
+import os
+import random
+import struct
+
+import pytest
+
+from dragonboat_tpu import dio, snappy
+from dragonboat_tpu.rsm import encoded
+from dragonboat_tpu.wire import Entry, EntryType
+
+
+# ---------------------------------------------------------------- snappy
+
+def test_snappy_roundtrip_basic():
+    for data in (
+        b"",
+        b"a",
+        b"abc",
+        b"aaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaa",
+        b"0123456789abcdef" * 100,
+        bytes(range(256)) * 10,
+        b"x" * 100000,
+    ):
+        assert snappy.decompress(snappy.compress(data)) == data
+
+
+def test_snappy_compresses_repetitive_data():
+    data = b"0123456789abcdef" * 4096  # 64KB of repetition
+    comp = snappy.compress(data)
+    assert len(comp) < len(data) // 10
+    assert snappy.decompress(comp) == data
+
+
+def test_snappy_uncompressed_length():
+    data = b"hello world" * 7
+    assert snappy.uncompressed_length(snappy.compress(data)) == len(data)
+
+
+def test_snappy_decode_handbuilt_tags():
+    # stream built tag-by-tag from the public format description:
+    # literal "abcd", then copy2 (offset 4, len 4) => "abcdabcd"
+    s = bytearray()
+    s.append(8)           # uvarint uncompressed len = 8
+    s.append((4 - 1) << 2)  # literal, len 4
+    s += b"abcd"
+    s.append(((4 - 1) << 2) | 0x02)  # copy2, len 4
+    s += struct.pack("<H", 4)
+    assert snappy.decompress(bytes(s)) == b"abcdabcd"
+
+    # copy with 1-byte offset: literal "ab", copy1 len 4 offset 2 -> ababab
+    s = bytearray()
+    s.append(6)
+    s.append((2 - 1) << 2)
+    s += b"ab"
+    s.append(((4 - 4) << 2) | 0x01)  # copy1, len 4, offset high bits 0
+    s.append(2)                      # offset low byte
+    assert snappy.decompress(bytes(s)) == b"ababab"
+
+    # copy with 4-byte offset
+    s = bytearray()
+    s.append(8)
+    s.append((4 - 1) << 2)
+    s += b"wxyz"
+    s.append(((4 - 1) << 2) | 0x03)  # copy4, len 4
+    s += struct.pack("<I", 4)
+    assert snappy.decompress(bytes(s)) == b"wxyzwxyz"
+
+    # overlapping copy (offset < len): run-length semantics
+    s = bytearray()
+    s.append(9)
+    s.append((1 - 1) << 2)
+    s += b"q"
+    s.append(((8 - 1) << 2) | 0x02)
+    s += struct.pack("<H", 1)
+    assert snappy.decompress(bytes(s)) == b"q" * 9
+
+
+def test_snappy_rejects_corrupt():
+    with pytest.raises(snappy.SnappyError):
+        snappy.decompress(b"")
+    with pytest.raises(snappy.SnappyError):
+        snappy.decompress(b"\x05\x00")  # truncated literal
+    # bad copy offset (no output yet)
+    bad = bytes([4, ((4 - 1) << 2) | 0x02, 9, 0])
+    with pytest.raises(snappy.SnappyError):
+        snappy.decompress(bad)
+
+
+def test_snappy_fuzz_roundtrip():
+    rng = random.Random(42)
+    for _ in range(200):
+        n = rng.randrange(0, 4096)
+        kind = rng.randrange(3)
+        if kind == 0:
+            data = bytes(rng.getrandbits(8) for _ in range(n))
+        elif kind == 1:
+            unit = bytes(rng.getrandbits(8) for _ in range(rng.randrange(1, 17)))
+            data = (unit * (n // max(1, len(unit)) + 1))[:n]
+        else:
+            data = bytes(rng.choice(b"ab") for _ in range(n))
+        assert snappy.decompress(snappy.compress(data)) == data
+
+
+# ------------------------------------------------------------------- dio
+
+def test_dio_stream_roundtrip():
+    payload = os.urandom(1024) + b"z" * (3 * dio.BLOCK_SIZE) + b"tail"
+    buf = io.BytesIO()
+    c = dio.Compressor(dio.CompressionType.SNAPPY, buf)
+    # write in awkward chunk sizes
+    view = memoryview(payload)
+    i = 0
+    for sz in (1, 10, 100000, dio.BLOCK_SIZE, len(payload)):
+        c.write(view[i : i + sz])
+        i += sz
+        if i >= len(payload):
+            break
+    c.write(view[i:])
+    c.close()
+    assert buf.tell() < len(payload) // 2  # the z-runs compress
+    buf.seek(0)
+    d = dio.Decompressor(dio.CompressionType.SNAPPY, buf)
+    assert d.read(-1) == payload
+
+
+def test_dio_stream_partial_reads():
+    payload = b"0123456789" * 1000
+    buf = io.BytesIO()
+    c = dio.Compressor(dio.CompressionType.SNAPPY, buf)
+    c.write(payload)
+    c.close()
+    buf.seek(0)
+    d = dio.Decompressor(dio.CompressionType.SNAPPY, buf)
+    out = b""
+    while True:
+        chunk = d.read(333)
+        if not chunk:
+            break
+        out += chunk
+    assert out == payload
+
+
+def test_counted_writer():
+    buf = io.BytesIO()
+    cw = dio.CountedWriter(buf)
+    cw.write(b"abc")
+    cw.write(b"defg")
+    with pytest.raises(RuntimeError):
+        cw.bytes_written()
+    cw.close()
+    assert cw.bytes_written() == 7
+
+
+# --------------------------------------------------------------- encoded
+
+def test_encoded_payload_roundtrip():
+    for ct in (dio.CompressionType.NO_COMPRESSION, dio.CompressionType.SNAPPY):
+        for cmd in (b"x", b"hello world" * 50, os.urandom(300)):
+            enc = encoded.get_encoded_payload(ct, cmd)
+            ver, flag, ses = encoded.parse_header(enc)
+            assert ver == encoded.EE_V0
+            assert not ses
+            assert encoded.get_decoded_payload(enc) == cmd
+
+
+def test_encoded_payload_smaller_with_snappy():
+    cmd = b"the same sixteen " * 256
+    raw = encoded.get_encoded_payload(dio.CompressionType.NO_COMPRESSION, cmd)
+    comp = encoded.get_encoded_payload(dio.CompressionType.SNAPPY, cmd)
+    assert len(raw) == len(cmd) + 1
+    assert len(comp) < len(raw) // 4
+
+
+def test_encoded_empty_payload_rejected():
+    with pytest.raises(ValueError):
+        encoded.get_encoded_payload(dio.CompressionType.SNAPPY, b"")
+
+
+def test_get_entry_payload_by_type():
+    e = Entry(type=EntryType.APPLICATION, cmd=b"plain")
+    assert encoded.get_entry_payload(e) == b"plain"
+    enc = encoded.get_encoded_payload(dio.CompressionType.SNAPPY, b"squeeze me" * 20)
+    e = Entry(type=EntryType.ENCODED, cmd=enc)
+    assert encoded.get_entry_payload(e) == b"squeeze me" * 20
+
+
+def test_mixed_version_read():
+    """A log can mix plain APPLICATION entries (older writers) with ENCODED
+    entries; the apply path must handle both."""
+    cmds = [b"old-style", b"new-style" * 30]
+    entries = [
+        Entry(type=EntryType.APPLICATION, cmd=cmds[0]),
+        Entry(
+            type=EntryType.ENCODED,
+            cmd=encoded.get_encoded_payload(dio.CompressionType.SNAPPY, cmds[1]),
+        ),
+    ]
+    assert [encoded.get_entry_payload(e) for e in entries] == cmds
+
+
+# ---------------------------------------------------- snapshot file path
+
+def test_snapshot_file_compression(tmp_path):
+    from dragonboat_tpu.rsm.snapshotio import SnapshotReader, SnapshotWriter
+
+    payload = (b"session-image-" * 64, b"sm-image " * 50000)
+    sizes = {}
+    for comp in (0, 1):
+        path = str(tmp_path / f"snap-{comp}.gbsnap")
+        w = SnapshotWriter(path, compression=comp)
+        w.write_session(payload[0])
+        w.write(payload[1])
+        w.finalize()
+        sizes[comp] = os.path.getsize(path)
+        r = SnapshotReader(path)
+        assert r.compression == comp
+        assert r.read_session() == payload[0]
+        assert r.read(-1) == payload[1]
+        r.validate_payload()
+        r.close()
+    assert sizes[1] < sizes[0] // 4
+
+
+def test_entry_compression_end_to_end():
+    """Proposing with entry_compression=SNAPPY stores a smaller entry in the
+    raft log than with NO_COMPRESSION (VERDICT r2 item 4 done-criterion)."""
+    import time
+
+    from dragonboat_tpu import Config, NodeHostConfig
+    from dragonboat_tpu.config import ExpertConfig
+    from dragonboat_tpu.nodehost import NodeHost
+    from dragonboat_tpu.transport import ChanRouter, ChanTransport
+    from dragonboat_tpu.statemachine import Result
+
+    class SM:
+        def __init__(self, c, n):
+            self.seen = []
+
+        def update(self, cmd):
+            self.seen.append(bytes(cmd))
+            return Result(value=len(cmd))
+
+        def lookup(self, q):
+            return self.seen
+
+        def save_snapshot(self, w, files, done):
+            w.write(b"\0")
+
+        def recover_from_snapshot(self, r, files, done):
+            r.read()
+
+        def close(self):
+            pass
+
+    cmd = b"compressible payload " * 100  # 2100B, highly repetitive
+    stored = {}
+    for comp in (0, 1):
+        router = ChanRouter()
+        nhs = [
+            NodeHost(
+                NodeHostConfig(
+                    node_host_dir=":memory:",
+                    rtt_millisecond=100,
+                    raft_address=f"c{comp}-{i}:1",
+                    raft_rpc_factory=lambda s, rh, ch: ChanTransport(
+                        s, rh, ch, router=router
+                    ),
+                    expert=ExpertConfig(quorum_engine="scalar"),
+                )
+            )
+            for i in (1, 2, 3)
+        ]
+        addrs = {i: f"c{comp}-{i}:1" for i in (1, 2, 3)}
+        for i, nh in enumerate(nhs, 1):
+            nh.start_cluster(
+                addrs, False, SM,
+                Config(cluster_id=7, node_id=i, election_rtt=10,
+                       heartbeat_rtt=1, entry_compression=comp),
+            )
+        nhs[0].get_node(7).request_campaign()
+        deadline = time.time() + 30
+        leader = None
+        while leader is None and time.time() < deadline:
+            for nh in nhs:
+                lid, ok = nh.get_leader_id(7)
+                if ok:
+                    leader = nhs[lid - 1]
+                    break
+            time.sleep(0.02)
+        s = leader.get_noop_session(7)
+        rs = leader.propose(s, cmd, timeout=10.0)
+        assert rs.wait(10.0).completed
+        node = leader.get_node(7)
+        ents = node.peer.raft.log.get_entries(1, node.peer.raft.log.last_index() + 1, 1 << 62)
+        payload_entry = next(e for e in ents if e.type == EntryType.ENCODED)
+        stored[comp] = len(payload_entry.cmd)
+        # the user SM must still see the original command
+        applied = leader.get_node(7).sm.lookup(None)
+        assert cmd in applied
+        for nh in nhs:
+            nh.stop()
+    assert stored[1] < stored[0] // 4, stored
