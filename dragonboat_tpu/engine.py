@@ -165,6 +165,21 @@ class Engine:
         # step_s]
         self._step_stats = [[0, 0, 0, 0.0] for _ in range(step_workers)]
         self._committers = [_Committer(self, i) for i in range(step_workers)]
+        # dedicated snapshot worker pool (reference execengine.go:240-635,
+        # 64 workers): multi-second SM save/recover/stream work must never
+        # block the apply workers — a slow user snapshot on one group would
+        # stall every group sharing that apply worker
+        import queue as _queue
+
+        self._ss_q: "_queue.Queue" = _queue.Queue()
+        snapshot_workers = max(2, min(8, step_workers * 2))
+        for i in range(snapshot_workers):
+            t = threading.Thread(
+                target=self._snapshot_worker_main,
+                name=f"snapshot-worker-{i}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
         for i in range(step_workers):
             t = threading.Thread(
                 target=self._step_worker_main, args=(i,),
@@ -345,6 +360,20 @@ class Engine:
                 except Exception:
                     plog.exception("apply worker %d failed on %d", idx, cid)
 
+    def submit_snapshot(self, fn) -> None:
+        """Queue snapshot save/stream work onto the dedicated pool."""
+        self._ss_q.put(fn)
+
+    def _snapshot_worker_main(self) -> None:
+        while True:
+            fn = self._ss_q.get()
+            if fn is None or self._stopped.is_set():
+                return
+            try:
+                fn()
+            except Exception:
+                plog.exception("snapshot worker task failed")
+
     def stop(self) -> None:
         import os
 
@@ -358,6 +387,8 @@ class Engine:
             self._prof = None
         self._stopped.set()
         self.notify_all()
+        for _ in range(32):  # wake every snapshot worker
+            self._ss_q.put(None)
         for c in self._committers:
             c.join()
         for t in self._threads:

@@ -1,0 +1,91 @@
+"""Delayed snapshot-status feedback to raft.
+
+Reference: ``feedback.go:23-129`` ``snapshotFeedback``.  When the transport
+finishes (or fails) sending a snapshot to a follower, the status must not
+reach raft immediately: the follower still needs time to install the image,
+and reporting success too early moves its progress tracker out of the
+Snapshot state before it can accept appends.  Instead the status is parked
+with a long release delay; when the follower's SNAPSHOT_RECEIVED ack
+arrives, the release is rescheduled much sooner.  If pushing the status
+into the node's queue fails, it is retried shortly after — a dropped
+status message therefore cannot strand a follower in Snapshot state.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Tuple
+
+# delays in milliseconds (reference ticks are 1ms: feedback.go:24-27)
+PUSH_DELAY_MS = 20000
+CONFIRMED_DELAY_MS = 1500
+RETRY_DELAY_MS = 200
+
+
+class _Status:
+    __slots__ = ("cluster_id", "node_id", "release_ms", "failed")
+
+    def __init__(self, cluster_id, node_id, release_ms, failed):
+        self.cluster_id = cluster_id
+        self.node_id = node_id
+        self.release_ms = release_ms
+        self.failed = failed
+
+
+class SnapshotFeedback:
+    """push_fn(cluster_id, node_id, failed) -> bool (True = delivered)."""
+
+    def __init__(
+        self,
+        push_fn: Callable[[int, int, bool], bool],
+        push_delay_ms: int = PUSH_DELAY_MS,
+        confirmed_delay_ms: int = CONFIRMED_DELAY_MS,
+        retry_delay_ms: int = RETRY_DELAY_MS,
+    ):
+        self._pf = push_fn
+        self._mu = threading.Lock()
+        self._pendings: Dict[Tuple[int, int], _Status] = {}
+        self.push_delay_ms = push_delay_ms
+        self.confirmed_delay_ms = confirmed_delay_ms
+        self.retry_delay_ms = retry_delay_ms
+
+    def add_status(self, cluster_id: int, node_id: int, failed: bool, now_ms: int) -> None:
+        """Transport finished a snapshot send (reference addStatus)."""
+        with self._mu:
+            self._pendings[(cluster_id, node_id)] = _Status(
+                cluster_id, node_id, now_ms + self.push_delay_ms, failed
+            )
+
+    def confirm(self, cluster_id: int, node_id: int, now_ms: int) -> None:
+        """The follower acked with SNAPSHOT_RECEIVED (reference confirm):
+        release a success status soon."""
+        with self._mu:
+            self._pendings[(cluster_id, node_id)] = _Status(
+                cluster_id, node_id, now_ms + self.confirmed_delay_ms, False
+            )
+
+    def _get_ready(self, now_ms: int) -> List[_Status]:
+        with self._mu:
+            ready = [s for s in self._pendings.values() if s.release_ms < now_ms]
+            for s in ready:
+                del self._pendings[(s.cluster_id, s.node_id)]
+            return ready
+
+    def push_ready(self, now_ms: int) -> None:
+        """Called from the tick loop (reference pushReady)."""
+        ready = self._get_ready(now_ms)
+        if not ready:
+            return
+        retry = [s for s in ready if not self._pf(s.cluster_id, s.node_id, s.failed)]
+        if retry:
+            with self._mu:
+                for s in retry:
+                    self._pendings[(s.cluster_id, s.node_id)] = _Status(
+                        s.cluster_id,
+                        s.node_id,
+                        now_ms + self.retry_delay_ms,
+                        s.failed,
+                    )
+
+    def pending_count(self) -> int:
+        with self._mu:
+            return len(self._pendings)

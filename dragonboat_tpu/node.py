@@ -303,11 +303,15 @@ class Node:
         self.mq.add(Message(type=MT.ELECTION, from_=self.node_id))
         self.nh.engine.set_step_ready(self.cluster_id)
 
-    def handle_snapshot_status(self, node_id: int, failed: bool) -> None:
-        self.mq.add(
+    def handle_snapshot_status(self, node_id: int, failed: bool) -> bool:
+        """Returns True when queued (the feedback tracker retries on
+        False — reference pushfunc feedback.go:36)."""
+        if not self.mq.add(
             Message(type=MT.SNAPSHOT_STATUS, from_=node_id, reject=failed)
-        )
+        ):
+            return False
         self.nh.engine.set_step_ready(self.cluster_id)
+        return True
 
     def handle_unreachable(self, node_id: int) -> None:
         self.mq.add(Message(type=MT.UNREACHABLE, from_=node_id))
@@ -556,7 +560,14 @@ class Node:
             if self._stopped.is_set():
                 return
             if t.save:
-                self._save_snapshot(t)
+                # snapshot saves run on the dedicated pool (reference
+                # execengine.go:240-635) so a slow user save_snapshot never
+                # blocks the other groups sharing this apply worker; the
+                # regular-SM save/update lock in rsm.StateMachine keeps the
+                # image consistent against concurrent applies
+                self.nh.engine.submit_snapshot(
+                    lambda t=t: self._save_snapshot(t)
+                )
             elif t.recover:
                 self._recover_from_snapshot(t)
             else:

@@ -84,6 +84,21 @@ class NodeHost:
         )
         # storage
         in_memory = nhconfig.node_host_dir == ":memory:"
+        # directory management: deployment-id layout + flock + compat flag
+        # file (reference internal/server/context.go:73-378).  A second
+        # NodeHost on the same dir fails fast; a changed hard setting
+        # refuses to open instead of corrupting data.
+        self.server_ctx = None
+        if not in_memory:
+            from .server.context import ServerContext
+
+            self.server_ctx = ServerContext(nhconfig)
+            did = nhconfig.get_deployment_id()
+            data_dir, _ = self.server_ctx.create_nodehost_dir(did)
+            self.server_ctx.lock_nodehost_dir()
+            self.server_ctx.check_nodehost_dir(
+                did, nhconfig.raft_address, "nativekv"
+            )
         # shard-count priority: expert override > logdb config.  Aligning
         # shards with the step-worker count reproduces the reference's
         # DoubleFixedPartitioner geometry (server/partition.go:59): one
@@ -95,10 +110,21 @@ class NodeHost:
             self.logdb = open_logdb("", shards=shards)
         else:
             self.logdb = open_logdb(
-                os.path.join(self._host_dir(), "logdb"),
+                os.path.join(data_dir, "logdb"),
                 shards=shards,
                 fsync=nhconfig.logdb_config.fsync,
             )
+        # delayed snapshot-status feedback (reference feedback.go:23-129):
+        # transport-reported send status is parked and released to raft
+        # later; the follower's SNAPSHOT_RECEIVED ack accelerates it.
+        # Created before the transport so an early inbound message can't
+        # race the attribute into existence.
+        from .feedback import SnapshotFeedback
+
+        self.snapshot_feedback = SnapshotFeedback(
+            self._push_snapshot_status,
+            push_delay_ms=Soft.snapshot_status_push_delay_ms,
+        )
         # transport
         self.node_registry = Registry()
         self.transport: Transport = create_transport(
@@ -109,6 +135,7 @@ class NodeHost:
             unreachable_handler=self._unreachable,
             snapshot_dir_fn=self.snapshot_dir,
             sys_events=self.sys_events,
+            snapshot_received_handler=self._snapshot_received,
         )
         self.logdb.on_compaction = lambda cid, nid: self.sys_events.publish(
             SystemEvent(
@@ -143,21 +170,17 @@ class NodeHost:
 
     # ---- dirs ----
 
-    def _host_dir(self) -> str:
-        d = os.path.join(
-            self.nhconfig.node_host_dir,
-            self.nhconfig.raft_address.replace(":", "_"),
-        )
-        os.makedirs(d, exist_ok=True)
-        return d
-
     def snapshot_dir(self, cluster_id: int, node_id: int) -> str:
-        if self.nhconfig.node_host_dir == ":memory:":
-            base = os.path.join("/tmp", "dragonboat-tpu-mem", self.raft_address().replace(":", "_"))
-        else:
-            base = self._host_dir()
-        return os.path.join(
-            base, "snapshot", f"{cluster_id:020d}-{node_id:020d}"
+        if self.server_ctx is None:
+            base = os.path.join(
+                "/tmp", "dragonboat-tpu-mem",
+                self.raft_address().replace(":", "_"),
+            )
+            return os.path.join(
+                base, "snapshot", f"{cluster_id:020d}-{node_id:020d}"
+            )
+        return self.server_ctx.get_snapshot_dir(
+            self.nhconfig.get_deployment_id(), cluster_id, node_id
         )
 
     def raft_address(self) -> str:
@@ -357,6 +380,8 @@ class NodeHost:
             self.quorum_coordinator.stop()
         self.transport.stop()
         self.logdb.close()
+        if self.server_ctx is not None:
+            self.server_ctx.stop()
         self.sys_events.stop()
 
     # ---- proposals / reads (reference SyncPropose :523, SyncRead :548) ----
@@ -618,6 +643,14 @@ class NodeHost:
         touched = {}
         src = batch.source_address
         for m in batch.requests:
+            if m.type == MessageType.SNAPSHOT_RECEIVED:
+                # follower's ack for a sent snapshot: accelerates the
+                # parked status release; never delivered to raft
+                # (reference nodehost.go:2039-2044)
+                self.snapshot_feedback.confirm(
+                    m.cluster_id, m.from_, self._now_ms()
+                )
+                continue
             node = self._clusters.get(m.cluster_id)
             if node is None or node.node_id != m.to:
                 continue
@@ -631,10 +664,37 @@ class NodeHost:
         for cid in touched:
             engine.set_step_ready(cid)
 
+    def _now_ms(self) -> int:
+        return int(time.monotonic() * 1000)
+
     def _snapshot_status(self, cluster_id: int, node_id: int, failed: bool):
+        """Transport finished a snapshot send: park the status with the
+        feedback tracker instead of reporting to raft immediately
+        (reference messageHandler.HandleSnapshotStatus nodehost.go:2063)."""
+        self.snapshot_feedback.add_status(
+            cluster_id, node_id, failed, self._now_ms()
+        )
+
+    def _push_snapshot_status(
+        self, cluster_id: int, node_id: int, failed: bool
+    ) -> bool:
         node = self._clusters.get(cluster_id)
-        if node is not None:
-            node.handle_snapshot_status(node_id, failed)
+        if node is None:
+            return True  # group gone; nothing to deliver
+        return node.handle_snapshot_status(node_id, failed)
+
+    def _snapshot_received(self, cluster_id: int, node_id: int, from_: int) -> None:
+        """A streamed/chunked snapshot finished arriving: ack the sender so
+        its feedback tracker releases the status quickly (reference
+        messageHandler.HandleSnapshot nodehost.go:2090)."""
+        self.send_message(
+            Message(
+                type=MessageType.SNAPSHOT_RECEIVED,
+                cluster_id=cluster_id,
+                from_=node_id,
+                to=from_,
+            )
+        )
 
     def _unreachable(self, cluster_id: int, node_id: int) -> None:
         node = self._clusters.get(cluster_id)
@@ -653,6 +713,7 @@ class NodeHost:
             for n in nodes:
                 if n is not None:
                     n.request_tick()
+            self.snapshot_feedback.push_ready(self._now_ms())
             if ticks % max(1, int(1.0 / max(interval, 0.001))) == 0:
                 self.transport.tick()
 

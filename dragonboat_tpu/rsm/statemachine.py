@@ -167,6 +167,15 @@ class StateMachine:
         self.sessions = SessionManager()
         self.members = MembershipState(cluster_id, node_id, ordered_config_change)
         self._mu = threading.RLock()
+        # regular (non-concurrent) SMs must not be mutated while a snapshot
+        # of them is being written: the apply path and the snapshot pool
+        # serialize on this lock (reference statemachine.go:761 holds the
+        # SM RLock for the whole regular save).  Concurrent/on-disk SMs
+        # snapshot from a prepared context and skip it.
+        self._update_mu = threading.RLock()
+        # serializes whole snapshot save/recover operations of this SM
+        # (see save() docstring); always acquired BEFORE _update_mu
+        self._save_mu = threading.RLock()
         # watermarks (reference statemachine.go index/term fields)
         self.last_applied = 0
         self.last_applied_term = 0
@@ -290,7 +299,8 @@ class StateMachine:
         if not batch:
             return
         sm_entries = [se for _, se in batch]
-        results = self.managed.update(sm_entries)
+        with self._update_mu:
+            results = self.managed.update(sm_entries)
         if len(results) != len(sm_entries):
             raise RuntimeError("update dropped entries")
         for (e, _), se in zip(batch, results):
@@ -332,7 +342,10 @@ class StateMachine:
         if ok:
             self._advance(e, cached, False, False, True)
             return
-        results = self.managed.update([SMEntry(index=e.index, cmd=get_entry_payload(e))])
+        with self._update_mu:
+            results = self.managed.update(
+                [SMEntry(index=e.index, cmd=get_entry_payload(e))]
+            )
         result = results[0].result
         session.add_response(e.series_id, result)
         if e.responded_to > 0:
@@ -384,10 +397,33 @@ class StateMachine:
             self.managed.save_snapshot(meta.ctx, writer, None, self.stopc)
 
     def save(self, req: SSRequest) -> Tuple[Snapshot, object]:
-        """Full snapshot save via the snapshotter.  Regular SMs are locked
-        for the duration; concurrent/on-disk save from the prepared ctx."""
+        """Full snapshot save via the snapshotter.
+
+        ``_save_mu`` serializes saves of this SM (a user-requested and a
+        periodic save can otherwise run concurrently on two pool workers and
+        clobber each other's identically-named temp dir — the reference
+        serializes per group via the snapshotState single-slot handoff,
+        ``snapshotstate.go:65``).  Regular SMs additionally hold
+        ``_update_mu`` across BOTH the meta capture and the image write:
+        capturing meta.index first and locking later would let applies land
+        in between and the image would reflect state newer than its label —
+        double-apply after recovery."""
         if self.snapshotter is None:
             raise RuntimeError("no snapshotter configured")
+        with self._save_mu:
+            if self.concurrent_snapshot or self.on_disk:
+                meta = self._checked_meta(req)
+                ss, env = self.snapshotter.save(self, meta)
+            else:
+                with self._update_mu:
+                    meta = self._checked_meta(req)
+                    ss, env = self.snapshotter.save(self, meta)
+        with self._mu:
+            if not req.exported and ss.index > self.snapshot_index:
+                self.snapshot_index = ss.index
+        return ss, env
+
+    def _checked_meta(self, req: SSRequest) -> SSMeta:
         meta = self.prepare_snapshot(req)
         if meta.index < self.on_disk_init_index:
             raise SnapshotIgnored("nothing new to snapshot")
@@ -395,17 +431,17 @@ class StateMachine:
             meta.from_index >= meta.index and not req.exported
         ):
             raise SnapshotIgnored("no progress since last snapshot")
-        ss, env = self.snapshotter.save(self, meta)
-        with self._mu:
-            if not req.exported and ss.index > self.snapshot_index:
-                self.snapshot_index = ss.index
-        return ss, env
+        return meta
 
     # ---- snapshot recover (reference Recover :228-341) ----
 
     def recover(self, t: Task) -> Optional[Snapshot]:
         """Recover from the snapshot carried by ``t`` (install) or the newest
-        local snapshot (restart)."""
+        local snapshot (restart).
+
+        Lock order matches save(): ``_save_mu`` then ``_update_mu`` — an
+        install arriving while a pool worker is still writing an image of
+        this SM must not overwrite the state mid-serialization."""
         if self.snapshotter is None:
             raise RuntimeError("no snapshotter configured")
         ss = t.ss
@@ -418,7 +454,9 @@ class StateMachine:
             # SM's own store already covers it; just adopt metadata
             self._post_recover(ss)
             return ss
-        self.snapshotter.recover(self, ss)
+        with self._save_mu:
+            with self._update_mu:
+                self.snapshotter.recover(self, ss)
         self._post_recover(ss)
         return ss
 

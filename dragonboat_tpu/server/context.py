@@ -1,0 +1,207 @@
+"""NodeHost directory management: layout, locking, compatibility checks.
+
+Reference: ``internal/server/context.go:73-378`` — deployment-id based
+directory layout under ``<node_host_dir>/<hostname>/<did>``, ``flock``-held
+LOCK files so a second NodeHost on the same data directory fails fast, and
+the ``dragonboat.ds`` flag file (``raftpb.RaftDataStatus``) recording the
+owner address/hostname/deployment-id plus the hard-settings hash
+(``internal/settings/hard.go:124-137``) so an incompatible change refuses
+to open the store instead of corrupting it.
+"""
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import socket
+import zlib
+from typing import Dict, Optional
+
+from ..settings import Hard
+from .partition import FixedPartitioner
+
+LOCK_FILENAME = "LOCK"
+FLAG_FILENAME = "dragonboat-tpu.ds"
+DEFAULT_CLUSTER_ID_MOD = 16
+BIN_VER = 1  # on-disk LogDB binary format version
+
+
+class ContextError(Exception):
+    pass
+
+
+class LockDirectoryError(ContextError):
+    """Another live NodeHost holds the directory lock."""
+
+
+class NotOwnerError(ContextError):
+    """The directory belongs to a NodeHost with a different raft address."""
+
+
+class HostnameChangedError(ContextError):
+    pass
+
+
+class DeploymentIDChangedError(ContextError):
+    pass
+
+
+class HardSettingsChangedError(ContextError):
+    """A data-format-affecting (hard) setting differs from the one the
+    directory was created with."""
+
+
+class IncompatibleDataError(ContextError):
+    pass
+
+
+class ServerContext:
+    """Reference ``server.Context``."""
+
+    def __init__(self, nhconfig):
+        self.nhconfig = nhconfig
+        self.hostname = socket.gethostname() or "localhost"
+        self.partitioner = FixedPartitioner(DEFAULT_CLUSTER_ID_MOD)
+        self._flocks: Dict[str, object] = {}
+
+    # ---- layout ----
+
+    @staticmethod
+    def _did_dirname(did: int) -> str:
+        return f"{did:020d}"
+
+    def _data_dirs(self):
+        dir_ = self.nhconfig.node_host_dir
+        lldir = getattr(self.nhconfig, "wal_dir", "") or dir_
+        return dir_, lldir
+
+    def get_logdb_dirs(self, did: int):
+        """(data dir, low-latency WAL dir) for this deployment.
+
+        The hostname is recorded in the flag file, NOT the path: embedding
+        it in the layout would give a renamed host a fresh empty directory
+        — silently discarding its log and vote record — and the
+        HostnameChangedError check could never fire."""
+        dir_, lldir = self._data_dirs()
+        sub = self._did_dirname(did)
+        return os.path.join(dir_, sub), os.path.join(lldir, sub)
+
+    def get_snapshot_dir(self, did: int, cluster_id: int, node_id: int) -> str:
+        part = self.partitioner.get_partition_id(cluster_id)
+        return os.path.join(
+            self.nhconfig.node_host_dir,
+            self._did_dirname(did),
+            f"snapshot-part-{part}",
+            f"snapshot-{cluster_id}-{node_id}",
+        )
+
+    def create_nodehost_dir(self, did: int):
+        dir_, lldir = self.get_logdb_dirs(did)
+        os.makedirs(dir_, exist_ok=True)
+        os.makedirs(lldir, exist_ok=True)
+        return dir_, lldir
+
+    def create_snapshot_dir(self, did: int, cluster_id: int, node_id: int) -> str:
+        d = self.get_snapshot_dir(did, cluster_id, node_id)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    # ---- locking (reference LockNodeHostDir / tryLockNodeHostDir) ----
+
+    def lock_nodehost_dir(self) -> None:
+        for d in set(self.get_logdb_dirs(self.nhconfig.get_deployment_id())):
+            self._try_lock(d)
+
+    def _try_lock(self, dirname: str) -> None:
+        fp = os.path.join(dirname, LOCK_FILENAME)
+        if fp in self._flocks:
+            return
+        f = open(fp, "a+")
+        try:
+            fcntl.flock(f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError as e:
+            f.close()
+            raise LockDirectoryError(
+                f"directory {dirname!r} is locked by another NodeHost"
+            ) from e
+        self._flocks[fp] = f
+
+    # ---- compatibility flag file (reference checkNodeHostDir/check) ----
+
+    def check_nodehost_dir(self, did: int, addr: str, logdb_type: str) -> None:
+        for d in set(self.get_logdb_dirs(did)):
+            self._check(d, did, addr, logdb_type)
+
+    def _flag_path(self, dirname: str) -> str:
+        return os.path.join(dirname, FLAG_FILENAME)
+
+    def _check(self, dirname: str, did: int, addr: str, logdb_type: str) -> None:
+        fp = self._flag_path(dirname)
+        if not os.path.exists(fp):
+            self._create_flag_file(dirname, did, addr, logdb_type)
+            return
+        s = self._read_flag_file(fp)
+        same = lambda a, b: str(a).strip().lower() == str(b).strip().lower()
+        if not same(s.get("address", ""), addr):
+            raise NotOwnerError(
+                f"{dirname!r} belongs to {s.get('address')!r}, not {addr!r}"
+            )
+        if s.get("hostname") and not same(s["hostname"], self.hostname):
+            raise HostnameChangedError(
+                f"hostname changed: {s['hostname']!r} -> {self.hostname!r}"
+            )
+        if s.get("deployment_id", 0) and s["deployment_id"] != did:
+            raise DeploymentIDChangedError(
+                f"deployment id changed: {s['deployment_id']} -> {did}"
+            )
+        if s.get("bin_ver") != BIN_VER:
+            raise IncompatibleDataError(
+                f"binary format {s.get('bin_ver')} != {BIN_VER}"
+            )
+        if s.get("hard_hash") != Hard.hash():
+            raise HardSettingsChangedError(
+                "hard settings changed since this directory was created"
+            )
+
+    def _create_flag_file(self, dirname: str, did: int, addr: str, logdb_type: str) -> None:
+        payload = json.dumps(
+            {
+                "address": addr,
+                "hostname": self.hostname,
+                "deployment_id": did,
+                "bin_ver": BIN_VER,
+                "logdb_type": logdb_type,
+                "hard_hash": Hard.hash(),
+                "step_worker_count": Hard.step_engine_worker_count,
+                "logdb_shard_count": Hard.logdb_pool_size,
+                "max_session_count": Hard.lru_max_session_count,
+                "entry_batch_size": Hard.logdb_entry_batch_size,
+            },
+            sort_keys=True,
+        ).encode()
+        crc = zlib.crc32(payload)
+        tmp = self._flag_path(dirname) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(crc.to_bytes(4, "little") + payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._flag_path(dirname))
+
+    @staticmethod
+    def _read_flag_file(fp: str) -> dict:
+        with open(fp, "rb") as f:
+            raw = f.read()
+        if len(raw) < 4 or zlib.crc32(raw[4:]) != int.from_bytes(raw[:4], "little"):
+            raise IncompatibleDataError(f"corrupted flag file {fp!r}")
+        return json.loads(raw[4:].decode())
+
+    # ---- shutdown ----
+
+    def stop(self) -> None:
+        for fp, f in self._flocks.items():
+            try:
+                fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+                f.close()
+            except OSError:
+                pass
+        self._flocks.clear()

@@ -42,16 +42,22 @@ plog = get_logger("tools")
 
 
 def _host_dir(nhconfig: NodeHostConfig) -> str:
-    # must match NodeHost._host_dir layout
-    return os.path.join(
-        nhconfig.node_host_dir, nhconfig.raft_address.replace(":", "_")
-    )
+    # must match the ServerContext deployment-id layout the NodeHost uses
+    # (server/context.py get_logdb_dirs)
+    from ..server.context import ServerContext
+
+    ctx = ServerContext(nhconfig)
+    data_dir, _ = ctx.get_logdb_dirs(nhconfig.get_deployment_id())
+    return data_dir
 
 
 def _snapshot_dir(nhconfig: NodeHostConfig, cluster_id: int, node_id: int) -> str:
-    # must match NodeHost.snapshot_dir layout
-    return os.path.join(
-        _host_dir(nhconfig), "snapshot", f"{cluster_id:020d}-{node_id:020d}"
+    # must match NodeHost.snapshot_dir layout (ServerContext)
+    from ..server.context import ServerContext
+
+    ctx = ServerContext(nhconfig)
+    return ctx.get_snapshot_dir(
+        nhconfig.get_deployment_id(), cluster_id, node_id
     )
 
 

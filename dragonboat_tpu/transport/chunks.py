@@ -42,7 +42,7 @@ class Chunks:
         snapshot_dir_fn: Callable[[int, int], str],
         message_handler: Callable[[MessageBatch], None],
         source_address: str = "",
-        on_received: Optional[Callable[[int, int, int], None]] = None,
+        on_received: Optional[Callable[[int, int, int, int], None]] = None,
     ):
         self.deployment_id = deployment_id
         self.snapshot_dir_fn = snapshot_dir_fn
@@ -107,7 +107,7 @@ class Chunks:
                 return False
             del self._tracked[k]
             if self.on_received is not None:
-                self.on_received(c.cluster_id, c.node_id, c.index)
+                self.on_received(c.cluster_id, c.node_id, c.index, c.from_)
             self.message_handler(
                 MessageBatch(
                     requests=[msg],

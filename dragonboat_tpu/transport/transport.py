@@ -70,12 +70,14 @@ class Transport:
         sys_events=None,
         snapshot_dir_fn: Optional[Callable[[int, int], str]] = None,
         max_send_queue_size: int = 0,
+        snapshot_received_handler: Optional[Callable[[int, int, int], None]] = None,
     ):
         self.source_address = source_address
         self.deployment_id = deployment_id
         self.registry = registry
         self.message_handler = message_handler
         self.snapshot_status_handler = snapshot_status_handler
+        self.snapshot_received_handler = snapshot_received_handler
         self.unreachable_handler = unreachable_handler
         self.sys_events = sys_events
         self._mu = threading.Lock()
@@ -87,7 +89,7 @@ class Transport:
         self._snapshot_jobs = 0
         from .chunks import Chunks
 
-        def _snapshot_received(cluster_id, node_id, index):
+        def _snapshot_received(cluster_id, node_id, index, from_):
             if self.sys_events is not None:
                 from ..events import SystemEvent, SystemEventType
 
@@ -97,8 +99,13 @@ class Transport:
                         cluster_id=cluster_id,
                         node_id=node_id,
                         index=index,
+                        from_=from_,
                     )
                 )
+            if self.snapshot_received_handler is not None:
+                # ack the sender (SNAPSHOT_RECEIVED wire message) so its
+                # feedback tracker releases the send status quickly
+                self.snapshot_received_handler(cluster_id, node_id, from_)
 
         self.chunks = Chunks(
             deployment_id=deployment_id,
@@ -316,6 +323,7 @@ def create_transport(
     unreachable_handler=None,
     snapshot_dir_fn=None,
     sys_events=None,
+    snapshot_received_handler=None,
 ) -> Transport:
     """Reference ``nodehost.go:1677`` ``createTransport``: pick the RPC module
     from config (factory override, else TCP; chan under in-memory test runs)."""
@@ -346,4 +354,5 @@ def create_transport(
         snapshot_dir_fn=snapshot_dir_fn,
         max_send_queue_size=nhconfig.max_send_queue_size,
         sys_events=sys_events,
+        snapshot_received_handler=snapshot_received_handler,
     )

@@ -1,0 +1,173 @@
+"""Snapshot worker pool + snapshot-status feedback tests.
+
+Reference: dedicated snapshot workers (``execengine.go:240-635``) so a slow
+user snapshot never stalls other groups' applies, and the delayed
+snapshot-status feedback (``feedback.go:23-129``) so a dropped status/ack
+message cannot strand a follower in Snapshot state (VERDICT r2 item 7).
+"""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from dragonboat_tpu import Config, NodeHost, NodeHostConfig, Result
+from dragonboat_tpu.feedback import SnapshotFeedback
+from dragonboat_tpu.transport import ChanRouter, ChanTransport
+
+RTT_MS = 5
+
+
+# ------------------------------------------------- feedback unit tests
+
+
+def test_feedback_delays_push_until_release():
+    pushed = []
+    fb = SnapshotFeedback(lambda c, n, f: pushed.append((c, n, f)) or True,
+                          push_delay_ms=1000)
+    fb.add_status(1, 2, False, now_ms=0)
+    fb.push_ready(now_ms=500)
+    assert pushed == []  # still parked
+    fb.push_ready(now_ms=1001)
+    assert pushed == [(1, 2, False)]
+    assert fb.pending_count() == 0
+
+
+def test_feedback_confirm_accelerates_release():
+    pushed = []
+    fb = SnapshotFeedback(lambda c, n, f: pushed.append((c, n, f)) or True,
+                          push_delay_ms=100000, confirmed_delay_ms=100)
+    fb.add_status(1, 2, False, now_ms=0)
+    fb.confirm(1, 2, now_ms=10)
+    fb.push_ready(now_ms=50)
+    assert pushed == []
+    fb.push_ready(now_ms=111)
+    assert pushed == [(1, 2, False)]
+
+
+def test_feedback_retries_failed_push():
+    """A status the node queue rejected is re-parked and re-pushed — the
+    'dropped status message still recovers' guarantee."""
+    attempts = []
+
+    def push(c, n, f):
+        attempts.append((c, n, f))
+        return len(attempts) >= 3  # fail twice, then succeed
+
+    fb = SnapshotFeedback(push, push_delay_ms=10, retry_delay_ms=10)
+    fb.add_status(9, 3, True, now_ms=0)
+    now = 11
+    for _ in range(5):
+        fb.push_ready(now_ms=now)
+        now += 11
+    assert attempts == [(9, 3, True)] * 3
+    assert fb.pending_count() == 0
+
+
+def test_feedback_failed_status_preserved_through_retry():
+    seen = []
+    fb = SnapshotFeedback(lambda c, n, f: seen.append(f) or False,
+                          push_delay_ms=1, retry_delay_ms=1)
+    fb.add_status(1, 2, True, now_ms=0)
+    fb.push_ready(now_ms=5)
+    fb.push_ready(now_ms=10)
+    assert seen == [True, True]
+
+
+# --------------------------------------- slow save doesn't stall applies
+
+
+class SlowSnapSM:
+    """save_snapshot blocks; updates are instant."""
+
+    SAVE_SECONDS = 2.0
+
+    def __init__(self, cluster_id, node_id):
+        self.count = 0
+
+    def update(self, cmd):
+        self.count += 1
+        return Result(value=self.count)
+
+    def lookup(self, query):
+        return self.count
+
+    def save_snapshot(self, w, files, done):
+        time.sleep(self.SAVE_SECONDS)
+        w.write(self.count.to_bytes(8, "little"))
+
+    def recover_from_snapshot(self, r, files, done):
+        self.count = int.from_bytes(r.read(8), "little")
+
+    def close(self):
+        pass
+
+
+def test_slow_snapshot_save_does_not_block_other_groups():
+    """Two groups on the same apply worker (cid % workers equal); a
+    multi-second snapshot save on one must not delay the other's applies."""
+    router = ChanRouter()
+
+    def factory(src, rh, ch):
+        return ChanTransport(src, rh, ch, router=router)
+
+    nhs = [
+        NodeHost(
+            NodeHostConfig(
+                node_host_dir=":memory:",
+                rtt_millisecond=RTT_MS,
+                raft_address=f"sp{i}:1",
+                raft_rpc_factory=factory,
+            )
+        )
+        for i in (1, 2, 3)
+    ]
+    addrs = {i: f"sp{i}:1" for i in (1, 2, 3)}
+    # default engine: 4 step/apply workers → cids 1 and 5 share worker 1
+    slow_cid, fast_cid = 1, 5
+    try:
+        for cid in (slow_cid, fast_cid):
+            for i, nh in enumerate(nhs, 1):
+                nh.start_cluster(
+                    addrs, False, SlowSnapSM,
+                    Config(cluster_id=cid, node_id=i, election_rtt=10,
+                           heartbeat_rtt=1, snapshot_entries=0),
+                )
+            nhs[0].get_node(cid).request_campaign()
+        deadline = time.time() + 20
+        leaders = {}
+        while len(leaders) < 2 and time.time() < deadline:
+            for cid in (slow_cid, fast_cid):
+                for nh in nhs:
+                    lid, ok = nh.get_leader_id(cid)
+                    if ok:
+                        leaders[cid] = nhs[lid - 1]
+            time.sleep(0.02)
+        assert len(leaders) == 2
+        # a few writes so there is something to snapshot
+        for cid in (slow_cid, fast_cid):
+            s = leaders[cid].get_noop_session(cid)
+            rs = leaders[cid].propose(s, b"x", timeout=5.0)
+            assert rs.wait(5.0).completed
+        # kick the slow snapshot on every replica of slow_cid
+        for nh in nhs:
+            nh.get_node(slow_cid).request_snapshot(
+                __import__(
+                    "dragonboat_tpu.rsm", fromlist=["SSRequest"]
+                ).SSRequest(type=1),
+                timeout_s=30.0,
+            )
+        time.sleep(0.1)  # let the saves start on the snapshot pool
+        # applies on the co-scheduled fast group must stay fast
+        s = leaders[fast_cid].get_noop_session(fast_cid)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            rs = leaders[fast_cid].propose(s, b"y", timeout=5.0)
+            assert rs.wait(5.0).completed
+        elapsed = time.perf_counter() - t0
+        assert elapsed < SlowSnapSM.SAVE_SECONDS / 2, (
+            f"applies stalled behind the slow snapshot: {elapsed:.2f}s"
+        )
+    finally:
+        for nh in nhs:
+            nh.stop()
