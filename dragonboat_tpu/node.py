@@ -568,6 +568,10 @@ class Node:
                 self.nh.engine.submit_snapshot(
                     lambda t=t: self._save_snapshot(t)
                 )
+            elif t.stream:
+                self.nh.engine.submit_snapshot(
+                    lambda t=t: self._stream_snapshot(t)
+                )
             elif t.recover:
                 self._recover_from_snapshot(t)
             else:
@@ -650,6 +654,39 @@ class Node:
         finally:
             if req.type == SSReqType.PERIODIC:
                 self._snapshotting.release()
+
+    # ---- on-disk SM snapshot streaming (reference node.go:718-738) ----
+
+    def push_stream_snapshot_request(self, to: int) -> None:
+        """Queue a stream-to-follower task (reference
+        ``pushStreamSnapshotRequest``)."""
+        self.to_apply.enqueue(
+            Task(
+                cluster_id=self.cluster_id,
+                node_id=self.node_id,
+                stream=True,
+                index=to,  # target replica id rides in the index field
+                ss_request=SSRequest(type=SSReqType.STREAMING),
+            )
+        )
+        self.nh.engine.set_apply_ready(self.cluster_id)
+
+    def _stream_snapshot(self, t: Task) -> None:
+        to = t.index
+        sink = self.nh.transport.get_stream_sink(self.cluster_id, to)
+        if sink is None:
+            plog.warning(
+                "%s no stream sink for %d (unreachable/at capacity)",
+                self.describe(), to,
+            )
+            # report failure so the remote leaves Snapshot state eventually
+            self.nh._snapshot_status(self.cluster_id, to, True)
+            return
+        try:
+            self.sm.stream(sink, to, self.nh.nhconfig.get_deployment_id())
+        except Exception as e:  # noqa: BLE001
+            plog.error("%s streaming to %d failed: %s", self.describe(), to, e)
+            sink.stop()
 
     def _compact_log(self, ss: Snapshot, req: SSRequest) -> None:
         """Reference ``node.go:689-716``: keep ``compaction_overhead``

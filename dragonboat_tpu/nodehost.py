@@ -631,6 +631,14 @@ class NodeHost:
             if node is not None and node.node_id == m.to:
                 node.handle_message_batch(m)
                 return
+        # on-disk SMs stream their live state through a per-transfer job
+        # instead of chunking a snapshot file (reference nodehost.go:1796:
+        # witness/in-memory -> file send; on-disk -> stream)
+        sender = self._clusters.get(m.cluster_id)
+        witness = m.snapshot is not None and m.snapshot.witness
+        if sender is not None and sender.sm.on_disk and not witness:
+            sender.push_stream_snapshot_request(m.to)
+            return
         if not self.transport.send_snapshot(m):
             self._snapshot_status(m.cluster_id, m.to, True)
 

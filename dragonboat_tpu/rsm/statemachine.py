@@ -423,6 +423,21 @@ class StateMachine:
                 self.snapshot_index = ss.index
         return ss, env
 
+    def stream(self, sink, to_node_id: int, deployment_id: int) -> None:
+        """Stream this SM's state to a lagging follower (reference
+        ``statemachine.go`` ``Stream``; on-disk SMs only).  The image is
+        captured from a prepared context and written straight into the
+        transport sink via the ChunkWriter — never materialized locally."""
+        if self.snapshotter is None:
+            raise RuntimeError("no snapshotter configured")
+        # only the meta/ctx capture needs the save lock; the transfer
+        # itself writes no local files and may take as long as the slowest
+        # follower — holding _save_mu for it would stall periodic saves
+        # and compaction (the reference streams concurrently with saves)
+        with self._save_mu:
+            meta = self.prepare_snapshot(SSRequest(type=SSReqType.STREAMING))
+        self.snapshotter.stream(self, meta, sink, to_node_id, deployment_id)
+
     def _checked_meta(self, req: SSRequest) -> SSMeta:
         meta = self.prepare_snapshot(req)
         if meta.index < self.on_disk_init_index:

@@ -1,0 +1,122 @@
+"""Per-transfer snapshot streaming job + Sink.
+
+Reference: ``internal/transport/job.go:43-248`` — each outbound snapshot
+stream gets its own job with a dedicated snapshot connection and a bounded
+chunk queue; the ``Sink`` is handed to the on-disk state machine's save
+path (via the RSM ChunkWriter) so the image streams straight onto the wire
+without ever being materialized as a local file.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+from ..logger import get_logger
+from ..wire import Chunk, POISON_CHUNK_COUNT
+
+plog = get_logger("transport")
+
+STREAMING_CHAN_LENGTH = 16
+
+
+class Sink:
+    """Reference ``job.go:43`` ``Sink``: receive(chunk) -> accepted."""
+
+    def __init__(self, job: "StreamJob"):
+        self._j = job
+
+    def receive(self, chunk: Chunk) -> bool:
+        return self._j.add_chunk(chunk)
+
+    def stop(self) -> None:
+        self._j.add_chunk(Chunk(chunk_count=POISON_CHUNK_COUNT))
+
+    @property
+    def cluster_id(self) -> int:
+        return self._j.cluster_id
+
+    @property
+    def to_node_id(self) -> int:
+        return self._j.node_id
+
+
+class StreamJob:
+    """One streaming transfer: owns the connection + the sender thread."""
+
+    def __init__(
+        self,
+        rpc,
+        addr: str,
+        cluster_id: int,
+        node_id: int,
+        on_done,  # Callable[[int, int, bool], None] (cid, nid, failed)
+    ):
+        self.rpc = rpc
+        self.addr = addr
+        self.cluster_id = cluster_id
+        self.node_id = node_id
+        self._on_done = on_done
+        self._q: "queue.Queue[Chunk]" = queue.Queue(
+            maxsize=STREAMING_CHAN_LENGTH
+        )
+        self._failed = threading.Event()
+        self._done = threading.Event()
+        self._thread = threading.Thread(
+            target=self._main, name=f"stream-job-{addr}", daemon=True
+        )
+        self._thread.start()
+
+    def add_chunk(self, chunk: Chunk) -> bool:
+        """Producer side (ChunkWriter via Sink).  False once the job has
+        failed — the writer aborts the stream.  The poison chunk (abort)
+        is always accepted: it only flips the failure flag, so a full
+        queue or an already-failed job cannot block the abort."""
+        if chunk.chunk_count == POISON_CHUNK_COUNT:
+            self._failed.set()
+            return True
+        if self._failed.is_set() or self._done.is_set():
+            return False
+        try:
+            self._q.put(chunk, timeout=30.0)
+            return True
+        except queue.Full:
+            self._failed.set()
+            return False
+
+    def _main(self) -> None:
+        failed = False
+        conn = None
+        sent_any = False
+        try:
+            conn = self.rpc.get_snapshot_connection(self.addr)
+            while True:
+                try:
+                    c = self._q.get(timeout=1.0)
+                except queue.Empty:
+                    if self._failed.is_set():
+                        failed = True
+                        break
+                    continue
+                if self._failed.is_set():
+                    failed = True
+                    break
+                conn.send_chunk(c)
+                sent_any = True
+                if c.is_last_chunk():
+                    break
+        except Exception as e:  # noqa: BLE001 — connection/stream failure
+            plog.warning("stream job to %s failed: %s", self.addr, e)
+            failed = True
+            self._failed.set()
+        finally:
+            if conn is not None:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+            self._done.set()
+        self._on_done(self.cluster_id, self.node_id, failed or not sent_any)
+
+    def join(self, timeout: float = 10.0) -> None:
+        self._thread.join(timeout=timeout)

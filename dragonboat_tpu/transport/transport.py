@@ -281,6 +281,50 @@ class Transport:
         self._publish_conn_event(addr, failed=failed, snapshot=True)
         self.snapshot_status_handler(m.cluster_id, m.to, failed)
 
+    # ---- streaming plane (reference GetStreamSink snapshot.go:65) ----
+
+    def get_stream_sink(self, cluster_id: int, node_id: int):
+        """A Sink streaming chunks to ``(cluster_id, node_id)`` over a
+        dedicated connection, or None when unreachable/at capacity."""
+        from .job import Sink, StreamJob
+
+        if self._stopped.is_set():
+            return None
+        addr = self.registry.resolve(cluster_id, node_id)
+        if addr is None:
+            return None
+        b = self.breaker(addr)
+        if not b.ready():
+            return None
+        with self._snapshot_count_mu:
+            if self._snapshot_jobs >= Soft.max_concurrent_streaming_snapshots:
+                return None
+            self._snapshot_jobs += 1
+        if self.sys_events is not None:
+            from ..events import SystemEvent, SystemEventType
+
+            self.sys_events.publish(
+                SystemEvent(
+                    type=SystemEventType.SEND_SNAPSHOT_STARTED,
+                    cluster_id=cluster_id,
+                    node_id=node_id,
+                    address=addr,
+                )
+            )
+
+        def on_done(cid, nid, failed):
+            with self._snapshot_count_mu:
+                self._snapshot_jobs -= 1
+            if failed:
+                b.fail()
+            else:
+                b.success()
+            self._publish_conn_event(addr, failed=failed, snapshot=True)
+            self.snapshot_status_handler(cid, nid, failed)
+
+        job = StreamJob(self.rpc, addr, cluster_id, node_id, on_done)
+        return Sink(job)
+
     # ---- receive path ----
 
     def handle_request(self, batch: MessageBatch) -> None:

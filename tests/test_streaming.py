@@ -1,0 +1,158 @@
+"""On-disk SM snapshot streaming end-to-end.
+
+Reference: ``internal/transport/job.go:43-248`` (per-transfer job + Sink),
+``internal/rsm/chunkwriter.go``, ``node.go:718-738``.  VERDICT r2 item 5
+done-criterion: a lagging on-disk-SM replica catches up via a streamed
+snapshot over BOTH the chan and tcp transports.
+"""
+from __future__ import annotations
+
+import socket
+import time
+
+import pytest
+
+from dragonboat_tpu import Config, NodeHost, NodeHostConfig
+from dragonboat_tpu.statemachine import IOnDiskStateMachine, Result, SMEntry
+from dragonboat_tpu.transport import ChanRouter, ChanTransport
+
+RTT = 10
+
+
+class DiskKV(IOnDiskStateMachine):
+    """In-memory stand-in with on-disk SEMANTICS (own 'durable' store,
+    streaming snapshots); shared dict keyed per instance for inspection."""
+
+    def __init__(self, cluster_id, node_id):
+        self.kv = {}
+        self.applied = 0
+
+    def open(self, stopc) -> int:
+        return self.applied
+
+    def update(self, entries):
+        for e in entries:
+            k, v = bytes(e.cmd).decode().split("=", 1)
+            self.kv[k] = v
+            self.applied = e.index
+            e.result = Result(value=len(self.kv))
+        return entries
+
+    def lookup(self, query):
+        return self.kv.get(query)
+
+    def sync(self) -> None:
+        pass
+
+    def prepare_snapshot(self):
+        return dict(self.kv)
+
+    def save_snapshot(self, ctx, w, done) -> None:
+        import json
+
+        data = json.dumps(sorted(ctx.items())).encode()
+        w.write(len(data).to_bytes(8, "little") + data)
+
+    def recover_from_snapshot(self, r, done) -> None:
+        import json
+
+        n = int.from_bytes(r.read(8), "little")
+        self.kv = dict(json.loads(r.read(n).decode()))
+
+    def close(self) -> None:
+        pass
+
+
+def _free_ports(n):
+    out = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        out.append(s.getsockname()[1])
+        s.close()
+    return out
+
+
+def _wait_leader(nhs, cid, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        for nh in nhs:
+            lid, ok = nh.get_leader_id(cid)
+            if ok:
+                return nhs[lid - 1]
+        time.sleep(0.02)
+    raise AssertionError("no leader")
+
+
+def _run_streaming_catchup(make_transport, addrs, tmp_path):
+    """Replicas 1,2 run; replica 3 joins late with an empty store after the
+    log was compacted — it can only catch up via a streamed snapshot."""
+    CID = 1
+    sms = {}
+
+    def create(nh_idx):
+        def f(cluster_id, node_id):
+            sm = DiskKV(cluster_id, node_id)
+            sms[nh_idx] = sm
+            return sm
+
+        return f
+
+    nhs = []
+    for i in (1, 2, 3):
+        nhs.append(
+            NodeHost(
+                NodeHostConfig(
+                    node_host_dir=str(tmp_path / f"nh{i}"),
+                    rtt_millisecond=RTT,
+                    raft_address=addrs[i],
+                    raft_rpc_factory=make_transport,
+                )
+            )
+        )
+    cfg = lambda i: Config(
+        cluster_id=CID, node_id=i, election_rtt=10, heartbeat_rtt=1,
+        snapshot_entries=10, compaction_overhead=2,
+    )
+    try:
+        # only replicas 1 and 2 start; 3 stays down
+        for i in (1, 2):
+            nhs[i - 1].start_on_disk_cluster(addrs, False, create(i), cfg(i))
+        nhs[0].get_node(CID).request_campaign()
+        leader = _wait_leader(nhs[:2], CID)
+        s = leader.get_noop_session(CID)
+        for j in range(60):  # >> snapshot_entries: snapshots + compaction run
+            rs = leader.propose(s, f"k{j}=v{j}".encode(), timeout=10.0)
+            assert rs.wait(10.0).completed
+        time.sleep(1.0)  # let snapshot + compaction finish on the pool
+        # now start replica 3: its log was never written and the leader's
+        # log is compacted, so it must receive a streamed snapshot
+        nhs[2].start_on_disk_cluster(addrs, False, create(3), cfg(3))
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if sms.get(3) is not None and sms[3].kv.get("k59") == "v59":
+                break
+            time.sleep(0.1)
+        assert sms.get(3) is not None and sms[3].kv.get("k59") == "v59", (
+            f"lagging replica never caught up: "
+            f"{len(sms.get(3).kv) if sms.get(3) else 'no sm'} keys"
+        )
+    finally:
+        for nh in nhs:
+            nh.stop()
+
+
+def test_streaming_catchup_over_chan(tmp_path):
+    router = ChanRouter()
+    addrs = {i: f"st{i}:1" for i in (1, 2, 3)}
+
+    def factory(src, rh, ch):
+        return ChanTransport(src, rh, ch, router=router)
+
+    _run_streaming_catchup(factory, addrs, tmp_path)
+
+
+def test_streaming_catchup_over_tcp(tmp_path):
+    ports = _free_ports(3)
+    addrs = {i: f"127.0.0.1:{ports[i-1]}" for i in (1, 2, 3)}
+    _run_streaming_catchup(None, addrs, tmp_path)
