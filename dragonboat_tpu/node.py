@@ -665,14 +665,14 @@ class Node:
                 cluster_id=self.cluster_id,
                 node_id=self.node_id,
                 stream=True,
-                index=to,  # target replica id rides in the index field
+                stream_to=to,
                 ss_request=SSRequest(type=SSReqType.STREAMING),
             )
         )
         self.nh.engine.set_apply_ready(self.cluster_id)
 
     def _stream_snapshot(self, t: Task) -> None:
-        to = t.index
+        to = t.stream_to
         sink = self.nh.transport.get_stream_sink(self.cluster_id, to)
         if sink is None:
             plog.warning(

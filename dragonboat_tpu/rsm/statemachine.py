@@ -89,6 +89,8 @@ class Task:
     entries: List[Entry] = field(default_factory=list)
     save: bool = False
     stream: bool = False
+    # target replica of a stream task (Task.index stays a raft index)
+    stream_to: int = 0
     recover: bool = False
     initial: bool = False
     new_node: bool = False
@@ -136,7 +138,10 @@ class ISnapshotter(Protocol):
 
     def recover(self, recoverable, ss: Snapshot) -> None: ...
 
-    def stream(self, streamable, meta: SSMeta, sink) -> None: ...
+    def stream(
+        self, streamable, meta: SSMeta, sink, to_node_id: int,
+        deployment_id: int,
+    ) -> None: ...
 
     def get_snapshot(self, index: int) -> Snapshot: ...
 

@@ -1,0 +1,42 @@
+"""Token-bucket bandwidth limiter for snapshot traffic.
+
+Reference: ``internal/transport/tcp.go:430-437`` — snapshot chunk sends go
+through a juju/ratelimit token bucket sized by
+``NodeHostConfig.MaxSnapshotSendBytesPerSecond`` so bulk snapshot transfer
+cannot starve the raft message plane.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` bytes/second, burst of one second."""
+
+    def __init__(self, rate: int):
+        self.rate = max(0, int(rate))
+        self._mu = threading.Lock()
+        self._tokens = float(self.rate)
+        self._last = time.monotonic()
+
+    def take(self, n: int) -> None:
+        """Block until ``n`` tokens are available (no-op when unlimited).
+
+        Requests larger than one second's burst are clamped — a 2MB chunk
+        against a 1MB/s cap waits ~1s instead of forever."""
+        if self.rate <= 0:
+            return
+        n = min(n, self.rate)
+        while True:
+            with self._mu:
+                now = time.monotonic()
+                self._tokens = min(
+                    float(self.rate), self._tokens + (now - self._last) * self.rate
+                )
+                self._last = now
+                if self._tokens >= n:
+                    self._tokens -= n
+                    return
+                missing = n - self._tokens
+            time.sleep(min(1.0, missing / self.rate))

@@ -51,12 +51,14 @@ class StreamJob:
         cluster_id: int,
         node_id: int,
         on_done,  # Callable[[int, int, bool], None] (cid, nid, failed)
+        bucket=None,  # optional bandwidth TokenBucket
     ):
         self.rpc = rpc
         self.addr = addr
         self.cluster_id = cluster_id
         self.node_id = node_id
         self._on_done = on_done
+        self._bucket = bucket
         self._q: "queue.Queue[Chunk]" = queue.Queue(
             maxsize=STREAMING_CHAN_LENGTH
         )
@@ -101,6 +103,8 @@ class StreamJob:
                 if self._failed.is_set():
                     failed = True
                     break
+                if self._bucket is not None:
+                    self._bucket.take(c.chunk_size or len(c.data))
                 conn.send_chunk(c)
                 sent_any = True
                 if c.is_last_chunk():

@@ -1,0 +1,64 @@
+"""Transport metrics counters.
+
+Reference: ``internal/transport/metrics.go:21`` ``transportMetrics`` — the
+same counter family, written into the shared Prometheus-text
+MetricsRegistry (``dragonboat_tpu.events``) so ``write_health_metrics``
+exposes them alongside the per-raft-node metrics.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..events import DEFAULT_REGISTRY, MetricsRegistry
+
+
+class TransportMetrics:
+    """Reference ``newTransportMetrics`` counter set."""
+
+    NAMES = (
+        "dragonboat_transport_message_sent",
+        "dragonboat_transport_message_dropped",
+        "dragonboat_transport_message_received",
+        "dragonboat_transport_message_receive_dropped",
+        "dragonboat_transport_message_connection_failed",
+        "dragonboat_transport_snapshot_sent",
+        "dragonboat_transport_snapshot_dropped",
+        "dragonboat_transport_snapshot_received",
+        "dragonboat_transport_snapshot_connection_failed",
+    )
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry or DEFAULT_REGISTRY
+
+    def _add(self, name: str, n: int = 1) -> None:
+        self.registry.counter_add(name, n)
+
+    def message_sent(self, n: int = 1) -> None:
+        self._add("dragonboat_transport_message_sent", n)
+
+    def message_dropped(self, n: int = 1) -> None:
+        self._add("dragonboat_transport_message_dropped", n)
+
+    def message_received(self, n: int = 1) -> None:
+        self._add("dragonboat_transport_message_received", n)
+
+    def message_receive_dropped(self, n: int = 1) -> None:
+        self._add("dragonboat_transport_message_receive_dropped", n)
+
+    def message_connection_failed(self, n: int = 1) -> None:
+        self._add("dragonboat_transport_message_connection_failed", n)
+
+    def snapshot_sent(self, n: int = 1) -> None:
+        self._add("dragonboat_transport_snapshot_sent", n)
+
+    def snapshot_dropped(self, n: int = 1) -> None:
+        self._add("dragonboat_transport_snapshot_dropped", n)
+
+    def snapshot_received(self, n: int = 1) -> None:
+        self._add("dragonboat_transport_snapshot_received", n)
+
+    def snapshot_connection_failed(self, n: int = 1) -> None:
+        self._add("dragonboat_transport_snapshot_connection_failed", n)
+
+    def value(self, name: str) -> float:
+        return self.registry.counter_value(name)

@@ -92,9 +92,16 @@ def load_chunk_data(c: Chunk) -> Chunk:
 
 
 def send_snapshot_chunks(
-    conn: ISnapshotConnection, chunks: List[Chunk], stopped: threading.Event
+    conn: ISnapshotConnection,
+    chunks: List[Chunk],
+    stopped: threading.Event,
+    bucket=None,
 ) -> None:
     for c in chunks:
         if stopped.is_set():
             raise RuntimeError("transport stopped")
-        conn.send_chunk(load_chunk_data(c))
+        loaded = load_chunk_data(c)
+        if bucket is not None:
+            # snapshot bandwidth cap (reference tcp.go:430-437)
+            bucket.take(loaded.chunk_size or len(loaded.data))
+        conn.send_chunk(loaded)

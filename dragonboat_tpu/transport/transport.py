@@ -71,6 +71,7 @@ class Transport:
         snapshot_dir_fn: Optional[Callable[[int, int], str]] = None,
         max_send_queue_size: int = 0,
         snapshot_received_handler: Optional[Callable[[int, int, int], None]] = None,
+        max_snapshot_send_bytes_per_second: int = 0,
     ):
         self.source_address = source_address
         self.deployment_id = deployment_id
@@ -87,9 +88,16 @@ class Transport:
         self._queue_len = max_send_queue_size or Soft.send_queue_length
         self._snapshot_count_mu = threading.Lock()
         self._snapshot_jobs = 0
+        from .bandwidth import TokenBucket
+        from .metrics import TransportMetrics
+
+        self.metrics = TransportMetrics()
+        # snapshot-plane bandwidth cap (reference tcp.go:430-437); 0 = off
+        self.snapshot_bucket = TokenBucket(max_snapshot_send_bytes_per_second)
         from .chunks import Chunks
 
         def _snapshot_received(cluster_id, node_id, index, from_):
+            self.metrics.snapshot_received()
             if self.sys_events is not None:
                 from ..events import SystemEvent, SystemEventType
 
@@ -156,6 +164,7 @@ class Transport:
             sq.q.put_nowait(m)
             return True
         except queue.Full:
+            self.metrics.message_dropped()
             return False
 
     def _process_queue(self, addr: str, sq: SendQueue) -> None:
@@ -189,8 +198,10 @@ class Transport:
                     batch.requests.append(nxt)
                     size += _msg_size(nxt)
                 conn.send_message_batch(batch)
+                self.metrics.message_sent(len(batch.requests))
         except (TransportError, OSError) as e:
             plog.warning("sender to %s failed: %s", addr, e)
+            self.metrics.message_connection_failed()
             b.fail()
             self._publish_conn_event(addr, failed=True)
             self._notify_unreachable(addr)
@@ -269,9 +280,13 @@ class Transport:
                 m, self.deployment_id, Soft.snapshot_chunk_size
             )
             conn = self.rpc.get_snapshot_connection(addr)
-            send_snapshot_chunks(conn, chunks, self._stopped)
+            send_snapshot_chunks(
+                conn, chunks, self._stopped, bucket=self.snapshot_bucket
+            )
+            self.metrics.snapshot_sent()
         except (TransportError, OSError, RuntimeError) as e:
             plog.warning("snapshot send to %s failed: %s", addr, e)
+            self.metrics.snapshot_connection_failed()
             failed = True
         finally:
             if conn is not None:
@@ -317,12 +332,17 @@ class Transport:
                 self._snapshot_jobs -= 1
             if failed:
                 b.fail()
+                self.metrics.snapshot_connection_failed()
             else:
                 b.success()
+                self.metrics.snapshot_sent()
             self._publish_conn_event(addr, failed=failed, snapshot=True)
             self.snapshot_status_handler(cid, nid, failed)
 
-        job = StreamJob(self.rpc, addr, cluster_id, node_id, on_done)
+        job = StreamJob(
+            self.rpc, addr, cluster_id, node_id, on_done,
+            bucket=self.snapshot_bucket,
+        )
         return Sink(job)
 
     # ---- receive path ----
@@ -337,7 +357,9 @@ class Transport:
                 batch.deployment_id,
                 self.deployment_id,
             )
+            self.metrics.message_receive_dropped(len(batch.requests))
             return
+        self.metrics.message_received(len(batch.requests))
         self.message_handler(batch)
 
     def tick(self) -> None:
@@ -399,4 +421,7 @@ def create_transport(
         max_send_queue_size=nhconfig.max_send_queue_size,
         sys_events=sys_events,
         snapshot_received_handler=snapshot_received_handler,
+        max_snapshot_send_bytes_per_second=(
+            nhconfig.max_snapshot_send_bytes_per_second
+        ),
     )

@@ -1,0 +1,296 @@
+"""Codec + framing fuzz, snapshot bandwidth cap, transport counters.
+
+Reference: ``raftpb/fuzz.go`` and ``internal/transport/fuzz.go`` (go-fuzz
+entry points over wire decoding), ``tcp.go:430-437`` (snapshot token
+bucket), ``internal/transport/metrics.go:21`` (counters).  VERDICT r2
+item 9.
+"""
+from __future__ import annotations
+
+import io
+import random
+import struct
+import time
+import zlib
+
+import pytest
+
+from dragonboat_tpu.wire import (
+    Chunk,
+    Entry,
+    Message,
+    MessageBatch,
+    MessageType,
+)
+from dragonboat_tpu.wire.codec import (
+    CodecError,
+    decode_chunk,
+    decode_entry,
+    decode_message_batch,
+    encode_chunk,
+    encode_entry,
+    encode_message_batch,
+)
+
+N_FUZZ = 10000
+
+
+def _rand_bytes(rng, max_len=256):
+    return bytes(rng.getrandbits(8) for _ in range(rng.randrange(0, max_len)))
+
+
+# ------------------------------------------------------------- codec fuzz
+
+def test_fuzz_decode_random_bytes_never_crashes():
+    """10k random inputs: every decoder either succeeds or raises a typed
+    CodecError/ValueError — never IndexError/KeyError/MemoryError/hang."""
+    rng = random.Random(1234)
+    allowed = (CodecError, ValueError)
+    for i in range(N_FUZZ):
+        data = _rand_bytes(rng)
+        for dec in (decode_entry, decode_message_batch, decode_chunk):
+            try:
+                dec(data)
+            except allowed:
+                pass
+            except OverflowError:
+                pass  # declared lengths beyond practical bounds
+            # anything else (IndexError, struct.error, ...) fails the test
+
+
+def test_fuzz_mutated_valid_encodings():
+    """Bit-flipped valid encodings must decode or raise typed errors."""
+    rng = random.Random(99)
+    base = encode_message_batch(
+        MessageBatch(
+            requests=[
+                Message(
+                    type=MessageType.REPLICATE,
+                    cluster_id=7,
+                    from_=1,
+                    to=2,
+                    term=3,
+                    entries=[Entry(index=i, term=2, cmd=b"payload") for i in range(1, 5)],
+                )
+            ],
+            deployment_id=42,
+            source_address="a:1",
+        )
+    )
+    for _ in range(2000):
+        buf = bytearray(base)
+        for _ in range(rng.randrange(1, 6)):
+            buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+        try:
+            decode_message_batch(bytes(buf))
+        except (CodecError, ValueError, OverflowError):
+            pass
+
+
+def test_fuzz_roundtrip_random_messages():
+    rng = random.Random(7)
+    types = list(MessageType)
+    for _ in range(500):
+        m = Message(
+            type=rng.choice(types),
+            cluster_id=rng.getrandbits(32),
+            from_=rng.getrandbits(16),
+            to=rng.getrandbits(16),
+            term=rng.getrandbits(24),
+            log_term=rng.getrandbits(24),
+            log_index=rng.getrandbits(24),
+            commit=rng.getrandbits(24),
+            reject=bool(rng.getrandbits(1)),
+            hint=rng.getrandbits(40),
+            hint_high=rng.getrandbits(40),
+            entries=[
+                Entry(
+                    index=rng.getrandbits(16),
+                    term=rng.getrandbits(16),
+                    cmd=_rand_bytes(rng, 64),
+                )
+                for _ in range(rng.randrange(0, 4))
+            ],
+        )
+        b = MessageBatch(requests=[m], deployment_id=1, source_address="x:1")
+        out = decode_message_batch(encode_message_batch(b))
+        got, want = out.requests[0], m
+        assert (got.type, got.cluster_id, got.from_, got.to, got.term) == (
+            want.type, want.cluster_id, want.from_, want.to, want.term
+        )
+        assert [e.cmd for e in got.entries] == [e.cmd for e in want.entries]
+
+
+def test_fuzz_chunk_roundtrip():
+    rng = random.Random(3)
+    for _ in range(300):
+        c = Chunk(
+            cluster_id=rng.getrandbits(20),
+            node_id=rng.getrandbits(8),
+            from_=rng.getrandbits(8),
+            index=rng.getrandbits(20),
+            term=rng.getrandbits(16),
+            chunk_id=rng.getrandbits(10),
+            chunk_count=rng.getrandbits(10),
+            chunk_size=rng.getrandbits(10),
+            deployment_id=5,
+            data=_rand_bytes(rng, 128),
+        )
+        out = decode_chunk(encode_chunk(c))
+        assert (out.cluster_id, out.chunk_id, out.data) == (
+            c.cluster_id, c.chunk_id, c.data
+        )
+
+
+# ---------------------------------------------------------- tcp framing
+
+def test_fuzz_tcp_frames_rejected_cleanly():
+    """Random/corrupted frames through the framing decoder raise
+    TransportError/ConnectionError — never crash the serving loop."""
+    from dragonboat_tpu.transport import tcp
+
+    class FakeSock:
+        def __init__(self, data):
+            self._b = io.BytesIO(data)
+
+        def recv(self, n):
+            return self._b.read(n)
+
+    rng = random.Random(5)
+    for _ in range(2000):
+        blob = _rand_bytes(rng, 64)
+        try:
+            tcp._recv_frame(FakeSock(blob))
+        except (tcp.TransportError, ConnectionError):
+            pass
+    # a correct frame with a flipped payload byte must fail the crc
+    payload = b"hello world"
+    pcrc = zlib.crc32(payload)
+    hdr_wo = struct.pack(">HHQI", tcp.MAGIC, tcp.RAFT_METHOD, len(payload), pcrc)
+    frame = bytearray(hdr_wo + struct.pack(">I", zlib.crc32(hdr_wo)) + payload)
+    frame[-1] ^= 0xFF
+    with pytest.raises(tcp.TransportError):
+        tcp._recv_frame(FakeSock(bytes(frame)))
+
+
+# ------------------------------------------------- bandwidth token bucket
+
+def test_token_bucket_limits_rate():
+    from dragonboat_tpu.transport.bandwidth import TokenBucket
+
+    tb = TokenBucket(100_000)  # 100KB/s, 100KB burst
+    tb.take(100_000)  # drain the initial burst
+    t0 = time.monotonic()
+    tb.take(50_000)  # needs ~0.5s of refill
+    elapsed = time.monotonic() - t0
+    assert elapsed >= 0.35, f"bucket let 50KB through in {elapsed:.2f}s"
+
+
+def test_token_bucket_unlimited_is_noop():
+    from dragonboat_tpu.transport.bandwidth import TokenBucket
+
+    tb = TokenBucket(0)
+    t0 = time.monotonic()
+    for _ in range(1000):
+        tb.take(1 << 20)
+    assert time.monotonic() - t0 < 0.1
+
+
+def test_snapshot_send_respects_bandwidth_cap(tmp_path):
+    """A chunked snapshot file send through send_snapshot_chunks with a
+    bucket takes at least bytes/rate seconds."""
+    import threading
+
+    from dragonboat_tpu.transport.bandwidth import TokenBucket
+    from dragonboat_tpu.transport.snapshotsender import send_snapshot_chunks
+
+    sent = []
+
+    class Conn:
+        def send_chunk(self, c):
+            sent.append(c)
+
+    blob = tmp_path / "snap.bin"
+    blob.write_bytes(b"x" * 200_000)
+    chunks = [
+        Chunk(chunk_id=i, chunk_count=4, chunk_size=50_000,
+              filepath=str(blob), data=(i * 50_000, 50_000))
+        for i in range(4)
+    ]
+    bucket = TokenBucket(200_000)  # 200KB/s; 200KB payload, 200KB burst
+    bucket.take(200_000)  # drain burst: the 4 chunks now need ~1s
+    t0 = time.monotonic()
+    send_snapshot_chunks(Conn(), chunks, threading.Event(), bucket=bucket)
+    elapsed = time.monotonic() - t0
+    assert len(sent) == 4
+    assert elapsed >= 0.7, f"cap not enforced: {elapsed:.2f}s"
+
+
+# -------------------------------------------------------------- counters
+
+def test_transport_counters_on_live_traffic():
+    from dragonboat_tpu import Config, NodeHost, NodeHostConfig, Result
+    from dragonboat_tpu.transport import ChanRouter, ChanTransport
+
+    class SM:
+        def __init__(self, c, n):
+            self.n = 0
+
+        def update(self, cmd):
+            self.n += 1
+            return Result(value=self.n)
+
+        def lookup(self, q):
+            return self.n
+
+        def save_snapshot(self, w, files, done):
+            w.write(b"\0")
+
+        def recover_from_snapshot(self, r, files, done):
+            r.read()
+
+        def close(self):
+            pass
+
+    router = ChanRouter()
+    nhs = [
+        NodeHost(
+            NodeHostConfig(
+                node_host_dir=":memory:",
+                rtt_millisecond=10,
+                raft_address=f"tm{i}:1",
+                raft_rpc_factory=lambda s, rh, ch: ChanTransport(
+                    s, rh, ch, router=router
+                ),
+            )
+        )
+        for i in (1, 2, 3)
+    ]
+    addrs = {i: f"tm{i}:1" for i in (1, 2, 3)}
+    try:
+        for i, nh in enumerate(nhs, 1):
+            nh.start_cluster(
+                addrs, False, SM,
+                Config(cluster_id=3, node_id=i, election_rtt=10, heartbeat_rtt=1),
+            )
+        nhs[0].get_node(3).request_campaign()
+        deadline = time.time() + 20
+        leader = None
+        while leader is None and time.time() < deadline:
+            for nh in nhs:
+                lid, ok = nh.get_leader_id(3)
+                if ok:
+                    leader = nhs[lid - 1]
+            time.sleep(0.02)
+        s = leader.get_noop_session(3)
+        for _ in range(10):
+            assert leader.propose(s, b"x", timeout=5.0).wait(5.0).completed
+        sent = leader.transport.metrics.value("dragonboat_transport_message_sent")
+        recvd = leader.transport.metrics.value(
+            "dragonboat_transport_message_received"
+        )
+        assert sent > 0, "no sent messages counted"
+        assert recvd > 0, "no received messages counted"
+    finally:
+        for nh in nhs:
+            nh.stop()
