@@ -134,6 +134,11 @@ class Node:
         coord = getattr(self, "quorum_coordinator", None)
         if coord is not None:
             self.peer.raft.offload = coord
+            # device-tick mode: the tick kernel owns election/heartbeat/
+            # check-quorum firing; quiesce-enabled groups keep scalar ticks
+            # (their idle detection is host-side state)
+            if coord.drive_ticks and not self.config.quiesce:
+                self.peer.raft.device_ticks = True
             coord.register(self)
         # queue initial recovery so the apply worker restores the newest
         # local snapshot before any new entries apply
@@ -185,6 +190,65 @@ class Node:
                     r.become_follower(r.term, 0)
                 changed = True
         if changed:
+            self.nh.engine.set_step_ready(self.cluster_id)
+
+    def offload_tick_elect(self) -> None:
+        """Device tick kernel says this group's election timeout fired
+        (twin of the fire site in ``non_leader_tick``); all campaign guards
+        re-run inside the scalar ELECTION handler."""
+        fired = False
+        with self.raft_mu:
+            if self.peer is None:
+                return
+            r = self.peer.raft
+            if (
+                r.device_ticks
+                and not r.is_leader()
+                and not r.is_observer()
+                and not r.is_witness()
+                and not r.self_removed()
+                and not self.quiesce_mgr.quiesced()
+                # scalar clock must agree: it resets synchronously under
+                # raftMu on leader contact, so a device row whose staged
+                # contact reset is still riding a round cannot disrupt a
+                # healthy leader (same pattern as the commit term guard)
+                and r.time_for_election()
+            ):
+                r.election_tick = 0
+                r.handle(Message(from_=self.node_id, type=MT.ELECTION))
+                fired = True
+        if fired:
+            self.nh.engine.set_step_ready(self.cluster_id)
+
+    def offload_tick_heartbeat(self) -> None:
+        """Device tick kernel says a leader heartbeat is due (twin of the
+        LEADER_HEARTBEAT fire site in ``leader_tick``)."""
+        fired = False
+        with self.raft_mu:
+            if self.peer is None:
+                return
+            r = self.peer.raft
+            if r.device_ticks and r.is_leader():
+                r.heartbeat_tick = 0
+                r.handle(Message(from_=self.node_id, type=MT.LEADER_HEARTBEAT))
+                fired = True
+        if fired:
+            self.nh.engine.set_step_ready(self.cluster_id)
+
+    def offload_tick_demote(self) -> None:
+        """Device check-quorum window expired without a quorum of active
+        followers; the scalar CHECK_QUORUM handler re-verifies before any
+        demotion happens."""
+        fired = False
+        with self.raft_mu:
+            if self.peer is None:
+                return
+            r = self.peer.raft
+            if r.device_ticks and r.is_leader() and r.check_quorum:
+                r.election_tick = 0
+                r.handle(Message(from_=self.node_id, type=MT.CHECK_QUORUM))
+                fired = True
+        if fired:
             self.nh.engine.set_step_ready(self.cluster_id)
 
     def _publish_event(

@@ -721,6 +721,9 @@ class NodeHost:
             for n in nodes:
                 if n is not None:
                     n.request_tick()
+            if self.quorum_coordinator is not None:
+                # one device tick round per RTT for ALL registered groups
+                self.quorum_coordinator.request_tick()
             self.snapshot_feedback.push_ready(self._now_ms())
             if ticks % max(1, int(1.0 / max(interval, 0.001))) == 0:
                 self.transport.tick()

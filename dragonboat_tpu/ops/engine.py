@@ -321,6 +321,13 @@ class BatchedQuorumEngine:
         gi = self.groups[cluster_id]
         self._acks.append((gi.row, gi.slots[node_id], 0))
 
+    def leader_contact(self, cluster_id: int) -> None:
+        """A follower heard from its leader: reset the row's election clock
+        (twin: ``leader_is_available`` — the kernel resets election_tick on
+        any event touching a non-leader row)."""
+        gi = self.groups[cluster_id]
+        self._acks.append((gi.row, int(self.mirror.arrays["self_slot"][gi.row]), 0))
+
     # ------------------------------------------------------------------
     # dispatch
     # ------------------------------------------------------------------

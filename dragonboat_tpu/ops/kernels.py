@@ -169,6 +169,18 @@ def quorum_step_impl(
     match = st.match.at[ag, ack_p].max(ack_val, mode="drop")
     next_ = st.next.at[ag, ack_p].max(ack_val + 1, mode="drop")
     active = st.active.at[ag, ack_p].set(True, mode="drop")
+    # leader contact: any event touching a NON-leader row resets its
+    # election clock (twin: leader_is_available / raft.go follower
+    # heartbeat handling) — the host stages a zero-value ack when a
+    # follower hears from its leader, so device-tick followers don't
+    # campaign against a healthy leader
+    contacted = (
+        jnp.zeros((g_total + 1,), bool).at[ag].set(True)[:g_total]
+    )
+    nonleader = (st.node_state != LEADER) & st.live
+    election_tick = jnp.where(
+        contacted & nonleader, 0, st.election_tick
+    )
     # self-acks raise last_index (leader append); followers never exceed it
     self_match = jnp.take_along_axis(match, st.self_slot[:, None], axis=1)[:, 0]
     last_index = jnp.maximum(st.last_index, self_match)
@@ -199,6 +211,7 @@ def quorum_step_impl(
         votes=votes,
         committed=committed,
         last_index=last_index,
+        election_tick=election_tick,
     )
 
     if do_tick:

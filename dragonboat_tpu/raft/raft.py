@@ -161,6 +161,14 @@ class Raft:
         # scalar path.  When set, ack/vote tallying and commit advancement
         # are staged to the batched device engine instead of computed here
         self.offload = None
+        # True when the device quorum engine owns the per-tick FIRING
+        # decisions (election due / heartbeat due / check-quorum window);
+        # scalar clocks still advance (vote-lease checks, transfer abort)
+        # but the local fire sites below are suppressed — the coordinator
+        # applies the device flags through the same handlers instead
+        self.device_ticks = False
+        # first index of the current leadership term (set at promotion)
+        self.term_start_index = 0
         self.has_not_applied_config_change: Optional[Callable[[], bool]] = None
         # deterministic, seedable randomness (design delta; see module docstring)
         self.prng = _random.Random(
@@ -390,7 +398,11 @@ class Raft:
         if self.is_observer() or self.is_witness():
             return
         # 6th paragraph section 5.2 of the raft paper
-        if not self.self_removed() and self.time_for_election():
+        if (
+            not self.device_ticks
+            and not self.self_removed()
+            and self.time_for_election()
+        ):
             self.election_tick = 0
             self.handle(Message(from_=self.node_id, type=MT.ELECTION))
 
@@ -404,12 +416,12 @@ class Raft:
         time_to_abort = self.time_to_abort_leader_transfer()
         if self.time_for_check_quorum():
             self.election_tick = 0
-            if self.check_quorum:
+            if self.check_quorum and not self.device_ticks:
                 self.handle(Message(from_=self.node_id, type=MT.CHECK_QUORUM))
         if time_to_abort:
             self.abort_leader_transfer()
         self.heartbeat_tick += 1
-        if self.time_for_heartbeat():
+        if not self.device_ticks and self.time_for_heartbeat():
             self.heartbeat_tick = 0
             self.handle(Message(from_=self.node_id, type=MT.LEADER_HEARTBEAT))
 
@@ -423,6 +435,12 @@ class Raft:
         self.randomized_election_timeout = (
             self.election_timeout + self.prng.randrange(self.election_timeout)
         )
+        if self.offload is not None and self.device_ticks:
+            # keep the device row's election period in step so split votes
+            # get the randomized backoff the raft paper relies on
+            self.offload.set_randomized_timeout(
+                self.cluster_id, self.randomized_election_timeout
+            )
 
     # ------------------------------------------------------------------
     # send and broadcast
@@ -652,6 +670,10 @@ class Raft:
         self.pre_leader_promotion_handle_config_change()
         # p72 of the raft thesis: commit a noop entry at the start of the term
         self.append_entries([Entry(type=EntryType.APPLICATION, cmd=b"")])
+        # O(1) record of the noop's index — the floor below which
+        # counting-based commit is forbidden (raft paper p8); consumed by
+        # the device-engine row sync instead of a log scan
+        self.term_start_index = self.log.last_index()
         if self.offload is not None:
             # term_start = the noop's index: the floor for counting commits
             self.offload.set_leader(
@@ -1133,6 +1155,11 @@ class Raft:
         # reference raft.go:1702-1714
         self.must_be_leader()
         rp.set_active()
+        if self.offload is not None and self.device_ticks:
+            # device check-quorum tallies activity bits per row (its only
+            # consumer is the device-tick demote flag, so scalar-tick
+            # groups must not pay a dispatch per heartbeat for it)
+            self.offload.heartbeat_resp(self.cluster_id, m.from_)
         rp.wait_to_retry()
         if rp.match < self.log.last_index():
             self.send_replicate_message(m.from_)
@@ -1213,6 +1240,10 @@ class Raft:
 
     def leader_is_available(self) -> None:
         self.election_tick = 0
+        if self.offload is not None and self.device_ticks:
+            # reset the device row's election clock too, or the tick
+            # kernel would campaign against a healthy leader
+            self.offload.leader_contact(self.cluster_id)
 
     def handle_follower_replicate(self, m: Message) -> None:
         self.leader_is_available()
@@ -1252,7 +1283,14 @@ class Raft:
         # p29 of the raft thesis: equivalent to the clock jumping forward
         self.election_tick = self.randomized_election_timeout
         self.is_leader_transfer_target = True
-        self.tick()
+        if self.device_ticks:
+            # the tick fire site is device-owned; a leadership transfer is
+            # an explicit request, so campaign immediately with the
+            # transfer-target privileges intact
+            self.election_tick = 0
+            self.handle(Message(from_=self.node_id, type=MT.ELECTION))
+        else:
+            self.tick()
         if self.is_leader_transfer_target:
             self.is_leader_transfer_target = False
 

@@ -52,6 +52,7 @@ class TpuQuorumCoordinator:
         capacity: int = 1024,
         n_peers: int = 8,
         interval_s: float = 0.002,
+        drive_ticks: bool = True,
     ):
         from .ops.engine import BatchedQuorumEngine
 
@@ -59,6 +60,16 @@ class TpuQuorumCoordinator:
             capacity, n_peers, event_cap=max(4 * capacity, 4096)
         )
         self.capacity = capacity
+        # device-tick mode: the per-tick firing decisions (election due,
+        # heartbeat due, check-quorum window) come from the device tick
+        # kernel; registered nodes set raft.device_ticks accordingly
+        self.drive_ticks = drive_ticks
+        # monotonically increasing tick sequence written ONLY by the tick
+        # thread; the round compares against the last value it consumed, so
+        # a tick arriving mid-round is never lost (no lock needed: single
+        # writer, single reader)
+        self._tick_seq = 0
+        self._tick_seen = 0
         self._nodes: Dict[int, "Node"] = {}
         self._mu = threading.RLock()
         # staging is decoupled from the engine lock: raft step workers only
@@ -116,6 +127,9 @@ class TpuQuorumCoordinator:
             self_id=r.node_id,
             election_timeout=r.election_timeout,
             heartbeat_timeout=r.heartbeat_timeout,
+            # per-replica seeded randomized timeout (scalar raft's own),
+            # so co-hosted replicas don't fire elections in lockstep
+            rand_timeout=r.randomized_election_timeout,
             check_quorum=r.check_quorum,
             witnesses=witnesses,
             observers=observers,
@@ -141,9 +155,12 @@ class TpuQuorumCoordinator:
     @staticmethod
     def _term_start(r) -> int:
         """First index of the leader's current term — the floor below which
-        counting-based commit is forbidden (raft paper p8).  The leader
-        appends a noop on promotion, so scanning back from the tail for the
-        first entry of the current term is bounded and exact."""
+        counting-based commit is forbidden (raft paper p8).  O(1): the
+        leader records the index of its promotion noop
+        (``raft.term_start_index``); the scan fallback covers only rows
+        synced from state predating the attribute (never in practice)."""
+        if r.term_start_index > 0:
+            return r.term_start_index
         idx = r.log.last_index()
         first = r.log.first_index()
         while idx >= first:
@@ -170,6 +187,15 @@ class TpuQuorumCoordinator:
     def vote(self, cluster_id: int, node_id: int, granted: bool) -> None:
         self._stage(("vote", cluster_id, node_id, granted))
 
+    def heartbeat_resp(self, cluster_id: int, node_id: int) -> None:
+        self._stage(("hbresp", cluster_id, node_id))
+
+    def leader_contact(self, cluster_id: int) -> None:
+        self._stage(("contact", cluster_id))
+
+    def set_randomized_timeout(self, cluster_id: int, timeout: int) -> None:
+        self._stage(("randto", cluster_id, timeout))
+
     def set_leader(
         self, cluster_id: int, term: int, term_start: int, last_index: int
     ) -> None:
@@ -183,6 +209,13 @@ class TpuQuorumCoordinator:
 
     def membership_changed(self, cluster_id: int) -> None:
         self._stage(("resync", cluster_id))
+
+    def request_tick(self) -> None:
+        """One RTT elapsed: the next round runs the device tick kernel
+        (called from the NodeHost tick worker, once per tick for ALL
+        groups — the device ticks rows in lockstep)."""
+        self._tick_seq += 1
+        self._pending.set()
 
     def _drain_locked(self) -> None:
         """Apply staged ops to the engine in staging order (so a
@@ -199,6 +232,12 @@ class TpuQuorumCoordinator:
                     self.eng.ack(cid, op[2], op[3])
                 elif kind == "vote":
                     self.eng.vote(cid, op[2], op[3])
+                elif kind == "hbresp":
+                    self.eng.heartbeat_resp(cid, op[2])
+                elif kind == "contact":
+                    self.eng.leader_contact(cid)
+                elif kind == "randto":
+                    self.eng.set_randomized_timeout(cid, op[2])
                 elif kind == "leader":
                     self.eng.set_leader(
                         cid, term=op[2], term_start=op[3], last_index=op[4]
@@ -245,19 +284,35 @@ class TpuQuorumCoordinator:
 
     def _round(self) -> None:
         with self._mu:
+            seq = self._tick_seq
+            do_tick = self.drive_ticks and seq != self._tick_seen
+            self._tick_seen = seq
             self._drain_locked()
             if not (
-                self.eng._acks or self.eng._votes or self.eng._dirty
+                do_tick or self.eng._acks or self.eng._votes or self.eng._dirty
             ):
                 return
-            # ticks stay scalar in the integrated path: node.tick drives
-            # elections/heartbeats; the engine round only ingests events
-            # and advances commit/tally state
-            res = self.eng.step(do_tick=False)
+            res = self.eng.step(do_tick=do_tick)
         for cid, q in res.commit.items():
             node = self._nodes.get(cid)
             if node is not None:
                 node.offload_commit(q)
+        # device tick flags: election due / heartbeat due / check-quorum
+        # demote — applied through the scalar handlers under raftMu with
+        # all guards intact (stale flags are rejected there)
+        if do_tick:
+            for cid in res.elect:
+                node = self._nodes.get(cid)
+                if node is not None:
+                    node.offload_tick_elect()
+            for cid in res.heartbeat:
+                node = self._nodes.get(cid)
+                if node is not None:
+                    node.offload_tick_heartbeat()
+            for cid in res.demote:
+                node = self._nodes.get(cid)
+                if node is not None:
+                    node.offload_tick_demote()
         # tag election outcomes with the term the row held when the round
         # ran: during long dispatches (first jit compile, busy host) the
         # scalar side may have restarted the campaign at a higher term, and

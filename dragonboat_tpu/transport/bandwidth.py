@@ -20,15 +20,20 @@ class TokenBucket:
         self._tokens = float(self.rate)
         self._last = time.monotonic()
 
-    def take(self, n: int) -> None:
+    def take(self, n: int, stop=None) -> None:
         """Block until ``n`` tokens are available (no-op when unlimited).
 
         Requests larger than one second's burst are clamped — a 2MB chunk
-        against a 1MB/s cap waits ~1s instead of forever."""
+        against a 1MB/s cap waits ~1s instead of forever.  ``stop`` is an
+        optional Event-like; once set the wait aborts (the caller's own
+        stop/failure handling then takes over instead of this thread
+        sitting in a throttle sleep after shutdown)."""
         if self.rate <= 0:
             return
         n = min(n, self.rate)
         while True:
+            if stop is not None and stop.is_set():
+                return
             with self._mu:
                 now = time.monotonic()
                 self._tokens = min(
@@ -39,4 +44,4 @@ class TokenBucket:
                     self._tokens -= n
                     return
                 missing = n - self._tokens
-            time.sleep(min(1.0, missing / self.rate))
+            time.sleep(min(0.2, missing / self.rate))

@@ -104,7 +104,12 @@ class StreamJob:
                     failed = True
                     break
                 if self._bucket is not None:
-                    self._bucket.take(c.chunk_size or len(c.data))
+                    self._bucket.take(
+                        c.chunk_size or len(c.data), stop=self._failed
+                    )
+                    if self._failed.is_set():
+                        failed = True
+                        break
                 conn.send_chunk(c)
                 sent_any = True
                 if c.is_last_chunk():

@@ -103,5 +103,7 @@ def send_snapshot_chunks(
         loaded = load_chunk_data(c)
         if bucket is not None:
             # snapshot bandwidth cap (reference tcp.go:430-437)
-            bucket.take(loaded.chunk_size or len(loaded.data))
+            bucket.take(loaded.chunk_size or len(loaded.data), stop=stopped)
+        if stopped.is_set():
+            raise RuntimeError("transport stopped")
         conn.send_chunk(loaded)

@@ -87,6 +87,12 @@ class SyncDeviceOffload:
         except (ValueError, KeyError):
             self._register()
 
+    def heartbeat_resp(self, cluster_id, node_id):
+        try:
+            self.eng.heartbeat_resp(cluster_id, node_id)
+        except (ValueError, KeyError):
+            self._register()
+
     def set_leader(self, cluster_id, term, term_start, last_index):
         self.eng.set_leader(
             cluster_id, term=term, term_start=term_start, last_index=last_index
