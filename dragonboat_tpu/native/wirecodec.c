@@ -1,0 +1,236 @@
+/* Native accelerator for the wire codec hot path.
+ *
+ * The reference ships hand-optimized marshal paths for its wire types
+ * (raftpb/raft_optimized.go); this is the analogous native component for
+ * the TPU build's codec (dragonboat_tpu/wire/codec.py).  Only the
+ * per-field varint plumbing moves to C — object construction and the
+ * rarely-used types (snapshots, memberships) stay in Python.  codec.py
+ * falls back to the pure-Python path when this module is unavailable.
+ *
+ * Exposed functions (all operate on bytes-like objects / bytearrays):
+ *   parse_message_fields(data, pos) ->
+ *       (mtype, flags, to, frm, cluster_id, term, log_term, log_index,
+ *        commit, hint, hint_high, nentries, newpos)
+ *   parse_entry_fields(data, pos) ->
+ *       (term, index, etype, key, client_id, series_id, responded_to,
+ *        cmd_start, cmd_end, newpos)   # cmd bounds, zero-copy slicing in py
+ *   encode_message_header(bytearray, mtype, flags, to, frm, cluster_id,
+ *        term, log_term, log_index, commit, hint, hint_high, nentries)
+ *   encode_entry_fields(bytearray, term, index, etype, key, client_id,
+ *        series_id, responded_to, cmd_bytes)
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+static PyObject *CodecError;
+
+/* ---- varint helpers ---------------------------------------------------- */
+
+static int read_uvarint(const unsigned char *buf, Py_ssize_t len,
+                        Py_ssize_t *pos, uint64_t *out) {
+    uint64_t result = 0;
+    int shift = 0;
+    Py_ssize_t p = *pos;
+    while (1) {
+        if (p >= len) return -1;
+        unsigned char b = buf[p++];
+        result |= ((uint64_t)(b & 0x7F)) << shift;
+        if (!(b & 0x80)) break;
+        shift += 7;
+        if (shift > 63) return -1;
+    }
+    *pos = p;
+    *out = result;
+    return 0;
+}
+
+static int write_uvarint(PyObject *ba, uint64_t v) {
+    unsigned char tmp[10];
+    int n = 0;
+    while (1) {
+        unsigned char b = v & 0x7F;
+        v >>= 7;
+        if (v) {
+            tmp[n++] = b | 0x80;
+        } else {
+            tmp[n++] = b;
+            break;
+        }
+    }
+    Py_ssize_t old = PyByteArray_GET_SIZE(ba);
+    if (PyByteArray_Resize(ba, old + n) < 0) return -1;
+    memcpy(PyByteArray_AS_STRING(ba) + old, tmp, n);
+    return 0;
+}
+
+/* ---- decode ------------------------------------------------------------ */
+
+static PyObject *parse_message_fields(PyObject *self, PyObject *args) {
+    Py_buffer view;
+    Py_ssize_t pos;
+    if (!PyArg_ParseTuple(args, "y*n", &view, &pos)) return NULL;
+    const unsigned char *buf = view.buf;
+    Py_ssize_t len = view.len;
+    uint64_t f[11];  /* mtype,to,frm,cid,term,log_term,log_index,commit,
+                        hint,hint_high,nentries */
+    unsigned char flags;
+    if (pos < 0 || read_uvarint(buf, len, &pos, &f[0]) < 0) goto trunc;
+    if (pos >= len) goto trunc;
+    flags = buf[pos++];
+    for (int i = 1; i < 11; i++)
+        if (read_uvarint(buf, len, &pos, &f[i]) < 0) goto trunc;
+    PyBuffer_Release(&view);
+    return Py_BuildValue(
+        "(KBKKKKKKKKKKn)",
+        (unsigned long long)f[0], flags,
+        (unsigned long long)f[1], (unsigned long long)f[2],
+        (unsigned long long)f[3], (unsigned long long)f[4],
+        (unsigned long long)f[5], (unsigned long long)f[6],
+        (unsigned long long)f[7], (unsigned long long)f[8],
+        (unsigned long long)f[9], (unsigned long long)f[10], pos);
+trunc:
+    PyBuffer_Release(&view);
+    PyErr_SetString(CodecError, "truncated Message");
+    return NULL;
+}
+
+static PyObject *parse_entry_fields(PyObject *self, PyObject *args) {
+    Py_buffer view;
+    Py_ssize_t pos;
+    if (!PyArg_ParseTuple(args, "y*n", &view, &pos)) return NULL;
+    const unsigned char *buf = view.buf;
+    Py_ssize_t len = view.len;
+    uint64_t f[7]; /* term,index,etype,key,client_id,series_id,responded_to */
+    uint64_t cmdlen;
+    if (pos < 0) goto trunc;
+    for (int i = 0; i < 7; i++)
+        if (read_uvarint(buf, len, &pos, &f[i]) < 0) goto trunc;
+    if (read_uvarint(buf, len, &pos, &cmdlen) < 0) goto trunc;
+    if (cmdlen > (uint64_t)(len - pos)) goto trunc;
+    {
+        Py_ssize_t cmd_start = pos, cmd_end = pos + (Py_ssize_t)cmdlen;
+        PyBuffer_Release(&view);
+        return Py_BuildValue(
+            "(KKKKKKKnnn)",
+            (unsigned long long)f[0], (unsigned long long)f[1],
+            (unsigned long long)f[2], (unsigned long long)f[3],
+            (unsigned long long)f[4], (unsigned long long)f[5],
+            (unsigned long long)f[6], cmd_start, cmd_end, cmd_end);
+    }
+trunc:
+    PyBuffer_Release(&view);
+    PyErr_SetString(CodecError, "truncated Entry");
+    return NULL;
+}
+
+/* ---- encode ------------------------------------------------------------ */
+
+/* Exact unsigned conversion: raises on negative / >= 2**64 (matching the
+ * pure-Python path's CodecError on negative varints, so a mixed fleet
+ * cannot produce divergent bytes for the same object). */
+static int as_u64(PyObject *o, unsigned long long *out) {
+    unsigned long long v = PyLong_AsUnsignedLongLong(o);
+    if (v == (unsigned long long)-1 && PyErr_Occurred()) {
+        PyErr_Clear();
+        PyErr_SetString(CodecError, "field out of uint64 range");
+        return -1;
+    }
+    *out = v;
+    return 0;
+}
+
+static PyObject *encode_message_header(PyObject *self, PyObject *args) {
+    PyObject *ba, *o[11];
+    unsigned long long mtype, to, frm, cid, term, log_term, log_index,
+        commit, hint, hint_high, nentries;
+    unsigned char flags;
+    if (!PyArg_ParseTuple(args, "O!OBOOOOOOOOOO", &PyByteArray_Type, &ba,
+                          &o[0], &flags, &o[1], &o[2], &o[3], &o[4], &o[5],
+                          &o[6], &o[7], &o[8], &o[9], &o[10]))
+        return NULL;
+    if (as_u64(o[0], &mtype) < 0 || as_u64(o[1], &to) < 0 ||
+        as_u64(o[2], &frm) < 0 || as_u64(o[3], &cid) < 0 ||
+        as_u64(o[4], &term) < 0 || as_u64(o[5], &log_term) < 0 ||
+        as_u64(o[6], &log_index) < 0 || as_u64(o[7], &commit) < 0 ||
+        as_u64(o[8], &hint) < 0 || as_u64(o[9], &hint_high) < 0 ||
+        as_u64(o[10], &nentries) < 0)
+        return NULL;
+    if (write_uvarint(ba, mtype) < 0) return NULL;
+    {
+        Py_ssize_t old = PyByteArray_GET_SIZE(ba);
+        if (PyByteArray_Resize(ba, old + 1) < 0) return NULL;
+        PyByteArray_AS_STRING(ba)[old] = (char)flags;
+    }
+    if (write_uvarint(ba, to) < 0 || write_uvarint(ba, frm) < 0 ||
+        write_uvarint(ba, cid) < 0 || write_uvarint(ba, term) < 0 ||
+        write_uvarint(ba, log_term) < 0 || write_uvarint(ba, log_index) < 0 ||
+        write_uvarint(ba, commit) < 0 || write_uvarint(ba, hint) < 0 ||
+        write_uvarint(ba, hint_high) < 0 || write_uvarint(ba, nentries) < 0)
+        return NULL;
+    Py_RETURN_NONE;
+}
+
+static PyObject *encode_entry_fields(PyObject *self, PyObject *args) {
+    PyObject *ba, *o[7];
+    unsigned long long term, index, etype, key, client_id, series_id,
+        responded_to;
+    Py_buffer cmd;
+    if (!PyArg_ParseTuple(args, "O!OOOOOOOy*", &PyByteArray_Type, &ba, &o[0],
+                          &o[1], &o[2], &o[3], &o[4], &o[5], &o[6], &cmd))
+        return NULL;
+    if (as_u64(o[0], &term) < 0 || as_u64(o[1], &index) < 0 ||
+        as_u64(o[2], &etype) < 0 || as_u64(o[3], &key) < 0 ||
+        as_u64(o[4], &client_id) < 0 || as_u64(o[5], &series_id) < 0 ||
+        as_u64(o[6], &responded_to) < 0) {
+        PyBuffer_Release(&cmd);
+        return NULL;
+    }
+    if (write_uvarint(ba, term) < 0 || write_uvarint(ba, index) < 0 ||
+        write_uvarint(ba, etype) < 0 || write_uvarint(ba, key) < 0 ||
+        write_uvarint(ba, client_id) < 0 || write_uvarint(ba, series_id) < 0 ||
+        write_uvarint(ba, responded_to) < 0 ||
+        write_uvarint(ba, (uint64_t)cmd.len) < 0) {
+        PyBuffer_Release(&cmd);
+        return NULL;
+    }
+    {
+        Py_ssize_t old = PyByteArray_GET_SIZE(ba);
+        if (PyByteArray_Resize(ba, old + cmd.len) < 0) {
+            PyBuffer_Release(&cmd);
+            return NULL;
+        }
+        memcpy(PyByteArray_AS_STRING(ba) + old, cmd.buf, cmd.len);
+    }
+    PyBuffer_Release(&cmd);
+    Py_RETURN_NONE;
+}
+
+/* ---- module ------------------------------------------------------------ */
+
+static PyMethodDef Methods[] = {
+    {"parse_message_fields", parse_message_fields, METH_VARARGS, NULL},
+    {"parse_entry_fields", parse_entry_fields, METH_VARARGS, NULL},
+    {"encode_message_header", encode_message_header, METH_VARARGS, NULL},
+    {"encode_entry_fields", encode_entry_fields, METH_VARARGS, NULL},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "dbtpu_wirecodec", NULL, -1, Methods,
+};
+
+PyMODINIT_FUNC PyInit_dbtpu_wirecodec(void) {
+    PyObject *m = PyModule_Create(&moduledef);
+    if (!m) return NULL;
+    CodecError = PyErr_NewException("dbtpu_wirecodec.CodecError",
+                                    PyExc_ValueError, NULL);
+    Py_XINCREF(CodecError);
+    if (PyModule_AddObject(m, "CodecError", CodecError) < 0) {
+        Py_XDECREF(CodecError);
+        Py_DECREF(m);
+        return NULL;
+    }
+    return m;
+}

@@ -2,7 +2,9 @@
 
 Each kernel is the tensorized twin of a scalar hot loop in
 :mod:`dragonboat_tpu.raft.raft`; the differential tests in
-``tests/test_ops_kernels.py`` assert bit-identical outputs against it.
+``tests/test_ops_quorum.py`` (and the live-path suites
+``tests/test_tpuquorum.py``, ``tests/test_raft_etcd_tpu.py``,
+``tests/test_device_ticks.py``) assert bit-identical outputs against it.
 
 Scalar twin map:
 
@@ -126,7 +128,13 @@ def tick_step(st: QuorumState) -> tuple[QuorumState, TickFlags]:
         st.active, st.voting, st.self_slot, st.quorum
     )
     run_checkq = checkq_due & st.check_quorum_on
-    checkq_demote = run_checkq & ~has_q
+    # fire on EVERY window expiry (not only when the device tally lacks a
+    # quorum): the scalar CHECK_QUORUM handler is the authority and must
+    # consume its per-peer activity bits once per window exactly like the
+    # reference's leader_tick cadence — otherwise stale scalar bits would
+    # make the first real demotion refuse (doubling stale-leader exposure)
+    checkq_demote = run_checkq
+    del has_q  # advisory only; the scalar re-check decides
     active = jnp.where(run_checkq[:, None], cleared_active, st.active)
 
     heartbeat_tick = jnp.where(is_leader, st.heartbeat_tick + 1, st.heartbeat_tick)

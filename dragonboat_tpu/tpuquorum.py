@@ -79,6 +79,11 @@ class TpuQuorumCoordinator:
         # Replicate before fsync, execengine.go:954-961)
         self._stage_mu = threading.Lock()
         self._staged: list = []
+        # per-round leader-contact dedup: one election-clock reset per
+        # group per round is sufficient and idempotent; without this a
+        # follower ingesting tens of thousands of Replicates per second
+        # would stage one event slot per message
+        self._contacted: set = set()
         self._pending = threading.Event()
         self._stopped = threading.Event()
         self._interval = interval_s
@@ -191,7 +196,14 @@ class TpuQuorumCoordinator:
         self._stage(("hbresp", cluster_id, node_id))
 
     def leader_contact(self, cluster_id: int) -> None:
-        self._stage(("contact", cluster_id))
+        if cluster_id in self._contacted:
+            return
+        with self._stage_mu:
+            if cluster_id in self._contacted:
+                return
+            self._contacted.add(cluster_id)
+            self._staged.append(("contact", cluster_id))
+        self._pending.set()
 
     def set_randomized_timeout(self, cluster_id: int, timeout: int) -> None:
         self._stage(("randto", cluster_id, timeout))
@@ -223,6 +235,7 @@ class TpuQuorumCoordinator:
         staged before it)."""
         with self._stage_mu:
             ops, self._staged = self._staged, []
+            self._contacted.clear()
         for op in ops:
             kind, cid = op[0], op[1]
             if cid not in self.eng.groups:
@@ -285,7 +298,12 @@ class TpuQuorumCoordinator:
     def _round(self) -> None:
         with self._mu:
             seq = self._tick_seq
-            do_tick = self.drive_ticks and seq != self._tick_seen
+            # catch up missed ticks (a slow round — first jit compile,
+            # tunneled dispatch — can span several host ticks; the scalar
+            # path replays every LOCAL_TICK the same way).  Capped so a
+            # pathological stall can't turn into a dispatch storm.
+            deficit = min(seq - self._tick_seen, 4) if self.drive_ticks else 0
+            do_tick = deficit > 0
             self._tick_seen = seq
             self._drain_locked()
             if not (
@@ -293,6 +311,13 @@ class TpuQuorumCoordinator:
             ):
                 return
             res = self.eng.step(do_tick=do_tick)
+            for _ in range(deficit - 1):  # replay remaining missed ticks
+                extra = self.eng.step(do_tick=True)
+                res.commit.update(extra.commit)
+                for field in ("won", "lost", "elect", "heartbeat", "demote"):
+                    merged = set(getattr(res, field))
+                    merged.update(getattr(extra, field))
+                    setattr(res, field, list(merged))
         for cid, q in res.commit.items():
             node = self._nodes.get(cid)
             if node is not None:

@@ -100,12 +100,31 @@ def _run_workload(engine):
                 if node.peer.raft.device_ticks
             )
             assert n_dev == 3 * GROUPS, f"device_ticks on {n_dev} replicas"
-        # commit workload on every group
+        # commit workload on every group; re-resolve the leader and retry
+        # once if a proposal lands mid-leadership-churn (the suite runs
+        # under heavy CPU contention, so transient elections can happen)
+        def commit_5(cid):
+            for attempt in range(2):
+                nh = leaders[cid]
+                s = nh.get_noop_session(cid)
+                rss = [nh.propose(s, b"w", timeout=20.0) for _ in range(5)]
+                if all(rs.wait(20.0).completed for rs in rss):
+                    return True
+                deadline2 = time.time() + 20
+                while time.time() < deadline2:
+                    for cand in nhs:
+                        lid, ok = cand.get_leader_id(cid)
+                        if ok:
+                            leaders[cid] = nhs[lid - 1]
+                            break
+                    else:
+                        time.sleep(0.05)
+                        continue
+                    break
+            return False
+
         for cid in cids:
-            s = leaders[cid].get_noop_session(cid)
-            rss = [leaders[cid].propose(s, b"w", timeout=15.0) for _ in range(5)]
-            for rs in rss:
-                assert rs.wait(15.0).completed, (engine, cid)
+            assert commit_5(cid), (engine, cid)
         return {
             cid: leaders[cid].get_node(cid).peer.raft.log.committed
             for cid in cids
