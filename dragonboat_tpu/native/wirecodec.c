@@ -36,6 +36,10 @@ static int read_uvarint(const unsigned char *buf, Py_ssize_t len,
     while (1) {
         if (p >= len) return -1;
         unsigned char b = buf[p++];
+        /* uint64 exactly: the 10th byte may contribute only one bit —
+         * reject (don't truncate) overflow, identical to the pure-Python
+         * decoder so the same bytes can never decode differently */
+        if (shift == 63 && (b & 0x7F) > 1) return -1;
         result |= ((uint64_t)(b & 0x7F)) << shift;
         if (!(b & 0x80)) break;
         shift += 7;

@@ -192,14 +192,24 @@ class Chunks:
             witness=first.witness,
         )
         t.env.save_ss_metadata(ss)
-        t.env.finalize_snapshot()
+        try:
+            t.env.finalize_snapshot()
+        except FileExistsError:
+            # the same snapshot was already received and promoted (an
+            # earlier transfer's install message may have been lost); the
+            # image on disk is identical, so delivering the install message
+            # again is the idempotent repair — raft rejects it if stale
+            t.env.remove_tmp_dir()
         del last
+        # m.term stays 0: chunk.term is the snapshot point's ENTRY term and
+        # must not be stamped on the message — the receiver's raft would
+        # drop it as an old-term message (reference toMessage
+        # chunks.go:375-407 builds the message without a term)
         return Message(
             type=MessageType.INSTALL_SNAPSHOT,
             to=first.node_id,
             from_=first.from_,
             cluster_id=first.cluster_id,
-            term=first.term,
             snapshot=ss,
         )
 

@@ -53,7 +53,13 @@ def split_snapshot_message(
                 chunk_size=ln,
                 chunk_count=total,
                 index=ss.index,
-                term=m.term,
+                # the ENTRY term of the snapshot point, NOT the raft term of
+                # the carrying message (reference snapshot.go:211 uses
+                # msg.Snapshot.Term): the receiver rebuilds the Snapshot from
+                # chunk fields and a raft-term stamp here corrupts the log's
+                # term(ss.index) after restore — probes from the real leader
+                # then mismatch forever and replication livelocks
+                term=ss.term,
                 membership=ss.membership,
                 filepath=f.filepath,
                 file_size=f.file_size,

@@ -56,15 +56,25 @@ def _load_native():
     so = os.path.join(d, "dbtpu_wirecodec.so")
     src = os.path.join(d, "wirecodec.c")
     try:
-        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+        # a prebuilt .so without the source present is simply used; the
+        # staleness check only applies when both exist
+        have_so = os.path.exists(so)
+        stale = (
+            os.path.exists(src)
+            and (not have_so or os.path.getmtime(so) < os.path.getmtime(src))
+        )
+        if not have_so and not os.path.exists(src):
+            return None
+        if stale:
             # compile against THIS interpreter's headers, into a temp file
             # promoted atomically — concurrent importers then either see
             # the old .so or the complete new one, never a partial write
+            # (build recipe mirrored in native/Makefile for manual builds)
             fd, tmp = tempfile.mkstemp(suffix=".so", dir=d)
             os.close(fd)
             r = subprocess.run(
                 [
-                    "cc", "-O2", "-fPIC", "-shared",
+                    os.environ.get("CC", "cc"), "-O2", "-fPIC", "-shared",
                     f"-I{sysconfig.get_paths()['include']}",
                     "-o", tmp, src,
                 ],
@@ -86,8 +96,8 @@ _native = _load_native()
 
 
 def _write_uvarint(buf: bytearray, v: int) -> None:
-    if v < 0:
-        raise CodecError(f"negative varint {v}")
+    if v < 0 or v >= 1 << 64:
+        raise CodecError(f"varint out of uint64 range: {v}")
     while True:
         b = v & 0x7F
         v >>= 7
@@ -106,11 +116,17 @@ def _read_uvarint(data: bytes, pos: int) -> Tuple[int, int]:
             raise CodecError("truncated varint")
         b = data[pos]
         pos += 1
+        # values are uint64 exactly: a 10th byte may only contribute one
+        # bit, and an 11th byte is always invalid (kept identical to the
+        # native decoder so the same wire bytes can never decode
+        # differently across implementations)
+        if shift == 63 and (b & 0x7F) > 1:
+            raise CodecError("varint overflows uint64")
         result |= (b & 0x7F) << shift
         if not b & 0x80:
             return result, pos
         shift += 7
-        if shift > 70:
+        if shift > 63:
             raise CodecError("varint too long")
 
 

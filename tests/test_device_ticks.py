@@ -110,6 +110,8 @@ def _run_workload(engine):
                 rss = [nh.propose(s, b"w", timeout=20.0) for _ in range(5)]
                 if all(rs.wait(20.0).completed for rs in rss):
                     return True
+                if attempt == 1:
+                    break  # no point re-resolving after the final attempt
                 deadline2 = time.time() + 20
                 while time.time() < deadline2:
                     for cand in nhs:
