@@ -150,8 +150,11 @@ class Engine:
         logdb,
         step_workers: int = 4,
         apply_workers: int = 4,
+        get_csi=None,  # cheap cluster-set-index read; avoids the locked
+        # dict copy in get_nodes on every worker wakeup when nothing changed
     ):
         self.get_nodes = get_nodes
+        self.get_csi = get_csi
         self.logdb = logdb
         self._stopped = threading.Event()
         self.step_ready = _WorkReady(step_workers)
@@ -212,8 +215,10 @@ class Engine:
     def _worker_nodes(
         self, cache: List, idx: int, partitioner: FixedPartitioner
     ) -> Dict[int, "Node"]:
-        csi, nodes = self.get_nodes()
         cached_csi, cached = cache[idx]
+        if self.get_csi is not None and self.get_csi() == cached_csi:
+            return cached
+        csi, nodes = self.get_nodes()
         if cached_csi == csi:
             return cached
         mine = {

@@ -161,6 +161,7 @@ class NodeHost:
             self.logdb,
             step_workers=workers,
             apply_workers=workers,
+            get_csi=self._get_csi,
         )
         # ticks
         self._tick_thread = threading.Thread(
@@ -195,9 +196,15 @@ class NodeHost:
                 k: v for k, v in self._clusters.items() if v is not None
             }
 
+    def _get_csi(self) -> int:
+        # GIL-atomic int read; lets engine workers skip the locked
+        # dict copy in _get_nodes when the cluster set hasn't changed
+        return self._csi
+
     def get_node(self, cluster_id: int) -> Node:
-        with self._mu:
-            n = self._clusters.get(cluster_id)
+        # lock-free read (GIL-atomic dict get): this sits on the propose
+        # hot path, once per client request
+        n = self._clusters.get(cluster_id)
         if n is None:
             raise ClusterNotFoundError(f"cluster {cluster_id} not found")
         return n
