@@ -476,16 +476,27 @@ def rank_main() -> int:
     emit("STARTED", {"rank": rank, "started_s": round(started_s, 1)})
     expect("CAMPAIGN")
 
+    t_campaign = time.perf_counter()
     for cid in mine:
         nh.get_node(cid).request_campaign()
     deadline = time.time() + leader_timeout
     led = set()
     next_retry = time.time() + 3.0
+    next_report = time.time() + 5.0
     while len(led) < len(mine) and time.time() < deadline:
         for cid in mine:
             if cid not in led and nh.get_node(cid).is_leader():
                 led.add(cid)
         if len(led) < len(mine):
+            if time.time() >= next_report:
+                # election progress to stderr so a slow tunneled-TPU run
+                # is diagnosable from the driver capture
+                print(
+                    f"rank{rank}: led {len(led)}/{len(mine)} at "
+                    f"{time.perf_counter() - t_campaign:.1f}s",
+                    file=sys.stderr, flush=True,
+                )
+                next_report = time.time() + 5.0
             if time.time() >= next_retry:
                 for cid in mine:
                     if cid not in led:
