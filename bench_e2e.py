@@ -398,7 +398,9 @@ def rank_main() -> int:
     # engine per rank: the device engine lives where the leaders it serves
     # live; with one TPU chip only rank 0 attaches to it (leader_mode
     # "rank0" puts every leader there so ALL commit tallying runs through
-    # the device).  Other ranks never import jax.
+    # the device).  Other ranks never import jax.  (An all-ranks-engined
+    # spread variant was tried and thrashes elections: three device-ticked
+    # replicas per group contend through three round pipelines.)
     my_engine = engine if (engine != "tpu" or rank == 0) else "scalar"
     if my_engine == "tpu":
         _force_cpu_for_engine()
@@ -499,8 +501,14 @@ def rank_main() -> int:
                 next_report = time.time() + 5.0
             if time.time() >= next_retry:
                 for cid in mine:
-                    if cid not in led:
-                        nh.get_node(cid).request_campaign()
+                    if cid in led:
+                        continue
+                    node = nh.get_node(cid)
+                    # don't restart a campaign whose votes are still in
+                    # flight (e.g. riding a busy engine round): bumping the
+                    # term would invalidate the staged tally and thrash
+                    if not node.peer.raft.is_candidate():
+                        node.request_campaign()
                 next_retry = time.time() + 3.0
             time.sleep(0.05)
     leaders = {cid: nh for cid in led}
