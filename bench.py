@@ -226,6 +226,8 @@ def _run_host_loop(n_groups: int, rounds: int) -> dict:
     follower ack are staged via ``eng.ack`` and one ``eng.step`` dispatch
     ingests them and advances commits.  Includes the Python staging cost
     the pipelined kernel mode deliberately excludes."""
+    if rounds < 1 or n_groups < 1:
+        return {"error": f"invalid parameters: groups={n_groups} rounds={rounds}"}
     eng = build_state(n_groups, 2 * n_groups)
     base = 1
     # warmup (jit compile)
