@@ -298,7 +298,7 @@ def run(
     durable: bool = True,
     threads: int = 16,
     n_hosts: int = 3,
-    leader_timeout: float = 120.0,
+    leader_timeout: float = 180.0,
     latency_groups: int = 64,
 ) -> dict:
     """Single-process run; two measurement phases over one live cluster:
@@ -624,7 +624,7 @@ def run_mp(
     threads: int = 8,
     procs: int = 3,
     leader_mode: str = "",
-    leader_timeout: float = 120.0,
+    leader_timeout: float = 180.0,
     latency_groups: int = 64,
     deadline_s: float = 420.0,
 ) -> dict:
