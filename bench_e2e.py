@@ -208,6 +208,77 @@ def _measure(
     }
 
 
+def _mixed_worker(nh_by_cid, cids, payload, read_ratio, stop_at, out):
+    """9:1-style mixed load (BASELINE.md's Mixed IO row): weighted
+    round-robin of linearizable ReadIndex reads and writes, sequential per
+    thread so each op's latency is a real round trip."""
+    reads = writes = errors = 0
+    lat_r = []
+    lat_w = []
+    try:
+        sessions = {cid: nh_by_cid[cid].get_noop_session(cid) for cid in cids}
+        i = 0
+        while time.time() < stop_at:
+            cid = cids[i % len(cids)]
+            i += 1
+            is_read = (i % (read_ratio + 1)) != 0
+            t0 = time.perf_counter()
+            try:
+                if is_read:
+                    nh_by_cid[cid].sync_read(cid, None, timeout=10.0)
+                    lat_r.append(time.perf_counter() - t0)
+                    reads += 1
+                else:
+                    rs = nh_by_cid[cid].propose(
+                        sessions[cid], payload, timeout=10.0
+                    )
+                    if rs.wait(10.0).completed:
+                        lat_w.append(time.perf_counter() - t0)
+                        writes += 1
+                    else:
+                        errors += 1
+            except Exception:
+                errors += 1
+                time.sleep(0.01)
+    except Exception:
+        errors += 1
+    out.append((reads, writes, errors, lat_r, lat_w))
+
+
+def _measure_mixed(leaders, cids, payload, read_ratio, stop_at, threads) -> dict:
+    nthreads = max(1, min(threads, len(cids)))
+    slices = [cids[i::nthreads] for i in range(nthreads)]
+    out = []
+    t_begin = time.time()
+    duration = max(stop_at - t_begin, 0.001)
+    ts = [
+        threading.Thread(
+            target=_mixed_worker,
+            args=(leaders, s, payload, read_ratio, stop_at, out),
+        )
+        for s in slices
+        if s
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    reads = sum(r for r, _, _, _, _ in out)
+    writes = sum(w for _, w, _, _, _ in out)
+    errors = sum(e for _, _, e, _, _ in out)
+    lat_r = [l for _, _, _, ls, _ in out for l in ls]
+    lat_w = [l for _, _, _, _, ls in out for l in ls]
+    return {
+        "ops_per_sec": round((reads + writes) / duration, 1),
+        "reads": reads,
+        "writes": writes,
+        "errors": errors,
+        "read_ratio": read_ratio,
+        "read_latency_ms": _percentiles(lat_r),
+        "write_latency_ms": _percentiles(lat_w),
+    }
+
+
 # ======================================================================
 # single-process mode (chan transport; tests + fallback)
 # ======================================================================
@@ -577,6 +648,17 @@ def rank_main() -> int:
                 "lat_lats": lat_lats[:: max(1, len(lat_lats) // 20000)],
             },
         )
+        # phase 3: mixed 9:1 read:write (BASELINE.md Mixed IO axis)
+        stage = "MIXED"
+        plan = expect("MIX")
+        mix_cids = [c for c in plan["cids"] if c in led]
+        while time.time() < plan["t0"]:
+            time.sleep(0.005)
+        mixed = _measure_mixed(
+            leaders, mix_cids, payload, plan.get("read_ratio", 9),
+            plan["t0"] + plan["duration"], threads,
+        )
+        emit("MIXED", {"rank": rank, "mixed": mixed})
         # final barrier: a rank with no leaders finishes its phases
         # instantly — it must NOT stop its NodeHost (killing quorum for
         # the others) until every rank is done measuring
@@ -586,8 +668,10 @@ def rank_main() -> int:
         # plus every later tag, so the parent never hangs or drops it
         err = {"rank": rank, "error": str(e)}
         emit(stage, err)
-        if stage == "TPUT":
-            emit("RESULT", err)
+        for later in {"TPUT": ("RESULT", "MIXED"), "RESULT": ("MIXED",)}.get(
+            stage, ()
+        ):
+            emit(later, err)
         rc = 1
     finally:
         if sampler is not None:
@@ -599,6 +683,21 @@ def rank_main() -> int:
         except Exception:
             pass
     return rc
+
+
+def _aggregate_mixed(mixed_results):
+    oks = [r["mixed"] for r in mixed_results if "mixed" in r]
+    if not oks:
+        return {"error": "no rank completed the mixed phase"}
+    return {
+        "ops_per_sec": round(sum(m["ops_per_sec"] for m in oks), 1),
+        "reads": sum(m["reads"] for m in oks),
+        "writes": sum(m["writes"] for m in oks),
+        "errors": sum(m["errors"] for m in oks),
+        "read_ratio": oks[0]["read_ratio"],
+        "read_latency_ms": oks[0]["read_latency_ms"],
+        "write_latency_ms": oks[0]["write_latency_ms"],
+    }
 
 
 def _free_ports(n):
@@ -744,6 +843,17 @@ def run_mp(
             read_tagged(i, "RESULT", hard_deadline)
             for i in range(len(children))
         ]
+        # phase 3: mixed 9:1 read:write on a bounded group subset
+        mix_cids = [BASE_CID + g for g in range(min(256, groups))]
+        broadcast("MIX", {"t0": time.time() + 0.5,
+                          "duration": min(duration, 5.0),
+                          "read_ratio": 9, "cids": mix_cids})
+        mixed_results = []
+        for i in range(len(children)):
+            try:
+                mixed_results.append(read_tagged(i, "MIXED", hard_deadline))
+            except Exception as e:  # a rank that died earlier
+                mixed_results.append({"rank": i, "error": str(e)})
         broadcast("EXIT", {})
         # one entry per failed rank (a TPUT-stage error is re-emitted under
         # RESULT so the parent never hangs — don't double-count it)
@@ -784,6 +894,7 @@ def run_mp(
                 "proposing_groups": len(lat_cids),
                 "latency_ms": _percentiles(lat_lats),
             },
+            "mixed_phase": _aggregate_mixed(mixed_results),
             "ranks": [
                 {k: r[k] for k in ("rank", "engine", "platform", "led", "setup_s")}
                 for r in readies
