@@ -88,6 +88,7 @@ class BatchedQuorumEngine:
         event_cap: int = DEFAULT_EVENT_CAP,
         sharding=None,
     ):
+        self.n_groups = n_groups
         self.n_peers = n_peers
         self.event_cap = event_cap
         self.mirror = HostMirror(n_groups, n_peers)
@@ -101,6 +102,8 @@ class BatchedQuorumEngine:
         self._acks: List[Tuple[int, int, int]] = []    # row, slot, rel_val
         self._votes: List[Tuple[int, int, int]] = []   # row, slot, grant
         self._voted_cells: set[Tuple[int, int]] = set()  # within-buffer dedup
+        # vectorized bulk-ingest blocks (ack_block): (rows, slots, rels)
+        self._ack_blocks: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
 
     # ------------------------------------------------------------------
     # group lifecycle (rare path, host scalar)
@@ -172,6 +175,13 @@ class BatchedQuorumEngine:
         self._acks = [e for e in self._acks if e[0] != row]
         self._votes = [e for e in self._votes if e[0] != row]
         self._voted_cells = {c for c in self._voted_cells if c[0] != row}
+        if self._ack_blocks:
+            filtered = []
+            for r, s, v in self._ack_blocks:
+                keep = r != row
+                if keep.any():
+                    filtered.append((r[keep], s[keep], v[keep]))
+            self._ack_blocks = filtered
 
     def remove_group(self, cluster_id: int) -> None:
         gi = self.groups.pop(cluster_id)
@@ -300,6 +310,38 @@ class BatchedQuorumEngine:
             raise ValueError(f"index {index} needs rebase (base {gi.base})")
         self._acks.append((gi.row, gi.slots[node_id], rel))
 
+    def ack_block(self, rows, slots, rels) -> None:
+        """Vectorized bulk ack ingest (numpy arrays in row/slot space).
+
+        The per-event ``ack()`` path costs a Python call per event; a
+        native or vectorized control plane staging thousands of acks per
+        round uses this instead — arrays append as one block and are
+        concatenated at dispatch.  Caller contract: rows are live group
+        rows, slots valid for their rows, ``rels`` already rebased
+        (0 <= rel < REBASE_THRESHOLD); the bounds are validated
+        vectorized, membership is the caller's responsibility.
+        """
+        # validate on the ORIGINAL dtype (an int64 >= 2^32 must hit the
+        # rebase guard, not wrap into range), then narrow
+        rows = np.asarray(rows)
+        slots = np.asarray(slots)
+        rels = np.asarray(rels)
+        if not (rows.shape == slots.shape == rels.shape):
+            raise ValueError("ack_block arrays must share a shape")
+        if rels.size and rels.max() >= REBASE_THRESHOLD:
+            raise ValueError("ack_block rel out of range (rebase needed)")
+        if rows.size and (rows.min() < 0 or rows.max() >= self.n_groups):
+            raise ValueError("ack_block row out of range")
+        if slots.size and (slots.min() < 0 or slots.max() >= self.n_peers):
+            raise ValueError("ack_block slot out of range")
+        # below-base acks are legal raft traffic (delayed retransmits) and
+        # clamp to rel 0, matching ack()'s scalar semantics
+        rels = np.maximum(rels, 0)
+        self._ack_blocks.append(
+            (rows.astype(np.int32), slots.astype(np.int32),
+             rels.astype(np.int32))
+        )
+
     def vote(self, cluster_id: int, node_id: int, granted: bool) -> None:
         """First vote per (group, peer) wins (twin: ``handle_vote_resp``).
 
@@ -378,14 +420,21 @@ class BatchedQuorumEngine:
         self._upload_dirty()
         prev_committed = np.asarray(self.dev.committed)
 
-        while len(self._acks) > self.event_cap or len(self._votes) > self.event_cap:
+        ack_g, ack_p, ack_v = self._gather_acks()
+        pos = 0
+        while (ack_g.size - pos) > self.event_cap or len(self._votes) > self.event_cap:
+            take = min(self.event_cap, ack_g.size - pos)
             self._dispatch(
-                self._acks[: self.event_cap], self._votes[: self.event_cap], False
+                (ack_g[pos : pos + take], ack_p[pos : pos + take],
+                 ack_v[pos : pos + take]),
+                self._votes[: self.event_cap],
+                False,
             )
-            del self._acks[: self.event_cap]
+            pos += take
             del self._votes[: self.event_cap]
-        out = self._dispatch(self._acks, self._votes, do_tick)
-        self._acks.clear()
+        out = self._dispatch(
+            (ack_g[pos:], ack_p[pos:], ack_v[pos:]), self._votes, do_tick
+        )
         self._votes.clear()
         self._voted_cells.clear()
 
@@ -423,8 +472,48 @@ class BatchedQuorumEngine:
                         lst.append(gi.cluster_id)
         return res
 
+    def _gather_acks(self):
+        """Tuple-staged + block-staged acks as three flat arrays; clears
+        both buffers."""
+        parts = []
+        if self._acks:
+            cols = np.array(self._acks, dtype=np.int64)
+            parts.append(
+                (cols[:, 0].astype(np.int32), cols[:, 1].astype(np.int32),
+                 cols[:, 2].astype(np.int32))
+            )
+            self._acks = []
+        if self._ack_blocks:
+            parts.extend(self._ack_blocks)
+            self._ack_blocks = []
+        if not parts:
+            z = np.zeros((0,), np.int32)
+            return z, z, z
+        return (
+            np.concatenate([p[0] for p in parts]),
+            np.concatenate([p[1] for p in parts]),
+            np.concatenate([p[2] for p in parts]),
+        )
+
+    def _pad_ack_arrays(self, g, p, v):
+        cap = self.event_cap
+        n = g.size
+        og = np.zeros((cap,), np.int32)
+        op = np.zeros((cap,), np.int32)
+        ov = np.zeros((cap,), np.int32)
+        valid = np.zeros((cap,), bool)
+        if n:
+            og[:n] = g
+            op[:n] = p
+            ov[:n] = v
+            valid[:n] = True
+        return og, op, ov, valid
+
     def _dispatch(self, acks, votes, do_tick: bool):
-        ag, ap, av, avalid = self._pad(acks, 3)
+        if isinstance(acks, tuple):
+            ag, ap, av, avalid = self._pad_ack_arrays(*acks)
+        else:
+            ag, ap, av, avalid = self._pad(acks, 3)
         vg, vp, vv, vvalid = self._pad(votes, 1)
         out = quorum_step(
             self.dev,

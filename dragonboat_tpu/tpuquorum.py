@@ -307,7 +307,11 @@ class TpuQuorumCoordinator:
             self._tick_seen = seq
             self._drain_locked()
             if not (
-                do_tick or self.eng._acks or self.eng._votes or self.eng._dirty
+                do_tick
+                or self.eng._acks
+                or self.eng._ack_blocks
+                or self.eng._votes
+                or self.eng._dirty
             ):
                 return
             res = self.eng.step(do_tick=do_tick)
