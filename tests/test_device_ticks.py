@@ -104,13 +104,13 @@ def _run_workload(engine):
         # once if a proposal lands mid-leadership-churn (the suite runs
         # under heavy CPU contention, so transient elections can happen)
         def commit_5(cid):
-            for attempt in range(2):
+            for attempt in range(3):
                 nh = leaders[cid]
                 s = nh.get_noop_session(cid)
                 rss = [nh.propose(s, b"w", timeout=20.0) for _ in range(5)]
                 if all(rs.wait(20.0).completed for rs in rss):
                     return True
-                if attempt == 1:
+                if attempt == 2:
                     break  # no point re-resolving after the final attempt
                 deadline2 = time.time() + 20
                 while time.time() < deadline2:
