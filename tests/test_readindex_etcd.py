@@ -1,0 +1,89 @@
+"""Port of the reference's ReadIndex protocol-state tests.
+
+Reference: ``/root/reference/internal/raft/readindex_test.go`` — same
+names and case tables, against :mod:`dragonboat_tpu.raft.readindex`.
+"""
+from __future__ import annotations
+
+import pytest
+
+from dragonboat_tpu.raft import InMemLogDB
+from dragonboat_tpu.raft.readindex import ReadIndex
+from dragonboat_tpu.wire import SystemCtx
+from tests.raft_harness import new_test_raft
+
+
+def ctx_of(v: int) -> SystemCtx:
+    return SystemCtx(low=v, high=v + 1)
+
+
+def test_same_ctx_cannot_be_added_twice():
+    r = ReadIndex()
+    r.add_request(1, ctx_of(10001), 1)
+    assert len(r.pending) == 1
+    r.add_request(2, ctx_of(10001), 2)
+    assert len(r.pending) == 1
+
+
+def test_inconsistent_pending_queue():
+    r = ReadIndex()
+    r.add_request(1, ctx_of(10001), 1)
+    r.queue.append(ctx_of(10003))
+    with pytest.raises(Exception):
+        r.add_request(2, ctx_of(10002), 2)
+
+
+def test_read_index_request_can_be_added():
+    r = ReadIndex()
+    r.add_request(1, ctx_of(10001), 1)
+    r.add_request(2, ctx_of(10002), 2)
+    assert r.has_pending_request()
+    assert len(r.queue) == 2 and len(r.pending) == 2
+    p = r.pending[ctx_of(10002)]
+    assert p.index == 2
+    assert p.from_ == 2
+    assert p.ctx == ctx_of(10002)
+    assert r.peep_ctx() == ctx_of(10002)
+
+
+def test_read_index_checks_input_index():
+    r = ReadIndex()
+    r.add_request(3, ctx_of(10001), 1)
+    r.add_request(5, ctx_of(10002), 3)
+    with pytest.raises(Exception):
+        r.add_request(4, ctx_of(10003), 2)
+
+
+def test_add_confirmation_checks_inconsistent_pending_queue():
+    r = ReadIndex()
+    ctx, ctx2, ctx3 = ctx_of(10001), ctx_of(10002), ctx_of(10003)
+    r.add_request(3, ctx2, 1)
+    r.add_request(4, ctx, 3)
+    r.add_request(5, ctx3, 2)
+    q = list(r.queue)
+    r.queue = [ctx_of(10004)] + q
+    with pytest.raises(Exception):
+        r.confirm(ctx, 1, 3)
+        r.confirm(ctx, 3, 3)
+
+
+def test_read_index_leader_can_be_confirmed():
+    r = ReadIndex()
+    ctx, ctx2, ctx3 = ctx_of(10001), ctx_of(10002), ctx_of(10003)
+    r.add_request(3, ctx2, 1)
+    r.add_request(4, ctx, 3)
+    r.add_request(5, ctx3, 2)
+    assert not r.confirm(ctx, 1, 3)  # quorum not yet reached
+    ris = r.confirm(ctx, 3, 3)
+    assert len(ris) == 2
+    assert ris[1].index == 4 and ris[1].from_ == 3 and ris[1].ctx == ctx
+    assert ris[0].index == 4 and ris[0].from_ == 1 and ris[0].ctx == ctx2
+    assert len(r.pending) == 1 and len(r.queue) == 1
+
+
+def test_read_index_is_reset_after_raft_state_change():
+    r = new_test_raft(1, [1, 2, 3], 10, 1, InMemLogDB())
+    r.read_index.add_request(3, ctx_of(10001), 1)
+    assert len(r.read_index.queue) == 1 and len(r.read_index.pending) == 1
+    r.reset(2)
+    assert len(r.read_index.queue) == 0 and len(r.read_index.pending) == 0
