@@ -1,0 +1,1272 @@
+// natraft: native steady-state replication core (the "fast lane").
+//
+// WHAT THIS IS.  The host-path profile (PERF.md) shows ~75us of serialized
+// Python per write spread across propose -> step -> replicate -> WAL ->
+// ack -> commit -> apply; with three NodeHost ranks on one machine that
+// bounds the end-to-end rate around 10k writes/s, ~3 orders of magnitude
+// off the reference's 9M/s (README Performance; SURVEY.md section 6).  The
+// reference reaches its number with a compiled per-group step loop
+// (internal/raft/raft.go driven by execengine.go worker goroutines); this
+// file is that loop's native equivalent for the tpu build: the steady-state
+// replication data plane (leader propose -> Replicate fan-out, follower
+// append -> ack, ack -> quorum commit, heartbeats, WAL persistence) for
+// *enrolled* groups runs entirely in C++, while Python remains the control
+// plane (elections, membership, snapshots, ReadIndex, recovery) and the
+// apply/notify surface.
+//
+// ENROLLMENT CONTRACT.  A group is enrolled from Python at a quiescent
+// point (under the node's raftMu, with no pending raft Update, log fully
+// persisted, commit == processed == last_index, every remote caught up).
+// While enrolled, the Python raft object for the group is *frozen*: every
+// fast-path message (REPLICATE / REPLICATE_RESP / HEARTBEAT /
+// HEARTBEAT_RESP in the group's current term) is consumed here and MUST NOT
+// reach the stale Python state machine.  Anything else -- a different
+// term, a vote request, a snapshot, a rejection, flow-control trouble,
+// contact loss -- flips the group to EJECTING: subsequent messages pass
+// through to Python as leftovers, and the Python router completes the
+// handoff (natr_eject) under raftMu before delivering them, rebuilding
+// scalar raft state (log watermarks, remote progress, persisted-state
+// cache) from the snapshot this core returns.  Correctness therefore never
+// depends on the fast path handling every case -- only on the eject
+// protocol being airtight (tests/test_fastlane*.py).
+//
+// PERSISTENCE.  Entries/State/MaxIndex records are written to the SAME
+// native segmented-WAL KV engine (nativekv.cpp, via dlopen) with byte-
+// identical key schema (logdb/keys.py: >BQQQ big-endian, tag 5 plain
+// entries) and value encodings (wire/codec.py varint entries; 3x u64-LE
+// State; u64-BE MaxIndex), so restart/replay and all Python-side readers
+// (logreader, conformance tests, import tools) see one coherent store.
+// The round thread groups every staged append across all groups of a
+// shard into ONE fsynced nkv batch -- the reference's
+// one-WriteBatch-per-worker-round geometry (rdb.go:187-210).
+//
+// ORDERING RULES (mirroring the reference's execengine pipeline):
+//   - Replicate fan-out of freshly proposed entries is sent BEFORE the
+//     local fsync (thesis 10.2.1; execengine.go:954-961).
+//   - Follower REPLICATE_RESP and all apply hand-offs are emitted only
+//     AFTER the local fsync covers them (rdb save -> processRaftUpdate).
+//   - The leader's own match advances only at fsync; commit q is the
+//     quorum-th largest of {self fsynced} U {peer match}, and entries are
+//     handed to apply only up to min(commit, fsynced).
+//   - Entries committed by counting are always in the leader's current
+//     term: enrollment starts at commit == last_index, so every index a
+//     tally can newly commit was appended under the enrolled term (raft
+//     paper p8's guard holds structurally).
+//
+// Reference map: leader tally tryCommit raft.go:861-909, follower append
+// handleReplicateMessage raft.go:1426-1450, resp handling raft.go:1671-1700,
+// heartbeat raft.go:826+1702, transport framing tcp.go:57-114.
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <dlfcn.h>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- utils
+
+static inline int64_t mono_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (int64_t)ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
+// zlib-compatible CRC32 (IEEE), table-based.
+struct Crc32Table {
+  uint32_t t[256];
+  Crc32Table() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+  }
+};
+static const Crc32Table kCrc;
+static uint32_t crc32ieee(const uint8_t* p, size_t n, uint32_t crc = 0) {
+  crc = ~crc;
+  for (size_t i = 0; i < n; i++) crc = kCrc.t[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+static void put_uvarint(std::string& b, uint64_t v) {
+  while (v >= 0x80) {
+    b.push_back((char)(v | 0x80));
+    v >>= 7;
+  }
+  b.push_back((char)v);
+}
+
+// Matches wire/codec.py `_read_uvarint` limits (max 10 bytes, uint64).
+static bool get_uvarint(const uint8_t* d, size_t len, size_t& pos, uint64_t& out) {
+  uint64_t r = 0;
+  int shift = 0;
+  while (true) {
+    if (pos >= len) return false;
+    uint8_t b = d[pos++];
+    if (shift == 63 && (b & 0x7F) > 1) return false;
+    r |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      out = r;
+      return true;
+    }
+    shift += 7;
+    if (shift > 63) return false;
+  }
+}
+
+static void put_u64be(std::string& b, uint64_t v) {
+  for (int i = 7; i >= 0; i--) b.push_back((char)((v >> (8 * i)) & 0xFF));
+}
+static void put_u64le(std::string& b, uint64_t v) {
+  for (int i = 0; i < 8; i++) b.push_back((char)((v >> (8 * i)) & 0xFF));
+}
+static void put_u32le(std::string& b, uint32_t v) {
+  for (int i = 0; i < 4; i++) b.push_back((char)((v >> (8 * i)) & 0xFF));
+}
+
+// message types (wire/types.py, values == raftpb/raft.proto:26-53)
+enum MsgType : uint64_t {
+  MT_REPLICATE = 12,
+  MT_REPLICATE_RESP = 13,
+  MT_HEARTBEAT = 17,
+  MT_HEARTBEAT_RESP = 18,
+};
+constexpr uint8_t kFlagSnapshot = 1;
+constexpr uint8_t kFlagReject = 2;
+
+// logdb key schema (logdb/keys.py)
+enum KeyTag : uint8_t { TAG_STATE = 0x02, TAG_MAX_INDEX = 0x03, TAG_ENTRY = 0x05 };
+static std::string make_key(uint8_t tag, uint64_t cid, uint64_t nid, uint64_t idx) {
+  std::string k;
+  k.reserve(25);
+  k.push_back((char)tag);
+  put_u64be(k, cid);
+  put_u64be(k, nid);
+  put_u64be(k, idx);
+  return k;
+}
+
+// nativekv write-batch op encoding (native/__init__.py _encode_batch)
+static void batch_put(std::string& b, const std::string& k, const std::string& v) {
+  b.push_back((char)0);  // _PUT
+  put_u32le(b, (uint32_t)k.size());
+  b += k;
+  put_u32le(b, (uint32_t)v.size());
+  b += v;
+}
+
+// ------------------------------------------------------------ wire model
+
+struct NEntry {
+  uint64_t term = 0, index = 0;
+  std::string enc;  // canonical wire encoding (codec.encode_entry)
+};
+
+// Build the canonical Entry encoding (wire/codec.py encode_entry_into).
+static std::string encode_entry(uint64_t term, uint64_t index, uint64_t etype,
+                                uint64_t key, uint64_t client_id,
+                                uint64_t series_id, uint64_t responded_to,
+                                const uint8_t* cmd, size_t cmdlen) {
+  std::string b;
+  b.reserve(cmdlen + 24);
+  put_uvarint(b, term);
+  put_uvarint(b, index);
+  put_uvarint(b, etype);
+  put_uvarint(b, key);
+  put_uvarint(b, client_id);
+  put_uvarint(b, series_id);
+  put_uvarint(b, responded_to);
+  put_uvarint(b, cmdlen);
+  b.append((const char*)cmd, cmdlen);
+  return b;
+}
+
+// Parse an Entry, returning its term/index and raw span.
+static bool parse_entry(const uint8_t* d, size_t len, size_t& pos,
+                        uint64_t& term, uint64_t& index) {
+  uint64_t etype, key, cid, sid, resp, clen;
+  if (!get_uvarint(d, len, pos, term)) return false;
+  if (!get_uvarint(d, len, pos, index)) return false;
+  if (!get_uvarint(d, len, pos, etype)) return false;
+  if (!get_uvarint(d, len, pos, key)) return false;
+  if (!get_uvarint(d, len, pos, cid)) return false;
+  if (!get_uvarint(d, len, pos, sid)) return false;
+  if (!get_uvarint(d, len, pos, resp)) return false;
+  if (!get_uvarint(d, len, pos, clen)) return false;
+  if (pos + clen > len) return false;
+  pos += clen;
+  return true;
+}
+
+static bool skip_str(const uint8_t* d, size_t len, size_t& pos) {
+  uint64_t n;
+  if (!get_uvarint(d, len, pos, n)) return false;
+  if (pos + n > len) return false;
+  pos += n;
+  return true;
+}
+static bool skip_addr_map(const uint8_t* d, size_t len, size_t& pos) {
+  uint64_t n;
+  if (!get_uvarint(d, len, pos, n)) return false;
+  for (uint64_t i = 0; i < n; i++) {
+    uint64_t k;
+    if (!get_uvarint(d, len, pos, k)) return false;
+    if (!skip_str(d, len, pos)) return false;
+  }
+  return true;
+}
+static bool skip_membership(const uint8_t* d, size_t len, size_t& pos) {
+  uint64_t ccid, nrem;
+  if (!get_uvarint(d, len, pos, ccid)) return false;
+  if (!skip_addr_map(d, len, pos)) return false;
+  if (!get_uvarint(d, len, pos, nrem)) return false;
+  for (uint64_t i = 0; i < nrem; i++) {
+    uint64_t k;
+    if (!get_uvarint(d, len, pos, k)) return false;
+  }
+  if (!skip_addr_map(d, len, pos)) return false;
+  if (!skip_addr_map(d, len, pos)) return false;
+  return true;
+}
+static bool skip_snapshot_file(const uint8_t* d, size_t len, size_t& pos) {
+  uint64_t v;
+  if (!skip_str(d, len, pos)) return false;           // filepath
+  if (!get_uvarint(d, len, pos, v)) return false;     // file_size
+  if (!get_uvarint(d, len, pos, v)) return false;     // file_id
+  if (!skip_str(d, len, pos)) return false;           // metadata (bytes)
+  return true;
+}
+// Skip a Snapshot (wire/codec.py decode_snapshot_from) -- needed only to
+// find message span boundaries; snapshot messages always go to Python.
+static bool skip_snapshot(const uint8_t* d, size_t len, size_t& pos) {
+  uint64_t v, nfiles;
+  if (!skip_str(d, len, pos)) return false;        // filepath
+  if (!get_uvarint(d, len, pos, v)) return false;  // file_size
+  if (!get_uvarint(d, len, pos, v)) return false;  // index
+  if (!get_uvarint(d, len, pos, v)) return false;  // term
+  if (!skip_membership(d, len, pos)) return false;
+  if (!get_uvarint(d, len, pos, nfiles)) return false;
+  for (uint64_t i = 0; i < nfiles; i++)
+    if (!skip_snapshot_file(d, len, pos)) return false;
+  if (!skip_str(d, len, pos)) return false;  // checksum
+  if (pos >= len) return false;
+  pos += 1;  // flags
+  if (!get_uvarint(d, len, pos, v)) return false;  // cluster_id
+  if (!get_uvarint(d, len, pos, v)) return false;  // type
+  if (!get_uvarint(d, len, pos, v)) return false;  // on_disk_index
+  return true;
+}
+
+struct ParsedMsg {
+  uint64_t type, to, from, cluster_id, term, log_term, log_index, commit, hint,
+      hint_high, nentries;
+  uint8_t flags;
+  size_t span_start, span_end;      // raw bytes of the whole message
+  size_t entries_start;             // offset of first entry
+};
+
+static bool parse_message(const uint8_t* d, size_t len, size_t& pos, ParsedMsg& m) {
+  m.span_start = pos;
+  if (!get_uvarint(d, len, pos, m.type)) return false;
+  if (pos >= len) return false;
+  m.flags = d[pos++];
+  if (!get_uvarint(d, len, pos, m.to)) return false;
+  if (!get_uvarint(d, len, pos, m.from)) return false;
+  if (!get_uvarint(d, len, pos, m.cluster_id)) return false;
+  if (!get_uvarint(d, len, pos, m.term)) return false;
+  if (!get_uvarint(d, len, pos, m.log_term)) return false;
+  if (!get_uvarint(d, len, pos, m.log_index)) return false;
+  if (!get_uvarint(d, len, pos, m.commit)) return false;
+  if (!get_uvarint(d, len, pos, m.hint)) return false;
+  if (!get_uvarint(d, len, pos, m.hint_high)) return false;
+  if (!get_uvarint(d, len, pos, m.nentries)) return false;
+  m.entries_start = pos;
+  for (uint64_t i = 0; i < m.nentries; i++) {
+    uint64_t t, ix;
+    if (!parse_entry(d, len, pos, t, ix)) return false;
+  }
+  if (m.flags & kFlagSnapshot) {
+    if (!skip_snapshot(d, len, pos)) return false;
+  }
+  m.span_end = pos;
+  return true;
+}
+
+// Encode a fast-path message header (wire/codec.py encode_message_into).
+static void put_msg_header(std::string& b, uint64_t type, uint8_t flags,
+                           uint64_t to, uint64_t from, uint64_t cid,
+                           uint64_t term, uint64_t log_term, uint64_t log_index,
+                           uint64_t commit, uint64_t hint, uint64_t hint_high,
+                           uint64_t nentries) {
+  put_uvarint(b, type);
+  b.push_back((char)flags);
+  put_uvarint(b, to);
+  put_uvarint(b, from);
+  put_uvarint(b, cid);
+  put_uvarint(b, term);
+  put_uvarint(b, log_term);
+  put_uvarint(b, log_index);
+  put_uvarint(b, commit);
+  put_uvarint(b, hint);
+  put_uvarint(b, hint_high);
+  put_uvarint(b, nentries);
+}
+
+// ---------------------------------------------------------------- engine
+
+typedef int (*nkv_commit_fn)(void*, const uint8_t*, size_t);
+
+struct Shard {
+  void* handle = nullptr;
+};
+
+// Outbound plane: one buffer of ready-to-send transport frames per remote
+// address slot; a Python pump thread per slot drains with sendall.
+struct Remote {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::string buf;          // complete frames
+  std::string msgs;         // current pass's message spans (round thread only)
+  uint64_t msg_count = 0;   // messages in `msgs`
+  bool closed = false;
+  uint64_t dropped = 0;
+};
+
+struct ApplySpan {
+  uint64_t cid = 0, first = 0, last = 0;
+  std::string blob;  // varint(count) + entry encodings (decode_entry_batch)
+};
+
+enum GroupState { G_ACTIVE = 0, G_EJECTING = 1, G_GONE = 2 };
+enum EventCode {
+  EV_CONTACT_LOST = 1,   // follower: no leader contact within timeout
+  EV_QUORUM_LOST = 2,    // leader: check-quorum window expired
+  EV_PROTOCOL = 3,       // conflicting/unsupported message needs Python
+  EV_WAL_ERROR = 4,
+};
+
+struct PeerP {
+  uint64_t id = 0;
+  int slot = -1;
+  uint64_t match = 0, next = 0;
+  int64_t contact_ms = 0;
+};
+
+struct PendResp {
+  int slot;
+  uint64_t to, type, log_index, hint, hint_high;
+  uint8_t flags;
+};
+
+struct Group {
+  std::mutex mu;
+  uint64_t cid = 0, nid = 0, term = 0, vote = 0, leader_id = 0;
+  bool leader = false;
+  uint32_t shard = 0;
+  int state = G_ACTIVE;
+  // log
+  uint64_t log_first = 0;            // index of log.front()
+  uint64_t enroll_last = 0, enroll_last_term = 0;
+  uint64_t last_index = 0;
+  uint64_t staged_to = 0;            // appended into the shard batch
+  uint64_t fsynced = 0;              // durable locally
+  uint64_t commit = 0;
+  uint64_t applied_handed = 0;       // handed to the apply pump
+  uint64_t commit_sent = 0;          // commit watermark last broadcast
+  std::deque<NEntry> log;
+  std::vector<PeerP> peers;
+  std::vector<PendResp> resps;       // post-fsync responses (follower)
+  // persisted-record suppression (plays rdbcache's role for this group)
+  uint64_t st_written_term = 0, st_written_vote = 0, st_written_commit = 0;
+  uint64_t maxindex_written = 0;
+  bool dirty = false;
+  // clocks
+  int64_t hb_period_ms = 100, elect_timeout_ms = 1000;
+  int64_t last_hb_ms = 0;            // leader: last heartbeat broadcast
+  int64_t leader_contact_ms = 0;     // follower: last leader contact
+  int64_t quorum_ok_ms = 0;          // leader: last time a quorum was in contact
+
+  uint64_t term_of(uint64_t index) const {
+    // only called for index >= enroll_last (enrollment guarantees older
+    // indexes are committed and consistent)
+    if (index == enroll_last) return enroll_last_term;
+    if (index >= log_first && index < log_first + log.size())
+      return log[index - log_first].term;
+    return 0;  // unknown
+  }
+};
+
+struct Engine {
+  std::string source_address;
+  uint64_t deployment_id = 0, bin_ver = 1;
+  nkv_commit_fn nkv_commit = nullptr;
+  void* nkv_dl = nullptr;
+  std::vector<Shard> shards;
+  std::vector<std::unique_ptr<Remote>> remotes;
+
+  std::mutex gmu;  // group registry
+  std::unordered_map<uint64_t, std::unique_ptr<Group>> groups;
+
+  // work signalling
+  std::mutex wmu;
+  std::condition_variable wcv;
+  std::vector<Group*> dirtyq;
+
+  // apply plane
+  std::mutex amu;
+  std::condition_variable acv;
+  std::deque<ApplySpan> applyq;
+
+  // eject events
+  std::mutex emu;
+  std::condition_variable ecv;
+  std::deque<std::pair<uint64_t, int>> eventq;
+
+  std::atomic<bool> stopped{false};
+  std::thread round_thread;
+  int64_t round_interval_ms = 1;
+
+  // stats
+  std::atomic<uint64_t> proposed{0}, ingested_fast{0}, ingested_slow{0},
+      commits_advanced{0}, rounds{0}, fsyncs{0};
+
+  ~Engine() { stop(); }
+
+  void stop() {
+    bool was = stopped.exchange(true);
+    if (was) return;
+    wcv.notify_all();
+    acv.notify_all();
+    ecv.notify_all();
+    for (auto& r : remotes) {
+      std::lock_guard<std::mutex> g(r->mu);
+      r->closed = true;
+      r->cv.notify_all();
+    }
+    if (round_thread.joinable()) round_thread.join();
+  }
+
+  Group* find(uint64_t cid) {
+    std::lock_guard<std::mutex> g(gmu);
+    auto it = groups.find(cid);
+    return it == groups.end() ? nullptr : it->second.get();
+  }
+
+  void mark_dirty(Group* g) {  // callers hold g->mu
+    if (g->dirty) return;
+    g->dirty = true;
+    std::lock_guard<std::mutex> lk(wmu);
+    dirtyq.push_back(g);
+    wcv.notify_one();
+  }
+
+  void push_event(uint64_t cid, int code) {
+    std::lock_guard<std::mutex> lk(emu);
+    eventq.emplace_back(cid, code);
+    ecv.notify_one();
+  }
+
+  // callers hold g->mu
+  void begin_eject(Group* g, int code) {
+    if (g->state != G_ACTIVE) return;
+    g->state = G_EJECTING;
+    push_event(g->cid, code);
+  }
+
+  // Append a message span to a remote's current-pass buffer (round thread
+  // only, or ingest thread for direct responses under the remote's mutex).
+  void queue_msg(int slot, const std::string& span) {
+    if (slot < 0 || slot >= (int)remotes.size()) return;
+    Remote* r = remotes[slot].get();
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->msgs += span;
+    r->msg_count++;
+  }
+
+  // Wrap each remote's accumulated messages into one transport frame and
+  // publish it to the pump (tcp.py frame layout: >HHQII + payload).
+  void flush_remotes() {
+    for (auto& rp : remotes) {
+      Remote* r = rp.get();
+      std::string msgs;
+      uint64_t count;
+      {
+        std::lock_guard<std::mutex> lk(r->mu);
+        if (!r->msg_count) continue;
+        msgs.swap(r->msgs);
+        count = r->msg_count;
+        r->msg_count = 0;
+      }
+      std::string payload;
+      payload.reserve(msgs.size() + source_address.size() + 24);
+      put_uvarint(payload, deployment_id);
+      put_uvarint(payload, source_address.size());
+      payload += source_address;
+      put_uvarint(payload, bin_ver);
+      put_uvarint(payload, count);
+      payload += msgs;
+      std::string frame;
+      frame.reserve(payload.size() + 20);
+      // >HHQI magic method size payload_crc, then header crc, big-endian
+      frame.push_back((char)0xAE);
+      frame.push_back((char)0x7D);
+      frame.push_back((char)0x00);
+      frame.push_back((char)0x64);  // RAFT_METHOD 100
+      for (int i = 7; i >= 0; i--)
+        frame.push_back((char)((payload.size() >> (8 * i)) & 0xFF));
+      uint32_t pcrc = crc32ieee((const uint8_t*)payload.data(), payload.size());
+      for (int i = 3; i >= 0; i--) frame.push_back((char)((pcrc >> (8 * i)) & 0xFF));
+      uint32_t hcrc = crc32ieee((const uint8_t*)frame.data(), frame.size());
+      for (int i = 3; i >= 0; i--) frame.push_back((char)((hcrc >> (8 * i)) & 0xFF));
+      frame += payload;
+      {
+        std::lock_guard<std::mutex> lk(r->mu);
+        if (r->buf.size() > (64u << 20)) {
+          // pump stalled / peer dead: drop like the reference's full
+          // sendQueue (transport.go Send -> false); raft retries cover it
+          r->dropped++;
+        } else {
+          r->buf += frame;
+          r->cv.notify_one();
+        }
+      }
+    }
+  }
+
+  // quorum-th largest of {self fsynced} U {peer match} (tryCommit,
+  // raft.go:888-909; same reduction ops/kernels.py commit_quorum runs
+  // on-device for the batched engine)
+  uint64_t tally(Group* g) {
+    uint64_t m[17];
+    size_t n = 0;
+    m[n++] = g->fsynced;
+    for (auto& p : g->peers) m[n++] = p.match;
+    std::sort(m, m + n);
+    size_t quorum = n / 2 + 1;
+    return m[n - quorum];
+  }
+
+  void emit_apply(Group* g) {  // g->mu held
+    uint64_t upto = std::min(g->commit, g->fsynced);
+    if (upto <= g->applied_handed) return;
+    uint64_t first = g->applied_handed + 1;
+    if (first < g->log_first) return;  // should not happen
+    ApplySpan span;
+    span.cid = g->cid;
+    span.first = first;
+    span.last = upto;
+    put_uvarint(span.blob, upto - first + 1);
+    for (uint64_t i = first; i <= upto; i++)
+      span.blob += g->log[i - g->log_first].enc;
+    g->applied_handed = upto;
+    {
+      std::lock_guard<std::mutex> lk(amu);
+      applyq.push_back(std::move(span));
+      acv.notify_one();
+    }
+  }
+
+  void trim_log(Group* g) {  // g->mu held
+    uint64_t keep_from = g->applied_handed + 1;
+    for (auto& p : g->peers) keep_from = std::min(keep_from, p.match + 1);
+    while (g->log_first < keep_from && !g->log.empty() &&
+           g->log.size() > 64) {  // keep a small resend cushion
+      g->log.pop_front();
+      g->log_first++;
+    }
+  }
+
+  // Build and queue a REPLICATE to peer p with entries (p.next..last],
+  // capped; advances p.next (pipeline mode).  g->mu held.
+  void send_entries(Group* g, PeerP& p) {
+    static constexpr uint64_t kMaxBatch = 4096;
+    static constexpr uint64_t kMaxInflight = 1u << 14;
+    if (p.next <= g->enroll_last) return;  // needs pre-enroll entries: eject
+    while (p.next <= g->last_index && p.next - 1 - p.match < kMaxInflight) {
+      uint64_t first = p.next;
+      uint64_t last = std::min(g->last_index, first + kMaxBatch - 1);
+      uint64_t prev = first - 1;
+      uint64_t prev_term = g->term_of(prev);
+      if (prev_term == 0 && prev != 0) {
+        begin_eject(g, EV_PROTOCOL);
+        return;
+      }
+      std::string b;
+      put_msg_header(b, MT_REPLICATE, 0, p.id, g->nid, g->cid, g->term,
+                     prev_term, prev, g->commit, 0, 0, last - first + 1);
+      for (uint64_t i = first; i <= last; i++)
+        b += g->log[i - g->log_first].enc;
+      queue_msg(p.slot, b);
+      p.next = last + 1;
+    }
+    if (g->commit > g->commit_sent && p.next > g->last_index) {
+      // commit-update broadcast: empty REPLICATE carrying the watermark
+      std::string b;
+      put_msg_header(b, MT_REPLICATE, 0, p.id, g->nid, g->cid, g->term,
+                     g->term_of(g->last_index), g->last_index, g->commit, 0, 0,
+                     0);
+      queue_msg(p.slot, b);
+    }
+  }
+
+  // One pass of the round loop: stage WAL, fsync per shard, post-fsync
+  // effects, heartbeats/clocks.
+  void round_pass() {
+    std::vector<Group*> work;
+    {
+      std::unique_lock<std::mutex> lk(wmu);
+      if (dirtyq.empty())
+        wcv.wait_for(lk, std::chrono::milliseconds(round_interval_ms));
+      work.swap(dirtyq);
+    }
+    rounds++;
+    // stage phase: per-shard WAL batches + pre-fsync replicate fan-out
+    std::vector<std::string> batches(shards.size());
+    for (Group* g : work) {
+      std::lock_guard<std::mutex> lk(g->mu);
+      g->dirty = false;
+      if (g->state != G_ACTIVE) continue;
+      if (g->last_index > g->staged_to) {
+        std::string& b = batches[g->shard];
+        for (uint64_t i = g->staged_to + 1; i <= g->last_index; i++)
+          batch_put(b, make_key(TAG_ENTRY, g->cid, g->nid, i),
+                    g->log[i - g->log_first].enc);
+        g->staged_to = g->last_index;
+        if (g->last_index != g->maxindex_written) {
+          std::string v;
+          put_u64be(v, g->last_index);
+          batch_put(b, make_key(TAG_MAX_INDEX, g->cid, g->nid, 0), v);
+          g->maxindex_written = g->last_index;
+        }
+        // leader: replicate BEFORE fsync (thesis 10.2.1)
+        if (g->leader)
+          for (auto& p : g->peers) send_entries(g, p);
+      }
+      if (g->term != g->st_written_term || g->vote != g->st_written_vote ||
+          g->commit != g->st_written_commit) {
+        std::string v;
+        put_u64le(v, g->term);
+        put_u64le(v, g->vote);
+        put_u64le(v, g->commit);
+        batch_put(batches[g->shard], make_key(TAG_STATE, g->cid, g->nid, 0), v);
+        g->st_written_term = g->term;
+        g->st_written_vote = g->vote;
+        g->st_written_commit = g->commit;
+      }
+    }
+    flush_remotes();  // pre-fsync sends go out now
+    // fsync phase
+    std::vector<bool> ok(shards.size(), true);
+    for (size_t s = 0; s < shards.size(); s++) {
+      if (batches[s].empty()) continue;
+      fsyncs++;
+      int rc = nkv_commit(shards[s].handle, (const uint8_t*)batches[s].data(),
+                          batches[s].size());
+      ok[s] = rc >= 0;
+    }
+    // post-fsync phase
+    for (Group* g : work) {
+      std::lock_guard<std::mutex> lk(g->mu);
+      if (g->state != G_ACTIVE) continue;
+      if (!ok[g->shard]) {
+        begin_eject(g, EV_WAL_ERROR);
+        continue;
+      }
+      g->fsynced = g->staged_to;
+      // follower: durable -> acks out
+      for (auto& r : g->resps) {
+        std::string b;
+        put_msg_header(b, r.type, r.flags, r.to, g->nid, g->cid, g->term, 0,
+                       r.log_index, 0, r.hint, r.hint_high, 0);
+        queue_msg(r.slot, b);
+      }
+      g->resps.clear();
+      if (g->leader) {
+        uint64_t q = tally(g);
+        if (q > g->commit) {
+          g->commit = q;
+          commits_advanced++;
+        }
+        emit_apply(g);
+        for (auto& p : g->peers) send_entries(g, p);
+        if (g->commit > g->commit_sent) g->commit_sent = g->commit;
+      } else {
+        emit_apply(g);
+      }
+      trim_log(g);
+    }
+    flush_remotes();
+    clock_pass();
+  }
+
+  int64_t last_clock_ms = 0;
+  void clock_pass() {
+    int64_t now = mono_ms();
+    if (now - last_clock_ms < 10) return;
+    last_clock_ms = now;
+    std::lock_guard<std::mutex> reg(gmu);
+    for (auto& kv : groups) {
+      Group* g = kv.second.get();
+      std::lock_guard<std::mutex> lk(g->mu);
+      if (g->state != G_ACTIVE) continue;
+      if (g->leader) {
+        if (now - g->last_hb_ms >= g->hb_period_ms) {
+          g->last_hb_ms = now;
+          for (auto& p : g->peers) {
+            std::string b;
+            put_msg_header(b, MT_HEARTBEAT, 0, p.id, g->nid, g->cid, g->term,
+                           0, 0, std::min(p.match, g->commit), 0, 0, 0);
+            queue_msg(p.slot, b);
+          }
+        }
+        // check-quorum (leaderHasQuorum raft.go:380-390): count peers
+        // heard from inside the election window
+        size_t active = 1;
+        for (auto& p : g->peers)
+          if (now - p.contact_ms < g->elect_timeout_ms) active++;
+        size_t quorum = (g->peers.size() + 1) / 2 + 1;
+        if (active >= quorum) g->quorum_ok_ms = now;
+        if (now - g->quorum_ok_ms > 2 * g->elect_timeout_ms)
+          begin_eject(g, EV_QUORUM_LOST);
+      } else {
+        if (now - g->leader_contact_ms > g->elect_timeout_ms)
+          begin_eject(g, EV_CONTACT_LOST);
+      }
+    }
+    flush_remotes();
+  }
+
+  void round_main() {
+    while (!stopped.load()) round_pass();
+  }
+
+  // ------------------------------------------------------------ ingest
+
+  // Handle one fast-path message for an ACTIVE group.  Returns false when
+  // the message must go to Python (group flips to EJECTING first).
+  bool handle_fast(Group* g, const ParsedMsg& m, const uint8_t* d) {
+    std::lock_guard<std::mutex> lk(g->mu);
+    if (g->state != G_ACTIVE) return false;
+    if (m.term != g->term || m.to != g->nid) {
+      begin_eject(g, EV_PROTOCOL);
+      return false;
+    }
+    int64_t now = mono_ms();
+    switch (m.type) {
+      case MT_REPLICATE: {
+        if (g->leader || m.from != g->leader_id) {
+          begin_eject(g, EV_PROTOCOL);
+          return false;
+        }
+        g->leader_contact_ms = now;
+        int slot = peer_slot(g, m.from);
+        if (slot < 0) {
+          begin_eject(g, EV_PROTOCOL);
+          return false;
+        }
+        if (m.log_index < g->commit) {
+          g->resps.push_back({slot, m.from, MT_REPLICATE_RESP, g->commit, 0, 0, 0});
+          mark_dirty(g);
+          return true;
+        }
+        if (m.log_index > g->last_index) {
+          begin_eject(g, EV_PROTOCOL);  // gap: needs Python retry logic
+          return false;
+        }
+        // prev-term check where verifiable (enrollment guarantees
+        // consistency at or below enroll_last == commit-at-enroll)
+        uint64_t pt = g->term_of(m.log_index);
+        if (pt != 0 && pt != m.log_term) {
+          begin_eject(g, EV_PROTOCOL);
+          return false;
+        }
+        // append entries with index > last_index (same-term overlap is
+        // identical by the raft log-matching property)
+        size_t pos = m.entries_start;
+        uint64_t appended_last = m.log_index;
+        for (uint64_t i = 0; i < m.nentries; i++) {
+          size_t espan = pos;
+          uint64_t term, index;
+          if (!parse_entry(d, m.span_end, pos, term, index)) {
+            begin_eject(g, EV_PROTOCOL);
+            return false;
+          }
+          appended_last = index;
+          if (index <= g->last_index) continue;  // duplicate resend
+          if (index != g->last_index + 1 || term != g->term) {
+            begin_eject(g, EV_PROTOCOL);
+            return false;
+          }
+          NEntry e;
+          e.term = term;
+          e.index = index;
+          e.enc.assign((const char*)d + espan, pos - espan);
+          g->log.push_back(std::move(e));
+          g->last_index = index;
+        }
+        uint64_t c = std::min(appended_last, m.commit);
+        c = std::min(c, g->last_index);
+        if (c > g->commit) g->commit = c;
+        g->resps.push_back(
+            {slot, m.from, MT_REPLICATE_RESP, appended_last, 0, 0, 0});
+        mark_dirty(g);
+        return true;
+      }
+      case MT_REPLICATE_RESP: {
+        if (!g->leader) {
+          begin_eject(g, EV_PROTOCOL);
+          return false;
+        }
+        if (m.flags & kFlagReject) {
+          begin_eject(g, EV_PROTOCOL);  // conflict/lag: Python flow control
+          return false;
+        }
+        for (auto& p : g->peers) {
+          if (p.id != m.from) continue;
+          p.contact_ms = now;
+          if (m.log_index > p.match) {
+            p.match = m.log_index;
+            if (p.next < p.match + 1) p.next = p.match + 1;
+            mark_dirty(g);  // tally/apply happen on the round thread
+          }
+          return true;
+        }
+        begin_eject(g, EV_PROTOCOL);
+        return false;
+      }
+      case MT_HEARTBEAT: {
+        if (g->leader || m.from != g->leader_id) {
+          begin_eject(g, EV_PROTOCOL);
+          return false;
+        }
+        g->leader_contact_ms = now;
+        int slot = peer_slot(g, m.from);
+        if (slot < 0) {
+          begin_eject(g, EV_PROTOCOL);
+          return false;
+        }
+        uint64_t c = std::min(m.commit, g->fsynced);
+        if (c > g->commit) {
+          g->commit = c;
+          mark_dirty(g);
+        }
+        // ReadIndex confirmation hints are a pure echo on the follower
+        // (raft.go:883-892), so an enrolled follower keeps serving a
+        // Python leader's ReadIndex protocol
+        g->resps.push_back(
+            {slot, m.from, MT_HEARTBEAT_RESP, 0, m.hint, m.hint_high, 0});
+        mark_dirty(g);
+        return true;
+      }
+      case MT_HEARTBEAT_RESP: {
+        if (!g->leader) {
+          begin_eject(g, EV_PROTOCOL);
+          return false;
+        }
+        if (m.hint != 0) {
+          // an enrolled leader has no pending ReadIndex (reads eject) --
+          // a hinted resp is from a pre-enrollment round; re-sync scalar
+          begin_eject(g, EV_PROTOCOL);
+          return false;
+        }
+        for (auto& p : g->peers) {
+          if (p.id != m.from) continue;
+          p.contact_ms = now;
+          if (p.match < g->last_index) mark_dirty(g);
+          return true;
+        }
+        begin_eject(g, EV_PROTOCOL);
+        return false;
+      }
+      default:
+        begin_eject(g, EV_PROTOCOL);
+        return false;
+    }
+  }
+
+  static int peer_slot(Group* g, uint64_t id) {
+    for (auto& p : g->peers)
+      if (p.id == id) return p.slot;
+    return -1;
+  }
+};
+
+}  // namespace
+
+// ------------------------------------------------------------------ C ABI
+
+extern "C" {
+
+void* natr_create(const char* source_address, uint64_t deployment_id,
+                  uint64_t bin_ver, const char* nativekv_so_path, char* errbuf,
+                  size_t errlen) {
+  auto e = std::make_unique<Engine>();
+  e->source_address = source_address ? source_address : "";
+  e->deployment_id = deployment_id;
+  e->bin_ver = bin_ver;
+  e->nkv_dl = dlopen(nativekv_so_path, RTLD_NOW | RTLD_GLOBAL);
+  if (!e->nkv_dl) {
+    if (errbuf && errlen) snprintf(errbuf, errlen, "dlopen: %s", dlerror());
+    return nullptr;
+  }
+  e->nkv_commit = (nkv_commit_fn)dlsym(e->nkv_dl, "nkv_commit");
+  if (!e->nkv_commit) {
+    if (errbuf && errlen) snprintf(errbuf, errlen, "dlsym nkv_commit failed");
+    return nullptr;
+  }
+  return e.release();
+}
+
+void natr_start(void* h) {
+  Engine* e = (Engine*)h;
+  e->round_thread = std::thread([e] { e->round_main(); });
+}
+
+void natr_destroy(void* h) {
+  Engine* e = (Engine*)h;
+  delete e;
+}
+
+void natr_free(void* p) { free(p); }
+
+int natr_set_shards(void* h, void** handles, int n) {
+  Engine* e = (Engine*)h;
+  e->shards.resize(n);
+  for (int i = 0; i < n; i++) e->shards[i].handle = handles[i];
+  return 0;
+}
+
+// Register a remote address slot; returns the slot index.
+int natr_add_remote(void* h) {
+  Engine* e = (Engine*)h;
+  e->remotes.emplace_back(new Remote());
+  return (int)e->remotes.size() - 1;
+}
+
+// Enroll a quiescent group.  peers arrays exclude self.  Requires (checked
+// by the Python caller under raftMu): commit == processed == last_index,
+// log fully persisted, every peer's match == last_index.
+int natr_enroll(void* h, uint64_t cid, uint64_t nid, uint64_t term,
+                uint64_t vote, uint64_t leader_id, int is_leader,
+                uint64_t last_index, uint64_t last_term, uint64_t commit,
+                uint32_t shard, int64_t hb_period_ms, int64_t elect_timeout_ms,
+                const uint64_t* peer_ids, const int32_t* peer_slots,
+                int npeers) {
+  Engine* e = (Engine*)h;
+  if (shard >= e->shards.size() || npeers > 16) return -1;
+  auto g = std::make_unique<Group>();
+  g->cid = cid;
+  g->nid = nid;
+  g->term = term;
+  g->vote = vote;
+  g->leader_id = leader_id;
+  g->leader = is_leader != 0;
+  g->shard = shard;
+  g->log_first = last_index + 1;
+  g->enroll_last = last_index;
+  g->enroll_last_term = last_term;
+  g->last_index = last_index;
+  g->staged_to = last_index;
+  g->fsynced = last_index;
+  g->commit = commit;
+  g->applied_handed = commit;
+  g->commit_sent = commit;
+  // seed the suppression caches with current on-disk values so the first
+  // round only writes records that actually change
+  g->st_written_term = term;
+  g->st_written_vote = vote;
+  g->st_written_commit = commit;
+  g->maxindex_written = last_index;
+  g->hb_period_ms = hb_period_ms;
+  g->elect_timeout_ms = elect_timeout_ms;
+  int64_t now = mono_ms();
+  g->last_hb_ms = now;
+  g->leader_contact_ms = now;
+  g->quorum_ok_ms = now;
+  for (int i = 0; i < npeers; i++) {
+    PeerP p;
+    p.id = peer_ids[i];
+    p.slot = peer_slots[i];
+    p.match = last_index;
+    p.next = last_index + 1;
+    p.contact_ms = now;
+    g->peers.push_back(p);
+  }
+  std::lock_guard<std::mutex> lk(e->gmu);
+  auto& slot = e->groups[cid];
+  if (slot && slot->state != G_GONE) return -2;  // still enrolled
+  slot = std::move(g);
+  return 0;
+}
+
+// Propose on an enrolled leader group.  Returns the assigned index (>0) or
+// 0 when the group is not accepting (caller falls back to the scalar path).
+uint64_t natr_propose(void* h, uint64_t cid, uint64_t key, uint64_t client_id,
+                      uint64_t series_id, uint64_t responded_to, uint8_t etype,
+                      const uint8_t* cmd, size_t cmdlen) {
+  Engine* e = (Engine*)h;
+  Group* g = e->find(cid);
+  if (!g) return 0;
+  std::lock_guard<std::mutex> lk(g->mu);
+  if (g->state != G_ACTIVE || !g->leader) return 0;
+  uint64_t index = g->last_index + 1;
+  NEntry en;
+  en.term = g->term;
+  en.index = index;
+  en.enc = encode_entry(g->term, index, etype, key, client_id, series_id,
+                        responded_to, cmd, cmdlen);
+  g->log.push_back(std::move(en));
+  g->last_index = index;
+  e->proposed++;
+  e->mark_dirty(g);
+  return index;
+}
+
+// Parse a MessageBatch payload; consume fast-path messages for ACTIVE
+// enrolled groups.  Leftover messages are re-wrapped into a MessageBatch
+// payload returned via *leftover (malloc'd; natr_free).  Returns the number
+// of consumed messages, or -1 on a parse error (caller treats the whole
+// payload as leftover).
+long long natr_ingest(void* h, const uint8_t* d, size_t len, uint8_t** leftover,
+                      size_t* leftover_len) {
+  Engine* e = (Engine*)h;
+  *leftover = nullptr;
+  *leftover_len = 0;
+  size_t pos = 0;
+  uint64_t dep_id, bin_ver, count;
+  if (!get_uvarint(d, len, pos, dep_id)) return -1;
+  size_t src_start = pos;
+  if (!skip_str(d, len, pos)) return -1;
+  size_t src_end = pos;
+  if (!get_uvarint(d, len, pos, bin_ver)) return -1;
+  if (!get_uvarint(d, len, pos, count)) return -1;
+  long long consumed = 0;
+  std::string left;
+  uint64_t left_count = 0;
+  for (uint64_t i = 0; i < count; i++) {
+    ParsedMsg m;
+    if (!parse_message(d, len, pos, m)) return -1;
+    bool fast = false;
+    if (m.type == MT_REPLICATE || m.type == MT_REPLICATE_RESP ||
+        m.type == MT_HEARTBEAT || m.type == MT_HEARTBEAT_RESP) {
+      Group* g = e->find(m.cluster_id);
+      if (g) fast = e->handle_fast(g, m, d);
+    }
+    if (fast) {
+      consumed++;
+      e->ingested_fast++;
+    } else {
+      e->ingested_slow++;
+      left.append((const char*)d + m.span_start, m.span_end - m.span_start);
+      left_count++;
+    }
+  }
+  if (left_count) {
+    std::string out;
+    out.reserve(left.size() + 32);
+    put_uvarint(out, dep_id);
+    out.append((const char*)d + src_start, src_end - src_start);
+    put_uvarint(out, bin_ver);
+    put_uvarint(out, left_count);
+    out += left;
+    *leftover = (uint8_t*)malloc(out.size());
+    memcpy(*leftover, out.data(), out.size());
+    *leftover_len = out.size();
+  }
+  return consumed;
+}
+
+// Take ready-to-send frames for a remote slot; blocks up to timeout_ms.
+// Returns byte length (0 = timeout, -1 = stopped); *data is malloc'd.
+long long natr_take_send(void* h, int slot, int timeout_ms, uint8_t** data) {
+  Engine* e = (Engine*)h;
+  *data = nullptr;
+  if (slot < 0 || slot >= (int)e->remotes.size()) return -1;
+  Remote* r = e->remotes[slot].get();
+  std::unique_lock<std::mutex> lk(r->mu);
+  if (r->buf.empty() && !r->closed)
+    r->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms));
+  if (r->buf.empty()) return r->closed ? -1 : 0;
+  *data = (uint8_t*)malloc(r->buf.size());
+  memcpy(*data, r->buf.data(), r->buf.size());
+  long long n = (long long)r->buf.size();
+  r->buf.clear();
+  return n;
+}
+
+// Take the next apply span; blocks up to timeout_ms.  Blob is an
+// encode_entry_batch payload (decode with wire.codec.decode_entry_batch).
+// Returns 1 with outputs set, 0 on timeout, -1 when stopped.
+int natr_next_apply(void* h, int timeout_ms, uint64_t* cid, uint64_t* first,
+                    uint64_t* last, uint8_t** data, size_t* dlen) {
+  Engine* e = (Engine*)h;
+  std::unique_lock<std::mutex> lk(e->amu);
+  if (e->applyq.empty() && !e->stopped.load())
+    e->acv.wait_for(lk, std::chrono::milliseconds(timeout_ms));
+  if (e->applyq.empty()) return e->stopped.load() ? -1 : 0;
+  ApplySpan s = std::move(e->applyq.front());
+  e->applyq.pop_front();
+  *cid = s.cid;
+  *first = s.first;
+  *last = s.last;
+  *data = (uint8_t*)malloc(s.blob.size());
+  memcpy(*data, s.blob.data(), s.blob.size());
+  *dlen = s.blob.size();
+  return 1;
+}
+
+// Next native-initiated eject event.  Returns 1/0/-1 like natr_next_apply.
+int natr_next_event(void* h, int timeout_ms, uint64_t* cid, int* code) {
+  Engine* e = (Engine*)h;
+  std::unique_lock<std::mutex> lk(e->emu);
+  if (e->eventq.empty() && !e->stopped.load())
+    e->ecv.wait_for(lk, std::chrono::milliseconds(timeout_ms));
+  if (e->eventq.empty()) return e->stopped.load() ? -1 : 0;
+  auto ev = e->eventq.front();
+  e->eventq.pop_front();
+  *cid = ev.first;
+  *code = ev.second;
+  return 1;
+}
+
+// Eject: finalize the group and return the state snapshot Python needs to
+// rebuild the scalar raft object.  Any locally appended but not yet
+// fsynced entries are synchronously persisted here (nkv_commit is
+// thread-safe; double-writing a key the round thread also staged is
+// idempotent).  Remaining committed-but-unhanded entries are returned in
+// *apply_blob -- including any spans still sitting in the apply queue --
+// so the caller can enqueue them under raftMu in order.
+// Returns 0 on success, -1 unknown group.
+int natr_eject(void* h, uint64_t cid, uint64_t* term, uint64_t* vote,
+               uint64_t* leader_id, uint64_t* commit, uint64_t* last_index,
+               uint64_t* applied_handed, uint64_t* peer_match,
+               uint64_t* peer_next, int* npeers, uint8_t** apply_blob,
+               size_t* apply_len, uint64_t* apply_first) {
+  Engine* e = (Engine*)h;
+  Group* g = e->find(cid);
+  if (!g) return -1;
+  std::string pending_blob;
+  uint64_t pending_first = 0, pending_count = 0;
+  {
+    std::lock_guard<std::mutex> lk(g->mu);
+    if (g->state == G_GONE) return -1;
+    g->state = G_EJECTING;
+    // flush un-persisted tail synchronously
+    if (g->last_index > g->fsynced) {
+      std::string b;
+      for (uint64_t i = g->fsynced + 1; i <= g->last_index; i++)
+        batch_put(b, make_key(TAG_ENTRY, g->cid, g->nid, i),
+                  g->log[i - g->log_first].enc);
+      std::string v;
+      put_u64be(v, g->last_index);
+      batch_put(b, make_key(TAG_MAX_INDEX, g->cid, g->nid, 0), v);
+      int rc = e->nkv_commit(e->shards[g->shard].handle,
+                             (const uint8_t*)b.data(), b.size());
+      if (rc < 0) return -2;
+      g->staged_to = g->fsynced = g->last_index;
+      g->maxindex_written = g->last_index;
+    }
+    // final tally so committed-by-quorum entries are not lost (leader)
+    if (g->leader) {
+      uint64_t q = e->tally(g);
+      if (q > g->commit) g->commit = q;
+    }
+    // drain spans already queued for the pump (keep order) + the rest
+    {
+      std::lock_guard<std::mutex> alk(e->amu);
+      for (auto it = e->applyq.begin(); it != e->applyq.end();) {
+        if (it->cid != cid) {
+          ++it;
+          continue;
+        }
+        if (!pending_count) pending_first = it->first;
+        // strip the per-span count varint; re-counted below
+        size_t p = 0;
+        uint64_t c;
+        get_uvarint((const uint8_t*)it->blob.data(), it->blob.size(), p, c);
+        pending_blob.append(it->blob, p, std::string::npos);
+        pending_count += c;
+        it = e->applyq.erase(it);
+      }
+    }
+    uint64_t upto = std::min(g->commit, g->fsynced);
+    if (upto > g->applied_handed) {
+      if (!pending_count) pending_first = g->applied_handed + 1;
+      for (uint64_t i = g->applied_handed + 1; i <= upto; i++) {
+        pending_blob += g->log[i - g->log_first].enc;
+        pending_count++;
+      }
+      g->applied_handed = upto;
+    }
+    *term = g->term;
+    *vote = g->vote;
+    *leader_id = g->leader_id;
+    *commit = g->commit;
+    *last_index = g->last_index;
+    *applied_handed = g->applied_handed;
+    int n = 0;
+    for (auto& p : g->peers) {
+      peer_match[n] = p.match;
+      peer_next[n] = p.next;
+      n++;
+    }
+    *npeers = n;
+    g->state = G_GONE;
+  }
+  std::string out;
+  put_uvarint(out, pending_count);
+  out += pending_blob;
+  *apply_blob = (uint8_t*)malloc(out.size() ? out.size() : 1);
+  memcpy(*apply_blob, out.data(), out.size());
+  *apply_len = out.size();
+  *apply_first = pending_first;
+  {
+    std::lock_guard<std::mutex> lk(e->gmu);
+    e->groups.erase(cid);
+  }
+  return 0;
+}
+
+// Lightweight status probe: 1 = enrolled-active, 0 = not.
+int natr_active(void* h, uint64_t cid) {
+  Engine* e = (Engine*)h;
+  Group* g = e->find(cid);
+  if (!g) return 0;
+  std::lock_guard<std::mutex> lk(g->mu);
+  return g->state == G_ACTIVE ? 1 : 0;
+}
+
+void natr_stats(void* h, uint64_t* out8) {
+  Engine* e = (Engine*)h;
+  out8[0] = e->proposed.load();
+  out8[1] = e->ingested_fast.load();
+  out8[2] = e->ingested_slow.load();
+  out8[3] = e->commits_advanced.load();
+  out8[4] = e->rounds.load();
+  out8[5] = e->fsyncs.load();
+  uint64_t dropped = 0;
+  for (auto& r : e->remotes) dropped += r->dropped;
+  out8[6] = dropped;
+  {
+    std::lock_guard<std::mutex> lk(e->gmu);
+    out8[7] = e->groups.size();
+  }
+}
+
+void natr_stop(void* h) {
+  Engine* e = (Engine*)h;
+  e->stop();
+}
+
+}  // extern "C"

@@ -1,0 +1,324 @@
+"""ctypes binding for the native replication fast-lane core (natraft.cpp).
+
+One :class:`NatRaft` per NodeHost.  See the C++ header comment for the
+architecture; the Python-facing surface here is deliberately thin — raw
+buffers in/out, with all object mapping done by the fast-lane manager
+(:mod:`dragonboat_tpu.fastlane`).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libnatraft.so")
+_SRC = os.path.join(_DIR, "natraft.cpp")
+_NKV_SO = os.path.join(_DIR, "libnativekv.so")
+
+_lib = None
+_lib_mu = threading.Lock()
+_build_error: Optional[str] = None
+
+
+def _load():
+    global _lib, _build_error
+    with _lib_mu:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            raise RuntimeError(_build_error)
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
+            _SRC
+        ):
+            proc = subprocess.run(
+                ["make", "-C", _DIR, "libnatraft.so"],
+                capture_output=True,
+                text=True,
+            )
+            if proc.returncode != 0:
+                _build_error = f"natraft build failed:\n{proc.stderr}"
+                raise RuntimeError(_build_error)
+        lib = ctypes.CDLL(_SO)
+        c = ctypes
+        lib.natr_create.restype = c.c_void_p
+        lib.natr_create.argtypes = [
+            c.c_char_p, c.c_uint64, c.c_uint64, c.c_char_p, c.c_char_p,
+            c.c_size_t,
+        ]
+        lib.natr_start.argtypes = [c.c_void_p]
+        lib.natr_destroy.argtypes = [c.c_void_p]
+        lib.natr_stop.argtypes = [c.c_void_p]
+        lib.natr_free.argtypes = [c.c_void_p]
+        lib.natr_set_shards.argtypes = [
+            c.c_void_p, c.POINTER(c.c_void_p), c.c_int,
+        ]
+        lib.natr_add_remote.restype = c.c_int
+        lib.natr_add_remote.argtypes = [c.c_void_p]
+        lib.natr_enroll.restype = c.c_int
+        lib.natr_enroll.argtypes = [
+            c.c_void_p, c.c_uint64, c.c_uint64, c.c_uint64, c.c_uint64,
+            c.c_uint64, c.c_int, c.c_uint64, c.c_uint64, c.c_uint64,
+            c.c_uint32, c.c_int64, c.c_int64, c.POINTER(c.c_uint64),
+            c.POINTER(c.c_int32), c.c_int,
+        ]
+        lib.natr_propose.restype = c.c_uint64
+        lib.natr_propose.argtypes = [
+            c.c_void_p, c.c_uint64, c.c_uint64, c.c_uint64, c.c_uint64,
+            c.c_uint64, c.c_uint8, c.c_char_p, c.c_size_t,
+        ]
+        lib.natr_ingest.restype = c.c_longlong
+        lib.natr_ingest.argtypes = [
+            c.c_void_p, c.c_char_p, c.c_size_t, c.POINTER(c.c_void_p),
+            c.POINTER(c.c_size_t),
+        ]
+        lib.natr_take_send.restype = c.c_longlong
+        lib.natr_take_send.argtypes = [
+            c.c_void_p, c.c_int, c.c_int, c.POINTER(c.c_void_p),
+        ]
+        lib.natr_next_apply.restype = c.c_int
+        lib.natr_next_apply.argtypes = [
+            c.c_void_p, c.c_int, c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),
+            c.POINTER(c.c_uint64), c.POINTER(c.c_void_p), c.POINTER(c.c_size_t),
+        ]
+        lib.natr_next_event.restype = c.c_int
+        lib.natr_next_event.argtypes = [
+            c.c_void_p, c.c_int, c.POINTER(c.c_uint64), c.POINTER(c.c_int),
+        ]
+        lib.natr_eject.restype = c.c_int
+        lib.natr_eject.argtypes = [
+            c.c_void_p, c.c_uint64,
+            c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),  # term, vote
+            c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),  # leader, commit
+            c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),  # last, handed
+            c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),  # match[], next[]
+            c.POINTER(c.c_int),                            # npeers
+            c.POINTER(c.c_void_p), c.POINTER(c.c_size_t),  # blob
+            c.POINTER(c.c_uint64),                         # apply_first
+        ]
+        lib.natr_active.restype = c.c_int
+        lib.natr_active.argtypes = [c.c_void_p, c.c_uint64]
+        lib.natr_stats.argtypes = [c.c_void_p, c.POINTER(c.c_uint64)]
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except (RuntimeError, OSError):
+        return False
+
+
+class EjectState:
+    __slots__ = (
+        "term", "vote", "leader_id", "commit", "last_index",
+        "applied_handed", "peers", "apply_blob", "apply_first",
+    )
+
+    def __init__(self, term, vote, leader_id, commit, last_index,
+                 applied_handed, peers, apply_blob, apply_first):
+        self.term = term
+        self.vote = vote
+        self.leader_id = leader_id
+        self.commit = commit
+        self.last_index = last_index
+        self.applied_handed = applied_handed
+        self.peers = peers  # list of (match, next) aligned with enroll order
+        self.apply_blob = apply_blob  # encode_entry_batch payload
+        self.apply_first = apply_first
+
+
+class NatRaft:
+    """One native replication core (per NodeHost)."""
+
+    def __init__(self, source_address: str, deployment_id: int,
+                 bin_ver: int = 1):
+        lib = _load()
+        errbuf = ctypes.create_string_buffer(512)
+        self._h = lib.natr_create(
+            source_address.encode(), deployment_id, bin_ver,
+            _NKV_SO.encode(), errbuf, len(errbuf),
+        )
+        if not self._h:
+            raise RuntimeError(f"natraft init: {errbuf.value.decode()}")
+        self._lib = lib
+        self._peer_order: dict = {}  # cid -> peer id order used at enroll
+        self._stopped = False
+
+    def start(self) -> None:
+        self._lib.natr_start(self._h)
+
+    def set_shards(self, handles: List[int]) -> None:
+        arr = (ctypes.c_void_p * len(handles))(*handles)
+        self._lib.natr_set_shards(self._h, arr, len(handles))
+
+    def add_remote(self) -> int:
+        return int(self._lib.natr_add_remote(self._h))
+
+    def enroll(
+        self,
+        cluster_id: int,
+        node_id: int,
+        term: int,
+        vote: int,
+        leader_id: int,
+        is_leader: bool,
+        last_index: int,
+        last_term: int,
+        commit: int,
+        shard: int,
+        hb_period_ms: int,
+        elect_timeout_ms: int,
+        peers: List[Tuple[int, int]],  # (node_id, remote_slot)
+    ) -> bool:
+        ids = (ctypes.c_uint64 * len(peers))(*[p[0] for p in peers])
+        slots = (ctypes.c_int32 * len(peers))(*[p[1] for p in peers])
+        rc = self._lib.natr_enroll(
+            self._h, cluster_id, node_id, term, vote, leader_id,
+            1 if is_leader else 0, last_index, last_term, commit, shard,
+            hb_period_ms, elect_timeout_ms, ids, slots, len(peers),
+        )
+        if rc == 0:
+            self._peer_order[cluster_id] = [p[0] for p in peers]
+        return rc == 0
+
+    def propose(self, cluster_id: int, key: int, client_id: int,
+                series_id: int, responded_to: int, etype: int,
+                cmd: bytes) -> int:
+        """Returns the assigned index, or 0 (not enrolled / ejecting)."""
+        return int(
+            self._lib.natr_propose(
+                self._h, cluster_id, key, client_id, series_id, responded_to,
+                etype, cmd, len(cmd),
+            )
+        )
+
+    def ingest(self, payload: bytes) -> Tuple[int, Optional[bytes]]:
+        """Returns (consumed_count, leftover_batch_payload_or_None).
+        consumed < 0 means a parse error: treat the payload as leftover."""
+        out = ctypes.c_void_p()
+        outlen = ctypes.c_size_t()
+        n = self._lib.natr_ingest(
+            self._h, payload, len(payload), ctypes.byref(out),
+            ctypes.byref(outlen),
+        )
+        if n < 0:
+            return -1, payload
+        leftover = None
+        if out.value:
+            leftover = ctypes.string_at(out.value, outlen.value)
+            self._lib.natr_free(out)
+        return int(n), leftover
+
+    def take_send(self, slot: int, timeout_ms: int = 100) -> Optional[bytes]:
+        """Blocks (GIL released) for ready frames; None on timeout,
+        raises on shutdown."""
+        data = ctypes.c_void_p()
+        n = self._lib.natr_take_send(self._h, slot, timeout_ms,
+                                     ctypes.byref(data))
+        if n < 0:
+            raise ConnectionError("natraft stopped")
+        if n == 0:
+            return None
+        buf = ctypes.string_at(data.value, n)
+        self._lib.natr_free(data)
+        return buf
+
+    def next_apply(self, timeout_ms: int = 100):
+        """Returns (cluster_id, first, last, blob) or None; raises on stop."""
+        cid = ctypes.c_uint64()
+        first = ctypes.c_uint64()
+        last = ctypes.c_uint64()
+        data = ctypes.c_void_p()
+        dlen = ctypes.c_size_t()
+        rc = self._lib.natr_next_apply(
+            self._h, timeout_ms, ctypes.byref(cid), ctypes.byref(first),
+            ctypes.byref(last), ctypes.byref(data), ctypes.byref(dlen),
+        )
+        if rc < 0:
+            raise ConnectionError("natraft stopped")
+        if rc == 0:
+            return None
+        blob = ctypes.string_at(data.value, dlen.value)
+        self._lib.natr_free(data)
+        return int(cid.value), int(first.value), int(last.value), blob
+
+    def next_event(self, timeout_ms: int = 100):
+        """Returns (cluster_id, code) or None; raises on stop."""
+        cid = ctypes.c_uint64()
+        code = ctypes.c_int()
+        rc = self._lib.natr_next_event(
+            self._h, timeout_ms, ctypes.byref(cid), ctypes.byref(code)
+        )
+        if rc < 0:
+            raise ConnectionError("natraft stopped")
+        if rc == 0:
+            return None
+        return int(cid.value), int(code.value)
+
+    def eject(self, cluster_id: int) -> Optional[EjectState]:
+        c = ctypes
+        term = c.c_uint64()
+        vote = c.c_uint64()
+        leader = c.c_uint64()
+        commit = c.c_uint64()
+        last = c.c_uint64()
+        handed = c.c_uint64()
+        match = (c.c_uint64 * 16)()
+        nxt = (c.c_uint64 * 16)()
+        npeers = c.c_int()
+        blob = c.c_void_p()
+        blen = c.c_size_t()
+        afirst = c.c_uint64()
+        rc = self._lib.natr_eject(
+            self._h, cluster_id, c.byref(term), c.byref(vote), c.byref(leader),
+            c.byref(commit), c.byref(last), c.byref(handed), match, nxt,
+            c.byref(npeers), c.byref(blob), c.byref(blen), c.byref(afirst),
+        )
+        if rc != 0:
+            return None
+        apply_blob = ctypes.string_at(blob.value, blen.value)
+        self._lib.natr_free(blob)
+        order = self._peer_order.pop(cluster_id, [])
+        peers = {
+            order[i]: (int(match[i]), int(nxt[i]))
+            for i in range(npeers.value)
+            if i < len(order)
+        }
+        return EjectState(
+            int(term.value), int(vote.value), int(leader.value),
+            int(commit.value), int(last.value), int(handed.value), peers,
+            apply_blob, int(afirst.value),
+        )
+
+    def active(self, cluster_id: int) -> bool:
+        return bool(self._lib.natr_active(self._h, cluster_id))
+
+    def stats(self) -> dict:
+        out = (ctypes.c_uint64 * 8)()
+        self._lib.natr_stats(self._h, out)
+        return {
+            "proposed": int(out[0]),
+            "ingested_fast": int(out[1]),
+            "ingested_slow": int(out[2]),
+            "commits_advanced": int(out[3]),
+            "rounds": int(out[4]),
+            "fsyncs": int(out[5]),
+            "send_dropped": int(out[6]),
+            "groups": int(out[7]),
+        }
+
+    def stop(self) -> None:
+        if not self._stopped:
+            self._stopped = True
+            self._lib.natr_stop(self._h)
+
+    def close(self) -> None:
+        self.stop()
+        if self._h:
+            self._lib.natr_destroy(self._h)
+            self._h = None
