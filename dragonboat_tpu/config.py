@@ -85,6 +85,11 @@ class ExpertConfig:
     engine_block_groups: int = 0  # 0 = use Soft.quorum_engine_block_groups
     step_worker_count: int = 0  # 0 = use Hard.step_engine_worker_count
     logdb_shards: int = 0  # 0 = use Hard.logdb_pool_size
+    # native replication fast lane (fastlane.py + native/natraft.cpp): the
+    # steady-state data plane of enrolled groups runs in C++.  Requires the
+    # TCP transport and the native LogDB backend; silently unavailable
+    # otherwise.
+    fast_lane: bool = False
     # filesystem the snapshot paths go through; None = the real OS fs.
     # Setting a vfs.MemFS runs the whole stack diskless (reference memfs
     # builds); a vfs.ErrorFS enables fault-injection testing and is

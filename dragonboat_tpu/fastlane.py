@@ -1,0 +1,283 @@
+"""Fast-lane manager: Python side of the native replication core.
+
+Pairs one :class:`~dragonboat_tpu.native.natraft.NatRaft` engine with a
+NodeHost.  The native core (``native/natraft.cpp``) owns the steady-state
+replication data plane for *enrolled* groups; this module owns:
+
+- **eligibility + enrollment** — :meth:`try_enroll` is called from the
+  node's step path at a quiescent instant under raftMu (see
+  ``Node._maybe_enroll``);
+- **the eject protocol** — :meth:`eject_locked` finalizes the native group
+  and hands its state snapshot back to the scalar raft object (the caller,
+  ``Node.fast_eject``, rebuilds log watermarks / remote progress);
+- **pumps** — sender threads draining native frames onto per-remote TCP
+  connections, the apply pump converting native commit spans into normal
+  apply Tasks, and the event pump servicing native-initiated ejects
+  (contact loss, check-quorum failure, protocol punts);
+- **ingest** — the raw-payload hook installed into the TCP transport: the
+  native core consumes fast-path messages and returns a leftover
+  MessageBatch for the normal Python router.
+
+The fast lane is enabled by ``ExpertConfig.fast_lane`` and additionally
+requires the real TCP transport and the native (NativeKV) LogDB backend in
+plain-entry format, because the native core writes WAL records directly.
+Everything degrades gracefully: when unavailable, nothing below runs and
+the pure-Python path is untouched (the same contract as the TPU quorum
+plugin, ``tpuquorum.py``).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from .logger import get_logger
+
+plog = get_logger("fastlane")
+
+# native eject event codes (natraft.cpp EventCode)
+EV_NAMES = {1: "contact-lost", 2: "quorum-lost", 3: "protocol", 4: "wal-error"}
+
+
+class FastLaneManager:
+    """One per NodeHost; see module docstring."""
+
+    def __init__(self, nh) -> None:
+        self.nh = nh
+        self.enabled = False
+        self.nat = None
+        self._nodes: Dict[int, object] = {}  # cid -> Node, while enrolled
+        self._nodes_mu = threading.Lock()
+        self._slots: Dict[str, int] = {}
+        self._slots_mu = threading.Lock()
+        # ordering gate between the apply pump and eject hand-off: spans are
+        # popped from the native queue only under this lock, so an eject can
+        # atomically drain the remainder and keep per-group apply order
+        self.apply_gate = threading.Lock()
+        self._stopped = threading.Event()
+        self._threads = []
+
+        handles = self._native_shard_handles()
+        if handles is None:
+            return
+        rpc = getattr(nh.transport, "rpc", None)
+        if rpc is None or not hasattr(rpc, "raw_handler"):
+            plog.info("fast lane off: transport has no raw ingest hook")
+            return
+        from .native import natraft
+
+        if not natraft.available():
+            plog.info("fast lane off: libnatraft unavailable")
+            return
+        self.nat = natraft.NatRaft(
+            nh.raft_address(), nh.nhconfig.get_deployment_id()
+        )
+        self.nat.set_shards(handles)
+        self.n_shards = len(handles)
+        rpc.raw_handler = self._ingest
+        self.nat.start()
+        for fn, name in (
+            (self._apply_pump, "fastlane-apply"),
+            (self._event_pump, "fastlane-events"),
+        ):
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        self.enabled = True
+
+    def _native_shard_handles(self):
+        from . import native
+
+        logdb = self.nh.logdb
+        shards = getattr(logdb, "_shards", None)
+        if shards is None or getattr(logdb, "_batched", False):
+            plog.info("fast lane off: logdb is not plain-format sharded")
+            return None
+        handles = []
+        for s in shards:
+            kv = s.kv
+            if not isinstance(kv, native.NativeKV):
+                plog.info("fast lane off: shard backend %s", kv.name())
+                return None
+            handles.append(kv._h)
+        return handles
+
+    # ------------------------------------------------------------ ingest
+
+    def _ingest(self, payload: bytes) -> Optional[bytes]:
+        """TCP raw hook: returns the leftover batch payload (or None when
+        fully consumed).  Runs on transport recv threads."""
+        nat = self.nat
+        if nat is None or self._stopped.is_set():  # late frame past stop
+            return payload
+        n, leftover = nat.ingest(payload)
+        if n < 0:
+            return payload
+        return leftover
+
+    # --------------------------------------------------------- enrollment
+
+    def slot_for(self, addr: str) -> int:
+        with self._slots_mu:
+            slot = self._slots.get(addr)
+            if slot is not None:
+                return slot
+            slot = self.nat.add_remote()
+            if slot < 0:
+                return -1
+            self._slots[addr] = slot
+            t = threading.Thread(
+                target=self._sender, args=(slot, addr),
+                name=f"fastlane-send-{addr}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+            return slot
+
+    def register_node(self, node) -> None:
+        with self._nodes_mu:
+            self._nodes[node.cluster_id] = node
+
+    def eject_locked(self, node):
+        """Finalize the native group (caller holds the node's raftMu) and
+        return the EjectState; remaining apply spans are enqueued onto the
+        node's apply queue, in order, before returning."""
+        from .rsm import Task
+        from .wire.codec import decode_entry_batch
+
+        with self.apply_gate:
+            # drain spans the pump has not yet taken (ours and others' —
+            # delivering other groups' spans here is harmless and keeps
+            # the gate hold short)
+            self._drain_applies_locked()
+            st = self.nat.eject(node.cluster_id)
+            with self._nodes_mu:
+                self._nodes.pop(node.cluster_id, None)
+            if st is None:
+                return None
+            entries = decode_entry_batch(st.apply_blob)
+            if entries:
+                node.to_apply.enqueue(
+                    Task(
+                        cluster_id=node.cluster_id,
+                        node_id=node.node_id,
+                        entries=entries,
+                    )
+                )
+                self.nh.engine.set_apply_ready(node.cluster_id)
+            return st
+
+    # ------------------------------------------------------------- pumps
+
+    def _deliver_span(self, cid: int, blob: bytes) -> None:
+        from .rsm import Task
+        from .wire.codec import decode_entry_batch
+
+        with self._nodes_mu:
+            node = self._nodes.get(cid)
+        if node is None:
+            # unreachable by construction (ejects drain under the gate);
+            # log loudly rather than silently dropping committed entries
+            plog.error("apply span for unenrolled group %d dropped", cid)
+            return
+        entries = decode_entry_batch(blob)
+        node.to_apply.enqueue(
+            Task(cluster_id=cid, node_id=node.node_id, entries=entries)
+        )
+        self.nh.engine.set_apply_ready(cid)
+
+    def _drain_applies_locked(self) -> None:
+        while True:
+            got = self.nat.next_apply(0)
+            if got is None:
+                return
+            cid, _first, _last, blob = got
+            self._deliver_span(cid, blob)
+
+    def _apply_pump(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                if not self.nat.wait_apply(200):
+                    continue
+            except ConnectionError:
+                return
+            with self.apply_gate:
+                self._drain_applies_locked()
+
+    def _event_pump(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                ev = self.nat.next_event(500)
+            except ConnectionError:
+                return
+            if ev is None:
+                continue
+            cid, code = ev
+            with self._nodes_mu:
+                node = self._nodes.get(cid)
+            if node is not None:
+                plog.info(
+                    "group %d native eject: %s", cid, EV_NAMES.get(code, code)
+                )
+                node.fast_eject(contact_lost=code in (1, 2))
+
+    def _sender(self, slot: int, addr: str) -> None:
+        """Drain native frames for one remote onto a dedicated TCP
+        connection (the fast plane's analog of the transport's per-remote
+        sender, ``transport.go:436``)."""
+        conn = None
+        backoff = 0.05
+        buf = None
+        retries = 0
+        while not self._stopped.is_set():
+            if buf is None:
+                try:
+                    buf = self.nat.take_send(slot, 200)
+                except ConnectionError:
+                    break
+                if buf is None:
+                    continue
+            try:
+                if conn is None:
+                    conn = self.nh.transport.rpc.get_connection(addr)
+                    backoff = 0.05
+                conn.sock.sendall(buf)
+                buf = None
+                retries = 0
+            except Exception:
+                if conn is not None:
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+                    conn = None
+                retries += 1
+                if retries > 20:
+                    buf = None  # drop; raft-level retry recovers
+                    retries = 0
+                time.sleep(backoff)
+                backoff = min(1.0, backoff * 2)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------- misc
+
+    def stats(self) -> dict:
+        if not self.enabled:
+            return {"enabled": False}
+        out = self.nat.stats()
+        out["enabled"] = True
+        return out
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self.nat is not None:
+            self.nat.stop()
+        for t in self._threads:
+            t.join(timeout=2)
+        if self.nat is not None:
+            self.nat.close()
+            self.nat = None

@@ -97,6 +97,22 @@ class ShardedDB:
             cluster_id, node_id, last_index
         )
 
+    def refresh_cached_state(
+        self, cluster_id: int, node_id: int, term: int, vote: int,
+        commit: int, max_index: int,
+    ) -> None:
+        """Re-seed the write-suppression caches after an external writer
+        (the native fast lane) updated the State/MaxIndex records directly —
+        else a later save round would either suppress a needed write or
+        re-issue a redundant one against stale assumptions."""
+        from ..wire import State
+
+        shard = self._shard(cluster_id)
+        shard.cache.set_state(
+            cluster_id, node_id, State(term=term, vote=vote, commit=commit)
+        )
+        shard.cache.set_max_index(cluster_id, node_id, max_index)
+
     def iterate_entries(
         self,
         ents: List[Entry],

@@ -371,6 +371,7 @@ struct PendResp {
 
 struct Group {
   std::mutex mu;
+  std::weak_ptr<Group> self;  // set at enroll; lets mark_dirty avoid gmu
   uint64_t cid = 0, nid = 0, term = 0, vote = 0, leader_id = 0;
   bool leader = false;
   uint32_t shard = 0;
@@ -407,21 +408,28 @@ struct Group {
   }
 };
 
+constexpr int kMaxRemotes = 64;
+
 struct Engine {
   std::string source_address;
   uint64_t deployment_id = 0, bin_ver = 1;
   nkv_commit_fn nkv_commit = nullptr;
   void* nkv_dl = nullptr;
   std::vector<Shard> shards;
+  // preallocated so ingest/round threads can index without locking the
+  // container while natr_add_remote runs
   std::vector<std::unique_ptr<Remote>> remotes;
+  std::atomic<int> nremotes{0};
 
   std::mutex gmu;  // group registry
-  std::unordered_map<uint64_t, std::unique_ptr<Group>> groups;
+  // shared_ptr: the round thread and eject may hold a group concurrently;
+  // erasing the map entry must not free state under another thread
+  std::unordered_map<uint64_t, std::shared_ptr<Group>> groups;
 
   // work signalling
   std::mutex wmu;
   std::condition_variable wcv;
-  std::vector<Group*> dirtyq;
+  std::vector<std::shared_ptr<Group>> dirtyq;
 
   // apply plane
   std::mutex amu;
@@ -441,6 +449,11 @@ struct Engine {
   std::atomic<uint64_t> proposed{0}, ingested_fast{0}, ingested_slow{0},
       commits_advanced{0}, rounds{0}, fsyncs{0};
 
+  Engine() {
+    remotes.reserve(kMaxRemotes);
+    for (int i = 0; i < kMaxRemotes; i++) remotes.emplace_back(new Remote());
+  }
+
   ~Engine() { stop(); }
 
   void stop() {
@@ -457,17 +470,19 @@ struct Engine {
     if (round_thread.joinable()) round_thread.join();
   }
 
-  Group* find(uint64_t cid) {
+  std::shared_ptr<Group> find(uint64_t cid) {
     std::lock_guard<std::mutex> g(gmu);
     auto it = groups.find(cid);
-    return it == groups.end() ? nullptr : it->second.get();
+    return it == groups.end() ? nullptr : it->second;
   }
 
-  void mark_dirty(Group* g) {  // callers hold g->mu
+  void mark_dirty(Group* g) {  // callers hold g->mu; must NOT take gmu
     if (g->dirty) return;
     g->dirty = true;
+    std::shared_ptr<Group> sp = g->self.lock();
+    if (!sp) return;
     std::lock_guard<std::mutex> lk(wmu);
-    dirtyq.push_back(g);
+    dirtyq.push_back(std::move(sp));
     wcv.notify_one();
   }
 
@@ -487,7 +502,7 @@ struct Engine {
   // Append a message span to a remote's current-pass buffer (round thread
   // only, or ingest thread for direct responses under the remote's mutex).
   void queue_msg(int slot, const std::string& span) {
-    if (slot < 0 || slot >= (int)remotes.size()) return;
+    if (slot < 0 || slot >= nremotes.load()) return;
     Remote* r = remotes[slot].get();
     std::lock_guard<std::mutex> lk(r->mu);
     r->msgs += span;
@@ -497,8 +512,9 @@ struct Engine {
   // Wrap each remote's accumulated messages into one transport frame and
   // publish it to the pump (tcp.py frame layout: >HHQII + payload).
   void flush_remotes() {
-    for (auto& rp : remotes) {
-      Remote* r = rp.get();
+    int n = nremotes.load();
+    for (int ri = 0; ri < n; ri++) {
+      Remote* r = remotes[ri].get();
       std::string msgs;
       uint64_t count;
       {
@@ -623,7 +639,7 @@ struct Engine {
   // One pass of the round loop: stage WAL, fsync per shard, post-fsync
   // effects, heartbeats/clocks.
   void round_pass() {
-    std::vector<Group*> work;
+    std::vector<std::shared_ptr<Group>> work;
     {
       std::unique_lock<std::mutex> lk(wmu);
       if (dirtyq.empty())
@@ -633,7 +649,8 @@ struct Engine {
     rounds++;
     // stage phase: per-shard WAL batches + pre-fsync replicate fan-out
     std::vector<std::string> batches(shards.size());
-    for (Group* g : work) {
+    for (auto& gsp : work) {
+      Group* g = gsp.get();
       std::lock_guard<std::mutex> lk(g->mu);
       g->dirty = false;
       if (g->state != G_ACTIVE) continue;
@@ -676,7 +693,8 @@ struct Engine {
       ok[s] = rc >= 0;
     }
     // post-fsync phase
-    for (Group* g : work) {
+    for (auto& gsp : work) {
+      Group* g = gsp.get();
       std::lock_guard<std::mutex> lk(g->mu);
       if (g->state != G_ACTIVE) continue;
       if (!ok[g->shard]) {
@@ -684,14 +702,24 @@ struct Engine {
         continue;
       }
       g->fsynced = g->staged_to;
-      // follower: durable -> acks out
+      // follower: durable -> acks out.  An ingest thread may have queued
+      // an ack for an entry appended DURING this round's fsync; sending it
+      // now would acknowledge a non-durable entry (the leader would count
+      // it toward commit, and a crash here would lose a committed entry).
+      // Hold such acks for the round whose fsync covers them.
+      size_t kept = 0;
       for (auto& r : g->resps) {
+        if (r.log_index > g->fsynced) {
+          g->resps[kept++] = r;
+          continue;
+        }
         std::string b;
         put_msg_header(b, r.type, r.flags, r.to, g->nid, g->cid, g->term, 0,
                        r.log_index, 0, r.hint, r.hint_high, 0);
         queue_msg(r.slot, b);
       }
-      g->resps.clear();
+      g->resps.resize(kept);
+      if (kept) mark_dirty(g);  // flush after the next fsync
       if (g->leader) {
         uint64_t q = tally(g);
         if (q > g->commit) {
@@ -715,9 +743,16 @@ struct Engine {
     int64_t now = mono_ms();
     if (now - last_clock_ms < 10) return;
     last_clock_ms = now;
-    std::lock_guard<std::mutex> reg(gmu);
-    for (auto& kv : groups) {
-      Group* g = kv.second.get();
+    // snapshot the registry first: holding gmu while locking a group
+    // would invert the g->mu -> (no gmu) order the hot paths rely on
+    std::vector<std::shared_ptr<Group>> all;
+    {
+      std::lock_guard<std::mutex> reg(gmu);
+      all.reserve(groups.size());
+      for (auto& kv : groups) all.push_back(kv.second);
+    }
+    for (auto& sp : all) {
+      Group* g = sp.get();
       std::lock_guard<std::mutex> lk(g->mu);
       if (g->state != G_ACTIVE) continue;
       if (g->leader) {
@@ -776,7 +811,10 @@ struct Engine {
           return false;
         }
         if (m.log_index < g->commit) {
-          g->resps.push_back({slot, m.from, MT_REPLICATE_RESP, g->commit, 0, 0, 0});
+          // ack at the commit watermark, capped to what is durable here
+          // (commit may run ahead of the local fsync on a follower)
+          uint64_t ack = std::min(g->commit, g->fsynced);
+          g->resps.push_back({slot, m.from, MT_REPLICATE_RESP, ack, 0, 0, 0});
           mark_dirty(g);
           return true;
         }
@@ -947,11 +985,15 @@ int natr_set_shards(void* h, void** handles, int n) {
   return 0;
 }
 
-// Register a remote address slot; returns the slot index.
+// Register a remote address slot; returns the slot index (-1 when full).
 int natr_add_remote(void* h) {
   Engine* e = (Engine*)h;
-  e->remotes.emplace_back(new Remote());
-  return (int)e->remotes.size() - 1;
+  int slot = e->nremotes.fetch_add(1);
+  if (slot >= kMaxRemotes) {
+    e->nremotes.fetch_sub(1);
+    return -1;
+  }
+  return slot;
 }
 
 // Enroll a quiescent group.  peers arrays exclude self.  Requires (checked
@@ -965,7 +1007,8 @@ int natr_enroll(void* h, uint64_t cid, uint64_t nid, uint64_t term,
                 int npeers) {
   Engine* e = (Engine*)h;
   if (shard >= e->shards.size() || npeers > 16) return -1;
-  auto g = std::make_unique<Group>();
+  auto g = std::make_shared<Group>();
+  g->self = g;
   g->cid = cid;
   g->nid = nid;
   g->term = term;
@@ -1016,10 +1059,17 @@ uint64_t natr_propose(void* h, uint64_t cid, uint64_t key, uint64_t client_id,
                       uint64_t series_id, uint64_t responded_to, uint8_t etype,
                       const uint8_t* cmd, size_t cmdlen) {
   Engine* e = (Engine*)h;
-  Group* g = e->find(cid);
+  std::shared_ptr<Group> sp = e->find(cid);
+  Group* g = sp.get();
   if (!g) return 0;
   std::lock_guard<std::mutex> lk(g->mu);
   if (g->state != G_ACTIVE || !g->leader) return 0;
+  // backpressure: the scalar path bounds in-flight work via its entry
+  // queue; the native lane bounds the retained log (which trim_log cannot
+  // shrink past the slowest peer's match).  Falling back (return 0) routes
+  // the proposal through the scalar queue, whose next step ejects the
+  // group and applies the normal flow-control/snapshot machinery.
+  if (g->log.size() >= 32768) return 0;
   uint64_t index = g->last_index + 1;
   NEntry en;
   en.term = g->term;
@@ -1046,6 +1096,9 @@ long long natr_ingest(void* h, const uint8_t* d, size_t len, uint8_t** leftover,
   size_t pos = 0;
   uint64_t dep_id, bin_ver, count;
   if (!get_uvarint(d, len, pos, dep_id)) return -1;
+  // deployment filtering stays in Python (transport.handle_request):
+  // foreign batches pass through untouched
+  if (dep_id != e->deployment_id) return -1;
   size_t src_start = pos;
   if (!skip_str(d, len, pos)) return -1;
   size_t src_end = pos;
@@ -1060,8 +1113,8 @@ long long natr_ingest(void* h, const uint8_t* d, size_t len, uint8_t** leftover,
     bool fast = false;
     if (m.type == MT_REPLICATE || m.type == MT_REPLICATE_RESP ||
         m.type == MT_HEARTBEAT || m.type == MT_HEARTBEAT_RESP) {
-      Group* g = e->find(m.cluster_id);
-      if (g) fast = e->handle_fast(g, m, d);
+      std::shared_ptr<Group> g = e->find(m.cluster_id);
+      if (g) fast = e->handle_fast(g.get(), m, d);
     }
     if (fast) {
       consumed++;
@@ -1154,7 +1207,8 @@ int natr_eject(void* h, uint64_t cid, uint64_t* term, uint64_t* vote,
                uint64_t* peer_next, int* npeers, uint8_t** apply_blob,
                size_t* apply_len, uint64_t* apply_first) {
   Engine* e = (Engine*)h;
-  Group* g = e->find(cid);
+  std::shared_ptr<Group> sp = e->find(cid);
+  Group* g = sp.get();
   if (!g) return -1;
   std::string pending_blob;
   uint64_t pending_first = 0, pending_count = 0;
@@ -1241,10 +1295,23 @@ int natr_eject(void* h, uint64_t cid, uint64_t* term, uint64_t* vote,
 // Lightweight status probe: 1 = enrolled-active, 0 = not.
 int natr_active(void* h, uint64_t cid) {
   Engine* e = (Engine*)h;
-  Group* g = e->find(cid);
+  std::shared_ptr<Group> g = e->find(cid);
   if (!g) return 0;
   std::lock_guard<std::mutex> lk(g->mu);
   return g->state == G_ACTIVE ? 1 : 0;
+}
+
+// Wait for the apply queue to become non-empty WITHOUT popping — the
+// Python apply pump blocks here, then drains with non-blocking
+// natr_next_apply calls under its ordering gate so an eject can atomically
+// take over the remaining spans.  Returns 1 ready, 0 timeout, -1 stopped.
+int natr_wait_apply(void* h, int timeout_ms) {
+  Engine* e = (Engine*)h;
+  std::unique_lock<std::mutex> lk(e->amu);
+  if (e->applyq.empty() && !e->stopped.load())
+    e->acv.wait_for(lk, std::chrono::milliseconds(timeout_ms));
+  if (e->stopped.load()) return -1;
+  return e->applyq.empty() ? 0 : 1;
 }
 
 void natr_stats(void* h, uint64_t* out8) {

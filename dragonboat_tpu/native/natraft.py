@@ -83,6 +83,8 @@ def _load():
             c.c_void_p, c.c_int, c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),
             c.POINTER(c.c_uint64), c.POINTER(c.c_void_p), c.POINTER(c.c_size_t),
         ]
+        lib.natr_wait_apply.restype = c.c_int
+        lib.natr_wait_apply.argtypes = [c.c_void_p, c.c_int]
         lib.natr_next_event.restype = c.c_int
         lib.natr_next_event.argtypes = [
             c.c_void_p, c.c_int, c.POINTER(c.c_uint64), c.POINTER(c.c_int),
@@ -247,6 +249,14 @@ class NatRaft:
         self._lib.natr_free(data)
         return int(cid.value), int(first.value), int(last.value), blob
 
+    def wait_apply(self, timeout_ms: int = 100) -> bool:
+        """Block until the apply queue is non-empty (no pop).  Raises on
+        shutdown."""
+        rc = self._lib.natr_wait_apply(self._h, timeout_ms)
+        if rc < 0:
+            raise ConnectionError("natraft stopped")
+        return rc == 1
+
     def next_event(self, timeout_ms: int = 100):
         """Returns (cluster_id, code) or None; raises on stop."""
         cid = ctypes.c_uint64()
@@ -279,6 +289,13 @@ class NatRaft:
             c.byref(commit), c.byref(last), c.byref(handed), match, nxt,
             c.byref(npeers), c.byref(blob), c.byref(blen), c.byref(afirst),
         )
+        if rc == -2:
+            # the synchronous WAL tail flush failed: the native log holds
+            # appended entries that never reached disk and the group is
+            # stuck EJECTING — the caller must treat the replica as failed
+            # (resuming scalar raft on pre-enroll state would reuse
+            # already-persisted indices)
+            raise IOError(f"fast-lane eject of group {cluster_id}: WAL flush failed")
         if rc != 0:
             return None
         apply_blob = ctypes.string_at(blob.value, blen.value)

@@ -54,6 +54,7 @@ from .wire import (
     Message,
     MessageType,
     Snapshot,
+    State,
     Update,
     is_empty_snapshot,
 )
@@ -108,6 +109,12 @@ class Node:
         # pipeline; the step worker skips the group until the committer
         # clears it (per-group round ordering, see engine._Committer)
         self.commit_inflight = False
+        # native replication fast lane (fastlane.py / native/natraft.cpp):
+        # while fast_lane is True the Python raft object is frozen and the
+        # native core owns the group's steady-state data plane
+        self.fastlane = None  # FastLaneManager, set by NodeHost
+        self.fast_lane = False
+        self._next_enroll_try = 0.0
         self._tick_count_pending = 0
         self._snapshotting = threading.Lock()
         self.leader_id = 0
@@ -163,7 +170,7 @@ class Node:
         bit-identical to the scalar path."""
         advanced = False
         with self.raft_mu:
-            if self.peer is None:
+            if self.peer is None or self.fast_lane:
                 return
             r = self.peer.raft
             if r.is_leader() and r.log.try_commit(q, r.term):
@@ -179,7 +186,7 @@ class Node:
         the campaign restarted at a higher term is discarded."""
         changed = False
         with self.raft_mu:
-            if self.peer is None:
+            if self.peer is None or self.fast_lane:
                 return
             r = self.peer.raft
             if r.is_candidate() and r.term == term:
@@ -198,7 +205,7 @@ class Node:
         re-run inside the scalar ELECTION handler."""
         fired = False
         with self.raft_mu:
-            if self.peer is None:
+            if self.peer is None or self.fast_lane:
                 return
             r = self.peer.raft
             if (
@@ -225,7 +232,7 @@ class Node:
         LEADER_HEARTBEAT fire site in ``leader_tick``)."""
         fired = False
         with self.raft_mu:
-            if self.peer is None:
+            if self.peer is None or self.fast_lane:
                 return
             r = self.peer.raft
             if r.device_ticks and r.is_leader():
@@ -241,7 +248,7 @@ class Node:
         demotion happens."""
         fired = False
         with self.raft_mu:
-            if self.peer is None:
+            if self.peer is None or self.fast_lane:
                 return
             r = self.peer.raft
             if r.device_ticks and r.is_leader() and r.check_quorum:
@@ -292,6 +299,16 @@ class Node:
         )
         entry.type = entry_type
         entry.responded_to = session.responded_to
+        # native fast lane: the index is assigned and the entry staged for
+        # replication + WAL entirely in C++ (completion still arrives via
+        # the normal apply -> pending_proposals.applied path).  A 0 return
+        # means not-enrolled/ejecting: fall back to the scalar queue.
+        if self.fast_lane and self.fastlane is not None:
+            if self.fastlane.nat.propose(
+                self.cluster_id, entry.key, entry.client_id, entry.series_id,
+                entry.responded_to, int(entry.type), cmd,
+            ):
+                return rs
         if not self.entry_q.add(entry):
             self.pending_proposals.dropped(entry.key)
             raise SystemBusyError()
@@ -310,6 +327,9 @@ class Node:
         return rs
 
     def read(self, timeout_s: float) -> RequestState:
+        # ReadIndex needs the scalar heartbeat-confirmation protocol
+        if self.fast_lane:
+            self.fast_eject()
         rs = self.pending_reads.read(self._timeout_ticks(timeout_s))
         self.nh.engine.set_step_ready(self.cluster_id)
         return rs
@@ -317,6 +337,8 @@ class Node:
     def request_config_change(
         self, cc: ConfigChange, timeout_s: float
     ) -> RequestState:
+        if self.fast_lane:
+            self.fast_eject()
         rs = self.pending_config_change.request(
             cc, self._timeout_ticks(timeout_s)
         )
@@ -324,11 +346,15 @@ class Node:
         return rs
 
     def request_snapshot(self, req: SSRequest, timeout_s: float) -> RequestState:
+        if self.fast_lane:
+            self.fast_eject()
         rs = self.pending_snapshot.request(req, self._timeout_ticks(timeout_s))
         self.nh.engine.set_step_ready(self.cluster_id)
         return rs
 
     def request_leader_transfer(self, target: int, timeout_s: float) -> RequestState:
+        if self.fast_lane:
+            self.fast_eject()
         rs = self.pending_leader_transfer.request(
             target, self._timeout_ticks(timeout_s)
         )
@@ -389,12 +415,232 @@ class Node:
                 return None
             if not self.initialized():
                 return None
+            if self.fast_lane and not self._fast_lane_step():
+                return None
             self._handle_events()
             more = self.to_apply.more_entries_to_apply()
             if self.peer.has_update(more):
                 ud = self.peer.get_update(more, self.sm.get_last_applied())
                 return ud
+            self._maybe_enroll()
             return None
+
+    # ---- native fast lane (fastlane.py) ----
+
+    def _fast_lane_step(self) -> bool:
+        """Enrolled-mode step (under raftMu): ticks only feed the pending
+        trackers (the native core owns heartbeat/election clocks); any
+        other input forces an eject.  Returns True when the caller should
+        continue into the normal scalar step."""
+        ticks = 0
+        others: List[Message] = []
+        for m in self.mq.get():
+            if m.type == MT.LOCAL_TICK:
+                ticks += 1
+            else:
+                others.append(m)
+        if ticks:
+            self.current_tick += ticks
+            self._tick_trackers(ticks)
+        entries = self.entry_q.get()
+        if not (others or entries or self._fast_slow_inputs()):
+            return False
+        self.fast_eject()
+        if entries:
+            self.peer.propose_entries(entries)
+        if others:
+            self._process_messages(others)
+        return True
+
+    def _fast_slow_inputs(self) -> bool:
+        """Inputs the fast lane cannot serve (checked each enrolled step;
+        the user-facing entry points also eject eagerly)."""
+        if (
+            self.pending_reads.peep()
+            or self.pending_config_change.pending() is not None
+            or self.pending_snapshot.pending() is not None
+            or self.pending_leader_transfer.pending() is not None
+        ):
+            return True
+        se = self.config.snapshot_entries
+        if se:
+            applied = self.sm.get_last_applied()
+            if applied - self.sm.get_snapshot_index() >= se:
+                return True
+        return False
+
+    def _maybe_enroll(self) -> None:
+        """Enroll this group into the native fast lane when quiescent and
+        eligible (under raftMu; see natraft.cpp's enrollment contract)."""
+        fl = self.fastlane
+        if fl is None or not fl.enabled or self.fast_lane:
+            return
+        import time as _time
+
+        now = _time.monotonic()
+        if now < self._next_enroll_try:
+            return
+        self._next_enroll_try = now + 0.25
+        r = self.peer.raft
+        if not (r.is_leader() or (r.is_follower() and r.leader_id != 0)):
+            return
+        if r.is_observer() or r.is_witness() or r.observers or r.witnesses:
+            return
+        if len(r.remotes) < 2 or len(r.remotes) > 16:
+            return
+        if (
+            r.has_pending_config_change()
+            or r.leader_transfering()
+            or self.config.quiesce
+        ):
+            return
+        log = r.log
+        li = log.last_index()
+        if log.committed != li or log.processed != li:
+            return
+        if log.inmem.entries or log.inmem.snapshot is not None:
+            return
+        if r.msgs or r.dropped_entries or r.dropped_read_indexes or r.ready_to_read:
+            return
+        if self._fast_slow_inputs():
+            return
+        if self._snapshotting.locked():
+            return
+        if r.is_leader():
+            from .raft.remote import RemoteState
+
+            for nid, rp in r.remotes.items():
+                if nid == self.node_id:
+                    continue
+                if rp.match != li or rp.state == RemoteState.SNAPSHOT:
+                    return
+        try:
+            last_term = log.term(li)
+        except Exception:
+            return
+        peers = []
+        for nid in sorted(r.remotes):
+            if nid == self.node_id:
+                continue
+            addr = self.nh.node_registry.resolve(self.cluster_id, nid)
+            if addr is None:
+                return
+            slot = fl.slot_for(addr)
+            if slot < 0:
+                return
+            peers.append((nid, slot))
+        hb_ms = max(1, self.config.heartbeat_rtt * self.tick_millisecond)
+        elect_ms = max(10, 2 * self.config.election_rtt * self.tick_millisecond)
+        ok = fl.nat.enroll(
+            self.cluster_id,
+            self.node_id,
+            term=r.term,
+            vote=r.vote,
+            leader_id=r.leader_id,
+            is_leader=r.is_leader(),
+            last_index=li,
+            last_term=last_term,
+            commit=log.committed,
+            shard=self.cluster_id % fl.n_shards,
+            hb_period_ms=hb_ms,
+            elect_timeout_ms=elect_ms,
+            peers=peers,
+        )
+        if ok:
+            fl.register_node(self)
+            self.fast_lane = True
+
+    def fast_eject(self, contact_lost: bool = False) -> None:
+        """Hand the group back from the native core to scalar raft.
+
+        Rebuilds exactly the state the Python raft object would have had:
+        log watermarks (committed/processed), a fresh saved in-memory tail,
+        the stable-log window in the LogReader (entries were persisted by
+        the native core), per-remote progress, and the persisted-state
+        caches (the native core wrote State/MaxIndex records directly, so
+        the Python rdbcache must be refreshed to match the disk)."""
+        fl = self.fastlane
+        if fl is None:
+            return
+        with self.raft_mu:
+            if not self.fast_lane:
+                return
+            try:
+                st = fl.eject_locked(self)
+            except IOError:
+                # WAL tail flush failed during the handoff: the LogDB holds
+                # records the scalar state cannot account for.  Resuming
+                # would reuse persisted indices — fail the replica instead
+                # (the rest of the group continues; restart replays the log)
+                plog.critical(
+                    "%s fast-lane eject failed on WAL error; stopping replica",
+                    self.describe(),
+                )
+                self.fast_lane = False
+                self._stopped.set()
+                return
+            self.fast_lane = False
+            if st is None or self.peer is None:
+                return
+            r = self.peer.raft
+            log = r.log
+            # stable window: native entries are in the LogDB already
+            _, prev_last = self.logreader.get_range()
+            if st.last_index > prev_last:
+                self.logreader.set_range(
+                    prev_last + 1, st.last_index - prev_last
+                )
+            from .raft.inmemory import InMemory
+            from .raft.remote import RemoteState
+
+            log.inmem = InMemory(st.last_index, log.inmem.rl)
+            log.committed = st.commit
+            log.processed = st.commit
+            for nid, (match, _next) in st.peers.items():
+                rp = r.remotes.get(nid)
+                if rp is None:
+                    continue
+                rp.match = match
+                rp.next = match + 1
+                rp.state = RemoteState.RETRY
+                rp.active = True
+            selfrp = r.remotes.get(self.node_id)
+            if selfrp is not None:
+                selfrp.try_update(st.last_index)
+            r.reset_match_value_array()
+            self.peer.prev_state = State(
+                term=st.term, vote=st.vote, commit=st.commit
+            )
+            # refresh the Python-side persisted-state caches to the records
+            # the native core wrote (else a later suppressed write would
+            # leave disk stale, or a redundant one would be re-issued)
+            self.logdb.refresh_cached_state(
+                self.cluster_id,
+                self.node_id,
+                st.term,
+                st.vote,
+                st.commit,
+                st.last_index,
+            )
+            # the device quorum row (if the TPU plugin is live) went stale
+            # while the native core advanced commits; rebuild it
+            coord = getattr(self, "quorum_coordinator", None)
+            if coord is not None:
+                coord.register(self)
+            if contact_lost:
+                # the native clock already waited out the election window
+                # with zero leader contact — without this the group would
+                # re-enroll (leader_id still set, log quiescent), reset the
+                # native contact clock and ping-pong forever instead of
+                # ever campaigning
+                import time as _time
+
+                self._next_enroll_try = _time.monotonic() + 2.0 * (
+                    2 * self.config.election_rtt * self.tick_millisecond
+                ) / 1000.0
+                if r.is_follower():
+                    r.election_tick = r.randomized_election_timeout
+        self.nh.engine.set_step_ready(self.cluster_id)
 
     def _handle_events(self) -> None:
         self._handle_received_messages()
@@ -405,8 +651,11 @@ class Node:
         self._handle_snapshot_request()
 
     def _handle_received_messages(self) -> None:
+        self._process_messages(self.mq.get())
+
+    def _process_messages(self, msgs) -> None:
         ticks = 0
-        for m in self.mq.get():
+        for m in msgs:
             if m.type == MT.LOCAL_TICK:
                 ticks += 1
             elif m.type == MT.QUIESCE:
@@ -462,12 +711,18 @@ class Node:
                 self.peer.quiesced_tick()
             else:
                 self.peer.tick()
+        self._tick_trackers(count)
+        self._update_leader_info()
+
+    def _tick_trackers(self, count: int) -> None:
+        """Advance the pending-request timeout clocks only — the raft clock
+        itself is owned by the native core while the group is enrolled."""
+        for _ in range(count):
             self.pending_proposals.tick()
             self.pending_reads.tick()
             self.pending_config_change.tick()
             self.pending_snapshot.tick()
             self.pending_leader_transfer.tick()
-        self._update_leader_info()
 
     def _update_leader_info(self) -> None:
         lid = self.peer.raft.leader_id
@@ -905,6 +1160,14 @@ class Node:
         return self._stopped.is_set()
 
     def stop(self) -> None:
+        if self.fast_lane:
+            # clean shutdown: flush the native WAL tail and reclaim the
+            # scalar state (a crash without this is still raft-safe — only
+            # unreplicated, unacked proposals are lost)
+            try:
+                self.fast_eject()
+            except Exception:
+                plog.exception("%s fast-lane eject on stop", self.describe())
         self._stopped.set()
         self.sm.stopc.stop()
         self.entry_q.close()

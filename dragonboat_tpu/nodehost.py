@@ -154,6 +154,15 @@ class NodeHost:
                 capacity=expert.engine_block_groups
                 or Soft.quorum_engine_block_groups,
             )
+        # native replication fast lane (ExpertConfig.fast_lane): enrolled
+        # groups' steady-state replication runs in C++ (fastlane.py)
+        self.fastlane = None
+        if expert.fast_lane:
+            from .fastlane import FastLaneManager
+
+            mgr = FastLaneManager(self)
+            if mgr.enabled:
+                self.fastlane = mgr
         # engine
         workers = expert.step_worker_count or 4
         self.engine = Engine(
@@ -335,6 +344,7 @@ class NodeHost:
         ]
         node.peer_raft_events = self.raft_events
         node.quorum_coordinator = self.quorum_coordinator
+        node.fastlane = self.fastlane
         node.start(addresses, initial=not join and new_node, new_node=new_node)
         with self._mu:
             self._clusters[cluster_id] = node
@@ -382,6 +392,8 @@ class NodeHost:
         for n in nodes:
             if n is not None:
                 n.stop()
+        if self.fastlane is not None:
+            self.fastlane.stop()
         self.engine.stop()
         if self.quorum_coordinator is not None:
             self.quorum_coordinator.stop()
@@ -673,6 +685,12 @@ class NodeHost:
                 # learn the sender's address so replies route before
                 # membership is applied locally (reference nodes.go)
                 self.node_registry.add_remote(m.cluster_id, m.from_, src)
+            # a message reaching Python for a fast-lane group means the
+            # native core could not serve it: complete the eject handoff
+            # FIRST so the scalar raft state is current when it handles
+            # the message (fastlane.py eject protocol)
+            if node.fast_lane:
+                node.fast_eject()
             if node.enqueue_message(m):
                 touched[m.cluster_id] = None
         engine = self.engine

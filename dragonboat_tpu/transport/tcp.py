@@ -140,6 +140,11 @@ class TCPTransport(IRaftRPC):
         self._listener: Optional[socket.socket] = None
         self._stopped = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
+        # optional raw-payload hook (the native replication fast lane,
+        # fastlane.py): called with each RAFT_METHOD payload BEFORE
+        # decoding; returns the leftover payload for the normal path, or
+        # None when fully consumed natively
+        self.raw_handler = None
 
     def name(self) -> str:
         return "tcp-transport"
@@ -211,6 +216,11 @@ class TCPTransport(IRaftRPC):
                 if method == POISON_METHOD:
                     return
                 if method == RAFT_METHOD:
+                    raw = self.raw_handler
+                    if raw is not None:
+                        payload = raw(payload)
+                        if payload is None:
+                            continue
                     self.request_handler(decode_message_batch(payload))
                 elif method == SNAPSHOT_METHOD:
                     if not self.chunk_handler(decode_chunk(payload)):

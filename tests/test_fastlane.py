@@ -1,0 +1,279 @@
+"""Integration tests for the native replication fast lane.
+
+Three NodeHosts over the real framed-TCP transport with the durable native
+LogDB — the deployment shape where `ExpertConfig.fast_lane` activates.
+Covers: enrollment at quiescence, native steady-state replication with
+client completion, ReadIndex forcing eject + re-enroll, follower and
+leader kill/restart recovery through the eject protocol, and full-cluster
+restart replaying natively written WAL records through the Python path.
+"""
+from __future__ import annotations
+
+import socket
+import time
+
+import pytest
+
+from dragonboat_tpu import Config, NodeHost, NodeHostConfig, Result
+from dragonboat_tpu.config import ExpertConfig
+from dragonboat_tpu.native import natraft
+
+pytestmark = pytest.mark.skipif(
+    not natraft.available(), reason="libnatraft unavailable"
+)
+
+RTT = 20
+CID = 31
+
+
+class CountSM:
+    def __init__(self, cluster_id, node_id):
+        self.applied = []
+
+    def update(self, cmd):
+        self.applied.append(bytes(cmd))
+        return Result(value=len(self.applied))
+
+    def lookup(self, query):
+        return list(self.applied)
+
+    def save_snapshot(self, w, files, done):
+        import json
+
+        data = json.dumps([c.decode() for c in self.applied]).encode()
+        w.write(len(data).to_bytes(8, "little") + data)
+
+    def recover_from_snapshot(self, r, files, done):
+        import json
+
+        n = int.from_bytes(r.read(8), "little")
+        self.applied = [c.encode() for c in json.loads(r.read(n).decode())]
+
+    def close(self):
+        pass
+
+
+def _ports(n):
+    out = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        out.append(s.getsockname()[1])
+        s.close()
+    return out
+
+
+def _mk(i, addrs, tmp_path, sms, snapshot_entries=0):
+    nh = NodeHost(
+        NodeHostConfig(
+            node_host_dir=str(tmp_path / f"nh{i}"),
+            rtt_millisecond=RTT,
+            raft_address=addrs[i],
+            expert=ExpertConfig(fast_lane=True, logdb_shards=2),
+        )
+    )
+    assert nh.fastlane is not None and nh.fastlane.enabled
+
+    def create(cluster_id, node_id):
+        sm = CountSM(cluster_id, node_id)
+        sms[i] = sm
+        return sm
+
+    nh.start_cluster(
+        addrs, False, create,
+        Config(cluster_id=CID, node_id=i, election_rtt=10, heartbeat_rtt=1,
+               snapshot_entries=snapshot_entries, compaction_overhead=5),
+    )
+    return nh
+
+
+def _cluster(tmp_path, sms, n=3):
+    ports = _ports(n)
+    addrs = {i + 1: f"127.0.0.1:{ports[i]}" for i in range(n)}
+    nhs = {i: _mk(i, addrs, tmp_path, sms) for i in addrs}
+    return nhs, addrs
+
+
+def _leader(nhs, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        for nh in nhs.values():
+            try:
+                lid, ok = nh.get_leader_id(CID)
+                if ok and lid in nhs:
+                    return lid, nhs[lid]
+            except Exception:
+                pass
+        time.sleep(0.05)
+    raise TimeoutError("no leader")
+
+
+def _wait_enrolled(nh, timeout=15.0, want=True):
+    node = nh.get_node(CID)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if node.fast_lane == want:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _propose_all(nh, payloads, timeout=30.0):
+    s = nh.get_noop_session(CID)
+    pending = [nh.propose(s, p, timeout=10.0) for p in payloads]
+    for rs in pending:
+        r = rs.wait(timeout)
+        assert r.completed, r
+    return len(pending)
+
+
+def _wait_converged(sms, count, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        lens = [len(sm.applied) for sm in sms.values()]
+        if all(n == count for n in lens):
+            return True
+        time.sleep(0.1)
+    raise AssertionError(
+        f"replicas did not converge: {[len(sm.applied) for sm in sms.values()]}"
+        f" != {count}"
+    )
+
+
+def _stop_all(nhs):
+    for nh in nhs.values():
+        try:
+            nh.stop()
+        except Exception:
+            pass
+
+
+def test_enroll_and_native_replication(tmp_path):
+    sms = {}
+    nhs, _ = _cluster(tmp_path, sms)
+    try:
+        lid, leader = _leader(nhs)
+        assert _wait_enrolled(leader), "leader never enrolled"
+        n = _propose_all(leader, [b"k%d" % i for i in range(200)])
+        _wait_converged(sms, n)
+        st = leader.fastlane.stats()
+        assert st["proposed"] >= 200
+        assert st["commits_advanced"] > 0
+        # followers served acks natively once enrolled
+        total_fast = sum(nh.fastlane.stats()["ingested_fast"] for nh in nhs.values())
+        assert total_fast > 0
+        # order is identical across replicas
+        base = sms[lid].applied
+        for i, sm in sms.items():
+            assert sm.applied == base, f"replica {i} diverged"
+    finally:
+        _stop_all(nhs)
+
+
+def test_read_index_ejects_and_reenrolls(tmp_path):
+    sms = {}
+    nhs, _ = _cluster(tmp_path, sms)
+    try:
+        lid, leader = _leader(nhs)
+        assert _wait_enrolled(leader)
+        _propose_all(leader, [b"a", b"b", b"c"])
+        node = leader.get_node(CID)
+        # linearizable read forces an eject...
+        got = leader.sync_read(CID, None, timeout=10.0)
+        assert len(got) == 3
+        # ...and the group re-enrolls once quiescent again
+        assert _wait_enrolled(leader), "no re-enroll after read"
+        _propose_all(leader, [b"d"])
+        _wait_converged(sms, 4)
+        assert not node._stopped.is_set()
+    finally:
+        _stop_all(nhs)
+
+
+def test_follower_kill_and_restart(tmp_path):
+    sms = {}
+    nhs, addrs = _cluster(tmp_path, sms)
+    try:
+        lid, leader = _leader(nhs)
+        assert _wait_enrolled(leader)
+        _propose_all(leader, [b"w%d" % i for i in range(20)])
+        victim = next(i for i in nhs if i != lid)
+        nhs[victim].stop()
+        # quorum holds: native leader keeps committing with one follower
+        _propose_all(leader, [b"x%d" % i for i in range(20)])
+        # restart the follower; recovery runs through the scalar path
+        nhs[victim] = _mk(victim, addrs, tmp_path, sms)
+        _propose_all(leader, [b"y%d" % i for i in range(20)])
+        _wait_converged(sms, 60, timeout=60.0)
+    finally:
+        _stop_all(nhs)
+
+
+def test_leader_kill_failover(tmp_path):
+    sms = {}
+    nhs, addrs = _cluster(tmp_path, sms)
+    try:
+        lid, leader = _leader(nhs)
+        assert _wait_enrolled(leader)
+        _propose_all(leader, [b"p%d" % i for i in range(10)])
+        nhs.pop(lid).stop()
+        # followers eject on contact loss and elect a new leader scalar-side
+        new_lid, new_leader = _leader(nhs, timeout=60.0)
+        assert new_lid != lid
+        _propose_all(new_leader, [b"q%d" % i for i in range(10)])
+        live = {i: sm for i, sm in sms.items() if i in nhs}
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if all(len(sm.applied) == 20 for sm in live.values()):
+                break
+            time.sleep(0.1)
+        assert all(len(sm.applied) == 20 for sm in live.values())
+    finally:
+        _stop_all(nhs)
+
+
+def test_full_restart_replays_native_wal(tmp_path):
+    """Entries written by the native core must replay through the normal
+    Python recovery path (byte-identical record formats)."""
+    sms = {}
+    nhs, addrs = _cluster(tmp_path, sms)
+    lid, leader = _leader(nhs)
+    assert _wait_enrolled(leader)
+    _propose_all(leader, [b"r%d" % i for i in range(30)])
+    _wait_converged(sms, 30)
+    _stop_all(nhs)
+
+    sms2 = {}
+    nhs2 = {i: _mk(i, addrs, tmp_path, sms2) for i in addrs}
+    try:
+        lid2, leader2 = _leader(nhs2, timeout=60.0)
+        _propose_all(leader2, [b"s%d" % i for i in range(5)])
+        _wait_converged(sms2, 35, timeout=60.0)
+        base = sms2[lid2].applied
+        assert base[:30] == [b"r%d" % i for i in range(30)]
+    finally:
+        _stop_all(nhs2)
+
+
+def test_periodic_snapshot_forces_eject(tmp_path):
+    """snapshot_entries > 0: the enrolled step detects the due snapshot,
+    ejects, and the normal auto-snapshot machinery runs."""
+    sms = {}
+    ports = _ports(3)
+    addrs = {i + 1: f"127.0.0.1:{ports[i]}" for i in range(3)}
+    nhs = {
+        i: _mk(i, addrs, tmp_path, sms, snapshot_entries=25) for i in addrs
+    }
+    try:
+        lid, leader = _leader(nhs)
+        node = leader.get_node(CID)
+        _propose_all(leader, [b"z%d" % i for i in range(80)])
+        _wait_converged(sms, 80)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if node.sm.get_snapshot_index() > 0:
+                break
+            time.sleep(0.2)
+        assert node.sm.get_snapshot_index() > 0, "auto snapshot never ran"
+    finally:
+        _stop_all(nhs)
