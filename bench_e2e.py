@@ -105,31 +105,29 @@ def _percentiles(lats):
 
 
 def _load_worker(nh_by_cid, cids, payload, window, stop_at, drain_deadline, out):
-    """Drive a slice of groups: keep `window` proposals in flight per group,
-    FIFO-wait completions (apply order is FIFO per group, so the oldest
-    future completes first).  The throughput claim counts only completions
-    inside [start, stop_at]; the drain afterwards is bounded and excluded
-    so a deep window can't dilute the rate or wedge the phase schedule."""
-    inflight = collections.deque()  # (t0, rs)
+    """Drive a slice of groups: keep `window` proposals in flight per group.
+
+    Completions are consumed by POLLING finished futures in batches (apply
+    order is FIFO per group, so each deque drains from the front) with a
+    single blocking wait only when nothing has completed anywhere.  A
+    per-op blocking ``Event.wait`` here throttles the whole benchmark: the
+    GIL hands the client thread one wakeup per scheduling quantum, and the
+    runtime ends up idle waiting for the client to refill windows (the
+    native pipeline commits a full window in ~10ms; a blocking client took
+    ~50ms to notice).  The throughput claim counts only completions inside
+    [start, stop_at]; the drain afterwards is bounded and excluded."""
     lat = []
     in_window = 0
     done = 0
     errors = 0
     abandoned = 0
+    inflight = {cid: collections.deque() for cid in cids}
     try:
         sessions = {cid: nh_by_cid[cid].get_noop_session(cid) for cid in cids}
-        cap = window * len(cids)
-        # group-major proposal order: a group's window arrives as one burst,
-        # so the runtime's entry queue coalesces it into a single step round
-        # (the reference's benchmark clients are pipelined per-group streams
-        # too); round-robin order would hand the step path one entry at a
-        # time and pay the full per-step cost per write
-        cid_cycle = [cid for cid in cids for _ in range(window)]
-        i = 0
-        while time.time() < stop_at:
-            while len(inflight) < cap and time.time() < stop_at:
-                cid = cid_cycle[i % len(cid_cycle)]
-                i += 1
+
+        def refill(cid, dq):
+            nonlocal errors
+            while len(dq) < window and time.time() < stop_at:
                 t0 = time.perf_counter()
                 try:
                     rs = nh_by_cid[cid].propose(
@@ -137,34 +135,50 @@ def _load_worker(nh_by_cid, cids, payload, window, stop_at, drain_deadline, out)
                     )
                 except Exception:
                     errors += 1
-                    time.sleep(0.01)  # don't busy-spin on a dead group
-                    continue
-                inflight.append((t0, rs))
-            if not inflight:
-                continue
-            t0, rs = inflight.popleft()
-            r = rs.wait(30.0)
-            t1 = time.perf_counter()
-            if r.completed:
-                lat.append(t1 - t0)
-                done += 1
-                if time.time() <= stop_at:
-                    in_window += 1
-            else:
-                errors += 1
+                    time.sleep(0.005)  # don't busy-spin on a dead group
+                    return False
+                dq.append((t0, rs))
+            return True
+
+        while time.time() < stop_at:
+            progress = 0
+            for cid, dq in inflight.items():
+                while dq and dq[0][1].done():
+                    t0, rs = dq.popleft()
+                    r = rs.result  # property; set before the event
+                    t1 = time.perf_counter()
+                    if r is not None and r.completed:
+                        lat.append(t1 - t0)
+                        done += 1
+                        progress += 1
+                        if time.time() <= stop_at:
+                            in_window += 1
+                    else:
+                        errors += 1
+                refill(cid, dq)
+            if not progress:
+                oldest = None
+                for dq in inflight.values():
+                    if dq and (oldest is None or dq[0][0] < oldest[0]):
+                        oldest = dq[0]
+                if oldest is None:
+                    time.sleep(0.002)
+                else:
+                    oldest[1].wait(0.05)
         # bounded drain (not counted toward the rate)
-        while inflight and time.time() < drain_deadline:
-            t0, rs = inflight.popleft()
-            r = rs.wait(max(0.1, min(10.0, drain_deadline - time.time())))
-            t1 = time.perf_counter()
-            if r.completed:
-                lat.append(t1 - t0)
-                done += 1
-            else:
-                errors += 1
-        abandoned = len(inflight)
+        for cid, dq in inflight.items():
+            while dq and time.time() < drain_deadline:
+                t0, rs = dq.popleft()
+                r = rs.wait(max(0.1, min(10.0, drain_deadline - time.time())))
+                t1 = time.perf_counter()
+                if r.completed:
+                    lat.append(t1 - t0)
+                    done += 1
+                else:
+                    errors += 1
+        abandoned = sum(len(dq) for dq in inflight.values())
     except Exception:
-        errors += 1 + len(inflight)
+        errors += 1 + sum(len(dq) for dq in inflight.values())
     out.append((in_window, done, errors, abandoned, lat))
 
 
@@ -450,6 +464,12 @@ def rank_main() -> int:
                             "lat_duration":…, "lat_cids":[…]}
     """
     rank = _rank_env_int("E2E_RANK", 0)
+    # GIL switch interval is tunable for experiments; the default (5ms)
+    # measured best — shorter intervals add context-switch overhead
+    # without improving the pipeline's wakeup latency
+    si = os.environ.get("E2E_SWITCH_INTERVAL")
+    if si:
+        sys.setswitchinterval(float(si))
     if os.environ.get("DBTPU_CPROFILE_STEP_DIR"):
         os.environ["DBTPU_CPROFILE_STEP"] = os.path.join(
             os.environ["DBTPU_CPROFILE_STEP_DIR"], f"step_rank{rank}.prof"
@@ -486,6 +506,12 @@ def rank_main() -> int:
 
     ldb = LogDBConfig()
     ldb.fsync = os.environ.get("E2E_FSYNC", "1") == "1"
+    # native replication fast lane (fastlane.py): the steady-state data
+    # plane of enrolled groups runs in C++ — the host-path answer to the
+    # ~75us-of-Python-per-write bound documented in PERF.md.  On by
+    # default in this benchmark's deployment shape (TCP + durable native
+    # LogDB); E2E_FAST_LANE=0 measures the pure-Python path.
+    fast_lane = durable and os.environ.get("E2E_FAST_LANE", "1") == "1"
     nh = NodeHost(
         NodeHostConfig(
             node_host_dir=(
@@ -497,7 +523,11 @@ def rank_main() -> int:
             expert=ExpertConfig(
                 quorum_engine=my_engine,
                 engine_block_groups=max(groups, 64),
-                logdb_shards=4,
+                logdb_shards=int(os.environ.get("E2E_SHARDS", "4")),
+                fast_lane=fast_lane,
+                fast_lane_commit_window_ms=float(
+                    os.environ.get("E2E_COMMIT_WINDOW_MS", "2.0")
+                ),
             ),
         )
     )
@@ -639,12 +669,19 @@ def rank_main() -> int:
             plan["t0"] + plan["duration"], threads,
         )
         lat_lats = lat.pop("_lats")
+        fl_stats = (
+            nh.fastlane.stats() if nh.fastlane is not None else {"enabled": False}
+        )
+        fl_stats["enrolled_now"] = sum(
+            1 for cid in led if nh.get_node(cid).fast_lane
+        )
         emit(
             "RESULT",
             {
                 "rank": rank,
                 "lat": lat,
                 "engine_stats": nh.engine.stats(),
+                "fastlane": fl_stats,
                 "lat_lats": lat_lats[:: max(1, len(lat_lats) // 20000)],
             },
         )
@@ -902,6 +939,7 @@ def run_mp(
         }
         if os.environ.get("E2E_KEEP_STATS") == "1":
             out["rank_engine_stats"] = [r.get("engine_stats") for r in lat_oks]
+        out["fastlane"] = [r.get("fastlane") for r in lat_oks]
         if errors:
             out["rank_errors"] = errors
         return out

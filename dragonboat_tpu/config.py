@@ -90,6 +90,10 @@ class ExpertConfig:
     # TCP transport and the native LogDB backend; silently unavailable
     # otherwise.
     fast_lane: bool = False
+    # group-commit accumulation window per WAL shard (ms): pacing fsyncs
+    # multiplies batch depth when the flush device is the bottleneck, at
+    # the cost of up to this much added commit latency per durability hop
+    fast_lane_commit_window_ms: float = 0.0
     # filesystem the snapshot paths go through; None = the real OS fs.
     # Setting a vfs.MemFS runs the whole stack diskless (reference memfs
     # builds); a vfs.ErrorFS enables fault-injection testing and is

@@ -54,8 +54,14 @@ class FastLaneManager:
         # popped from the native queue only under this lock, so an eject can
         # atomically drain the remainder and keep per-group apply order
         self.apply_gate = threading.Lock()
+        # nodes whose task queues received spans this drain (gate-guarded);
+        # the pump applies them inline after releasing the gate
+        self._touched = []
         self._stopped = threading.Event()
         self._threads = []
+        # diagnostics: why groups leave the lane (native event codes plus
+        # Python-initiated reasons), exposed via stats()
+        self.eject_reasons: Dict[str, int] = {}
 
         handles = self._native_shard_handles()
         if handles is None:
@@ -73,12 +79,22 @@ class FastLaneManager:
             nh.raft_address(), nh.nhconfig.get_deployment_id()
         )
         self.nat.set_shards(handles)
+        window_ms = nh.nhconfig.expert.fast_lane_commit_window_ms
+        if window_ms > 0:
+            self.nat.set_commit_window(int(window_ms * 1000))
         self.n_shards = len(handles)
         rpc.raw_handler = self._ingest
+        rpc.raw_stream = self  # stream_open/stream_feed/stream_close below
+        if not getattr(nh.nhconfig, "mutual_tls", False) and hasattr(
+            rpc, "takeover_fd"
+        ):
+            # plain TCP: native reader threads own inbound connections
+            rpc.takeover_fd = self._takeover_fd
         self.nat.start()
         for fn, name in (
             (self._apply_pump, "fastlane-apply"),
             (self._event_pump, "fastlane-events"),
+            (self._leftover_pump, "fastlane-leftover"),
         ):
             t = threading.Thread(target=fn, name=name, daemon=True)
             t.start()
@@ -115,6 +131,78 @@ class FastLaneManager:
             return payload
         return leftover
 
+    # stream-ingest hooks (tcp.py _serve_conn_stream): large recv chunks
+    # go straight to the native frame reassembler; only leftovers return
+
+    def stream_open(self) -> int:
+        return self.nat.conn_new()
+
+    def stream_feed(self, h: int, data: bytes):
+        nat = self.nat
+        if nat is None or self._stopped.is_set():
+            return [(0xFFFF, b"")]  # shutting down: close the connection
+        return nat.ingest_stream(h, data)
+
+    def stream_close(self, h: int) -> None:
+        nat = self.nat
+        if nat is not None and h:
+            nat.conn_free(h)
+
+    def _takeover_fd(self, fd: int) -> bool:
+        nat = self.nat
+        if nat is None or self._stopped.is_set():
+            return False
+        return nat.serve_fd(fd)
+
+    def _leftover_pump(self) -> None:
+        """Route frames the native readers could not consume through the
+        normal transport handlers (decode + router; the router completes
+        any needed eject before delivery)."""
+        from .wire.codec import decode_chunk, decode_message_batch
+
+        transport = self.nh.transport
+        while not self._stopped.is_set():
+            try:
+                got = self.nat.next_leftover(200)
+            except ConnectionError:
+                return
+            if got is None:
+                continue
+            method, payload, conn_id = got
+            try:
+                if method == 100:
+                    transport.handle_request(decode_message_batch(payload))
+                elif method == 200:
+                    if not transport.chunks.add_chunk(decode_chunk(payload)):
+                        # a rejected chunk must fail the stream visibly:
+                        # close the connection so the sender reports a
+                        # failed snapshot instead of believing it landed
+                        self.nat.close_conn(conn_id)
+                # poison (999) / framing errors (0xFFFF): the native
+                # reader already closed the connection
+            except Exception:
+                plog.exception("leftover route failed (method %d)", method)
+
+    def ingest_message(self, m) -> bool:
+        """Offer one decoded in-flight message to the native core (used for
+        fast-path messages that were already queued on the Python side when
+        the group enrolled).  True = consumed natively."""
+        from .wire import MessageBatch
+        from .wire.codec import encode_message_batch
+
+        nat = self.nat
+        if nat is None:
+            return False
+        payload = encode_message_batch(
+            MessageBatch(
+                requests=[m],
+                deployment_id=self.nh.nhconfig.get_deployment_id(),
+                source_address=self.nh.raft_address(),
+            )
+        )
+        n, leftover = nat.ingest(payload)
+        return n == 1 and leftover is None
+
     # --------------------------------------------------------- enrollment
 
     def slot_for(self, addr: str) -> int:
@@ -126,12 +214,26 @@ class FastLaneManager:
             if slot < 0:
                 return -1
             self._slots[addr] = slot
-            t = threading.Thread(
-                target=self._sender, args=(slot, addr),
-                name=f"fastlane-send-{addr}", daemon=True,
-            )
-            t.start()
-            self._threads.append(t)
+            # outbound: a native sender thread when the address is a plain
+            # IPv4 literal (the GIL-free fast plane); Python pump otherwise
+            host, _, port = addr.rpartition(":")
+            native_ok = False
+            try:
+                socket_ok = all(
+                    p.isdigit() and 0 <= int(p) <= 255
+                    for p in host.split(".")
+                ) and len(host.split(".")) == 4
+                if socket_ok:
+                    native_ok = self.nat.remote_connect(slot, host, int(port))
+            except (ValueError, OSError):
+                native_ok = False
+            if not native_ok:
+                t = threading.Thread(
+                    target=self._sender, args=(slot, addr),
+                    name=f"fastlane-send-{addr}", daemon=True,
+                )
+                t.start()
+                self._threads.append(t)
             return slot
 
     def register_node(self, node) -> None:
@@ -184,7 +286,13 @@ class FastLaneManager:
         node.to_apply.enqueue(
             Task(cluster_id=cid, node_id=node.node_id, entries=entries)
         )
-        self.nh.engine.set_apply_ready(cid)
+        self._touched.append(node)
+
+    # applies for fast-lane spans run INLINE on the pump thread (same FIFO
+    # task queue, so ordering with slow-path tasks is preserved) — routing
+    # through the engine's apply workers adds a cross-thread wakeup whose
+    # GIL handoff latency dominates the end-to-end commit path
+    _APPLY_INLINE = True
 
     def _drain_applies_locked(self) -> None:
         while True:
@@ -203,6 +311,18 @@ class FastLaneManager:
                 return
             with self.apply_gate:
                 self._drain_applies_locked()
+                touched, self._touched = self._touched, []
+            # applies run OUTSIDE the gate: handle_apply_tasks takes
+            # raftMu, and fast_eject holds raftMu while taking the gate —
+            # running inside would deadlock (lock-order inversion)
+            for node in touched:
+                if self._APPLY_INLINE:
+                    try:
+                        node.handle_apply_tasks()
+                    except Exception:
+                        plog.exception("inline apply failed")
+                else:
+                    self.nh.engine.set_apply_ready(node.cluster_id)
 
     def _event_pump(self) -> None:
         while not self._stopped.is_set():
@@ -219,6 +339,7 @@ class FastLaneManager:
                 plog.info(
                     "group %d native eject: %s", cid, EV_NAMES.get(code, code)
                 )
+                self.count_eject(EV_NAMES.get(code, str(code)))
                 node.fast_eject(contact_lost=code in (1, 2))
 
     def _sender(self, slot: int, addr: str) -> None:
@@ -265,11 +386,15 @@ class FastLaneManager:
 
     # ------------------------------------------------------------- misc
 
+    def count_eject(self, reason: str) -> None:
+        self.eject_reasons[reason] = self.eject_reasons.get(reason, 0) + 1
+
     def stats(self) -> dict:
         if not self.enabled:
             return {"enabled": False}
         out = self.nat.stats()
         out["enabled"] = True
+        out["eject_reasons"] = dict(self.eject_reasons)
         return out
 
     def stop(self) -> None:

@@ -63,11 +63,19 @@
 #include <cstring>
 #include <deque>
 #include <dlfcn.h>
+#include <functional>
+#include <sys/prctl.h>
 #include <map>
 #include <memory>
 #include <mutex>
 #include <string>
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/types.h>
 #include <thread>
+#include <unistd.h>
 #include <unordered_map>
 #include <vector>
 
@@ -170,8 +178,15 @@ static void batch_put(std::string& b, const std::string& k, const std::string& v
 
 struct NEntry {
   uint64_t term = 0, index = 0;
+  int64_t born_us = 0;  // propose/append time (latency diagnostics)
   std::string enc;  // canonical wire encoding (codec.encode_entry)
 };
+
+static inline int64_t mono_us() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (int64_t)ts.tv_sec * 1000000 + ts.tv_nsec / 1000;
+}
 
 // Build the canonical Entry encoding (wire/codec.py encode_entry_into).
 static std::string encode_entry(uint64_t term, uint64_t index, uint64_t etype,
@@ -327,12 +342,32 @@ static void put_msg_header(std::string& b, uint64_t type, uint8_t flags,
 
 typedef int (*nkv_commit_fn)(void*, const uint8_t*, size_t);
 
+struct Group;
+
+// One WAL shard with its own committer thread: the staging pass appends
+// records and queues per-group post-fsync work; the committer swaps the
+// whole accumulation out, issues ONE fsynced nkv batch covering it
+// (classic group commit — the deeper the pipeline backs up, the bigger
+// the batch), then runs the deferred effects.  Staging never blocks on a
+// disk flush, and shards flush in parallel — the reference's
+// one-WriteBatch-per-worker-round geometry (sharded_rdb.go:156-163).
 struct Shard {
   void* handle = nullptr;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::string batch;
+  // (group, staged_until): entries <= staged_until are covered by the
+  // next commit of `batch`
+  std::vector<std::pair<std::shared_ptr<Group>, uint64_t>> post;
+  std::thread thread;
+  int64_t last_fsync_end_us = 0;
 };
 
 // Outbound plane: one buffer of ready-to-send transport frames per remote
-// address slot; a Python pump thread per slot drains with sendall.
+// address slot, drained by a native sender thread over its own TCP
+// connection (connect/reconnect handled here; the GIL never touches the
+// outbound fast plane).  A Python pump via natr_take_send remains as the
+// fallback when no native connection is attached (tests).
 struct Remote {
   std::mutex mu;
   std::condition_variable cv;
@@ -341,6 +376,11 @@ struct Remote {
   uint64_t msg_count = 0;   // messages in `msgs`
   bool closed = false;
   uint64_t dropped = 0;
+  // native sender (natr_remote_connect)
+  std::string host;
+  int port = 0;
+  std::thread sender;
+  int fd = -1;
 };
 
 struct ApplySpan {
@@ -361,6 +401,7 @@ struct PeerP {
   int slot = -1;
   uint64_t match = 0, next = 0;
   int64_t contact_ms = 0;
+  int64_t progress_ms = 0;  // last match advance / resend reset
 };
 
 struct PendResp {
@@ -415,7 +456,7 @@ struct Engine {
   uint64_t deployment_id = 0, bin_ver = 1;
   nkv_commit_fn nkv_commit = nullptr;
   void* nkv_dl = nullptr;
-  std::vector<Shard> shards;
+  std::vector<std::unique_ptr<Shard>> shards;
   // preallocated so ingest/round threads can index without locking the
   // container while natr_add_remote runs
   std::vector<std::unique_ptr<Remote>> remotes;
@@ -441,13 +482,58 @@ struct Engine {
   std::condition_variable ecv;
   std::deque<std::pair<uint64_t, int>> eventq;
 
+  // native connection readers (natr_serve_fd) + leftover frames for the
+  // Python pump
+  struct Reader {
+    int fd = -1;
+    bool closed = false;
+    std::thread th;
+  };
+  std::mutex readers_mu;
+  bool readers_stopping = false;
+  std::vector<std::shared_ptr<Reader>> readers;
+  std::mutex lmu;
+  std::condition_variable lcv;
+  struct Leftover {
+    uint16_t method;
+    uint64_t conn_id;  // Reader identity, for natr_close_conn
+    std::string payload;
+  };
+  std::deque<Leftover> leftq;
+
   std::atomic<bool> stopped{false};
   std::thread round_thread;
   int64_t round_interval_ms = 1;
+  std::atomic<int64_t> commit_window_us{0};
 
   // stats
   std::atomic<uint64_t> proposed{0}, ingested_fast{0}, ingested_slow{0},
       commits_advanced{0}, rounds{0}, fsyncs{0};
+  std::atomic<uint64_t> fsync_ns{0}, round_ns{0}, entries_staged{0};
+  // latency diagnostics (us sums + counts): born->staged, born->fsynced,
+  // born->apply-emitted
+  std::atomic<uint64_t> lat_stage_us{0}, lat_fsync_us{0}, lat_emit_us{0},
+      lat_count{0};
+  std::atomic<uint64_t> lat_emitf_us{0}, lat_countf{0}, buf_hiwater{0};
+  std::atomic<uint64_t> lat_ack_us{0}, lat_ackn{0};  // leader: born->ack covering entry
+  std::atomic<uint64_t> lat_resp_us{0}, lat_respn{0};  // follower: born->resp flushed
+  std::atomic<uint64_t> rtt_us{0}, rttn{0}, rtt_max_us{0};  // hb echo round trip
+  // single-group debug timeline (natr_debug)
+  std::atomic<uint64_t> debug_cid{0};
+  std::mutex dbg_mu;
+  std::string dbg;
+  void dbg_ev(Group* g, const char* ev, uint64_t a, uint64_t b) {
+    if (g->cid != debug_cid.load()) return;
+    std::lock_guard<std::mutex> lk(dbg_mu);
+    if (dbg.size() > (1u << 20)) return;
+    char line[160];
+    snprintf(line, sizeof(line), "%lld %s a=%llu b=%llu last=%llu fs=%llu c=%llu ah=%llu\n",
+             (long long)mono_us(), ev, (unsigned long long)a,
+             (unsigned long long)b, (unsigned long long)g->last_index,
+             (unsigned long long)g->fsynced, (unsigned long long)g->commit,
+             (unsigned long long)g->applied_handed);
+    dbg += line;
+  }
 
   Engine() {
     remotes.reserve(kMaxRemotes);
@@ -463,11 +549,33 @@ struct Engine {
     acv.notify_all();
     ecv.notify_all();
     for (auto& r : remotes) {
-      std::lock_guard<std::mutex> g(r->mu);
-      r->closed = true;
-      r->cv.notify_all();
+      {
+        std::lock_guard<std::mutex> g(r->mu);
+        r->closed = true;
+        if (r->fd >= 0) shutdown(r->fd, SHUT_RDWR);
+        r->cv.notify_all();
+      }
+      if (r->sender.joinable()) r->sender.join();
+    }
+    for (auto& sh : shards) {
+      sh->cv.notify_all();
+      if (sh->thread.joinable()) sh->thread.join();
     }
     if (round_thread.joinable()) round_thread.join();
+    // wake the readers (shutdown their sockets), then join them outside
+    // the mutex (their exit path takes readers_mu briefly)
+    std::vector<std::shared_ptr<Reader>> rds;
+    {
+      std::lock_guard<std::mutex> lk(readers_mu);
+      readers_stopping = true;
+      for (auto& rd : readers) {
+        if (!rd->closed) shutdown(rd->fd, SHUT_RDWR);
+      }
+      rds = readers;
+    }
+    for (auto& rd : rds)
+      if (rd->th.joinable()) rd->th.join();
+    lcv.notify_all();
   }
 
   std::shared_ptr<Group> find(uint64_t cid) {
@@ -554,6 +662,9 @@ struct Engine {
           r->dropped++;
         } else {
           r->buf += frame;
+          uint64_t sz = r->buf.size();
+          uint64_t hw = buf_hiwater.load();
+          while (sz > hw && !buf_hiwater.compare_exchange_weak(hw, sz)) {}
           r->cv.notify_one();
         }
       }
@@ -583,8 +694,18 @@ struct Engine {
     span.first = first;
     span.last = upto;
     put_uvarint(span.blob, upto - first + 1);
-    for (uint64_t i = first; i <= upto; i++)
-      span.blob += g->log[i - g->log_first].enc;
+    int64_t now = mono_us();
+    for (uint64_t i = first; i <= upto; i++) {
+      NEntry& e2 = g->log[i - g->log_first];
+      span.blob += e2.enc;
+      if (g->leader) {
+        lat_emit_us += now - e2.born_us;
+        lat_count++;
+      } else {
+        lat_emitf_us += now - e2.born_us;
+        lat_countf++;
+      }
+    }
     g->applied_handed = upto;
     {
       std::lock_guard<std::mutex> lk(amu);
@@ -608,7 +729,12 @@ struct Engine {
   void send_entries(Group* g, PeerP& p) {
     static constexpr uint64_t kMaxBatch = 4096;
     static constexpr uint64_t kMaxInflight = 1u << 14;
-    if (p.next <= g->enroll_last) return;  // needs pre-enroll entries: eject
+    if (p.next <= g->enroll_last) {
+      // the follower needs entries from before this enrollment's window;
+      // only the scalar path can serve them (snapshot/catch-up logic)
+      begin_eject(g, EV_PROTOCOL);
+      return;
+    }
     while (p.next <= g->last_index && p.next - 1 - p.match < kMaxInflight) {
       uint64_t first = p.next;
       uint64_t last = std::min(g->last_index, first + kMaxBatch - 1);
@@ -624,6 +750,7 @@ struct Engine {
       for (uint64_t i = first; i <= last; i++)
         b += g->log[i - g->log_first].enc;
       queue_msg(p.slot, b);
+      dbg_ev(g, "send", first, last);
       p.next = last + 1;
     }
     if (g->commit > g->commit_sent && p.next > g->last_index) {
@@ -636,8 +763,73 @@ struct Engine {
     }
   }
 
-  // One pass of the round loop: stage WAL, fsync per shard, post-fsync
-  // effects, heartbeats/clocks.
+  // Stage a State record when term/vote/commit changed since the last
+  // written one (rdbcache-style suppression).  g->mu held.
+  void stage_state(Group* g) {
+    if (g->term == g->st_written_term && g->vote == g->st_written_vote &&
+        g->commit == g->st_written_commit)
+      return;
+    std::string v;
+    put_u64le(v, g->term);
+    put_u64le(v, g->vote);
+    put_u64le(v, g->commit);
+    Shard* sh = shards[g->shard].get();
+    {
+      std::lock_guard<std::mutex> lk(sh->mu);
+      batch_put(sh->batch, make_key(TAG_STATE, g->cid, g->nid, 0), v);
+    }
+    sh->cv.notify_one();
+    g->st_written_term = g->term;
+    g->st_written_vote = g->vote;
+    g->st_written_commit = g->commit;
+  }
+
+  // Effects that are legal at the group's CURRENT durability point:
+  // follower acks covered by the local fsync, leader quorum tally +
+  // commit, apply hand-off (<= min(commit, fsynced)), entry fan-out
+  // (pre-fsync sending is the thesis-10.2.1 pipelining), commit-update
+  // broadcast, log trim.  g->mu held; called from both the round thread
+  // (stage/ack work) and the shard committers (post-fsync).
+  void run_effects(Group* g) {
+    size_t kept = 0;
+    for (auto& r : g->resps) {
+      // never ack an entry the local fsync does not cover yet: the
+      // leader would count a non-durable replica toward commit
+      if (r.log_index > g->fsynced) {
+        g->resps[kept++] = r;
+        continue;
+      }
+      std::string b;
+      put_msg_header(b, r.type, r.flags, r.to, g->nid, g->cid, g->term, 0,
+                     r.log_index, 0, r.hint, r.hint_high, 0);
+      queue_msg(r.slot, b);
+      if (r.type == MT_REPLICATE_RESP && r.log_index >= g->log_first &&
+          r.log_index < g->log_first + g->log.size()) {
+        lat_resp_us += mono_us() - g->log[r.log_index - g->log_first].born_us;
+        lat_respn++;
+      }
+    }
+    g->resps.resize(kept);
+    if (g->leader) {
+      uint64_t q = tally(g);
+      if (q > g->commit) {
+        g->commit = q;
+        commits_advanced++;
+        dbg_ev(g, "commit", q, 0);
+        stage_state(g);
+      }
+      emit_apply(g);
+      for (auto& p : g->peers) send_entries(g, p);
+      if (g->commit > g->commit_sent) g->commit_sent = g->commit;
+    } else {
+      emit_apply(g);
+    }
+    trim_log(g);
+  }
+
+  // One pass of the round loop: stage WAL bytes to the shard committers,
+  // run fsync-independent effects, heartbeats/clocks.  The round thread
+  // NEVER blocks on a disk flush.
   void round_pass() {
     std::vector<std::shared_ptr<Group>> work;
     {
@@ -647,95 +839,117 @@ struct Engine {
       work.swap(dirtyq);
     }
     rounds++;
-    // stage phase: per-shard WAL batches + pre-fsync replicate fan-out
-    std::vector<std::string> batches(shards.size());
+    struct timespec t0;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
     for (auto& gsp : work) {
       Group* g = gsp.get();
       std::lock_guard<std::mutex> lk(g->mu);
       g->dirty = false;
       if (g->state != G_ACTIVE) continue;
       if (g->last_index > g->staged_to) {
-        std::string& b = batches[g->shard];
-        for (uint64_t i = g->staged_to + 1; i <= g->last_index; i++)
-          batch_put(b, make_key(TAG_ENTRY, g->cid, g->nid, i),
-                    g->log[i - g->log_first].enc);
+        Shard* sh = shards[g->shard].get();
+        {
+          std::lock_guard<std::mutex> slk(sh->mu);
+          std::string& b = sh->batch;
+          int64_t now = mono_us();
+          for (uint64_t i = g->staged_to + 1; i <= g->last_index; i++) {
+            NEntry& e2 = g->log[i - g->log_first];
+            lat_stage_us += now - e2.born_us;
+            batch_put(b, make_key(TAG_ENTRY, g->cid, g->nid, i), e2.enc);
+          }
+          if (g->last_index != g->maxindex_written) {
+            std::string v;
+            put_u64be(v, g->last_index);
+            batch_put(b, make_key(TAG_MAX_INDEX, g->cid, g->nid, 0), v);
+            g->maxindex_written = g->last_index;
+          }
+          sh->post.emplace_back(gsp, g->last_index);
+        }
+        sh->cv.notify_one();
+        dbg_ev(g, "stage", g->last_index, 0);
+        entries_staged += g->last_index - g->staged_to;
         g->staged_to = g->last_index;
-        if (g->last_index != g->maxindex_written) {
-          std::string v;
-          put_u64be(v, g->last_index);
-          batch_put(b, make_key(TAG_MAX_INDEX, g->cid, g->nid, 0), v);
-          g->maxindex_written = g->last_index;
-        }
-        // leader: replicate BEFORE fsync (thesis 10.2.1)
-        if (g->leader)
-          for (auto& p : g->peers) send_entries(g, p);
       }
-      if (g->term != g->st_written_term || g->vote != g->st_written_vote ||
-          g->commit != g->st_written_commit) {
-        std::string v;
-        put_u64le(v, g->term);
-        put_u64le(v, g->vote);
-        put_u64le(v, g->commit);
-        batch_put(batches[g->shard], make_key(TAG_STATE, g->cid, g->nid, 0), v);
-        g->st_written_term = g->term;
-        g->st_written_vote = g->vote;
-        g->st_written_commit = g->commit;
-      }
-    }
-    flush_remotes();  // pre-fsync sends go out now
-    // fsync phase
-    std::vector<bool> ok(shards.size(), true);
-    for (size_t s = 0; s < shards.size(); s++) {
-      if (batches[s].empty()) continue;
-      fsyncs++;
-      int rc = nkv_commit(shards[s].handle, (const uint8_t*)batches[s].data(),
-                          batches[s].size());
-      ok[s] = rc >= 0;
-    }
-    // post-fsync phase
-    for (auto& gsp : work) {
-      Group* g = gsp.get();
-      std::lock_guard<std::mutex> lk(g->mu);
-      if (g->state != G_ACTIVE) continue;
-      if (!ok[g->shard]) {
-        begin_eject(g, EV_WAL_ERROR);
-        continue;
-      }
-      g->fsynced = g->staged_to;
-      // follower: durable -> acks out.  An ingest thread may have queued
-      // an ack for an entry appended DURING this round's fsync; sending it
-      // now would acknowledge a non-durable entry (the leader would count
-      // it toward commit, and a crash here would lose a committed entry).
-      // Hold such acks for the round whose fsync covers them.
-      size_t kept = 0;
-      for (auto& r : g->resps) {
-        if (r.log_index > g->fsynced) {
-          g->resps[kept++] = r;
-          continue;
-        }
-        std::string b;
-        put_msg_header(b, r.type, r.flags, r.to, g->nid, g->cid, g->term, 0,
-                       r.log_index, 0, r.hint, r.hint_high, 0);
-        queue_msg(r.slot, b);
-      }
-      g->resps.resize(kept);
-      if (kept) mark_dirty(g);  // flush after the next fsync
-      if (g->leader) {
-        uint64_t q = tally(g);
-        if (q > g->commit) {
-          g->commit = q;
-          commits_advanced++;
-        }
-        emit_apply(g);
-        for (auto& p : g->peers) send_entries(g, p);
-        if (g->commit > g->commit_sent) g->commit_sent = g->commit;
-      } else {
-        emit_apply(g);
-      }
-      trim_log(g);
+      stage_state(g);
+      run_effects(g);
     }
     flush_remotes();
     clock_pass();
+    struct timespec t3;
+    clock_gettime(CLOCK_MONOTONIC, &t3);
+    round_ns += (uint64_t)(t3.tv_sec - t0.tv_sec) * 1000000000ull +
+                (t3.tv_nsec - t0.tv_nsec);
+  }
+
+  // Per-shard committer: swap out everything staged since the last flush,
+  // commit it as ONE fsynced batch, then run the deferred post-fsync
+  // effects.  Group commit: a flush in progress lets the next batch grow.
+  void committer_main(Shard* sh) {
+    prctl(PR_SET_NAME, "natr-committer", 0, 0, 0);
+    while (!stopped.load()) {
+      std::string batch;
+      std::vector<std::pair<std::shared_ptr<Group>, uint64_t>> post;
+      {
+        std::unique_lock<std::mutex> lk(sh->mu);
+        if (sh->batch.empty() && sh->post.empty())
+          sh->cv.wait_for(lk, std::chrono::milliseconds(50));
+        if (sh->batch.empty() && sh->post.empty()) continue;
+      }
+      // group-commit accumulation window: pace fsyncs so each one covers
+      // more staged work (the fsync device is the shared bottleneck; at
+      // ~1ms per flush a handful of extra milliseconds multiplies batch
+      // depth and divides flush load).  Bounded added latency <= window.
+      int64_t w = commit_window_us.load();
+      if (w > 0) {
+        struct timespec ts;
+        clock_gettime(CLOCK_MONOTONIC, &ts);
+        int64_t now_us = (int64_t)ts.tv_sec * 1000000 + ts.tv_nsec / 1000;
+        int64_t wait_us = sh->last_fsync_end_us + w - now_us;
+        if (wait_us > 0) {
+          struct timespec d = {wait_us / 1000000,
+                               (wait_us % 1000000) * 1000};
+          nanosleep(&d, nullptr);
+        }
+      }
+      {
+        std::lock_guard<std::mutex> lk(sh->mu);
+        batch.swap(sh->batch);
+        post.swap(sh->post);
+      }
+      if (batch.empty() && post.empty()) continue;
+      bool ok = true;
+      if (!batch.empty()) {
+        fsyncs++;
+        struct timespec t1, t2;
+        clock_gettime(CLOCK_MONOTONIC, &t1);
+        ok = nkv_commit(sh->handle, (const uint8_t*)batch.data(),
+                        batch.size()) >= 0;
+        clock_gettime(CLOCK_MONOTONIC, &t2);
+        fsync_ns += (uint64_t)(t2.tv_sec - t1.tv_sec) * 1000000000ull +
+                    (t2.tv_nsec - t1.tv_nsec);
+        sh->last_fsync_end_us =
+            (int64_t)t2.tv_sec * 1000000 + t2.tv_nsec / 1000;
+      }
+      for (auto& [gsp, until] : post) {
+        Group* g = gsp.get();
+        std::lock_guard<std::mutex> lk(g->mu);
+        if (g->state != G_ACTIVE) continue;
+        if (!ok) {
+          begin_eject(g, EV_WAL_ERROR);
+          continue;
+        }
+        dbg_ev(g, "fsync-post", until, 0);
+        if (until > g->fsynced) {
+          int64_t now2 = mono_us();
+          for (uint64_t i = std::max(g->fsynced + 1, g->log_first);
+               i <= until && i < g->log_first + g->log.size(); i++)
+            lat_fsync_us += now2 - g->log[i - g->log_first].born_us;
+          g->fsynced = until;
+        }
+        run_effects(g);
+      }
+      flush_remotes();
+    }
   }
 
   int64_t last_clock_ms = 0;
@@ -758,10 +972,11 @@ struct Engine {
       if (g->leader) {
         if (now - g->last_hb_ms >= g->hb_period_ms) {
           g->last_hb_ms = now;
+          uint64_t stamp = (uint64_t)mono_us();
           for (auto& p : g->peers) {
             std::string b;
             put_msg_header(b, MT_HEARTBEAT, 0, p.id, g->nid, g->cid, g->term,
-                           0, 0, std::min(p.match, g->commit), 0, 0, 0);
+                           0, 0, std::min(p.match, g->commit), stamp, 0, 0);
             queue_msg(p.slot, b);
           }
         }
@@ -774,6 +989,20 @@ struct Engine {
         if (active >= quorum) g->quorum_ok_ms = now;
         if (now - g->quorum_ok_ms > 2 * g->elect_timeout_ms)
           begin_eject(g, EV_QUORUM_LOST);
+        // stall resend: a frame lost on a broken sender connection is
+        // never retransmitted by the pipeline itself (p.next is already
+        // past it) — the reference recovers via retry-state resends
+        // (remote.go becomeRetry); mirror that on a progress timeout
+        for (auto& p : g->peers) {
+          if (p.match >= g->last_index) continue;
+          if (p.progress_ms == 0) p.progress_ms = now;
+          if (now - p.progress_ms >
+              std::max((int64_t)50, 2 * g->hb_period_ms)) {
+            p.next = p.match + 1;
+            p.progress_ms = now;
+            mark_dirty(g);
+          }
+        }
       } else {
         if (now - g->leader_contact_ms > g->elect_timeout_ms)
           begin_eject(g, EV_CONTACT_LOST);
@@ -783,6 +1012,7 @@ struct Engine {
   }
 
   void round_main() {
+    prctl(PR_SET_NAME, "natr-round", 0, 0, 0);
     while (!stopped.load()) round_pass();
   }
 
@@ -849,6 +1079,7 @@ struct Engine {
           NEntry e;
           e.term = term;
           e.index = index;
+          e.born_us = mono_us();
           e.enc.assign((const char*)d + espan, pos - espan);
           g->log.push_back(std::move(e));
           g->last_index = index;
@@ -874,8 +1105,18 @@ struct Engine {
           if (p.id != m.from) continue;
           p.contact_ms = now;
           if (m.log_index > p.match) {
+            uint64_t old_match = p.match;
             p.match = m.log_index;
+            p.progress_ms = now;
+            dbg_ev(g, "ack", m.from, m.log_index);
             if (p.next < p.match + 1) p.next = p.match + 1;
+            // diagnostics: how stale is the newly acked range?
+            int64_t nowu = mono_us();
+            for (uint64_t i = std::max(old_match + 1, g->log_first);
+                 i <= m.log_index && i < g->log_first + g->log.size(); i++) {
+              lat_ack_us += nowu - g->log[i - g->log_first].born_us;
+              lat_ackn++;
+            }
             mark_dirty(g);  // tally/apply happen on the round thread
           }
           return true;
@@ -913,10 +1154,16 @@ struct Engine {
           return false;
         }
         if (m.hint != 0) {
-          // an enrolled leader has no pending ReadIndex (reads eject) --
-          // a hinted resp is from a pre-enrollment round; re-sync scalar
-          begin_eject(g, EV_PROTOCOL);
-          return false;
+          // hints on fast-lane heartbeats are our own clock stamps (an
+          // enrolled leader never has pending ReadIndex -- reads eject)
+          int64_t rtt = mono_us() - (int64_t)m.hint;
+          if (rtt > 0 && rtt < 60 * 1000000) {
+            rtt_us += (uint64_t)rtt;
+            rttn++;
+            uint64_t mx = rtt_max_us.load();
+            while ((uint64_t)rtt > mx &&
+                   !rtt_max_us.compare_exchange_weak(mx, (uint64_t)rtt)) {}
+          }
         }
         for (auto& p : g->peers) {
           if (p.id != m.from) continue;
@@ -980,8 +1227,13 @@ void natr_free(void* p) { free(p); }
 
 int natr_set_shards(void* h, void** handles, int n) {
   Engine* e = (Engine*)h;
-  e->shards.resize(n);
-  for (int i = 0; i < n; i++) e->shards[i].handle = handles[i];
+  for (int i = 0; i < n; i++) {
+    auto sh = std::make_unique<Shard>();
+    sh->handle = handles[i];
+    Shard* p = sh.get();
+    sh->thread = std::thread([e, p] { e->committer_main(p); });
+    e->shards.push_back(std::move(sh));
+  }
   return 0;
 }
 
@@ -996,17 +1248,31 @@ int natr_add_remote(void* h) {
   return slot;
 }
 
-// Enroll a quiescent group.  peers arrays exclude self.  Requires (checked
-// by the Python caller under raftMu): commit == processed == last_index,
-// log fully persisted, every peer's match == last_index.
+// Enroll a group, possibly mid-flight.  The caller (Node._maybe_enroll,
+// under raftMu, at a step instant with no pending raft Update) passes:
+// - the unapplied/unacked log tail `tail` = entries (log_first..last_index]
+//   as concatenated canonical encodings (everything a peer resend or an
+//   apply hand-off can still need: log_first = min(processed+1,
+//   min(peer next)));
+// - prev_term = term(log_first-1) for REPLICATE prev-entry checks;
+// - per-peer match/next as the scalar progress tracker holds them;
+// - processed = entries already handed to apply by the scalar path.
+// The caller guarantees every entry in (commit..last_index] carries the
+// current term (so counting-based commits never violate raft p8) and that
+// the log is fully persisted (no pending entries_to_save).
 int natr_enroll(void* h, uint64_t cid, uint64_t nid, uint64_t term,
                 uint64_t vote, uint64_t leader_id, int is_leader,
-                uint64_t last_index, uint64_t last_term, uint64_t commit,
-                uint32_t shard, int64_t hb_period_ms, int64_t elect_timeout_ms,
+                uint64_t last_index, uint64_t commit, uint64_t processed,
+                uint64_t log_first, uint64_t prev_term, uint32_t shard,
+                int64_t hb_period_ms, int64_t elect_timeout_ms,
                 const uint64_t* peer_ids, const int32_t* peer_slots,
-                int npeers) {
+                const uint64_t* peer_match, const uint64_t* peer_next,
+                int npeers, const uint8_t* tail, size_t tail_len) {
   Engine* e = (Engine*)h;
   if (shard >= e->shards.size() || npeers > 16) return -1;
+  if (log_first > last_index + 1 || processed < log_first - 1 ||
+      commit > last_index || processed > commit)
+    return -1;
   auto g = std::make_shared<Group>();
   g->self = g;
   g->cid = cid;
@@ -1016,15 +1282,29 @@ int natr_enroll(void* h, uint64_t cid, uint64_t nid, uint64_t term,
   g->leader_id = leader_id;
   g->leader = is_leader != 0;
   g->shard = shard;
-  g->log_first = last_index + 1;
-  g->enroll_last = last_index;
-  g->enroll_last_term = last_term;
+  g->log_first = log_first;
+  g->enroll_last = log_first - 1;
+  g->enroll_last_term = prev_term;
   g->last_index = last_index;
   g->staged_to = last_index;
   g->fsynced = last_index;
   g->commit = commit;
-  g->applied_handed = commit;
+  g->applied_handed = processed;
   g->commit_sent = commit;
+  // parse the tail entries; spans are the canonical encodings
+  size_t pos = 0;
+  for (uint64_t i = log_first; i <= last_index; i++) {
+    size_t start = pos;
+    uint64_t et, ei;
+    if (!parse_entry(tail, tail_len, pos, et, ei) || ei != i) return -3;
+    NEntry en;
+    en.term = et;
+    en.index = ei;
+    en.born_us = mono_us();
+    en.enc.assign((const char*)tail + start, pos - start);
+    g->log.push_back(std::move(en));
+  }
+  if (pos != tail_len) return -3;
   // seed the suppression caches with current on-disk values so the first
   // round only writes records that actually change
   g->st_written_term = term;
@@ -1041,15 +1321,23 @@ int natr_enroll(void* h, uint64_t cid, uint64_t nid, uint64_t term,
     PeerP p;
     p.id = peer_ids[i];
     p.slot = peer_slots[i];
-    p.match = last_index;
-    p.next = last_index + 1;
+    p.match = peer_match[i];
+    p.next = peer_next[i];
+    if (p.next < log_first || p.match > last_index) return -4;
     p.contact_ms = now;
     g->peers.push_back(p);
   }
-  std::lock_guard<std::mutex> lk(e->gmu);
-  auto& slot = e->groups[cid];
-  if (slot && slot->state != G_GONE) return -2;  // still enrolled
-  slot = std::move(g);
+  {
+    std::lock_guard<std::mutex> lk(e->gmu);
+    auto& slot = e->groups[cid];
+    if (slot && slot->state != G_GONE) return -2;  // still enrolled
+    slot = g;
+  }
+  // kick the first round so unacked tail entries resend / commit promptly
+  {
+    std::lock_guard<std::mutex> lk(g->mu);
+    e->mark_dirty(g.get());
+  }
   return 0;
 }
 
@@ -1074,25 +1362,24 @@ uint64_t natr_propose(void* h, uint64_t cid, uint64_t key, uint64_t client_id,
   NEntry en;
   en.term = g->term;
   en.index = index;
+  en.born_us = mono_us();
   en.enc = encode_entry(g->term, index, etype, key, client_id, series_id,
                         responded_to, cmd, cmdlen);
   g->log.push_back(std::move(en));
   g->last_index = index;
+  e->dbg_ev(g, "propose", index, 0);
   e->proposed++;
   e->mark_dirty(g);
   return index;
 }
 
-// Parse a MessageBatch payload; consume fast-path messages for ACTIVE
-// enrolled groups.  Leftover messages are re-wrapped into a MessageBatch
-// payload returned via *leftover (malloc'd; natr_free).  Returns the number
-// of consumed messages, or -1 on a parse error (caller treats the whole
-// payload as leftover).
-long long natr_ingest(void* h, const uint8_t* d, size_t len, uint8_t** leftover,
-                      size_t* leftover_len) {
-  Engine* e = (Engine*)h;
-  *leftover = nullptr;
-  *leftover_len = 0;
+// Core batch ingest: consume fast-path messages for ACTIVE enrolled
+// groups.  Returns consumed count; -1 on parse error / foreign deployment
+// (caller must route the whole payload to Python).  When some messages
+// remain, *leftover_out receives a rebuilt MessageBatch payload.
+static long long ingest_batch(Engine* e, const uint8_t* d, size_t len,
+                              std::string* leftover_out, bool* has_leftover) {
+  *has_leftover = false;
   size_t pos = 0;
   uint64_t dep_id, bin_ver, count;
   if (!get_uvarint(d, len, pos, dep_id)) return -1;
@@ -1126,18 +1413,293 @@ long long natr_ingest(void* h, const uint8_t* d, size_t len, uint8_t** leftover,
     }
   }
   if (left_count) {
-    std::string out;
+    std::string& out = *leftover_out;
+    out.clear();
     out.reserve(left.size() + 32);
     put_uvarint(out, dep_id);
     out.append((const char*)d + src_start, src_end - src_start);
     put_uvarint(out, bin_ver);
     put_uvarint(out, left_count);
     out += left;
+    *has_leftover = true;
+  }
+  return consumed;
+}
+
+long long natr_ingest(void* h, const uint8_t* d, size_t len, uint8_t** leftover,
+                      size_t* leftover_len) {
+  Engine* e = (Engine*)h;
+  *leftover = nullptr;
+  *leftover_len = 0;
+  std::string out;
+  bool has = false;
+  long long consumed = ingest_batch(e, d, len, &out, &has);
+  if (consumed < 0) return -1;
+  if (has) {
     *leftover = (uint8_t*)malloc(out.size());
     memcpy(*leftover, out.data(), out.size());
     *leftover_len = out.size();
   }
   return consumed;
+}
+
+// ---- stream ingest: the transport recv thread reads large chunks and
+// hands the raw byte stream here; frames are reassembled, CRC-checked and
+// fast-path batches consumed entirely without the GIL.  Leftovers (partial
+// batches, non-raft methods, corrupt frames) are returned packed as
+// [u16 method][u32 len][payload]... for the Python side to route.  A
+// method of 0xFFFF signals a framing/CRC error: the caller must close the
+// connection (matching tcp.py's TransportError behavior).
+struct ConnState {
+  std::string pending;
+};
+
+void* natr_conn_new(void* h) { return new ConnState(); }
+
+void natr_conn_free(void* h, void* c) { delete (ConnState*)c; }
+
+// Core stream processor: reassemble frames from raw bytes, consume raft
+// batches, emit leftovers via `emit(method, data, len)`.  Returns false on
+// a framing/CRC error (connection must be closed); an 0xFFFF record is
+// emitted in that case too.
+typedef std::function<void(uint16_t, const uint8_t*, size_t)> EmitFn;
+static bool process_stream(Engine* e, ConnState* cs, const uint8_t* d,
+                           size_t len, const EmitFn& emit) {
+  const uint8_t* buf = d;
+  size_t blen = len;
+  if (!cs->pending.empty()) {
+    cs->pending.append((const char*)d, len);
+    buf = (const uint8_t*)cs->pending.data();
+    blen = cs->pending.size();
+  }
+  std::string batch_left;
+  size_t pos = 0;
+  bool fatal = false;
+  while (true) {
+    if (blen - pos < 20) break;  // header: >HHQII
+    const uint8_t* hp = buf + pos;
+    uint32_t magic = ((uint32_t)hp[0] << 8) | hp[1];
+    uint32_t method = ((uint32_t)hp[2] << 8) | hp[3];
+    uint64_t size = 0;
+    for (int i = 0; i < 8; i++) size = (size << 8) | hp[4 + i];
+    uint32_t pcrc = 0, hcrc = 0;
+    for (int i = 0; i < 4; i++) pcrc = (pcrc << 8) | hp[12 + i];
+    for (int i = 0; i < 4; i++) hcrc = (hcrc << 8) | hp[16 + i];
+    if (magic != 0xAE7D || size > (1ull << 30) ||
+        crc32ieee(hp, 16) != hcrc) {
+      fatal = true;
+      break;
+    }
+    if (blen - pos - 20 < size) break;  // wait for the rest
+    const uint8_t* payload = hp + 20;
+    if (crc32ieee(payload, size) != pcrc) {
+      fatal = true;
+      break;
+    }
+    pos += 20 + size;
+    if (method == 100) {
+      bool has = false;
+      long long n = ingest_batch(e, payload, size, &batch_left, &has);
+      if (n < 0) {
+        emit(100, payload, size);  // foreign/unparseable: all to Python
+      } else if (has) {
+        emit(100, (const uint8_t*)batch_left.data(), batch_left.size());
+      }
+    } else {
+      // snapshot chunks, poison, unknown: Python routes them
+      emit(method, payload, size);
+    }
+  }
+  if (fatal) emit(0xFFFF, nullptr, 0);
+  // keep the unconsumed remainder for the next read
+  std::string rest((const char*)buf + pos, blen - pos);
+  cs->pending.swap(rest);
+  return !fatal;
+}
+
+long long natr_ingest_stream(void* h, void* cstate, const uint8_t* d,
+                             size_t len, uint8_t** leftover,
+                             size_t* leftover_len) {
+  Engine* e = (Engine*)h;
+  ConnState* cs = (ConnState*)cstate;
+  *leftover = nullptr;
+  *leftover_len = 0;
+  std::string out;
+  bool ok = process_stream(e, cs, d, len,
+                           [&](uint16_t method, const uint8_t* p, size_t n) {
+                             out.push_back((char)(method >> 8));
+                             out.push_back((char)(method & 0xFF));
+                             put_u32le(out, (uint32_t)n);
+                             if (n) out.append((const char*)p, n);
+                           });
+  if (!out.empty()) {
+    *leftover = (uint8_t*)malloc(out.size());
+    memcpy(*leftover, out.data(), out.size());
+    *leftover_len = out.size();
+  }
+  return ok ? 0 : -1;
+}
+
+// ---- native connection readers: the whole inbound fast plane runs
+// without the GIL.  tcp.py hands over plain (non-TLS) accepted sockets;
+// a reader thread per connection recvs, reassembles and consumes frames;
+// leftovers are queued for the Python leftover pump (fastlane.py), which
+// routes them through the normal transport handlers.  This removes the
+// Python recv glue from the hot path: with the GIL's scheduling quantum
+// in the loop, inbound service was capped near the switch rate and the
+// backlog sat invisibly in the kernel socket buffers (~hundreds of ms).
+int natr_serve_fd(void* h, int fd) {
+  Engine* e = (Engine*)h;
+  struct timeval tv = {60, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  auto rd = std::make_shared<Engine::Reader>();
+  rd->fd = fd;
+  // registration + thread start are atomic against stop(): either stop
+  // sees this reader (shuts it down and joins it) or we see stopped
+  std::lock_guard<std::mutex> reg(e->readers_mu);
+  if (e->stopped.load()) return -1;
+  e->readers.push_back(rd);
+  rd->th = std::thread([e, rd] {
+    prctl(PR_SET_NAME, "natr-reader", 0, 0, 0);
+    ConnState cs;
+    std::vector<uint8_t> buf(256 << 10);
+    uint64_t conn_id = (uint64_t)(uintptr_t)rd.get();
+    auto emit = [e, conn_id](uint16_t method, const uint8_t* p, size_t n) {
+      std::lock_guard<std::mutex> lk(e->lmu);
+      e->leftq.push_back({method, conn_id, std::string((const char*)p, n)});
+      e->lcv.notify_one();
+    };
+    while (!e->stopped.load()) {
+      ssize_t n = recv(rd->fd, buf.data(), buf.size(), 0);
+      if (n <= 0) break;
+      if (!process_stream(e, &cs, buf.data(), (size_t)n, emit)) break;
+    }
+    std::lock_guard<std::mutex> lk(e->readers_mu);
+    if (!rd->closed) {
+      rd->closed = true;
+      close(rd->fd);
+    }
+    // self-reap: without this, connection churn accumulates dead Reader
+    // entries (and unjoined thread handles) until engine stop
+    if (!e->readers_stopping) {
+      rd->th.detach();
+      auto& v = e->readers;
+      for (auto it = v.begin(); it != v.end(); ++it) {
+        if (it->get() == rd.get()) {
+          v.erase(it);
+          break;
+        }
+      }
+    }
+  });
+  return 0;
+}
+
+// Attach a native sender to a remote slot: its thread owns a TCP
+// connection to host:port, drains the slot's frame buffer with plain
+// send(2), and reconnects with backoff on failure.
+int natr_remote_connect(void* h, int slot, const char* host, int port) {
+  Engine* e = (Engine*)h;
+  if (slot < 0 || slot >= e->nremotes.load()) return -1;
+  Remote* r = e->remotes[slot].get();
+  std::lock_guard<std::mutex> reg(r->mu);
+  if (r->sender.joinable() || r->closed) return -1;  // attached / stopping
+  r->host = host;
+  r->port = port;
+  r->sender = std::thread([e, r] {
+    prctl(PR_SET_NAME, "natr-sender", 0, 0, 0);
+    int backoff_ms = 50;
+    while (!e->stopped.load()) {
+      // connect
+      int fd = socket(AF_INET, SOCK_STREAM, 0);
+      if (fd < 0) return;
+      struct sockaddr_in sa;
+      memset(&sa, 0, sizeof(sa));
+      sa.sin_family = AF_INET;
+      sa.sin_port = htons((uint16_t)r->port);
+      if (inet_pton(AF_INET, r->host.c_str(), &sa.sin_addr) != 1 ||
+          connect(fd, (struct sockaddr*)&sa, sizeof(sa)) != 0) {
+        close(fd);
+        struct timespec d = {backoff_ms / 1000,
+                             (backoff_ms % 1000) * 1000000};
+        nanosleep(&d, nullptr);
+        backoff_ms = std::min(backoff_ms * 2, 1000);
+        continue;
+      }
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      {
+        std::lock_guard<std::mutex> lk(r->mu);
+        if (r->closed) {
+          close(fd);
+          return;
+        }
+        r->fd = fd;
+      }
+      backoff_ms = 50;
+      bool broken = false;
+      while (!e->stopped.load() && !broken) {
+        std::string out;
+        {
+          std::unique_lock<std::mutex> lk(r->mu);
+          if (r->buf.empty() && !r->closed)
+            r->cv.wait_for(lk, std::chrono::milliseconds(200));
+          if (r->closed) break;
+          out.swap(r->buf);
+        }
+        size_t off = 0;
+        while (off < out.size()) {
+          ssize_t n = send(fd, out.data() + off, out.size() - off,
+                           MSG_NOSIGNAL);
+          if (n <= 0) {
+            broken = true;
+            break;
+          }
+          off += (size_t)n;
+        }
+      }
+      {
+        std::lock_guard<std::mutex> lk(r->mu);
+        r->fd = -1;
+      }
+      close(fd);
+      if (r->closed) return;
+    }
+  });
+  return 0;
+}
+
+// Next leftover frame from the native readers; 1 filled, 0 timeout,
+// -1 stopped.
+int natr_next_leftover(void* h, int timeout_ms, int* method, uint8_t** data,
+                       size_t* dlen, uint64_t* conn_id) {
+  Engine* e = (Engine*)h;
+  std::unique_lock<std::mutex> lk(e->lmu);
+  if (e->leftq.empty() && !e->stopped.load())
+    e->lcv.wait_for(lk, std::chrono::milliseconds(timeout_ms));
+  if (e->leftq.empty()) return e->stopped.load() ? -1 : 0;
+  auto fr = std::move(e->leftq.front());
+  e->leftq.pop_front();
+  *method = fr.method;
+  *conn_id = fr.conn_id;
+  *data = (uint8_t*)malloc(fr.payload.size() ? fr.payload.size() : 1);
+  memcpy(*data, fr.payload.data(), fr.payload.size());
+  *dlen = fr.payload.size();
+  return 1;
+}
+
+// Shut down a native-owned inbound connection (e.g. a failed snapshot
+// stream must close so the sender observes the failure).  conn_id comes
+// from natr_next_leftover; a stale id is a harmless no-op.
+void natr_close_conn(void* h, uint64_t conn_id) {
+  Engine* e = (Engine*)h;
+  std::lock_guard<std::mutex> lk(e->readers_mu);
+  for (auto& rd : e->readers) {
+    if ((uint64_t)(uintptr_t)rd.get() == conn_id && !rd->closed) {
+      shutdown(rd->fd, SHUT_RDWR);
+      return;
+    }
+  }
 }
 
 // Take ready-to-send frames for a remote slot; blocks up to timeout_ms.
@@ -1225,7 +1787,7 @@ int natr_eject(void* h, uint64_t cid, uint64_t* term, uint64_t* vote,
       std::string v;
       put_u64be(v, g->last_index);
       batch_put(b, make_key(TAG_MAX_INDEX, g->cid, g->nid, 0), v);
-      int rc = e->nkv_commit(e->shards[g->shard].handle,
+      int rc = e->nkv_commit(e->shards[g->shard]->handle,
                              (const uint8_t*)b.data(), b.size());
       if (rc < 0) return -2;
       g->staged_to = g->fsynced = g->last_index;
@@ -1314,21 +1876,59 @@ int natr_wait_apply(void* h, int timeout_ms) {
   return e->applyq.empty() ? 0 : 1;
 }
 
-void natr_stats(void* h, uint64_t* out8) {
+void natr_stats(void* h, uint64_t* out12) {  // array of 20 u64
   Engine* e = (Engine*)h;
-  out8[0] = e->proposed.load();
-  out8[1] = e->ingested_fast.load();
-  out8[2] = e->ingested_slow.load();
-  out8[3] = e->commits_advanced.load();
-  out8[4] = e->rounds.load();
-  out8[5] = e->fsyncs.load();
+  out12[0] = e->proposed.load();
+  out12[1] = e->ingested_fast.load();
+  out12[2] = e->ingested_slow.load();
+  out12[3] = e->commits_advanced.load();
+  out12[4] = e->rounds.load();
+  out12[5] = e->fsyncs.load();
   uint64_t dropped = 0;
   for (auto& r : e->remotes) dropped += r->dropped;
-  out8[6] = dropped;
+  out12[6] = dropped;
   {
     std::lock_guard<std::mutex> lk(e->gmu);
-    out8[7] = e->groups.size();
+    out12[7] = e->groups.size();
   }
+  out12[8] = e->fsync_ns.load();
+  out12[9] = e->round_ns.load();
+  out12[10] = e->entries_staged.load();
+  uint64_t n = e->lat_count.load();
+  uint64_t nf = e->lat_countf.load();
+  uint64_t ns = std::max(1ul, (unsigned long)e->entries_staged.load());
+  uint64_t ntot = std::max(1ul, (unsigned long)(n + nf));
+  out12[11] = n ? (e->lat_emit_us.load() / n) : 0;
+  out12[12] = e->lat_stage_us.load() / ns;
+  out12[13] = e->lat_fsync_us.load() / ntot;
+  out12[14] = nf ? (e->lat_emitf_us.load() / nf) : 0;
+  out12[15] = e->buf_hiwater.load();
+  uint64_t na = e->lat_ackn.load();
+  out12[16] = na ? (e->lat_ack_us.load() / na) : 0;
+  uint64_t nr = e->lat_respn.load();
+  out12[17] = nr ? (e->lat_resp_us.load() / nr) : 0;
+  uint64_t nrt = e->rttn.load();
+  out12[18] = nrt ? (e->rtt_us.load() / nrt) : 0;
+  out12[19] = e->rtt_max_us.load();
+}
+
+void natr_set_debug_cid(void* h, uint64_t cid) {
+  ((Engine*)h)->debug_cid.store(cid);
+}
+
+long long natr_debug_dump(void* h, uint8_t** data) {
+  Engine* e = (Engine*)h;
+  std::lock_guard<std::mutex> lk(e->dbg_mu);
+  *data = (uint8_t*)malloc(e->dbg.size() ? e->dbg.size() : 1);
+  memcpy(*data, e->dbg.data(), e->dbg.size());
+  long long n = (long long)e->dbg.size();
+  e->dbg.clear();
+  return n;
+}
+
+void natr_set_commit_window(void* h, int64_t us) {
+  Engine* e = (Engine*)h;
+  e->commit_window_us.store(us);
 }
 
 void natr_stop(void* h) {

@@ -61,8 +61,10 @@ def _load():
         lib.natr_enroll.argtypes = [
             c.c_void_p, c.c_uint64, c.c_uint64, c.c_uint64, c.c_uint64,
             c.c_uint64, c.c_int, c.c_uint64, c.c_uint64, c.c_uint64,
-            c.c_uint32, c.c_int64, c.c_int64, c.POINTER(c.c_uint64),
-            c.POINTER(c.c_int32), c.c_int,
+            c.c_uint64, c.c_uint64, c.c_uint32, c.c_int64, c.c_int64,
+            c.POINTER(c.c_uint64), c.POINTER(c.c_int32),
+            c.POINTER(c.c_uint64), c.POINTER(c.c_uint64), c.c_int,
+            c.c_char_p, c.c_size_t,
         ]
         lib.natr_propose.restype = c.c_uint64
         lib.natr_propose.argtypes = [
@@ -102,6 +104,27 @@ def _load():
         ]
         lib.natr_active.restype = c.c_int
         lib.natr_active.argtypes = [c.c_void_p, c.c_uint64]
+        lib.natr_set_commit_window.argtypes = [c.c_void_p, c.c_int64]
+        lib.natr_conn_new.restype = c.c_void_p
+        lib.natr_conn_new.argtypes = [c.c_void_p]
+        lib.natr_conn_free.argtypes = [c.c_void_p, c.c_void_p]
+        lib.natr_ingest_stream.restype = c.c_longlong
+        lib.natr_ingest_stream.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_char_p, c.c_size_t,
+            c.POINTER(c.c_void_p), c.POINTER(c.c_size_t),
+        ]
+        lib.natr_serve_fd.restype = c.c_int
+        lib.natr_serve_fd.argtypes = [c.c_void_p, c.c_int]
+        lib.natr_remote_connect.restype = c.c_int
+        lib.natr_remote_connect.argtypes = [
+            c.c_void_p, c.c_int, c.c_char_p, c.c_int,
+        ]
+        lib.natr_next_leftover.restype = c.c_int
+        lib.natr_next_leftover.argtypes = [
+            c.c_void_p, c.c_int, c.POINTER(c.c_int), c.POINTER(c.c_void_p),
+            c.POINTER(c.c_size_t), c.POINTER(c.c_uint64),
+        ]
+        lib.natr_close_conn.argtypes = [c.c_void_p, c.c_uint64]
         lib.natr_stats.argtypes = [c.c_void_p, c.POINTER(c.c_uint64)]
         _lib = lib
         return lib
@@ -170,19 +193,25 @@ class NatRaft:
         leader_id: int,
         is_leader: bool,
         last_index: int,
-        last_term: int,
         commit: int,
+        processed: int,
+        log_first: int,
+        prev_term: int,
         shard: int,
         hb_period_ms: int,
         elect_timeout_ms: int,
-        peers: List[Tuple[int, int]],  # (node_id, remote_slot)
+        peers: List[Tuple[int, int, int, int]],  # (id, slot, match, next)
+        tail: bytes,  # concatenated encodings of (log_first..last_index]
     ) -> bool:
         ids = (ctypes.c_uint64 * len(peers))(*[p[0] for p in peers])
         slots = (ctypes.c_int32 * len(peers))(*[p[1] for p in peers])
+        match = (ctypes.c_uint64 * len(peers))(*[p[2] for p in peers])
+        nxt = (ctypes.c_uint64 * len(peers))(*[p[3] for p in peers])
         rc = self._lib.natr_enroll(
             self._h, cluster_id, node_id, term, vote, leader_id,
-            1 if is_leader else 0, last_index, last_term, commit, shard,
-            hb_period_ms, elect_timeout_ms, ids, slots, len(peers),
+            1 if is_leader else 0, last_index, commit, processed, log_first,
+            prev_term, shard, hb_period_ms, elect_timeout_ms, ids, slots,
+            match, nxt, len(peers), tail, len(tail),
         )
         if rc == 0:
             self._peer_order[cluster_id] = [p[0] for p in peers]
@@ -315,8 +344,79 @@ class NatRaft:
     def active(self, cluster_id: int) -> bool:
         return bool(self._lib.natr_active(self._h, cluster_id))
 
+    def conn_new(self) -> int:
+        return self._lib.natr_conn_new(self._h)
+
+    def conn_free(self, conn: int) -> None:
+        self._lib.natr_conn_free(self._h, conn)
+
+    def ingest_stream(self, conn: int, data: bytes):
+        """Feed raw TCP bytes; returns a list of (method, payload) leftover
+        frames for Python routing.  method 0xFFFF = framing/CRC error, the
+        connection must be closed."""
+        out = ctypes.c_void_p()
+        outlen = ctypes.c_size_t()
+        self._lib.natr_ingest_stream(
+            self._h, conn, data, len(data), ctypes.byref(out),
+            ctypes.byref(outlen),
+        )
+        frames = []
+        if out.value:
+            buf = ctypes.string_at(out.value, outlen.value)
+            self._lib.natr_free(out)
+            pos = 0
+            import struct as _struct
+
+            while pos < len(buf):
+                method = (buf[pos] << 8) | buf[pos + 1]
+                (n,) = _struct.unpack_from("<I", buf, pos + 2)
+                pos += 6
+                frames.append((method, buf[pos : pos + n]))
+                pos += n
+        return frames
+
+    def remote_connect(self, slot: int, host: str, port: int) -> bool:
+        """Attach a native sender thread (own TCP connection + reconnect)
+        to a remote slot.  IPv4 literal hosts only."""
+        return (
+            self._lib.natr_remote_connect(self._h, slot, host.encode(), port)
+            == 0
+        )
+
+    def serve_fd(self, fd: int) -> bool:
+        """Hand a connected socket fd to a native reader thread (ownership
+        transfers; native closes it).  False when stopped."""
+        return self._lib.natr_serve_fd(self._h, fd) == 0
+
+    def next_leftover(self, timeout_ms: int = 200):
+        """Next leftover frame from native readers:
+        (method, payload, conn_id); None on timeout; raises on stop."""
+        method = ctypes.c_int()
+        data = ctypes.c_void_p()
+        dlen = ctypes.c_size_t()
+        conn = ctypes.c_uint64()
+        rc = self._lib.natr_next_leftover(
+            self._h, timeout_ms, ctypes.byref(method), ctypes.byref(data),
+            ctypes.byref(dlen), ctypes.byref(conn),
+        )
+        if rc < 0:
+            raise ConnectionError("natraft stopped")
+        if rc == 0:
+            return None
+        payload = ctypes.string_at(data.value, dlen.value)
+        self._lib.natr_free(data)
+        return int(method.value), payload, int(conn.value)
+
+    def close_conn(self, conn_id: int) -> None:
+        self._lib.natr_close_conn(self._h, conn_id)
+
+    def set_commit_window(self, us: int) -> None:
+        """Group-commit accumulation window per WAL shard, in microseconds
+        (0 = flush as fast as the device allows)."""
+        self._lib.natr_set_commit_window(self._h, us)
+
     def stats(self) -> dict:
-        out = (ctypes.c_uint64 * 8)()
+        out = (ctypes.c_uint64 * 20)()
         self._lib.natr_stats(self._h, out)
         return {
             "proposed": int(out[0]),
@@ -327,6 +427,18 @@ class NatRaft:
             "fsyncs": int(out[5]),
             "send_dropped": int(out[6]),
             "groups": int(out[7]),
+            "fsync_ms": round(int(out[8]) / 1e6, 1),
+            "round_ms": round(int(out[9]) / 1e6, 1),
+            "entries_staged": int(out[10]),
+            "lat_emit_avg_us": int(out[11]),
+            "lat_stage_avg_us": int(out[12]),
+            "lat_fsync_avg_us": int(out[13]),
+            "lat_emit_follower_avg_us": int(out[14]),
+            "send_buf_hiwater": int(out[15]),
+            "lat_ack_avg_us": int(out[16]),
+            "lat_resp_avg_us": int(out[17]),
+            "rtt_avg_us": int(out[18]),
+            "rtt_max_us": int(out[19]),
         }
 
     def stop(self) -> None:

@@ -61,6 +61,10 @@ from .wire import (
 
 plog = get_logger("node")
 MT = MessageType
+# wire types the native fast lane serves (natraft.cpp handle_fast)
+_FAST_WIRE_TYPES = frozenset(
+    (MT.REPLICATE, MT.REPLICATE_RESP, MT.HEARTBEAT, MT.HEARTBEAT_RESP)
+)
 
 
 class Node:
@@ -117,6 +121,7 @@ class Node:
         self._next_enroll_try = 0.0
         self._tick_count_pending = 0
         self._snapshotting = threading.Lock()
+        self._apply_serial = threading.Lock()
         self.leader_id = 0
         self._delete_required = False
 
@@ -329,6 +334,7 @@ class Node:
     def read(self, timeout_s: float) -> RequestState:
         # ReadIndex needs the scalar heartbeat-confirmation protocol
         if self.fast_lane:
+            self._count_eject("read")
             self.fast_eject()
         rs = self.pending_reads.read(self._timeout_ticks(timeout_s))
         self.nh.engine.set_step_ready(self.cluster_id)
@@ -428,26 +434,43 @@ class Node:
     # ---- native fast lane (fastlane.py) ----
 
     def _fast_lane_step(self) -> bool:
-        """Enrolled-mode step (under raftMu): ticks only feed the pending
-        trackers (the native core owns heartbeat/election clocks); any
-        other input forces an eject.  Returns True when the caller should
-        continue into the normal scalar step."""
+        """Enrolled-mode step (under raftMu): ticks feed only the pending
+        trackers (the native core owns heartbeat/election clocks); queued
+        proposals and in-flight fast-path messages are fed to the native
+        core directly; anything else forces an eject.  Returns True when
+        the caller should continue into the normal scalar step."""
+        fl = self.fastlane
         ticks = 0
         others: List[Message] = []
         for m in self.mq.get():
             if m.type == MT.LOCAL_TICK:
                 ticks += 1
+            elif m.type in _FAST_WIRE_TYPES and fl.ingest_message(m):
+                pass  # consumed natively (in-flight at enrollment)
             else:
                 others.append(m)
         if ticks:
             self.current_tick += ticks
             self._tick_trackers(ticks)
+        # proposals racing an enrollment land in the scalar queue; route
+        # them into the native lane in order (indices assigned there)
         entries = self.entry_q.get()
-        if not (others or entries or self._fast_slow_inputs()):
+        rest: List[Entry] = []
+        for e in entries:
+            if rest or e.is_config_change() or not fl.nat.propose(
+                self.cluster_id, e.key, e.client_id, e.series_id,
+                e.responded_to, int(e.type), e.cmd,
+            ):
+                rest.append(e)
+        if not (others or rest or self._fast_slow_inputs()):
             return False
+        self._count_eject(
+            "step-msgs:" + ",".join(sorted({m.type.name for m in others}))
+            if others else ("step-entries" if rest else "step-slow-input")
+        )
         self.fast_eject()
-        if entries:
-            self.peer.propose_entries(entries)
+        if rest:
+            self.peer.propose_entries(rest)
         if others:
             self._process_messages(others)
         return True
@@ -470,8 +493,13 @@ class Node:
         return False
 
     def _maybe_enroll(self) -> None:
-        """Enroll this group into the native fast lane when quiescent and
-        eligible (under raftMu; see natraft.cpp's enrollment contract)."""
+        """Enroll this group into the native fast lane (under raftMu, at a
+        step instant with no pending raft Update — so the in-memory log is
+        fully persisted and there are no queued messages).  Mid-flight
+        state is allowed: the uncommitted/unapplied tail, per-peer progress
+        and the apply watermark are captured into the native core
+        (natraft.cpp's enrollment contract), so groups re-enter the lane
+        under live load after an eject."""
         fl = self.fastlane
         if fl is None or not fl.enabled or self.fast_lane:
             return
@@ -496,9 +524,7 @@ class Node:
             return
         log = r.log
         li = log.last_index()
-        if log.committed != li or log.processed != li:
-            return
-        if log.inmem.entries or log.inmem.snapshot is not None:
+        if log.entries_to_save() or log.inmem.snapshot is not None:
             return
         if r.msgs or r.dropped_entries or r.dropped_read_indexes or r.ready_to_read:
             return
@@ -506,29 +532,54 @@ class Node:
             return
         if self._snapshotting.locked():
             return
-        if r.is_leader():
-            from .raft.remote import RemoteState
-
-            for nid, rp in r.remotes.items():
-                if nid == self.node_id:
-                    continue
-                if rp.match != li or rp.state == RemoteState.SNAPSHOT:
-                    return
+        committed, processed = log.committed, log.processed
         try:
-            last_term = log.term(li)
+            # every index a native tally can newly commit must carry the
+            # current term (raft paper p8 holds structurally in the core)
+            if committed < li and (
+                log.term(committed + 1) != r.term or log.term(li) != r.term
+            ):
+                return
         except Exception:
             return
+        from .raft.remote import RemoteState
+
         peers = []
+        min_next = li + 1
         for nid in sorted(r.remotes):
             if nid == self.node_id:
                 continue
+            rp = r.remotes[nid]
+            if rp.state == RemoteState.SNAPSHOT or rp.match > li:
+                return
             addr = self.nh.node_registry.resolve(self.cluster_id, nid)
             if addr is None:
                 return
             slot = fl.slot_for(addr)
             if slot < 0:
                 return
-            peers.append((nid, slot))
+            nxt = min(max(rp.next, rp.match + 1), li + 1)
+            min_next = min(min_next, nxt)
+            peers.append((nid, slot, rp.match, nxt))
+        # the native log must cover everything a resend or an apply
+        # hand-off can still need
+        log_first = min(processed + 1, min_next)
+        if log_first < log.first_index():
+            return  # tail partially compacted away: wait for idle
+        try:
+            prev_term = log.term(log_first - 1) if log_first > 1 else 0
+        except Exception:
+            return
+        tail_entries = (
+            log.get_entries(log_first, li + 1, 1 << 62) if li >= log_first else []
+        )
+        if len(tail_entries) != li - log_first + 1:
+            return
+        from .wire.codec import encode_entry_into
+
+        buf = bytearray()
+        for e in tail_entries:
+            encode_entry_into(buf, e)
         hb_ms = max(1, self.config.heartbeat_rtt * self.tick_millisecond)
         elect_ms = max(10, 2 * self.config.election_rtt * self.tick_millisecond)
         ok = fl.nat.enroll(
@@ -539,16 +590,23 @@ class Node:
             leader_id=r.leader_id,
             is_leader=r.is_leader(),
             last_index=li,
-            last_term=last_term,
-            commit=log.committed,
+            commit=committed,
+            processed=processed,
+            log_first=log_first,
+            prev_term=prev_term,
             shard=self.cluster_id % fl.n_shards,
             hb_period_ms=hb_ms,
             elect_timeout_ms=elect_ms,
             peers=peers,
+            tail=bytes(buf),
         )
         if ok:
             fl.register_node(self)
             self.fast_lane = True
+
+    def _count_eject(self, reason: str) -> None:
+        if self.fastlane is not None:
+            self.fastlane.count_eject(reason)
 
     def fast_eject(self, contact_lost: bool = False) -> None:
         """Hand the group back from the native core to scalar raft.
@@ -874,6 +932,14 @@ class Node:
     # ---- apply path (reference processApplies / handleTask) ----
 
     def handle_apply_tasks(self) -> None:
+        # serialized: the engine's apply workers already serialize per
+        # group among themselves, but the fast lane's apply pump calls
+        # this inline too — an unsynchronized drain would interleave
+        # get_all() batches and apply entries out of order
+        with self._apply_serial:
+            self._handle_apply_tasks_locked()
+
+    def _handle_apply_tasks_locked(self) -> None:
         tasks = self.to_apply.get_all()
         for t in tasks:
             if self._stopped.is_set():

@@ -690,6 +690,8 @@ class NodeHost:
             # FIRST so the scalar raft state is current when it handles
             # the message (fastlane.py eject protocol)
             if node.fast_lane:
+                if self.fastlane is not None:
+                    self.fastlane.count_eject(f"router:{m.type.name}")
                 node.fast_eject()
             if node.enqueue_message(m):
                 touched[m.cluster_id] = None

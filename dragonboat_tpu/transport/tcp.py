@@ -145,6 +145,17 @@ class TCPTransport(IRaftRPC):
         # decoding; returns the leftover payload for the normal path, or
         # None when fully consumed natively
         self.raw_handler = None
+        # optional stream hook (preferred when set): an object with
+        # stream_open() -> handle, stream_feed(handle, bytes) ->
+        # [(method, payload)...], stream_close(handle).  The recv loop
+        # reads large chunks and the native core reassembles/consumes
+        # frames without per-frame Python overhead.
+        self.raw_stream = None
+        # optional fd takeover hook (fastest): takeover_fd(fd) -> bool.
+        # Plain (non-TLS) accepted connections are handed to a native
+        # reader thread entirely — the GIL never touches the inbound
+        # fast plane; leftover frames surface via the fast-lane pump.
+        self.takeover_fd = None
 
     def name(self) -> str:
         return "tcp-transport"
@@ -202,6 +213,21 @@ class TCPTransport(IRaftRPC):
                     plog.warning("TLS handshake failed: %s", e)
                     conn.close()
                     continue
+            elif self.takeover_fd is not None:
+                # native reader owns the fd from here (fast lane)
+                import os as _os
+
+                fd = conn.detach()
+                try:
+                    if not self.takeover_fd(fd):
+                        _os.close(fd)
+                except Exception:
+                    plog.exception("fd takeover failed")
+                    try:
+                        _os.close(fd)
+                    except OSError:
+                        pass
+                continue
             t = threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
             )
@@ -209,6 +235,9 @@ class TCPTransport(IRaftRPC):
 
     def _serve_conn(self, conn) -> None:
         """Reference ``tcp.go:515`` ``serveConn``."""
+        stream = self.raw_stream
+        if stream is not None:
+            return self._serve_conn_stream(conn, stream)
         try:
             conn.settimeout(60.0)
             while not self._stopped.is_set():
@@ -231,6 +260,37 @@ class TCPTransport(IRaftRPC):
         except (ConnectionError, TransportError, socket.timeout, OSError):
             pass
         finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_conn_stream(self, conn, stream) -> None:
+        """Bulk-recv variant (native fast lane): large reads, frame
+        reassembly + CRC + fast-path consumption in C; only leftovers
+        surface here."""
+        h = stream.stream_open()
+        try:
+            conn.settimeout(60.0)
+            while not self._stopped.is_set():
+                data = conn.recv(1 << 20)
+                if not data:
+                    return
+                for method, payload in stream.stream_feed(h, data):
+                    if method == POISON_METHOD:
+                        return
+                    if method == RAFT_METHOD:
+                        self.request_handler(decode_message_batch(payload))
+                    elif method == SNAPSHOT_METHOD:
+                        if not self.chunk_handler(decode_chunk(payload)):
+                            return
+                    else:  # 0xFFFF framing/CRC error or unknown method
+                        plog.warning("stream error/unknown method %d", method)
+                        return
+        except (ConnectionError, TransportError, socket.timeout, OSError):
+            pass
+        finally:
+            stream.stream_close(h)
             try:
                 conn.close()
             except OSError:

@@ -101,17 +101,21 @@ def cluster(tmp_path):
     for nid, h in hosts.items():
         h.connect([p for p in hosts if p != nid])
     # enroll: leader on 1, followers on 2/3; empty quiescent log
-    peers = lambda h: [(p, h.slots[p]) for p in sorted(h.slots)]
+    peers = lambda h: [(p, h.slots[p], 5, 6) for p in sorted(h.slots)]
     assert hosts[1].nr.enroll(CID, 1, term=2, vote=1, leader_id=1,
-                              is_leader=True, last_index=5, last_term=2,
-                              commit=5, shard=0, hb_period_ms=HB_MS,
-                              elect_timeout_ms=ELECT_MS, peers=peers(hosts[1]))
+                              is_leader=True, last_index=5, commit=5,
+                              processed=5, log_first=6, prev_term=2,
+                              shard=0, hb_period_ms=HB_MS,
+                              elect_timeout_ms=ELECT_MS,
+                              peers=peers(hosts[1]), tail=b"")
     for nid in (2, 3):
         h = hosts[nid]
         assert h.nr.enroll(CID, nid, term=2, vote=1, leader_id=1,
-                           is_leader=False, last_index=5, last_term=2,
-                           commit=5, shard=0, hb_period_ms=HB_MS,
-                           elect_timeout_ms=ELECT_MS, peers=peers(h))
+                           is_leader=False, last_index=5, commit=5,
+                           processed=5, log_first=6, prev_term=2,
+                           shard=0, hb_period_ms=HB_MS,
+                           elect_timeout_ms=ELECT_MS, peers=peers(h),
+                           tail=b"")
     yield hosts
     for h in hosts.values():
         h.nr.close()
@@ -238,13 +242,15 @@ def test_heartbeats_and_contact_loss_event(tmp_path):
              3: Host(tmp_path, "c", 3)}
     for nid, h in hosts.items():
         h.connect([p for p in hosts if p != nid])
-    peers = lambda h: [(p, h.slots[p]) for p in sorted(h.slots)]
+    peers = lambda h: [(p, h.slots[p], 5, 6) for p in sorted(h.slots)]
     for nid in (1, 2, 3):
         h = hosts[nid]
         assert h.nr.enroll(CID, nid, term=2, vote=1, leader_id=1,
-                           is_leader=(nid == 1), last_index=5, last_term=2,
-                           commit=5, shard=0, hb_period_ms=HB_MS,
-                           elect_timeout_ms=elect_ms, peers=peers(h))
+                           is_leader=(nid == 1), last_index=5, commit=5,
+                           processed=5, log_first=6, prev_term=2,
+                           shard=0, hb_period_ms=HB_MS,
+                           elect_timeout_ms=elect_ms, peers=peers(h),
+                           tail=b"")
     try:
         # continuous pumping: heartbeats keep followers quiet
         deadline = time.time() + 3 * elect_ms / 1000
