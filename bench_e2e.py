@@ -127,16 +127,21 @@ def _load_worker(nh_by_cid, cids, payload, window, stop_at, drain_deadline, out)
 
         def refill(cid, dq):
             nonlocal errors
-            while len(dq) < window and time.time() < stop_at:
-                t0 = time.perf_counter()
-                try:
-                    rs = nh_by_cid[cid].propose(
-                        sessions[cid], payload, timeout=30.0
-                    )
-                except Exception:
-                    errors += 1
-                    time.sleep(0.005)  # don't busy-spin on a dead group
-                    return False
+            want = window - len(dq)
+            if want <= 0 or time.time() >= stop_at:
+                return True
+            t0 = time.perf_counter()
+            try:
+                # burst refill: one tracked future per command, one pass
+                # through the propose path (NodeHost.propose_batch)
+                states = nh_by_cid[cid].propose_batch(
+                    sessions[cid], [payload] * want, timeout=30.0
+                )
+            except Exception:
+                errors += 1
+                time.sleep(0.005)  # don't busy-spin on a dead group
+                return False
+            for rs in states:
                 dq.append((t0, rs))
             return True
 

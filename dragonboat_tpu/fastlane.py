@@ -36,7 +36,11 @@ from .logger import get_logger
 plog = get_logger("fastlane")
 
 # native eject event codes (natraft.cpp EventCode)
-EV_NAMES = {1: "contact-lost", 2: "quorum-lost", 3: "protocol", 4: "wal-error"}
+EV_NAMES = {
+    1: "contact-lost", 2: "quorum-lost", 3: "protocol", 4: "wal-error",
+    5: "term-mismatch", 6: "wrong-role", 7: "gap", 8: "prev-term",
+    9: "reject-resp", 10: "unknown-peer", 11: "resend-preenroll", 12: "parse",
+}
 
 
 class FastLaneManager:
@@ -95,6 +99,7 @@ class FastLaneManager:
             (self._apply_pump, "fastlane-apply"),
             (self._event_pump, "fastlane-events"),
             (self._leftover_pump, "fastlane-leftover"),
+            (self._read_pump, "fastlane-reads"),
         ):
             t = threading.Thread(target=fn, name=name, daemon=True)
             t.start()
@@ -147,6 +152,23 @@ class FastLaneManager:
         nat = self.nat
         if nat is not None and h:
             nat.conn_free(h)
+
+    def send_message(self, m) -> bool:
+        """Send a scalar-path raft message over the remote's native
+        stream (one ordered stream per remote; see natr_send_msg).  False
+        when the fast plane cannot serve it (caller uses the transport)."""
+        nat = self.nat
+        if nat is None or self._stopped.is_set():
+            return False
+        addr = self.nh.node_registry.resolve(m.cluster_id, m.to)
+        if addr is None:
+            return False
+        slot = self.slot_for(addr)
+        if slot < 0:
+            return False
+        from .wire.codec import encode_message
+
+        return nat.send_msg(slot, encode_message(m))
 
     def _takeover_fd(self, fd: int) -> bool:
         nat = self.nat
@@ -341,6 +363,29 @@ class FastLaneManager:
                 )
                 self.count_eject(EV_NAMES.get(code, str(code)))
                 node.fast_eject(contact_lost=code in (1, 2))
+                continue
+
+    def _read_pump(self) -> None:
+        """Deliver quorum-confirmed native ReadIndex contexts to the
+        pending-read trackers (the scalar path's ReadyToRead flow)."""
+        from .wire import ReadyToRead, SystemCtx
+
+        while not self._stopped.is_set():
+            try:
+                got = self.nat.next_read(200)
+            except ConnectionError:
+                return
+            if got is None:
+                continue
+            cid, low, high, index = got
+            with self._nodes_mu:
+                node = self._nodes.get(cid)
+            if node is None:
+                continue
+            node.pending_reads.add_ready(
+                [ReadyToRead(index=index, system_ctx=SystemCtx(low=low, high=high))]
+            )
+            node.pending_reads.applied(node.sm.get_last_applied())
 
     def _sender(self, slot: int, addr: str) -> None:
         """Drain native frames for one remote onto a dedicated TCP

@@ -394,6 +394,15 @@ enum EventCode {
   EV_QUORUM_LOST = 2,    // leader: check-quorum window expired
   EV_PROTOCOL = 3,       // conflicting/unsupported message needs Python
   EV_WAL_ERROR = 4,
+  // protocol sub-causes (diagnostics; all handled as EV_PROTOCOL)
+  EV_TERM_MISMATCH = 5,
+  EV_WRONG_ROLE = 6,
+  EV_GAP = 7,
+  EV_PREV_TERM = 8,
+  EV_REJECT_RESP = 9,
+  EV_UNKNOWN_PEER = 10,
+  EV_RESEND_PREENROLL = 11,
+  EV_PARSE = 12,
 };
 
 struct PeerP {
@@ -429,6 +438,18 @@ struct Group {
   std::deque<NEntry> log;
   std::vector<PeerP> peers;
   std::vector<PendResp> resps;       // post-fsync responses (follower)
+  // leader-side ReadIndex (thesis 6.4): pending contexts awaiting a
+  // heartbeat-echo quorum; the follower side is a pure hint echo
+  struct PendRead {
+    uint64_t low, high, index;
+    uint32_t acks;      // self counts as one
+    uint32_t peer_mask; // peers already counted
+  };
+  std::vector<PendRead> reads;
+  // raft.go:1079: a leader may serve ReadIndex only once an entry of its
+  // own term is committed; enrollment seeds this from the scalar state
+  // and any native commit advance (always current-term) sets it
+  bool term_commit_ok = false;
   // persisted-record suppression (plays rdbcache's role for this group)
   uint64_t st_written_term = 0, st_written_vote = 0, st_written_commit = 0;
   uint64_t maxindex_written = 0;
@@ -481,6 +502,14 @@ struct Engine {
   std::mutex emu;
   std::condition_variable ecv;
   std::deque<std::pair<uint64_t, int>> eventq;
+
+  // confirmed ReadIndex contexts: (cid, low, high, commit_index)
+  std::mutex rmu;
+  std::condition_variable rcv;
+  struct ReadReady {
+    uint64_t cid, low, high, index;
+  };
+  std::deque<ReadReady> readyq;
 
   // native connection readers (natr_serve_fd) + leftover frames for the
   // Python pump
@@ -576,6 +605,7 @@ struct Engine {
     for (auto& rd : rds)
       if (rd->th.joinable()) rd->th.join();
     lcv.notify_all();
+    rcv.notify_all();
   }
 
   std::shared_ptr<Group> find(uint64_t cid) {
@@ -732,7 +762,7 @@ struct Engine {
     if (p.next <= g->enroll_last) {
       // the follower needs entries from before this enrollment's window;
       // only the scalar path can serve them (snapshot/catch-up logic)
-      begin_eject(g, EV_PROTOCOL);
+      begin_eject(g, EV_RESEND_PREENROLL);
       return;
     }
     while (p.next <= g->last_index && p.next - 1 - p.match < kMaxInflight) {
@@ -814,6 +844,7 @@ struct Engine {
       uint64_t q = tally(g);
       if (q > g->commit) {
         g->commit = q;
+        g->term_commit_ok = true;  // counting commits are current-term
         commits_advanced++;
         dbg_ev(g, "commit", q, 0);
         stage_state(g);
@@ -972,11 +1003,15 @@ struct Engine {
       if (g->leader) {
         if (now - g->last_hb_ms >= g->hb_period_ms) {
           g->last_hb_ms = now;
-          uint64_t stamp = (uint64_t)mono_us();
+          uint64_t hl = 0, hh = 0;
+          if (!g->reads.empty()) {  // broadcast the newest pending ctx
+            hl = g->reads.back().low;
+            hh = g->reads.back().high;
+          }
           for (auto& p : g->peers) {
             std::string b;
             put_msg_header(b, MT_HEARTBEAT, 0, p.id, g->nid, g->cid, g->term,
-                           0, 0, std::min(p.match, g->commit), stamp, 0, 0);
+                           0, 0, std::min(p.match, g->commit), hl, hh, 0);
             queue_msg(p.slot, b);
           }
         }
@@ -1024,20 +1059,20 @@ struct Engine {
     std::lock_guard<std::mutex> lk(g->mu);
     if (g->state != G_ACTIVE) return false;
     if (m.term != g->term || m.to != g->nid) {
-      begin_eject(g, EV_PROTOCOL);
+      begin_eject(g, EV_TERM_MISMATCH);
       return false;
     }
     int64_t now = mono_ms();
     switch (m.type) {
       case MT_REPLICATE: {
         if (g->leader || m.from != g->leader_id) {
-          begin_eject(g, EV_PROTOCOL);
+          begin_eject(g, EV_WRONG_ROLE);
           return false;
         }
         g->leader_contact_ms = now;
         int slot = peer_slot(g, m.from);
         if (slot < 0) {
-          begin_eject(g, EV_PROTOCOL);
+          begin_eject(g, EV_UNKNOWN_PEER);
           return false;
         }
         if (m.log_index < g->commit) {
@@ -1049,14 +1084,14 @@ struct Engine {
           return true;
         }
         if (m.log_index > g->last_index) {
-          begin_eject(g, EV_PROTOCOL);  // gap: needs Python retry logic
+          begin_eject(g, EV_GAP);  // gap: needs Python retry logic
           return false;
         }
         // prev-term check where verifiable (enrollment guarantees
         // consistency at or below enroll_last == commit-at-enroll)
         uint64_t pt = g->term_of(m.log_index);
         if (pt != 0 && pt != m.log_term) {
-          begin_eject(g, EV_PROTOCOL);
+          begin_eject(g, EV_PREV_TERM);
           return false;
         }
         // append entries with index > last_index (same-term overlap is
@@ -1098,7 +1133,7 @@ struct Engine {
           return false;
         }
         if (m.flags & kFlagReject) {
-          begin_eject(g, EV_PROTOCOL);  // conflict/lag: Python flow control
+          begin_eject(g, EV_REJECT_RESP);  // conflict/lag: Python flow control
           return false;
         }
         for (auto& p : g->peers) {
@@ -1153,16 +1188,43 @@ struct Engine {
           begin_eject(g, EV_PROTOCOL);
           return false;
         }
-        if (m.hint != 0) {
-          // hints on fast-lane heartbeats are our own clock stamps (an
-          // enrolled leader never has pending ReadIndex -- reads eject)
-          int64_t rtt = mono_us() - (int64_t)m.hint;
-          if (rtt > 0 && rtt < 60 * 1000000) {
-            rtt_us += (uint64_t)rtt;
-            rttn++;
-            uint64_t mx = rtt_max_us.load();
-            while ((uint64_t)rtt > mx &&
-                   !rtt_max_us.compare_exchange_weak(mx, (uint64_t)rtt)) {}
+        if (m.hint != 0 || m.hint_high != 0) {
+          // ReadIndex confirmation echo (readindex.go confirm): count the
+          // peer toward every pending context at or before this one
+          size_t pi = 0;
+          for (; pi < g->peers.size(); pi++)
+            if (g->peers[pi].id == m.from) break;
+          uint32_t bit = 1u << pi;
+          // the echo proves leadership only for contexts registered at or
+          // before the one the heartbeat carried (readindex.go:77 confirm
+          // semantics): find the match FIRST, then count
+          size_t pos = g->reads.size();
+          for (size_t i = 0; i < g->reads.size(); i++) {
+            if (g->reads[i].low == m.hint && g->reads[i].high == m.hint_high) {
+              pos = i;
+              break;
+            }
+          }
+          if (pos < g->reads.size()) {
+            uint32_t quorum = (uint32_t)(g->peers.size() + 1) / 2 + 1;
+            size_t done = 0;
+            for (size_t i = 0; i <= pos; i++) {
+              auto& pr = g->reads[i];
+              if (!(pr.peer_mask & bit)) {
+                pr.peer_mask |= bit;
+                pr.acks++;
+              }
+              if (i == done && pr.acks >= quorum) done++;
+            }
+            if (done) {
+              std::lock_guard<std::mutex> rlk(rmu);
+              for (size_t i = 0; i < done; i++) {
+                auto& pr = g->reads[i];
+                readyq.push_back({g->cid, pr.low, pr.high, pr.index});
+              }
+              rcv.notify_one();
+              g->reads.erase(g->reads.begin(), g->reads.begin() + done);
+            }
           }
         }
         for (auto& p : g->peers) {
@@ -1265,6 +1327,7 @@ int natr_enroll(void* h, uint64_t cid, uint64_t nid, uint64_t term,
                 uint64_t last_index, uint64_t commit, uint64_t processed,
                 uint64_t log_first, uint64_t prev_term, uint32_t shard,
                 int64_t hb_period_ms, int64_t elect_timeout_ms,
+                int term_commit_ok,
                 const uint64_t* peer_ids, const int32_t* peer_slots,
                 const uint64_t* peer_match, const uint64_t* peer_next,
                 int npeers, const uint8_t* tail, size_t tail_len) {
@@ -1313,6 +1376,7 @@ int natr_enroll(void* h, uint64_t cid, uint64_t nid, uint64_t term,
   g->maxindex_written = last_index;
   g->hb_period_ms = hb_period_ms;
   g->elect_timeout_ms = elect_timeout_ms;
+  g->term_commit_ok = term_commit_ok != 0;
   int64_t now = mono_ms();
   g->last_hb_ms = now;
   g->leader_contact_ms = now;
@@ -1371,6 +1435,58 @@ uint64_t natr_propose(void* h, uint64_t cid, uint64_t key, uint64_t client_id,
   e->proposed++;
   e->mark_dirty(g);
   return index;
+}
+
+// Batch propose: append `count` entries in one lock hold.  cmds is
+// [u32le len][bytes] per command; keys are per-entry tracker keys; all
+// entries share client/series/responded/etype (one client burst).
+// Returns the FIRST assigned index (>0), or 0 when not accepting (the
+// caller falls back to the scalar queue for the whole batch).
+uint64_t natr_propose_batch(void* h, uint64_t cid, int count,
+                            const uint64_t* keys, uint64_t client_id,
+                            uint64_t series_id, uint64_t responded_to,
+                            uint8_t etype, const uint8_t* cmds,
+                            size_t cmds_len) {
+  Engine* e = (Engine*)h;
+  std::shared_ptr<Group> sp = e->find(cid);
+  Group* g = sp.get();
+  if (!g || count <= 0) return 0;
+  std::lock_guard<std::mutex> lk(g->mu);
+  if (g->state != G_ACTIVE || !g->leader) return 0;
+  if (g->log.size() + (size_t)count > 32768) return 0;  // backpressure
+  uint64_t first = g->last_index + 1;
+  // validate the whole blob BEFORE appending anything: a mid-batch
+  // failure after partial appends would make the caller's full-batch
+  // fallback double-propose the prefix
+  {
+    size_t vpos = 0;
+    for (int i = 0; i < count; i++) {
+      if (vpos + 4 > cmds_len) return 0;
+      uint32_t clen = 0;
+      memcpy(&clen, cmds + vpos, 4);
+      vpos += 4 + clen;
+      if (vpos > cmds_len) return 0;
+    }
+  }
+  size_t pos = 0;
+  int64_t now = mono_us();
+  for (int i = 0; i < count; i++) {
+    uint32_t clen = 0;
+    memcpy(&clen, cmds + pos, 4);
+    pos += 4;
+    NEntry en;
+    en.term = g->term;
+    en.index = first + i;
+    en.born_us = now;
+    en.enc = encode_entry(g->term, first + i, etype, keys[i], client_id,
+                          series_id, responded_to, cmds + pos, clen);
+    pos += clen;
+    g->log.push_back(std::move(en));
+  }
+  g->last_index = first + count - 1;
+  e->proposed += count;
+  e->mark_dirty(g);
+  return first;
 }
 
 // Core batch ingest: consume fast-path messages for ACTIVE enrolled
@@ -1669,6 +1785,21 @@ int natr_remote_connect(void* h, int slot, const char* host, int port) {
   return 0;
 }
 
+// Queue an already-encoded Message span onto a remote slot's outbound
+// stream.  Used by the Python runtime for its scalar-path messages so a
+// group's traffic rides ONE ordered stream per remote regardless of
+// enrollment state (mixing two sockets across eject/re-enroll cycles
+// reorders entries and forces gap ejects on the receiver).
+int natr_send_msg(void* h, int slot, const uint8_t* payload, size_t len) {
+  Engine* e = (Engine*)h;
+  if (slot < 0 || slot >= e->nremotes.load()) return -1;
+  e->queue_msg(slot, std::string((const char*)payload, len));
+  // flushed by the next round pass (<= round_interval_ms away); nudge it
+  std::lock_guard<std::mutex> lk(e->wmu);
+  e->wcv.notify_one();
+  return 0;
+}
+
 // Next leftover frame from the native readers; 1 filled, 0 timeout,
 // -1 stopped.
 int natr_next_leftover(void* h, int timeout_ms, int* method, uint8_t** data,
@@ -1852,6 +1983,46 @@ int natr_eject(void* h, uint64_t cid, uint64_t* term, uint64_t* vote,
     e->groups.erase(cid);
   }
   return 0;
+}
+
+// Leader-side ReadIndex: record the context and broadcast an immediate
+// hinted heartbeat (raft.go:1636 handleLeaderReadIndex + thesis 6.4).
+// Returns the recorded commit index (>0) or 0 when not serving (caller
+// falls back to the scalar protocol, which ejects the group).
+uint64_t natr_read_index(void* h, uint64_t cid, uint64_t low, uint64_t high) {
+  Engine* e = (Engine*)h;
+  std::shared_ptr<Group> sp = e->find(cid);
+  Group* g = sp.get();
+  if (!g || low == 0) return 0;
+  std::lock_guard<std::mutex> lk(g->mu);
+  if (g->state != G_ACTIVE || !g->leader || !g->term_commit_ok) return 0;
+  if (g->reads.size() >= 1024) return 0;
+  g->reads.push_back({low, high, g->commit, 1, 0});
+  for (auto& p : g->peers) {
+    std::string b;
+    put_msg_header(b, MT_HEARTBEAT, 0, p.id, g->nid, g->cid, g->term, 0, 0,
+                   std::min(p.match, g->commit), low, high, 0);
+    e->queue_msg(p.slot, b);
+  }
+  e->mark_dirty(g);  // flush the hinted heartbeats promptly
+  return g->commit;
+}
+
+// Next confirmed read context; 1 filled, 0 timeout, -1 stopped.
+int natr_next_read(void* h, int timeout_ms, uint64_t* cid, uint64_t* low,
+                   uint64_t* high, uint64_t* index) {
+  Engine* e = (Engine*)h;
+  std::unique_lock<std::mutex> lk(e->rmu);
+  if (e->readyq.empty() && !e->stopped.load())
+    e->rcv.wait_for(lk, std::chrono::milliseconds(timeout_ms));
+  if (e->readyq.empty()) return e->stopped.load() ? -1 : 0;
+  auto rr = e->readyq.front();
+  e->readyq.pop_front();
+  *cid = rr.cid;
+  *low = rr.low;
+  *high = rr.high;
+  *index = rr.index;
+  return 1;
 }
 
 // Lightweight status probe: 1 = enrolled-active, 0 = not.

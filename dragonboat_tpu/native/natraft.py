@@ -62,6 +62,7 @@ def _load():
             c.c_void_p, c.c_uint64, c.c_uint64, c.c_uint64, c.c_uint64,
             c.c_uint64, c.c_int, c.c_uint64, c.c_uint64, c.c_uint64,
             c.c_uint64, c.c_uint64, c.c_uint32, c.c_int64, c.c_int64,
+            c.c_int,
             c.POINTER(c.c_uint64), c.POINTER(c.c_int32),
             c.POINTER(c.c_uint64), c.POINTER(c.c_uint64), c.c_int,
             c.c_char_p, c.c_size_t,
@@ -70,6 +71,12 @@ def _load():
         lib.natr_propose.argtypes = [
             c.c_void_p, c.c_uint64, c.c_uint64, c.c_uint64, c.c_uint64,
             c.c_uint64, c.c_uint8, c.c_char_p, c.c_size_t,
+        ]
+        lib.natr_propose_batch.restype = c.c_uint64
+        lib.natr_propose_batch.argtypes = [
+            c.c_void_p, c.c_uint64, c.c_int, c.POINTER(c.c_uint64),
+            c.c_uint64, c.c_uint64, c.c_uint64, c.c_uint8, c.c_char_p,
+            c.c_size_t,
         ]
         lib.natr_ingest.restype = c.c_longlong
         lib.natr_ingest.argtypes = [
@@ -102,6 +109,15 @@ def _load():
             c.POINTER(c.c_void_p), c.POINTER(c.c_size_t),  # blob
             c.POINTER(c.c_uint64),                         # apply_first
         ]
+        lib.natr_read_index.restype = c.c_uint64
+        lib.natr_read_index.argtypes = [
+            c.c_void_p, c.c_uint64, c.c_uint64, c.c_uint64,
+        ]
+        lib.natr_next_read.restype = c.c_int
+        lib.natr_next_read.argtypes = [
+            c.c_void_p, c.c_int, c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),
+            c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),
+        ]
         lib.natr_active.restype = c.c_int
         lib.natr_active.argtypes = [c.c_void_p, c.c_uint64]
         lib.natr_set_commit_window.argtypes = [c.c_void_p, c.c_int64]
@@ -125,6 +141,10 @@ def _load():
             c.POINTER(c.c_size_t), c.POINTER(c.c_uint64),
         ]
         lib.natr_close_conn.argtypes = [c.c_void_p, c.c_uint64]
+        lib.natr_send_msg.restype = c.c_int
+        lib.natr_send_msg.argtypes = [
+            c.c_void_p, c.c_int, c.c_char_p, c.c_size_t,
+        ]
         lib.natr_stats.argtypes = [c.c_void_p, c.POINTER(c.c_uint64)]
         _lib = lib
         return lib
@@ -200,6 +220,7 @@ class NatRaft:
         shard: int,
         hb_period_ms: int,
         elect_timeout_ms: int,
+        term_commit_ok: bool,
         peers: List[Tuple[int, int, int, int]],  # (id, slot, match, next)
         tail: bytes,  # concatenated encodings of (log_first..last_index]
     ) -> bool:
@@ -210,7 +231,8 @@ class NatRaft:
         rc = self._lib.natr_enroll(
             self._h, cluster_id, node_id, term, vote, leader_id,
             1 if is_leader else 0, last_index, commit, processed, log_first,
-            prev_term, shard, hb_period_ms, elect_timeout_ms, ids, slots,
+            prev_term, shard, hb_period_ms, elect_timeout_ms,
+            1 if term_commit_ok else 0, ids, slots,
             match, nxt, len(peers), tail, len(tail),
         )
         if rc == 0:
@@ -225,6 +247,20 @@ class NatRaft:
             self._lib.natr_propose(
                 self._h, cluster_id, key, client_id, series_id, responded_to,
                 etype, cmd, len(cmd),
+            )
+        )
+
+    def propose_batch(self, cluster_id: int, keys: List[int], client_id: int,
+                      series_id: int, responded_to: int, etype: int,
+                      cmds_blob: bytes) -> int:
+        """Append a burst of entries atomically (cmds_blob: u32le-length-
+        prefixed commands, one per key).  Returns the first assigned index
+        or 0 (caller falls back for the whole batch)."""
+        arr = (ctypes.c_uint64 * len(keys))(*keys)
+        return int(
+            self._lib.natr_propose_batch(
+                self._h, cluster_id, len(keys), arr, client_id, series_id,
+                responded_to, etype, cmds_blob, len(cmds_blob),
             )
         )
 
@@ -341,6 +377,29 @@ class NatRaft:
             apply_blob, int(afirst.value),
         )
 
+    def read_index(self, cluster_id: int, low: int, high: int) -> int:
+        """Stage a leader-side ReadIndex; returns the recorded commit
+        index (>0) or 0 when the group is not natively serving."""
+        return int(
+            self._lib.natr_read_index(self._h, cluster_id, low, high)
+        )
+
+    def next_read(self, timeout_ms: int = 200):
+        """Next quorum-confirmed read ctx: (cid, low, high, index)."""
+        cid = ctypes.c_uint64()
+        low = ctypes.c_uint64()
+        high = ctypes.c_uint64()
+        index = ctypes.c_uint64()
+        rc = self._lib.natr_next_read(
+            self._h, timeout_ms, ctypes.byref(cid), ctypes.byref(low),
+            ctypes.byref(high), ctypes.byref(index),
+        )
+        if rc < 0:
+            raise ConnectionError("natraft stopped")
+        if rc == 0:
+            return None
+        return int(cid.value), int(low.value), int(high.value), int(index.value)
+
     def active(self, cluster_id: int) -> bool:
         return bool(self._lib.natr_active(self._h, cluster_id))
 
@@ -407,6 +466,9 @@ class NatRaft:
         self._lib.natr_free(data)
         return int(method.value), payload, int(conn.value)
 
+    def send_msg(self, slot: int, payload: bytes) -> bool:
+        return self._lib.natr_send_msg(self._h, slot, payload, len(payload)) == 0
+
     def close_conn(self, conn_id: int) -> None:
         self._lib.natr_close_conn(self._h, conn_id)
 
@@ -437,8 +499,6 @@ class NatRaft:
             "send_buf_hiwater": int(out[15]),
             "lat_ack_avg_us": int(out[16]),
             "lat_resp_avg_us": int(out[17]),
-            "rtt_avg_us": int(out[18]),
-            "rtt_max_us": int(out[19]),
         }
 
     def stop(self) -> None:

@@ -320,6 +320,56 @@ class Node:
         self.nh.engine.set_step_ready(self.cluster_id)
         return rs
 
+    def propose_batch(
+        self, session: Session, cmds: List[bytes], timeout_s: float
+    ) -> List[RequestState]:
+        """Propose a burst of commands in one pass — semantically identical
+        to N :meth:`propose` calls (one entry + one completion future per
+        command), amortizing the per-request tracking and, on the native
+        fast lane, appending the whole burst under one lock.  Pipelined
+        clients (and the e2e benchmark) refill their windows through this;
+        the per-request propose path is a first-order cost once replication
+        itself is native."""
+        if not cmds:
+            return []
+        entry_type = EntryType.APPLICATION
+        enc = [
+            get_encoded_payload(self._entry_ct, c) if c else c for c in cmds
+        ]
+        if any(enc):
+            entry_type = EntryType.ENCODED
+        states, entries = self.pending_proposals.propose_batch(
+            session.client_id, session.series_id, enc,
+            self._timeout_ticks(timeout_s),
+        )
+        for e in entries:
+            e.type = entry_type if e.cmd else EntryType.APPLICATION
+            e.responded_to = session.responded_to
+        if self.fast_lane and self.fastlane is not None and all(
+            e.type == entry_type for e in entries
+        ):
+            import struct as _struct
+
+            blob = b"".join(
+                _struct.pack("<I", len(e.cmd)) + e.cmd for e in entries
+            )
+            if self.fastlane.nat.propose_batch(
+                self.cluster_id, [e.key for e in entries], session.client_id,
+                session.series_id, session.responded_to, int(entry_type),
+                blob,
+            ):
+                return states
+        ok = True
+        for i, e in enumerate(entries):
+            if ok and not self.entry_q.add(e):
+                ok = False
+            if not ok:
+                # queue full mid-burst: drop the remainder; each dropped
+                # future resolves like a single propose hitting a full queue
+                self.pending_proposals.dropped(e.key)
+        self.nh.engine.set_step_ready(self.cluster_id)
+        return states
+
     def propose_session(self, session: Session, timeout_s: float) -> RequestState:
         rs, entry = self.pending_proposals.propose(
             session.client_id, session.series_id, b"",
@@ -332,11 +382,25 @@ class Node:
         return rs
 
     def read(self, timeout_s: float) -> RequestState:
-        # ReadIndex needs the scalar heartbeat-confirmation protocol
-        if self.fast_lane:
+        rs = self.pending_reads.read(self._timeout_ticks(timeout_s))
+        fl = self.fastlane
+        if self.fast_lane and fl is not None:
+            # native ReadIndex (natraft.cpp): the context rides hinted
+            # heartbeats; a quorum of echoes confirms it and the read pump
+            # completes the batch.  The ctx covers every read pending at
+            # take time (the scalar batching semantics).
+            ctx = self.pending_reads.next_ctx()
+            if not self.pending_reads.take_pending(ctx):
+                return rs  # a concurrent reader's context covers this one
+            if fl.nat.read_index(self.cluster_id, ctx.low, ctx.high):
+                return rs
+            # native cannot serve (ejecting / no current-term commit yet):
+            # hand back to scalar raft, which runs the full protocol
             self._count_eject("read")
             self.fast_eject()
-        rs = self.pending_reads.read(self._timeout_ticks(timeout_s))
+            with self.raft_mu:
+                if self.peer is not None:
+                    self.peer.read_index(ctx)
         self.nh.engine.set_step_ready(self.cluster_id)
         return rs
 
@@ -452,6 +516,18 @@ class Node:
         if ticks:
             self.current_tick += ticks
             self._tick_trackers(ticks)
+        # reads registered while (re)enrolling are served natively here
+        # (the same protocol Node.read drives; ejecting for them would
+        # defeat the native ReadIndex path)
+        while self.pending_reads.peep():
+            ctx = self.pending_reads.next_ctx()
+            if not self.pending_reads.take_pending(ctx):
+                break
+            if not fl.nat.read_index(self.cluster_id, ctx.low, ctx.high):
+                self._count_eject("read-fallback")
+                self.fast_eject()
+                self.peer.read_index(ctx)
+                return True
         # proposals racing an enrollment land in the scalar queue; route
         # them into the native lane in order (indices assigned there)
         entries = self.entry_q.get()
@@ -479,8 +555,7 @@ class Node:
         """Inputs the fast lane cannot serve (checked each enrolled step;
         the user-facing entry points also eject eagerly)."""
         if (
-            self.pending_reads.peep()
-            or self.pending_config_change.pending() is not None
+            self.pending_config_change.pending() is not None
             or self.pending_snapshot.pending() is not None
             or self.pending_leader_transfer.pending() is not None
         ):
@@ -528,7 +603,7 @@ class Node:
             return
         if r.msgs or r.dropped_entries or r.dropped_read_indexes or r.ready_to_read:
             return
-        if self._fast_slow_inputs():
+        if self._fast_slow_inputs() or self.pending_reads.peep():
             return
         if self._snapshotting.locked():
             return
@@ -597,6 +672,9 @@ class Node:
             shard=self.cluster_id % fl.n_shards,
             hb_period_ms=hb_ms,
             elect_timeout_ms=elect_ms,
+            term_commit_ok=(
+                r.is_leader() and r.has_committed_entry_at_current_term()
+            ),
             peers=peers,
             tail=bytes(buf),
         )
@@ -685,6 +763,12 @@ class Node:
             coord = getattr(self, "quorum_coordinator", None)
             if coord is not None:
                 coord.register(self)
+            # pending native ReadIndex contexts died with the native
+            # group; re-drive them through the scalar protocol (duplicate
+            # confirmations are harmless) so in-flight reads don't strand
+            if r.is_leader():
+                for ctx in self.pending_reads.pending_ctxs():
+                    self.peer.read_index(ctx)
             if contact_lost:
                 # the native clock already waited out the election window
                 # with zero leader contact — without this the group would

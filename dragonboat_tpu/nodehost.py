@@ -414,6 +414,14 @@ class NodeHost:
         node = self.get_node(session.cluster_id)
         return node.propose(session, cmd, timeout)
 
+    def propose_batch(
+        self, session: Session, cmds, timeout: float
+    ) -> list:
+        """Burst-propose: one completion future per command (see
+        ``Node.propose_batch``)."""
+        node = self.get_node(session.cluster_id)
+        return node.propose_batch(session, cmds, timeout)
+
     def sync_propose(
         self, session: Session, cmd: bytes, timeout: float = 5.0
     ) -> Result:
@@ -640,6 +648,12 @@ class NodeHost:
             node = self._clusters.get(m.cluster_id)
             if node is not None and node.node_id == m.to:
                 node.handle_message_batch(m)
+            return
+        # with the fast lane active, ALL raft messages for a remote ride
+        # its single ordered native stream — mixing the Python transport's
+        # sockets with the fast plane's reorders entries across
+        # eject/re-enroll transitions and forces gap ejects
+        if self.fastlane is not None and self.fastlane.send_message(m):
             return
         self.transport.send(m)
 

@@ -208,6 +208,44 @@ class PendingProposal:
         )
         return rs, entry
 
+    def propose_batch(
+        self, client_id: int, series_id: int, cmds: List[bytes],
+        timeout_ticks: int,
+    ) -> Tuple[List[RequestState], List[Entry]]:
+        """Track a burst of proposals in one pass.  Semantically identical
+        to N ``propose`` calls (one RequestState + one Entry per command);
+        amortizes the clock read, the deadline publication and — by
+        grouping keys per shard — the tracker lock traffic.  The per-write
+        Python cost of the propose path is a first-order term in end-to-end
+        throughput once replication itself runs in the native fast lane."""
+        if self._stopped:
+            raise ClusterClosedError()
+        deadline = self._clock.tick + timeout_ticks
+        bits = self._rng.getrandbits
+        states: List[RequestState] = []
+        entries: List[Entry] = []
+        by_shard: Dict[int, List[RequestState]] = {}
+        for cmd in cmds:
+            key = bits(64) or 1
+            rs = RequestState(key=key, deadline=deadline)
+            rs.client_id = client_id
+            rs.series_id = series_id
+            states.append(rs)
+            entries.append(
+                Entry(key=key, client_id=client_id, series_id=series_id, cmd=cmd)
+            )
+            by_shard.setdefault(key % self.nshards, []).append(rs)
+        for shard, group in by_shard.items():
+            with self._locks[shard]:
+                d = self._shards[shard]
+                for rs in group:
+                    d[rs.key] = rs
+        if deadline < self._pending_min:
+            with self._min_mu:
+                if deadline < self._pending_min:
+                    self._pending_min = deadline
+        return states, entries
+
     def applied(
         self,
         key: int,
@@ -310,6 +348,13 @@ class PendingReadIndex:
             self._batches[ctx] = self._pending
             self._pending = []
             return True
+
+    def pending_ctxs(self) -> List[SystemCtx]:
+        """Contexts taken for confirmation but not yet ready — after a
+        fast-lane eject these must be re-driven through the scalar
+        protocol or their reads strand until timeout."""
+        with self._mu:
+            return list(self._batches.keys())
 
     def add_ready(self, readies: List[ReadyToRead]) -> None:
         """Raft confirmed these contexts at an index

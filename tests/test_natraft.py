@@ -106,7 +106,7 @@ def cluster(tmp_path):
                               is_leader=True, last_index=5, commit=5,
                               processed=5, log_first=6, prev_term=2,
                               shard=0, hb_period_ms=HB_MS,
-                              elect_timeout_ms=ELECT_MS,
+                              elect_timeout_ms=ELECT_MS, term_commit_ok=True,
                               peers=peers(hosts[1]), tail=b"")
     for nid in (2, 3):
         h = hosts[nid]
@@ -114,8 +114,8 @@ def cluster(tmp_path):
                            is_leader=False, last_index=5, commit=5,
                            processed=5, log_first=6, prev_term=2,
                            shard=0, hb_period_ms=HB_MS,
-                           elect_timeout_ms=ELECT_MS, peers=peers(h),
-                           tail=b"")
+                           elect_timeout_ms=ELECT_MS, term_commit_ok=True,
+                           peers=peers(h), tail=b"")
     yield hosts
     for h in hosts.values():
         h.nr.close()
@@ -249,8 +249,8 @@ def test_heartbeats_and_contact_loss_event(tmp_path):
                            is_leader=(nid == 1), last_index=5, commit=5,
                            processed=5, log_first=6, prev_term=2,
                            shard=0, hb_period_ms=HB_MS,
-                           elect_timeout_ms=elect_ms, peers=peers(h),
-                           tail=b"")
+                           elect_timeout_ms=elect_ms, term_commit_ok=True,
+                           peers=peers(h), tail=b"")
     try:
         # continuous pumping: heartbeats keep followers quiet
         deadline = time.time() + 3 * elect_ms / 1000
@@ -293,7 +293,7 @@ def test_foreign_term_message_goes_leftover(cluster):
     assert got.requests[0].type == MessageType.REPLICATE
     # group flipped to EJECTING + event emitted
     ev = hosts[2].nr.next_event(timeout_ms=500)
-    assert ev == (CID, 3)  # EV_PROTOCOL
+    assert ev == (CID, 5)  # EV_TERM_MISMATCH
 
 
 def test_non_fast_message_untouched(cluster):
