@@ -772,9 +772,17 @@ def run_mp(
     """Parent orchestration: spawn one rank per NodeHost, coordinate the
     two measurement phases by wall clock, aggregate."""
     if not leader_mode:
-        # one TPU chip → put every leader (and thus every commit decision)
-        # on the rank that owns the device; scalar spreads leaders evenly
-        leader_mode = "rank0" if engine == "tpu" else "spread"
+        # With the native fast lane carrying steady-state replication,
+        # leaders spread evenly in BOTH modes: concentrating all 1,024
+        # leaders on the device rank (round 2's shape, when the device
+        # engine was the only commit-tally offload) overloads one process
+        # and wedges the mixed phase.  The device engine still runs on
+        # rank 0 serving election tallies, device ticks and any
+        # non-enrolled group's commit math; enrolled steady-state commits
+        # are native (see PERF.md).
+        leader_mode = "spread"
+        if engine == "tpu" and os.environ.get("E2E_FAST_LANE", "1") != "1":
+            leader_mode = "rank0"  # round-2 shape: device tallies it all
     t_start = time.time()
     hard_deadline = t_start + deadline_s
     ports = _free_ports(procs)
