@@ -127,7 +127,7 @@ def _propose_all(nh, payloads, timeout=30.0):
     return len(pending)
 
 
-def _wait_converged(sms, count, timeout=30.0):
+def _wait_converged(sms, count, timeout=90.0):
     deadline = time.time() + timeout
     while time.time() < deadline:
         lens = [len(sm.applied) for sm in sms.values()]
@@ -218,7 +218,7 @@ def test_leader_kill_failover(tmp_path):
         _propose_all(leader, [b"p%d" % i for i in range(10)])
         nhs.pop(lid).stop()
         # followers eject on contact loss and elect a new leader scalar-side
-        new_lid, new_leader = _leader(nhs, timeout=60.0)
+        new_lid, new_leader = _leader(nhs, timeout=90.0)
         assert new_lid != lid
         _propose_all(new_leader, [b"q%d" % i for i in range(10)])
         live = {i: sm for i, sm in sms.items() if i in nhs}
@@ -246,9 +246,9 @@ def test_full_restart_replays_native_wal(tmp_path):
     sms2 = {}
     nhs2 = {i: _mk(i, addrs, tmp_path, sms2) for i in addrs}
     try:
-        lid2, leader2 = _leader(nhs2, timeout=60.0)
+        lid2, leader2 = _leader(nhs2, timeout=90.0)
         _propose_all(leader2, [b"s%d" % i for i in range(5)])
-        _wait_converged(sms2, 35, timeout=60.0)
+        _wait_converged(sms2, 35, timeout=120.0)
         base = sms2[lid2].applied
         assert base[:30] == [b"r%d" % i for i in range(30)]
     finally:
