@@ -982,7 +982,9 @@ def run_mp(
 def run_quick() -> dict:
     """Bounded run for bench.py's detail field (driver time budget)."""
     groups = int(os.environ.get("E2E_GROUPS", "1024"))
-    duration = float(os.environ.get("E2E_DURATION", "10"))
+    # 15s measurement window: at 1,024 groups the 10s window showed ±30%
+    # run-to-run spread from election/enrollment timing riding the edges
+    duration = float(os.environ.get("E2E_DURATION", "15"))
     window = int(os.environ.get("E2E_WINDOW", "32"))
     rtt_ms = int(os.environ.get("E2E_RTT_MS", "1000"))
     engine = os.environ.get("E2E_ENGINE", "tpu")

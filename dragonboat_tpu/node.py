@@ -583,7 +583,7 @@ class Node:
         now = _time.monotonic()
         if now < self._next_enroll_try:
             return
-        self._next_enroll_try = now + 0.25
+        self._next_enroll_try = now + 0.1
         r = self.peer.raft
         if not (r.is_leader() or (r.is_follower() and r.leader_id != 0)):
             return
