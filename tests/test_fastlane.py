@@ -277,3 +277,34 @@ def test_periodic_snapshot_forces_eject(tmp_path):
         assert node.sm.get_snapshot_index() > 0, "auto snapshot never ran"
     finally:
         _stop_all(nhs)
+
+
+def test_propose_batch_both_paths(tmp_path):
+    """propose_batch == N propose calls: one future per command, applied
+    in order, on the native lane and on the scalar fallback."""
+    sms = {}
+    nhs, _ = _cluster(tmp_path, sms)
+    try:
+        lid, leader = _leader(nhs)
+        assert _wait_enrolled(leader)
+        s = leader.get_noop_session(CID)
+        states = leader.propose_batch(s, [b"b%d" % i for i in range(40)], 10.0)
+        assert len(states) == 40
+        for rs in states:
+            assert rs.wait(30.0).completed
+        # force the scalar path (eject via a leader transfer request slot
+        # check is heavyweight; simply eject directly) and batch again
+        node = leader.get_node(CID)
+        node.fast_eject()
+        states = leader.propose_batch(s, [b"c%d" % i for i in range(40)], 10.0)
+        for rs in states:
+            assert rs.wait(30.0).completed
+        _wait_converged(sms, 80)
+        base = sms[lid].applied
+        assert base == [b"b%d" % i for i in range(40)] + [
+            b"c%d" % i for i in range(40)
+        ]
+        for i, sm in sms.items():
+            assert sm.applied == base
+    finally:
+        _stop_all(nhs)
