@@ -40,6 +40,7 @@ EV_NAMES = {
     1: "contact-lost", 2: "quorum-lost", 3: "protocol", 4: "wal-error",
     5: "term-mismatch", 6: "wrong-role", 7: "gap", 8: "prev-term",
     9: "reject-resp", 10: "unknown-peer", 11: "resend-preenroll", 12: "parse",
+    13: "commit-stall",
 }
 
 

@@ -403,6 +403,7 @@ enum EventCode {
   EV_UNKNOWN_PEER = 10,
   EV_RESEND_PREENROLL = 11,
   EV_PARSE = 12,
+  EV_COMMIT_STALL = 13,  // liveness watchdog: pending entries, no progress
 };
 
 struct PeerP {
@@ -456,6 +457,7 @@ struct Group {
   bool dirty = false;
   // clocks
   int64_t hb_period_ms = 100, elect_timeout_ms = 1000;
+  int64_t last_commit_adv_ms = 0;    // liveness watchdog clock
   int64_t last_hb_ms = 0;            // leader: last heartbeat broadcast
   int64_t leader_contact_ms = 0;     // follower: last leader contact
   int64_t quorum_ok_ms = 0;          // leader: last time a quorum was in contact
@@ -844,6 +846,7 @@ struct Engine {
       uint64_t q = tally(g);
       if (q > g->commit) {
         g->commit = q;
+        g->last_commit_adv_ms = mono_ms();
         g->term_commit_ok = true;  // counting commits are current-term
         commits_advanced++;
         dbg_ev(g, "commit", q, 0);
@@ -1042,6 +1045,18 @@ struct Engine {
         if (now - g->leader_contact_ms > g->elect_timeout_ms)
           begin_eject(g, EV_CONTACT_LOST);
       }
+      // liveness watchdog: entries are pending yet commit has not moved
+      // for two election windows — some corner case has wedged the fast
+      // path; hand the group to the scalar machinery, which has the full
+      // recovery toolbox (snapshots, flow-control retries, elections).
+      // The clock REARMS while nothing is pending, so the window starts
+      // when pendingness begins — else the first burst after an idle gap
+      // would eject instantly on a stale clock.
+      if (g->commit >= g->last_index)
+        g->last_commit_adv_ms = now;
+      else if (g->state == G_ACTIVE &&
+               now - g->last_commit_adv_ms > 2 * g->elect_timeout_ms)
+        begin_eject(g, EV_COMMIT_STALL);
     }
     flush_remotes();
   }
@@ -1121,7 +1136,10 @@ struct Engine {
         }
         uint64_t c = std::min(appended_last, m.commit);
         c = std::min(c, g->last_index);
-        if (c > g->commit) g->commit = c;
+        if (c > g->commit) {
+          g->commit = c;
+          g->last_commit_adv_ms = now;
+        }
         g->resps.push_back(
             {slot, m.from, MT_REPLICATE_RESP, appended_last, 0, 0, 0});
         mark_dirty(g);
@@ -1173,6 +1191,7 @@ struct Engine {
         uint64_t c = std::min(m.commit, g->fsynced);
         if (c > g->commit) {
           g->commit = c;
+          g->last_commit_adv_ms = now;
           mark_dirty(g);
         }
         // ReadIndex confirmation hints are a pure echo on the follower
@@ -1381,6 +1400,7 @@ int natr_enroll(void* h, uint64_t cid, uint64_t nid, uint64_t term,
   g->last_hb_ms = now;
   g->leader_contact_ms = now;
   g->quorum_ok_ms = now;
+  g->last_commit_adv_ms = now;
   for (int i = 0; i < npeers; i++) {
     PeerP p;
     p.id = peer_ids[i];

@@ -603,6 +603,11 @@ class Node:
             return
         if r.msgs or r.dropped_entries or r.dropped_read_indexes or r.ready_to_read:
             return
+        # a ReadIndex context mid-confirmation in scalar raft (e.g. one
+        # re-driven by a previous eject) would freeze until timeout if the
+        # group enrolled now — its confirmation runs through scalar steps
+        if r.read_index.has_pending_request():
+            return
         if self._fast_slow_inputs() or self.pending_reads.peep():
             return
         if self._snapshotting.locked():
