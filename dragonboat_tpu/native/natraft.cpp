@@ -219,7 +219,10 @@ static bool parse_entry(const uint8_t* d, size_t len, size_t& pos,
   if (!get_uvarint(d, len, pos, sid)) return false;
   if (!get_uvarint(d, len, pos, resp)) return false;
   if (!get_uvarint(d, len, pos, clen)) return false;
-  if (pos + clen > len) return false;
+  // Overflow-safe: a crafted ~2^64 clen would wrap `pos + clen` backwards
+  // past a naive `pos + clen > len` check (pos <= len holds after
+  // get_uvarint, so len - pos cannot underflow).
+  if (clen > len - pos) return false;
   pos += clen;
   return true;
 }
@@ -227,7 +230,7 @@ static bool parse_entry(const uint8_t* d, size_t len, size_t& pos,
 static bool skip_str(const uint8_t* d, size_t len, size_t& pos) {
   uint64_t n;
   if (!get_uvarint(d, len, pos, n)) return false;
-  if (pos + n > len) return false;
+  if (n > len - pos) return false;  // overflow-safe (see parse_entry)
   pos += n;
   return true;
 }
@@ -370,6 +373,12 @@ struct Shard {
 // fallback when no native connection is attached (tests).
 struct Remote {
   std::mutex mu;
+  // serializes whole flush passes (swap -> frame-build -> buf append):
+  // flush_remotes runs concurrently on the round thread and the shard
+  // committers, and the swap and append are separate mu sections — without
+  // this, a later-queued REPLICATE could be appended before an earlier one
+  // on the single ordered stream, tripping EV_GAP ejects on followers
+  std::mutex flush_mu;
   std::condition_variable cv;
   std::string buf;          // complete frames
   std::string msgs;         // current pass's message spans (round thread only)
@@ -412,6 +421,7 @@ struct PeerP {
   uint64_t match = 0, next = 0;
   int64_t contact_ms = 0;
   int64_t progress_ms = 0;  // last match advance / resend reset
+  int64_t hb_sent_us = 0;   // outstanding heartbeat send time (RTT diag)
 };
 
 struct PendResp {
@@ -549,6 +559,7 @@ struct Engine {
   std::atomic<uint64_t> lat_ack_us{0}, lat_ackn{0};  // leader: born->ack covering entry
   std::atomic<uint64_t> lat_resp_us{0}, lat_respn{0};  // follower: born->resp flushed
   std::atomic<uint64_t> rtt_us{0}, rttn{0}, rtt_max_us{0};  // hb echo round trip
+  std::atomic<uint64_t> stale_dropped{0};  // stale-term fast frames consumed
   // single-group debug timeline (natr_debug)
   std::atomic<uint64_t> debug_cid{0};
   std::mutex dbg_mu;
@@ -655,6 +666,7 @@ struct Engine {
     int n = nremotes.load();
     for (int ri = 0; ri < n; ri++) {
       Remote* r = remotes[ri].get();
+      std::lock_guard<std::mutex> flk(r->flush_mu);
       std::string msgs;
       uint64_t count;
       {
@@ -1016,6 +1028,9 @@ struct Engine {
             put_msg_header(b, MT_HEARTBEAT, 0, p.id, g->nid, g->cid, g->term,
                            0, 0, std::min(p.match, g->commit), hl, hh, 0);
             queue_msg(p.slot, b);
+            // re-stamp every send: a lost echo would otherwise freeze the
+            // stamp and inflate the next sample by N heartbeat periods
+            p.hb_sent_us = mono_us();
           }
         }
         // check-quorum (leaderHasQuorum raft.go:380-390): count peers
@@ -1073,9 +1088,21 @@ struct Engine {
   bool handle_fast(Group* g, const ParsedMsg& m, const uint8_t* d) {
     std::lock_guard<std::mutex> lk(g->mu);
     if (g->state != G_ACTIVE) return false;
-    if (m.term != g->term || m.to != g->nid) {
+    if (m.term > g->term || m.to != g->nid) {
+      // a HIGHER term must go to scalar raft (step down / new election)
       begin_eject(g, EV_TERM_MISMATCH);
       return false;
+    }
+    if (m.term < g->term) {
+      // stale-term fast-path message: a deposed leader's tail or a late
+      // response from the pre-enrollment term.  Scalar raft ignores stale
+      // responses and answers stale leaders only to depose them — and the
+      // deposed peer independently recovers via the NEW leader's
+      // higher-term traffic plus its own quorum/commit-stall watchdogs.
+      // Consuming (dropping) instead of ejecting removes a post-churn
+      // eject storm (round 3: term-mismatch ejects on every late RESP)
+      stale_dropped++;
+      return true;
     }
     int64_t now = mono_ms();
     switch (m.type) {
@@ -1207,12 +1234,29 @@ struct Engine {
           begin_eject(g, EV_PROTOCOL);
           return false;
         }
+        // validate the sender FIRST: an echo from a non-member must not
+        // touch g->reads — with pi == peers.size() the phantom bit
+        // 1<<pi could count toward ReadIndex quorums
+        size_t pi = g->peers.size();
+        for (size_t i = 0; i < g->peers.size(); i++)
+          if (g->peers[i].id == m.from) { pi = i; break; }
+        if (pi == g->peers.size()) {
+          begin_eject(g, EV_PROTOCOL);
+          return false;
+        }
+        PeerP& pr0 = g->peers[pi];
+        pr0.contact_ms = now;
+        if (pr0.hb_sent_us) {  // heartbeat echo round trip (diagnostics)
+          uint64_t rtt = (uint64_t)(mono_us() - pr0.hb_sent_us);
+          pr0.hb_sent_us = 0;
+          rtt_us += rtt;
+          rttn++;
+          uint64_t mx = rtt_max_us.load();
+          while (rtt > mx && !rtt_max_us.compare_exchange_weak(mx, rtt)) {}
+        }
         if (m.hint != 0 || m.hint_high != 0) {
           // ReadIndex confirmation echo (readindex.go confirm): count the
           // peer toward every pending context at or before this one
-          size_t pi = 0;
-          for (; pi < g->peers.size(); pi++)
-            if (g->peers[pi].id == m.from) break;
           uint32_t bit = 1u << pi;
           // the echo proves leadership only for contexts registered at or
           // before the one the heartbeat carried (readindex.go:77 confirm
@@ -1246,14 +1290,8 @@ struct Engine {
             }
           }
         }
-        for (auto& p : g->peers) {
-          if (p.id != m.from) continue;
-          p.contact_ms = now;
-          if (p.match < g->last_index) mark_dirty(g);
-          return true;
-        }
-        begin_eject(g, EV_PROTOCOL);
-        return false;
+        if (pr0.match < g->last_index) mark_dirty(g);
+        return true;
       }
       default:
         begin_eject(g, EV_PROTOCOL);
@@ -2067,7 +2105,7 @@ int natr_wait_apply(void* h, int timeout_ms) {
   return e->applyq.empty() ? 0 : 1;
 }
 
-void natr_stats(void* h, uint64_t* out12) {  // array of 20 u64
+void natr_stats(void* h, uint64_t* out12) {  // array of 24 u64
   Engine* e = (Engine*)h;
   out12[0] = e->proposed.load();
   out12[1] = e->ingested_fast.load();
@@ -2101,6 +2139,8 @@ void natr_stats(void* h, uint64_t* out12) {  // array of 20 u64
   uint64_t nrt = e->rttn.load();
   out12[18] = nrt ? (e->rtt_us.load() / nrt) : 0;
   out12[19] = e->rtt_max_us.load();
+  out12[20] = e->stale_dropped.load();
+  out12[21] = out12[22] = out12[23] = 0;  // reserved
 }
 
 void natr_set_debug_cid(void* h, uint64_t cid) {

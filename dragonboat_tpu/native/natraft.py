@@ -478,7 +478,7 @@ class NatRaft:
         self._lib.natr_set_commit_window(self._h, us)
 
     def stats(self) -> dict:
-        out = (ctypes.c_uint64 * 20)()
+        out = (ctypes.c_uint64 * 24)()
         self._lib.natr_stats(self._h, out)
         return {
             "proposed": int(out[0]),
@@ -499,6 +499,9 @@ class NatRaft:
             "send_buf_hiwater": int(out[15]),
             "lat_ack_avg_us": int(out[16]),
             "lat_resp_avg_us": int(out[17]),
+            "hb_rtt_avg_us": int(out[18]),
+            "hb_rtt_max_us": int(out[19]),
+            "stale_dropped": int(out[20]),
         }
 
     def stop(self) -> None:

@@ -506,11 +506,21 @@ class Node:
         fl = self.fastlane
         ticks = 0
         others: List[Message] = []
+        cur_term = self.peer.raft.term  # frozen while enrolled (any native
+        # term change ejects), so this is the native group's term too
         for m in self.mq.get():
             if m.type == MT.LOCAL_TICK:
                 ticks += 1
             elif m.type in _FAST_WIRE_TYPES and fl.ingest_message(m):
                 pass  # consumed natively (in-flight at enrollment)
+            elif (
+                m.type == MT.REQUEST_VOTE_RESP and m.term <= cur_term
+            ):
+                # straggler from the election that preceded enrollment: an
+                # enrolled group is never a candidate, so scalar raft would
+                # no-op it — ejecting for it (round 3: router ejects) cost
+                # enrollment stability for nothing
+                fl.count_drop("stale-vote-resp")
             else:
                 others.append(m)
         if ticks:
@@ -662,6 +672,13 @@ class Node:
             encode_entry_into(buf, e)
         hb_ms = max(1, self.config.heartbeat_rtt * self.tick_millisecond)
         elect_ms = max(10, 2 * self.config.election_rtt * self.tick_millisecond)
+        # register BEFORE enroll: the native round thread may emit an apply
+        # span for this group the instant enroll inserts it (enrolling with
+        # committed > processed re-emits the unapplied window), and a span
+        # arriving before registration would be dropped — wedging applied
+        # below commit and timing out every later linearizable read (the
+        # round-3 chaos failure)
+        fl.register_node(self)
         ok = fl.nat.enroll(
             self.cluster_id,
             self.node_id,
@@ -684,8 +701,10 @@ class Node:
             tail=bytes(buf),
         )
         if ok:
-            fl.register_node(self)
             self.fast_lane = True
+            fl.note_enrolled(self.cluster_id)
+        else:
+            fl.unregister_node(self)
 
     def _count_eject(self, reason: str) -> None:
         if self.fastlane is not None:
@@ -718,9 +737,11 @@ class Node:
                     self.describe(),
                 )
                 self.fast_lane = False
+                fl.note_ejected(self.cluster_id)
                 self._stopped.set()
                 return
             self.fast_lane = False
+            fl.note_ejected(self.cluster_id)
             if st is None or self.peer is None:
                 return
             r = self.peer.raft

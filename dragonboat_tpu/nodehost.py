@@ -24,7 +24,7 @@ from .config import Config, NodeHostConfig
 from .engine import Engine
 from .logdb import LogReader, open_logdb
 from .logger import get_logger
-from .node import Node
+from .node import _FAST_WIRE_TYPES, Node
 from .raft.peer import PeerAddress
 from .requests import (
     ClusterAlreadyExistError,
@@ -699,11 +699,16 @@ class NodeHost:
                 # learn the sender's address so replies route before
                 # membership is applied locally (reference nodes.go)
                 self.node_registry.add_remote(m.cluster_id, m.from_, src)
-            # a message reaching Python for a fast-lane group means the
-            # native core could not serve it: complete the eject handoff
-            # FIRST so the scalar raft state is current when it handles
-            # the message (fastlane.py eject protocol)
-            if node.fast_lane:
+            # a non-fast message reaching Python for a fast-lane group means
+            # the native core could not serve it: complete the eject handoff
+            # FIRST so the scalar raft state is current when it handles the
+            # message (fastlane.py eject protocol).  Fast-wire types are
+            # NOT ejected for: they are frames that raced (re)enrollment
+            # through the leftover pump — the enrolled step feeds them to
+            # the native core in mq order (node._fast_lane_step), which was
+            # the dominant round-3 eject storm (router:REPLICATE /
+            # router:HEARTBEAT ~2-3k per rank, enrollment duty ~1/3)
+            if node.fast_lane and m.type not in _FAST_WIRE_TYPES:
                 if self.fastlane is not None:
                     self.fastlane.count_eject(f"router:{m.type.name}")
                 node.fast_eject()

@@ -188,6 +188,17 @@ def test_kill_restart_under_load_over_tcp(tmp_path, fast_lane):
         assert all(
             sms[i].kv.get(f"k{last}") == f"v{last}" for i in (1, 2, 3)
         ), {i: len(sms[i].kv) for i in (1, 2, 3)}
+        # regression pin (round-3 chaos failure): an apply span delivered
+        # before the group's Python node was registered was DROPPED,
+        # silently losing committed entries from the apply stream and
+        # wedging every later linearizable read at that index
+        if fast_lane:
+            for i, nh in nhs.items():
+                fl = nh.fastlane
+                if fl is not None and fl.enabled:
+                    assert fl.dropped_spans == 0, (
+                        f"rank {i} dropped {fl.dropped_spans} apply spans"
+                    )
     finally:
         stop_load.set()
         for nh in nhs.values():

@@ -141,11 +141,21 @@ def _wait_converged(sms, count, timeout=90.0):
 
 
 def _stop_all(nhs):
+    # regression pin (round-3 chaos failure): a span delivered before the
+    # node was registered was dropped, losing committed entries from the
+    # apply stream; registration now precedes native enrollment, so this
+    # must never fire
+    drops = {
+        i: nh.fastlane.dropped_spans
+        for i, nh in nhs.items()
+        if nh.fastlane is not None and nh.fastlane.enabled
+    }
     for nh in nhs.values():
         try:
             nh.stop()
         except Exception:
             pass
+    assert all(v == 0 for v in drops.values()), f"dropped apply spans: {drops}"
 
 
 def test_enroll_and_native_replication(tmp_path):
