@@ -43,7 +43,13 @@ def _note(msg: str) -> None:
     print(f"# {msg}", file=sys.stderr)
 
 
-def _probe_tpu(timeout: float = 90.0, tries: int = 2):
+#: diagnostics from every TPU probe attempt (surfaced in the artifact so a
+#: cpu fallback is attributable — VERDICT r3 missing #1: the r03 record had
+#: no TPU number and nothing explaining why)
+PROBE_LOG: list = []
+
+
+def _probe_tpu(timeout: float = 120.0, tries: int = 3):
     """Probe the default (TPU) backend in a SUBPROCESS with a timeout.
 
     The tunneled axon backend can hang (not just fail) during init —
@@ -56,6 +62,7 @@ def _probe_tpu(timeout: float = 90.0, tries: int = 2):
 
     code = "import jax; print(jax.devices()[0].platform)"
     for attempt in range(tries):
+        rec = {"attempt": len(PROBE_LOG) + 1}
         try:
             r = subprocess.run(
                 [sys.executable, "-c", code],
@@ -63,12 +70,19 @@ def _probe_tpu(timeout: float = 90.0, tries: int = 2):
                 text=True,
                 timeout=timeout,
             )
+            rec["rc"] = r.returncode
+            if r.stderr:
+                rec["stderr"] = r.stderr[-400:]
             if r.returncode == 0 and r.stdout.strip():
                 platform = r.stdout.strip().splitlines()[-1].strip()
                 if platform:
+                    rec["platform"] = platform
+                    PROBE_LOG.append(rec)
                     return platform
         except subprocess.TimeoutExpired:
-            pass
+            rec["timeout_s"] = timeout
+        PROBE_LOG.append(rec)
+        _note(f"tpu probe attempt failed: {rec}")
         time.sleep(2.0 * (attempt + 1))
     return None
 
@@ -261,6 +275,49 @@ def _run_host_loop(n_groups: int, rounds: int) -> dict:
     }
 
 
+def _slim_e2e(e2e: dict) -> dict:
+    """Headline-safe summary of an e2e result dict.
+
+    The driver records only the last ~2000 chars of output: round 3's
+    per-rank fast-lane stats bloated the JSON line past that and truncated
+    the metric away (`BENCH_r03.json parsed: null`).  The full dict goes to
+    BENCH_DETAIL.json; the stdout line carries only scalars.
+    """
+    if not isinstance(e2e, dict):
+        return e2e
+    out = {}
+    for k in ("error", "groups", "hosts", "engine", "leader_mode",
+              "writes_per_sec", "setup_s"):
+        if k in e2e:
+            out[k] = e2e[k]
+    lat = e2e.get("commit_latency_ms")
+    if isinstance(lat, dict):
+        out["commit_latency_ms"] = {
+            k: lat[k] for k in ("p50", "p99") if k in lat
+        }
+    mixed = e2e.get("mixed_phase")
+    if isinstance(mixed, dict) and "ops_per_sec" in mixed:
+        out["mixed_ops_per_sec"] = mixed["ops_per_sec"]
+    fl = e2e.get("fastlane")
+    if isinstance(fl, list):
+        ranks = [r for r in fl if isinstance(r, dict)]
+        if ranks:
+            out["fastlane"] = {
+                "enrolled_now": [r.get("enrolled_now") for r in ranks],
+                "enroll_duty": [r.get("enroll_duty") for r in ranks],
+                "ejects": [
+                    sum((r.get("eject_reasons") or {}).values())
+                    for r in ranks
+                ],
+                "dropped_spans": [r.get("dropped_spans") for r in ranks],
+            }
+    if e2e.get("rank_errors"):
+        out["rank_errors"] = len(e2e["rank_errors"])
+    if "tail" in e2e:
+        out["tail"] = e2e["tail"][-200:]
+    return out
+
+
 def main() -> None:
     # ---- e2e NodeHost numbers first (ladder rung 3; VERDICT r2 item 1).
     # The TPU chip is free at this point — the probe subprocess exits and
@@ -345,26 +402,65 @@ def main() -> None:
     except Exception as e:
         detail["host_loop"] = {"error": repr(e)}
 
-    print(
-        json.dumps(
-            {
-                "metric": "quorum_engine_writes_per_sec",
-                "value": round(writes_per_sec, 1),
-                "unit": "writes/s",
-                "vs_baseline": round(writes_per_sec / BASELINE_WRITES_PER_SEC, 4),
-                # machine-readable e2e status (ADVICE r2): a consumer
-                # checking rc/parsed must not read a partial failure as an
-                # unqualified pass
-                "e2e_ok": e2e_ok,
-                "detail": detail,
-            }
+    # full detail (per-rank stats and all) goes to a FILE; the stdout line
+    # stays small enough that the driver's 2000-char tail capture can never
+    # truncate the headline (VERDICT r3 missing #1)
+    detail["tpu_probe"] = PROBE_LOG
+    detail_file_ok = False
+    try:
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_DETAIL.json"), "w"
+        ) as f:
+            json.dump(detail, f, indent=1)
+        detail_file_ok = True
+    except OSError as e:
+        _note(f"could not write BENCH_DETAIL.json: {e!r}")
+    slim = dict(detail)
+    for k in ("e2e", "e2e_scalar"):
+        if k in slim:
+            slim[k] = _slim_e2e(slim[k])
+    slim.pop("tpu_probe", None)
+    if not on_tpu and PROBE_LOG:
+        slim["tpu_probe_last"] = PROBE_LOG[-1]
+    tpu_required = os.environ.get("BENCH_PLATFORM") != "cpu"
+    record = {
+        "metric": "quorum_engine_writes_per_sec",
+        "value": round(writes_per_sec, 1),
+        "unit": "writes/s",
+        "vs_baseline": round(writes_per_sec / BASELINE_WRITES_PER_SEC, 4),
+        "platform": platform,
+        # loud, machine-readable TPU status: false means the bench ran
+        # but NOT on the hardware the record is about
+        "tpu_ok": on_tpu,
+        # machine-readable e2e status (ADVICE r2): a consumer
+        # checking rc/parsed must not read a partial failure as an
+        # unqualified pass
+        "e2e_ok": e2e_ok,
+        "detail": slim,
+    }
+    line = json.dumps(record)
+    if len(line) > 1900:  # last-resort guard for the tail capture
+        _note("slim detail still too large; dropping it from the line")
+        record["detail"] = (
+            {"see": "BENCH_DETAIL.json"}
+            if detail_file_ok
+            else {"error": "detail too large and BENCH_DETAIL.json unwritable"}
         )
-    )
+        line = json.dumps(record)
+    print(line)
+    if tpu_required and not on_tpu:
+        # the TPU was expected (driver runs on real hardware) but could not
+        # be reached: exit nonzero so the record flags it even if nobody
+        # reads the JSON fields
+        sys.exit(3)
 
 
 if __name__ == "__main__":
     try:
         main()
+    except SystemExit:
+        raise
     except Exception as e:  # ALWAYS emit a parseable line for the driver
         traceback.print_exc()
         print(
@@ -374,8 +470,11 @@ if __name__ == "__main__":
                     "value": 0.0,
                     "unit": "writes/s",
                     "vs_baseline": 0.0,
+                    "platform": None,
+                    "tpu_ok": False,
                     "e2e_ok": False,
-                    "detail": {"error": repr(e)},
+                    "detail": {"error": repr(e)[:600]},
                 }
             )
         )
+        sys.exit(4)

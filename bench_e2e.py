@@ -648,6 +648,13 @@ def rank_main() -> int:
         plan = expect("RUN")
         while time.time() < plan["t0"]:
             time.sleep(0.005)
+        # enrollment duty-cycle window opens with the measurement phases
+        duty_t0 = time.monotonic()
+        duty_gs0 = (
+            nh.fastlane.duty_group_seconds()
+            if nh.fastlane is not None and nh.fastlane.enabled
+            else 0.0
+        )
         tput = _measure(
             leaders, sorted(led), payload, window,
             plan["t0"] + plan["duration"], threads,
@@ -677,9 +684,21 @@ def rank_main() -> int:
         fl_stats = (
             nh.fastlane.stats() if nh.fastlane is not None else {"enabled": False}
         )
-        fl_stats["enrolled_now"] = sum(
+        # led-only count kept under its own key; stats() already provides
+        # enrolled_now as ALL local enrolled replicas (followers enroll too)
+        fl_stats["enrolled_now_led"] = sum(
             1 for cid in led if nh.get_node(cid).fast_lane
         )
+        if nh.fastlane is not None and nh.fastlane.enabled:
+            # duty cycle over the measurement phases: fraction of
+            # group-seconds this rank's REPLICAS (not just leaders — every
+            # local replica can enroll) spent in the lane
+            elapsed = max(1e-9, time.monotonic() - duty_t0)
+            fl_stats["enroll_duty"] = round(
+                (nh.fastlane.duty_group_seconds() - duty_gs0)
+                / (max(1, groups) * elapsed),
+                4,
+            )
         emit(
             "RESULT",
             {
