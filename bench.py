@@ -303,7 +303,12 @@ def _slim_e2e(e2e: dict) -> dict:
         ranks = [r for r in fl if isinstance(r, dict)]
         if ranks:
             out["fastlane"] = {
+                # led-only (round-3-comparable) and all-replica populations
                 "enrolled_now": [r.get("enrolled_now") for r in ranks],
+                "led": [r.get("led") for r in ranks],
+                "enrolled_replicas": [
+                    r.get("enrolled_replicas") for r in ranks
+                ],
                 "enroll_duty": [r.get("enroll_duty") for r in ranks],
                 "ejects": [
                     sum((r.get("eject_reasons") or {}).values())

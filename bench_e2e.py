@@ -648,18 +648,20 @@ def rank_main() -> int:
         plan = expect("RUN")
         while time.time() < plan["t0"]:
             time.sleep(0.005)
-        # enrollment duty-cycle window opens with the measurement phases
-        duty_t0 = time.monotonic()
-        duty_gs0 = (
-            nh.fastlane.duty_group_seconds()
-            if nh.fastlane is not None and nh.fastlane.enabled
-            else 0.0
-        )
+        # enrollment duty cycle, bracketed around the MEASUREMENT windows
+        # only (drain budgets and cross-rank barriers between phases would
+        # otherwise dilute the denominator)
+        _fl_on = nh.fastlane is not None and nh.fastlane.enabled
+        _dgs = nh.fastlane.duty_group_seconds if _fl_on else (lambda: 0.0)
+        duty_gs = duty_el = 0.0
+        _w_t0, _w_g0 = time.monotonic(), _dgs()
         tput = _measure(
             leaders, sorted(led), payload, window,
             plan["t0"] + plan["duration"], threads,
             drain_budget=plan.get("drain_budget", 30.0),
         )
+        duty_gs += _dgs() - _w_g0
+        duty_el += time.monotonic() - _w_t0
         tput_lats = tput.pop("_lats")
         emit(
             "TPUT",
@@ -676,28 +678,30 @@ def rank_main() -> int:
         lat_cids = [c for c in plan["lat_cids"] if c in led]
         while time.time() < plan["t0"]:
             time.sleep(0.005)
+        _w_t0, _w_g0 = time.monotonic(), _dgs()
         lat = _measure(
             leaders, lat_cids, payload, 1,
             plan["t0"] + plan["duration"], threads,
         )
+        duty_gs += _dgs() - _w_g0
+        duty_el += time.monotonic() - _w_t0
         lat_lats = lat.pop("_lats")
         fl_stats = (
             nh.fastlane.stats() if nh.fastlane is not None else {"enabled": False}
         )
-        # led-only count kept under its own key; stats() already provides
-        # enrolled_now as ALL local enrolled replicas (followers enroll too)
-        fl_stats["enrolled_now_led"] = sum(
+        # round-3-comparable key: groups this rank LEADS that are enrolled
+        # (stats() separately reports enrolled_replicas = all local
+        # replicas in the lane, followers included)
+        fl_stats["enrolled_now"] = sum(
             1 for cid in led if nh.get_node(cid).fast_lane
         )
-        if nh.fastlane is not None and nh.fastlane.enabled:
-            # duty cycle over the measurement phases: fraction of
+        fl_stats["led"] = len(led)
+        if _fl_on:
+            # duty cycle over the measurement windows: fraction of
             # group-seconds this rank's REPLICAS (not just leaders — every
             # local replica can enroll) spent in the lane
-            elapsed = max(1e-9, time.monotonic() - duty_t0)
             fl_stats["enroll_duty"] = round(
-                (nh.fastlane.duty_group_seconds() - duty_gs0)
-                / (max(1, groups) * elapsed),
-                4,
+                duty_gs / (max(1, groups) * max(1e-9, duty_el)), 4
             )
         emit(
             "RESULT",

@@ -501,7 +501,9 @@ class FastLaneManager:
         out["drop_reasons"] = dict(self.drop_reasons)
         out["dropped_spans"] = self.dropped_spans
         with self._duty_mu:
-            out["enrolled_now"] = len(self._enroll_t0)
+            # explicit population: ALL local enrolled replicas (followers
+            # enroll too) — distinct from the e2e's led-only count
+            out["enrolled_replicas"] = len(self._enroll_t0)
         out["enroll_events"] = self.enroll_events
         out["enrolled_group_seconds"] = round(self.duty_group_seconds(), 2)
         return out

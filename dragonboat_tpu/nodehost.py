@@ -709,6 +709,18 @@ class NodeHost:
             # the dominant round-3 eject storm (router:REPLICATE /
             # router:HEARTBEAT ~2-3k per rank, enrollment duty ~1/3)
             if node.fast_lane and m.type not in _FAST_WIRE_TYPES:
+                if (
+                    m.type is MessageType.REQUEST_VOTE_RESP
+                    and m.term <= node.peer.raft.term
+                ):
+                    # straggler from the pre-enrollment election: an
+                    # enrolled group is never a candidate, so scalar raft
+                    # would no-op it — not worth an eject (term read is
+                    # lock-free but safe: a racing campaign bumps the term,
+                    # making a stale resp stale still)
+                    if self.fastlane is not None:
+                        self.fastlane.count_drop("router-stale-vote-resp")
+                    continue
                 if self.fastlane is not None:
                     self.fastlane.count_eject(f"router:{m.type.name}")
                 node.fast_eject()
