@@ -1,0 +1,26 @@
+# dragonboat_tpu developer entry points (reference Makefile roles:
+# test / monkey-test / benchmark — docs/test.md)
+
+PY ?= python
+
+.PHONY: test native soak soak-smoke bench dryrun
+
+test: native
+	$(PY) -m pytest tests/ -x -q
+
+native:
+	$(MAKE) -C dragonboat_tpu/native
+
+# Drummer-analog chaos soak (docs/test.md:6-36): kill -9/restart churn,
+# continuous cross-replica hash checks, linearizability on sampled keys
+soak: native
+	$(PY) soak.py --minutes 10 --groups 16
+
+soak-smoke: native
+	$(PY) soak.py --minutes 1 --groups 8
+
+bench: native
+	$(PY) bench.py
+
+dryrun:
+	$(PY) __graft_entry__.py

@@ -537,12 +537,20 @@ def rank_main() -> int:
         )
     )
     addrs = {i + 1: f"127.0.0.1:{ports[i]}" for i in range(procs)}
+    # E2E_SM=native: the C-ABI KV state machine (natsm.py) — enrolled
+    # groups then apply committed entries natively with only batched
+    # completion records crossing the GIL (PERF.md ~40us/write apply rim)
+    sm_factory = CounterSM
+    if os.environ.get("E2E_SM", "python") == "native":
+        from dragonboat_tpu.native.natsm import NativeKVStateMachine
+
+        sm_factory = NativeKVStateMachine
     cids = [BASE_CID + g for g in range(groups)]
     for cid in cids:
         nh.start_cluster(
             addrs,
             False,
-            CounterSM,
+            sm_factory,
             Config(
                 cluster_id=cid,
                 node_id=rank + 1,
@@ -947,6 +955,7 @@ def run_mp(
             "hosts": procs,
             "procs": procs,
             "engine": engine,
+            "sm": os.environ.get("E2E_SM", "python"),
             "leader_mode": leader_mode,
             "durable": durable,
             "payload_bytes": 16,

@@ -77,8 +77,12 @@ class ExpertConfig:
       - ``"scalar"``: per-group host stepping only (the reference's model).
       - ``"tpu"``: route hot-path group stepping through the batched
         ``(nGroups, nPeers)`` device engine (:mod:`dragonboat_tpu.ops`).
-      - ``"auto"``: tpu when a device is available and the group count makes
-        batching worthwhile.
+      - ``"auto"``: resolved at NodeHost construction: ``scalar`` when the
+        native fast lane is active (measured r4: at ~1.0 enrollment duty
+        the device engine's per-tick dispatches only compete for CPU —
+        6.3k vs 8.8k w/s at rung 3), else ``tpu`` iff a probe dispatch
+        fits the commit-latency budget (a tunneled backend's ~70ms round
+        trip does not; a local device's ~0.2ms does).
     """
 
     quorum_engine: str = "scalar"

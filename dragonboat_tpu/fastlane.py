@@ -40,7 +40,7 @@ EV_NAMES = {
     1: "contact-lost", 2: "quorum-lost", 3: "protocol", 4: "wal-error",
     5: "term-mismatch", 6: "wrong-role", 7: "gap", 8: "prev-term",
     9: "reject-resp", 10: "unknown-peer", 11: "resend-preenroll", 12: "parse",
-    13: "commit-stall",
+    13: "commit-stall", 14: "sm-punt",
 }
 
 
@@ -68,6 +68,9 @@ class FastLaneManager:
         # Python-initiated reasons), exposed via stats()
         self.eject_reasons: Dict[str, int] = {}
         self.drop_reasons: Dict[str, int] = {}
+        # serializes completion-batch draining: the pump and the eject-path
+        # drain share the native call's reusable buffers
+        self._compl_mu = threading.Lock()
         self._duty_mu = threading.Lock()
         self._enroll_t0: Dict[int, float] = {}
         self._enrolled_gs = 0.0
@@ -111,6 +114,7 @@ class FastLaneManager:
             (self._event_pump, "fastlane-events"),
             (self._leftover_pump, "fastlane-leftover"),
             (self._read_pump, "fastlane-reads"),
+            (self._completion_pump, "fastlane-compl"),
         ):
             t = threading.Thread(target=fn, name=name, daemon=True)
             t.start()
@@ -319,6 +323,13 @@ class FastLaneManager:
                 # committed entries enqueued but never applied
                 touched, self._touched = self._touched, []
                 st = self.nat.eject(node.cluster_id)
+                # native-SM completions must land before scalar applies
+                # resume (the eject blob starts at the NATIVE applied
+                # watermark, so the manager watermark must catch up first)
+                # — and only AFTER nat.eject, which finalizes the group:
+                # draining a still-ACTIVE group would race further native
+                # applies queued behind the drain
+                self._drain_completions()
                 with self._nodes_mu:
                     self._nodes.pop(node.cluster_id, None)
                 if st is not None:
@@ -398,6 +409,66 @@ class FastLaneManager:
                         plog.exception("inline apply failed")
                 else:
                     self.nh.engine.set_apply_ready(node.cluster_id)
+
+    def _process_completions(self, got) -> None:
+        """Apply one batch of native-SM completion records: advance the
+        manager watermark (the native plane already applied the entries to
+        the shared SM) and complete leader proposal futures.  None of this
+        takes raftMu, so the eject path can drain synchronously while
+        holding it."""
+        from .statemachine import Result
+
+        cids, indexes, terms, keys, results, leaders = got
+        per: Dict[int, list] = {}
+        for i in range(len(cids)):
+            per.setdefault(int(cids[i]), []).append(i)
+        for cid, idxs in per.items():
+            node = self.nh.get_node(cid)
+            if node is None:
+                continue
+            last = idxs[-1]
+            node.sm.advance_applied_native(
+                int(indexes[last]), int(terms[last])
+            )
+            for i in idxs:
+                if leaders[i] and keys[i]:
+                    node.pending_proposals.applied(
+                        int(keys[i]), 0, 0,
+                        Result(value=int(results[i])), False,
+                    )
+            node.pending_reads.applied(node.sm.get_last_applied())
+
+    def _completion_pump(self) -> None:
+        # Processing happens WHILE HOLDING _compl_mu: the eject-path drain
+        # must never observe an empty native queue while a popped batch is
+        # still mid-flight on this thread (the watermark would be stale
+        # when the eject blob applies).  The 20ms idle timeout bounds how
+        # long a drain (which runs under raftMu) can wait for the lock.
+        while not self._stopped.is_set():
+            try:
+                with self._compl_mu:
+                    got = self.nat.next_completions(20)
+                    if got is not None:
+                        self._process_completions(got)
+            except ConnectionError:
+                return
+            except Exception:
+                plog.exception("completion batch failed")
+
+    def _drain_completions(self) -> None:
+        """Synchronously drain pending native-SM completions (eject path:
+        the manager watermark must be current before scalar applies resume
+        past it)."""
+        while True:
+            try:
+                with self._compl_mu:
+                    got = self.nat.next_completions(0)
+                    if got is not None:
+                        self._process_completions(got)
+            except ConnectionError:
+                return
+            if got is None:
+                return
 
     def _event_pump(self) -> None:
         while not self._stopped.is_set():

@@ -37,7 +37,12 @@ class Op:
 
 
 def _check_register(ops: List[Op], initial: Optional[str] = None) -> bool:
-    """Wing & Gong search over one key's history."""
+    """Wing & Gong search over one key's history.
+
+    Iterative DFS with an explicit stack: a soak history can run to
+    thousands of ops per key, so the search depth (one level per op) must
+    not ride the Python recursion limit.
+    """
     n = len(ops)
     if n == 0:
         return True
@@ -45,24 +50,19 @@ def _check_register(ops: List[Op], initial: Optional[str] = None) -> bool:
     ops = [ops[i] for i in order]
     full = (1 << n) - 1
     seen: set = set()
-    budget = [5_000_000]  # visited-state cap: fail loudly, never hang
+    budget = 5_000_000  # visited-state cap: fail loudly, never hang
 
-    def search(done_mask: int, state: Optional[str]) -> bool:
-        if done_mask == full:
-            return True
-        if (done_mask, state) in seen:
-            return False
-        seen.add((done_mask, state))
-        budget[0] -= 1
-        if budget[0] < 0:
-            raise RuntimeError("linearizability search budget exhausted")
+    def _successors(done_mask: int, state: Optional[str]):
         # an op may linearize next only if no other pending op RETURNED
         # before this op was INVOKED (returned-before implies
         # linearized-before)
         min_ret = INF
         for i in range(n):
             if not done_mask & (1 << i):
-                min_ret = min(min_ret, ops[i].ret)
+                r = ops[i].ret
+                if r < min_ret:
+                    min_ret = r
+        out = []
         for i in range(n):
             bit = 1 << i
             if done_mask & bit:
@@ -71,17 +71,25 @@ def _check_register(ops: List[Op], initial: Optional[str] = None) -> bool:
             if op.invoke > min_ret:
                 continue
             if op.kind == "put":
-                if search(done_mask | bit, op.value):
-                    return True
-            else:  # get
+                out.append((done_mask | bit, op.value))
+            elif not op.ok or op.value == state:
                 # a get with unknown outcome observed nothing: any state fits
-                if (not op.ok or op.value == state) and search(
-                    done_mask | bit, state
-                ):
-                    return True
-        return False
+                out.append((done_mask | bit, state))
+        return out
 
-    return search(0, initial)
+    stack = [(0, initial)]
+    while stack:
+        done_mask, state = stack.pop()
+        if done_mask == full:
+            return True
+        if (done_mask, state) in seen:
+            continue
+        seen.add((done_mask, state))
+        budget -= 1
+        if budget < 0:
+            raise RuntimeError("linearizability search budget exhausted")
+        stack.extend(_successors(done_mask, state))
+    return False
 
 
 def check_linearizable(

@@ -413,6 +413,7 @@ enum EventCode {
   EV_RESEND_PREENROLL = 11,
   EV_PARSE = 12,
   EV_COMMIT_STALL = 13,  // liveness watchdog: pending entries, no progress
+  EV_SM = 14,  // native SM cannot apply (session-managed / non-app entry)
 };
 
 struct PeerP {
@@ -444,8 +445,18 @@ struct Group {
   uint64_t staged_to = 0;            // appended into the shard batch
   uint64_t fsynced = 0;              // durable locally
   uint64_t commit = 0;
-  uint64_t applied_handed = 0;       // handed to the apply pump
+  uint64_t applied_handed = 0;       // handed to the apply pump / applied natively
   uint64_t commit_sent = 0;          // commit watermark last broadcast
+  // native C-ABI state machine (natsm.cpp): when attached, committed
+  // noop-session application entries are applied HERE (no Python apply
+  // hop) and only batched completion records cross the GIL boundary
+  void* sm = nullptr;
+  uint64_t (*sm_update)(void*, const uint8_t*, size_t) = nullptr;
+  // order barrier vs the scalar plane: entries <= apply_barrier were
+  // handed to the PYTHON apply queue before enrollment; native applies
+  // hold off until Python reports them applied (py_applied)
+  uint64_t apply_barrier = 0;
+  uint64_t py_applied = 0;
   std::deque<NEntry> log;
   std::vector<PeerP> peers;
   std::vector<PendResp> resps;       // post-fsync responses (follower)
@@ -514,6 +525,17 @@ struct Engine {
   std::mutex emu;
   std::condition_variable ecv;
   std::deque<std::pair<uint64_t, int>> eventq;
+
+  // native-SM apply completions: one record per natively applied LEADER
+  // entry (key!=0 completes the proposal future) plus per-span follower
+  // watermark records (key==0); drained in batches by the Python pump
+  struct Completion {
+    uint64_t cid, index, term, key, result;
+    uint8_t leader;
+  };
+  std::mutex cmu;
+  std::condition_variable ccv;
+  std::deque<Completion> complq;
 
   // confirmed ReadIndex contexts: (cid, low, high, commit_index)
   std::mutex rmu;
@@ -590,6 +612,7 @@ struct Engine {
     wcv.notify_all();
     acv.notify_all();
     ecv.notify_all();
+    ccv.notify_all();
     for (auto& r : remotes) {
       {
         std::lock_guard<std::mutex> g(r->mu);
@@ -731,6 +754,12 @@ struct Engine {
   void emit_apply(Group* g) {  // g->mu held
     uint64_t upto = std::min(g->commit, g->fsynced);
     if (upto <= g->applied_handed) return;
+    if (g->sm != nullptr && g->state == G_ACTIVE) {
+      // entries handed to the PYTHON apply queue before enrollment must
+      // land in the shared SM first (natr_note_applied lifts the barrier)
+      if (g->py_applied >= g->apply_barrier) apply_native(g, upto);
+      return;
+    }
     uint64_t first = g->applied_handed + 1;
     if (first < g->log_first) return;  // should not happen
     ApplySpan span;
@@ -755,6 +784,71 @@ struct Engine {
       std::lock_guard<std::mutex> lk(amu);
       applyq.push_back(std::move(span));
       acv.notify_one();
+    }
+  }
+
+  // Apply committed entries straight into the attached native SM (the
+  // whole point: no GIL on the apply path).  Session-managed or non-
+  // application entries punt to the scalar plane via eject — exactly-once
+  // dedup and config semantics live in the Python RSM.  g->mu held.
+  void apply_native(Group* g, uint64_t upto) {
+    uint64_t first = g->applied_handed + 1;
+    if (first < g->log_first) return;  // should not happen
+    int64_t now = mono_us();
+    std::vector<Completion> batch;
+    batch.reserve(upto - first + 1);
+    for (uint64_t i = first; i <= upto; i++) {
+      NEntry& e2 = g->log[i - g->log_first];
+      const uint8_t* d = (const uint8_t*)e2.enc.data();
+      size_t len = e2.enc.size(), pos = 0;
+      uint64_t term, index, etype, key, cid_, sid, resp, clen;
+      bool ok = get_uvarint(d, len, pos, term) &&
+                get_uvarint(d, len, pos, index) &&
+                get_uvarint(d, len, pos, etype) &&
+                get_uvarint(d, len, pos, key) &&
+                get_uvarint(d, len, pos, cid_) &&
+                get_uvarint(d, len, pos, sid) &&
+                get_uvarint(d, len, pos, resp) &&
+                get_uvarint(d, len, pos, clen) && clen <= len - pos;
+      // applicable natively: APPLICATION (0) raw cmd, or ENCODED (2) with
+      // the v0 uncompressed header (rsm/encoded.py: |ver4|compress3|ses1|
+      // then raw payload) — snappy-compressed payloads and everything
+      // session-managed punt to the Python RSM
+      const uint8_t* payload = d + pos;
+      size_t plen = clen;
+      if (ok && etype == 2 && clen >= 1 && payload[0] == 0) {
+        payload += 1;  // strip the v0 no-compression no-session header
+        plen -= 1;
+      } else if (!ok || etype != 0) {
+        begin_eject(g, EV_SM);
+        break;
+      }
+      if (cid_ != 0) {
+        begin_eject(g, EV_SM);
+        break;
+      }
+      uint64_t result = g->sm_update(g->sm, payload, plen);
+      g->applied_handed = i;
+      if (g->leader) {
+        batch.push_back({g->cid, i, term, key, result, 1});
+        lat_emit_us += now - e2.born_us;
+        lat_count++;
+      } else {
+        lat_emitf_us += now - e2.born_us;
+        lat_countf++;
+      }
+    }
+    if (g->applied_handed >= first && !g->leader) {
+      // follower watermark record: Python needs last_applied to advance
+      // (ReadIndex completion, snapshot triggers) but no futures complete
+      uint64_t hi = g->applied_handed;
+      batch.push_back(
+          {g->cid, hi, g->term_of(hi), 0, 0, 0});
+    }
+    if (!batch.empty()) {
+      std::lock_guard<std::mutex> lk(cmu);
+      for (auto& c : batch) complq.push_back(c);
+      ccv.notify_one();
     }
   }
 
@@ -1461,6 +1555,67 @@ int natr_enroll(void* h, uint64_t cid, uint64_t nid, uint64_t term,
     e->mark_dirty(g.get());
   }
   return 0;
+}
+
+// Attach a native C-ABI state machine (natsm.cpp) to an enrolled group.
+// Entries already handed to the Python apply plane form the order barrier:
+// native applies start only once Python reports them applied
+// (natr_note_applied).  py_applied0 = the Python RSM manager's current
+// last_applied.  Returns 1 on success, 0 when the group is not enrolled.
+int natr_attach_sm(void* h, uint64_t cid, void* sm, void* update_fn,
+                   uint64_t py_applied0) {
+  Engine* e = (Engine*)h;
+  std::shared_ptr<Group> sp = e->find(cid);
+  Group* g = sp.get();
+  if (!g || sm == nullptr || update_fn == nullptr) return 0;
+  std::lock_guard<std::mutex> lk(g->mu);
+  if (g->state != G_ACTIVE) return 0;
+  g->sm = sm;
+  g->sm_update = (uint64_t (*)(void*, const uint8_t*, size_t))update_fn;
+  g->apply_barrier = g->applied_handed;
+  // max: a racing natr_note_applied may already have reported fresher
+  // Python progress than the caller's snapshot — never clobber a lift
+  if (py_applied0 > g->py_applied) g->py_applied = py_applied0;
+  e->mark_dirty(g);  // an applicable backlog applies on the next pass
+  return 1;
+}
+
+// Python reports its apply progress (lifts the attach-time barrier).
+void natr_note_applied(void* h, uint64_t cid, uint64_t applied) {
+  Engine* e = (Engine*)h;
+  std::shared_ptr<Group> sp = e->find(cid);
+  Group* g = sp.get();
+  if (!g) return;
+  std::lock_guard<std::mutex> lk(g->mu);
+  if (applied > g->py_applied) g->py_applied = applied;
+  if (g->sm != nullptr && g->py_applied >= g->apply_barrier)
+    e->mark_dirty(g);
+}
+
+// Drain up to `cap` native-SM apply completions into the caller's arrays.
+// Returns the count, 0 on timeout, -1 when stopped.
+long long natr_next_completions(void* h, int timeout_ms, uint64_t* cids,
+                                uint64_t* indexes, uint64_t* terms,
+                                uint64_t* keys, uint64_t* results,
+                                uint8_t* leaders, long long cap) {
+  Engine* e = (Engine*)h;
+  std::unique_lock<std::mutex> lk(e->cmu);
+  if (e->complq.empty() && !e->stopped.load())
+    e->ccv.wait_for(lk, std::chrono::milliseconds(timeout_ms));
+  if (e->complq.empty()) return e->stopped.load() ? -1 : 0;
+  long long n = 0;
+  while (n < cap && !e->complq.empty()) {
+    const Engine::Completion& c = e->complq.front();
+    cids[n] = c.cid;
+    indexes[n] = c.index;
+    terms[n] = c.term;
+    keys[n] = c.key;
+    results[n] = c.result;
+    leaders[n] = c.leader;
+    e->complq.pop_front();
+    n++;
+  }
+  return n;
 }
 
 // Propose on an enrolled leader group.  Returns the assigned index (>0) or

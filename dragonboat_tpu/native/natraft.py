@@ -146,6 +146,18 @@ def _load():
             c.c_void_p, c.c_int, c.c_char_p, c.c_size_t,
         ]
         lib.natr_stats.argtypes = [c.c_void_p, c.POINTER(c.c_uint64)]
+        lib.natr_attach_sm.restype = c.c_int
+        lib.natr_attach_sm.argtypes = [
+            c.c_void_p, c.c_uint64, c.c_void_p, c.c_void_p, c.c_uint64,
+        ]
+        lib.natr_note_applied.argtypes = [c.c_void_p, c.c_uint64, c.c_uint64]
+        lib.natr_next_completions.restype = c.c_longlong
+        lib.natr_next_completions.argtypes = [
+            c.c_void_p, c.c_int,
+            c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),
+            c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),
+            c.POINTER(c.c_uint64), c.POINTER(c.c_uint8), c.c_longlong,
+        ]
         _lib = lib
         return lib
 
@@ -468,6 +480,49 @@ class NatRaft:
 
     def send_msg(self, slot: int, payload: bytes) -> bool:
         return self._lib.natr_send_msg(self._h, slot, payload, len(payload)) == 0
+
+    # ---- native C-ABI state machine (natsm.cpp) ----
+
+    def attach_sm(
+        self, cid: int, sm_handle: int, update_fn: int, py_applied: int
+    ) -> bool:
+        """Attach a native SM to an enrolled group; committed application
+        entries then apply in C++ with only batched completion records
+        crossing the GIL."""
+        return (
+            self._lib.natr_attach_sm(
+                self._h, cid, sm_handle, update_fn, py_applied
+            )
+            == 1
+        )
+
+    def note_applied(self, cid: int, applied: int) -> None:
+        """Report Python-plane apply progress (lifts the attach barrier)."""
+        self._lib.natr_note_applied(self._h, cid, applied)
+
+    _COMPL_CAP = 4096
+
+    def next_completions(self, timeout_ms: int = 200):
+        """Batch of native-SM apply completions as parallel lists
+        (cids, indexes, terms, keys, results, leader_flags); None on
+        timeout; raises on stop."""
+        cap = self._COMPL_CAP
+        if not hasattr(self, "_cbufs"):
+            u64 = ctypes.c_uint64 * cap
+            self._cbufs = (
+                u64(), u64(), u64(), u64(), u64(), (ctypes.c_uint8 * cap)()
+            )
+        b = self._cbufs
+        n = self._lib.natr_next_completions(
+            self._h, timeout_ms, b[0], b[1], b[2], b[3], b[4], b[5], cap
+        )
+        if n < 0:
+            raise ConnectionError("natraft stopped")
+        if n == 0:
+            return None
+        return (
+            b[0][:n], b[1][:n], b[2][:n], b[3][:n], b[4][:n], b[5][:n]
+        )
 
     def close_conn(self, conn_id: int) -> None:
         self._lib.natr_close_conn(self._h, conn_id)

@@ -1,0 +1,168 @@
+// Native C-ABI state machine: the in-memory KV test/bench SM implemented
+// in C++ so ENROLLED fast-lane groups can apply committed entries without
+// the GIL (attacking the measured ~40us/write Python apply rim — PERF.md).
+//
+// Role model: the reference's KVTest SM (internal/tests/kvtest.go:85) — an
+// in-memory map with deterministic snapshot serialization — but exposed
+// through a minimal C ABI so BOTH planes share one instance:
+//
+//   - the native replication core calls `natsm_update` directly from its
+//     apply path (function pointer handed over at enrollment);
+//   - the Python adapter (native/natsm.py NativeKVStateMachine) fronts the
+//     same handle for the scalar path: lookups, post-eject applies,
+//     snapshot save/recover.
+//
+// Command format matches the Python test SMs: "key=value" sets, the result
+// is the map size after the set (deterministic across replicas).  The
+// internal mutex makes cross-plane access safe; per-group apply ORDER is
+// the replication layer's contract (native applies only past the
+// enrollment barrier; ejects drain before the scalar plane resumes).
+//
+// Build: make -C dragonboat_tpu/native  (libnatsm.so)
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+struct KV {
+  std::mutex mu;
+  // std::map: ordered iteration gives deterministic snapshots/hashes
+  // without a sort pass at save time
+  std::map<std::string, std::string> m;
+};
+
+// crc32 (IEEE, same table the WAL/wire paths use)
+uint32_t crc_table[256];
+struct CrcInit {
+  CrcInit() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      crc_table[i] = c;
+    }
+  }
+} crc_init;
+
+uint32_t crc32ieee(uint32_t crc, const uint8_t* p, size_t n) {
+  crc = ~crc;
+  for (size_t i = 0; i < n; i++) crc = crc_table[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+void put_u32(std::string& b, uint32_t v) {
+  for (int i = 0; i < 4; i++) b.push_back((char)((v >> (8 * i)) & 0xFF));
+}
+uint32_t get_u32(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* natsm_kv_create() { return new KV(); }
+
+void natsm_close(void* h) { delete (KV*)h; }
+
+// Apply one command; returns the SM result value (map size after the set,
+// matching the Python KVSM/CountSM convention).  Unparseable commands are
+// applied as a no-op returning the current size (never crash: a committed
+// entry must not wedge the apply loop).
+uint64_t natsm_update(void* h, const uint8_t* cmd, size_t len) {
+  KV* kv = (KV*)h;
+  const uint8_t* eq = (const uint8_t*)memchr(cmd, '=', len);
+  std::lock_guard<std::mutex> lk(kv->mu);
+  if (eq != nullptr) {
+    kv->m[std::string((const char*)cmd, eq - cmd)] =
+        std::string((const char*)eq + 1, len - (eq - cmd) - 1);
+  }
+  return (uint64_t)kv->m.size();
+}
+
+// Point lookup; returns value length and a malloc'd copy in *out (caller
+// frees via natsm_buf_free), or -1 when the key is absent.
+long long natsm_lookup(void* h, const uint8_t* q, size_t qlen, uint8_t** out) {
+  KV* kv = (KV*)h;
+  std::lock_guard<std::mutex> lk(kv->mu);
+  auto it = kv->m.find(std::string((const char*)q, qlen));
+  if (it == kv->m.end()) return -1;
+  *out = (uint8_t*)malloc(it->second.size() ? it->second.size() : 1);
+  memcpy(*out, it->second.data(), it->second.size());
+  return (long long)it->second.size();
+}
+
+// Deterministic state hash (reference monkey.go GetHash role).
+uint64_t natsm_hash(void* h) {
+  KV* kv = (KV*)h;
+  std::lock_guard<std::mutex> lk(kv->mu);
+  uint32_t c = 0;
+  for (auto& [k, v] : kv->m) {
+    c = crc32ieee(c, (const uint8_t*)k.data(), k.size());
+    c = crc32ieee(c, (const uint8_t*)"\x00", 1);
+    c = crc32ieee(c, (const uint8_t*)v.data(), v.size());
+    c = crc32ieee(c, (const uint8_t*)"\x01", 1);
+  }
+  return ((uint64_t)kv->m.size() << 32) | c;
+}
+
+// Serialize the full state (count, then length-prefixed k/v pairs, ordered)
+// into a malloc'd buffer; returns its size.
+long long natsm_save(void* h, uint8_t** out) {
+  KV* kv = (KV*)h;
+  std::string b;
+  {
+    std::lock_guard<std::mutex> lk(kv->mu);
+    put_u32(b, (uint32_t)kv->m.size());
+    for (auto& [k, v] : kv->m) {
+      put_u32(b, (uint32_t)k.size());
+      b += k;
+      put_u32(b, (uint32_t)v.size());
+      b += v;
+    }
+  }
+  *out = (uint8_t*)malloc(b.size() ? b.size() : 1);
+  memcpy(*out, b.data(), b.size());
+  return (long long)b.size();
+}
+
+// Replace the state from a natsm_save image; 0 ok, -1 malformed.
+int natsm_recover(void* h, const uint8_t* data, size_t len) {
+  KV* kv = (KV*)h;
+  std::map<std::string, std::string> m;
+  size_t pos = 0;
+  if (len < 4) return -1;
+  uint32_t n = get_u32(data);
+  pos = 4;
+  for (uint32_t i = 0; i < n; i++) {
+    if (pos + 4 > len) return -1;
+    uint32_t kl = get_u32(data + pos);
+    pos += 4;
+    if (kl > len - pos) return -1;
+    std::string k((const char*)data + pos, kl);
+    pos += kl;
+    if (pos + 4 > len) return -1;
+    uint32_t vl = get_u32(data + pos);
+    pos += 4;
+    if (vl > len - pos) return -1;
+    m[std::move(k)] = std::string((const char*)data + pos, vl);
+    pos += vl;
+  }
+  std::lock_guard<std::mutex> lk(kv->mu);
+  kv->m = std::move(m);
+  return 0;
+}
+
+void natsm_buf_free(uint8_t* p) { free(p); }
+
+// The update entry point as a raw pointer, for handing to the replication
+// core (natr_enroll's sm_update parameter) through Python without the two
+// libraries linking against each other.
+void* natsm_update_ptr() { return (void*)&natsm_update; }
+
+}  // extern "C"

@@ -1,0 +1,132 @@
+"""Python front for the native C-ABI state machine (``natsm.cpp``).
+
+:class:`NativeKVStateMachine` implements the regular user SM protocol
+(update/lookup/save_snapshot/recover_from_snapshot/close — the contract of
+``statemachine.py``) over a C++ KV instance, the analog of the reference's
+KVTest SM (``internal/tests/kvtest.go:85``).  One instance is shared by
+both planes:
+
+- the **scalar plane** calls through this adapter (ctypes) exactly like
+  any Python SM — lookups, post-eject applies, snapshot save/recover;
+- the **native fast lane** applies committed entries directly in C++
+  (``natraft.cpp apply_native``) via the raw function pointer exposed as
+  :attr:`natsm_update_fn`, with no GIL on the apply path.
+
+``Node._maybe_enroll`` detects the ``natsm_handle`` attribute and attaches
+the instance to the enrolled group.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+from ..statemachine import Result
+
+_lib = None
+_lib_mu = threading.Lock()
+
+
+def _load():
+    global _lib
+    with _lib_mu:
+        if _lib is not None:
+            return _lib
+        path = os.path.join(os.path.dirname(__file__), "libnatsm.so")
+        if not os.path.exists(path):
+            # build on demand like the sibling libraries (__init__.py)
+            import subprocess
+
+            subprocess.run(
+                ["make", "-C", os.path.dirname(__file__), "libnatsm.so"],
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(path)
+        lib.natsm_kv_create.restype = ctypes.c_void_p
+        lib.natsm_update.restype = ctypes.c_uint64
+        lib.natsm_update.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t
+        ]
+        lib.natsm_lookup.restype = ctypes.c_longlong
+        lib.natsm_lookup.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ]
+        lib.natsm_hash.restype = ctypes.c_uint64
+        lib.natsm_hash.argtypes = [ctypes.c_void_p]
+        lib.natsm_save.restype = ctypes.c_longlong
+        lib.natsm_save.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))
+        ]
+        lib.natsm_recover.restype = ctypes.c_int
+        lib.natsm_recover.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t
+        ]
+        lib.natsm_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.natsm_close.argtypes = [ctypes.c_void_p]
+        lib.natsm_update_ptr.restype = ctypes.c_void_p
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    try:
+        return _load() is not None
+    except OSError:
+        return False
+
+
+class NativeKVStateMachine:
+    """Regular (in-memory) user SM backed by the native KV instance."""
+
+    def __init__(self, cluster_id: int, node_id: int) -> None:
+        self._lib = _load()
+        self.cluster_id = cluster_id
+        self.node_id = node_id
+        #: raw handle + update fn pointer for natr_attach_sm
+        self.natsm_handle: int = self._lib.natsm_kv_create()
+        self.natsm_update_fn: int = self._lib.natsm_update_ptr()
+
+    # ---- user SM protocol (scalar plane) ----
+
+    def update(self, cmd: bytes) -> Result:
+        v = self._lib.natsm_update(self.natsm_handle, bytes(cmd), len(cmd))
+        return Result(value=v)
+
+    def lookup(self, query):
+        if query is None:
+            # whole-state probe (bench/CounterSM convention): entry count
+            return int(self._lib.natsm_hash(self.natsm_handle) >> 32)
+        q = query.encode() if isinstance(query, str) else bytes(query)
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.natsm_lookup(self.natsm_handle, q, len(q), ctypes.byref(out))
+        if n < 0:
+            return None
+        try:
+            return bytes(ctypes.string_at(out, n)).decode()
+        finally:
+            self._lib.natsm_buf_free(out)
+
+    def get_hash(self) -> int:
+        return int(self._lib.natsm_hash(self.natsm_handle))
+
+    def save_snapshot(self, w, files, done) -> None:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.natsm_save(self.natsm_handle, ctypes.byref(out))
+        try:
+            data = ctypes.string_at(out, n)
+        finally:
+            self._lib.natsm_buf_free(out)
+        w.write(len(data).to_bytes(8, "little") + data)
+
+    def recover_from_snapshot(self, r, files, done) -> None:
+        n = int.from_bytes(r.read(8), "little")
+        data = r.read(n)
+        if self._lib.natsm_recover(self.natsm_handle, data, len(data)) != 0:
+            raise ValueError("malformed native SM snapshot image")
+
+    def close(self) -> None:
+        if self.natsm_handle:
+            self._lib.natsm_close(self.natsm_handle)
+            self.natsm_handle = 0

@@ -118,6 +118,7 @@ class Node:
         # native core owns the group's steady-state data plane
         self.fastlane = None  # FastLaneManager, set by NodeHost
         self.fast_lane = False
+        self._natsm_attached = False  # native C-ABI SM wired to the lane
         self._next_enroll_try = 0.0
         self._tick_count_pending = 0
         self._snapshotting = threading.Lock()
@@ -703,8 +704,30 @@ class Node:
         if ok:
             self.fast_lane = True
             fl.note_enrolled(self.cluster_id)
+            self._maybe_attach_native_sm(fl)
         else:
             fl.unregister_node(self)
+
+    def _maybe_attach_native_sm(self, fl) -> None:
+        """If the user SM is a native C-ABI instance (natsm.py), let the
+        enrolled group apply committed entries in C++ — the apply/notify
+        rim was the measured ~40us/write Python cost (PERF.md)."""
+        if self.sm.on_disk:
+            return
+        user = getattr(self.sm.managed, "sm", None)
+        handle = getattr(user, "natsm_handle", 0)
+        fn = getattr(user, "natsm_update_fn", 0)
+        if handle and fn:
+            # flag BEFORE attach, applied-read AFTER the flag: an apply
+            # finishing in the window then still calls note_applied (the
+            # native side takes max, so a racing lift is never clobbered);
+            # flag-first with a late read closes the barrier-never-lifts
+            # TOCTOU
+            self._natsm_attached = True
+            if not fl.nat.attach_sm(
+                self.cluster_id, handle, fn, self.sm.get_last_applied()
+            ):
+                self._natsm_attached = False
 
     def _count_eject(self, reason: str) -> None:
         if self.fastlane is not None:
@@ -737,13 +760,22 @@ class Node:
                     self.describe(),
                 )
                 self.fast_lane = False
+                self._natsm_attached = False
                 fl.note_ejected(self.cluster_id)
                 self._stopped.set()
                 return
             self.fast_lane = False
+            was_natsm = self._natsm_attached
+            self._natsm_attached = False
             fl.note_ejected(self.cluster_id)
             if st is None or self.peer is None:
                 return
+            if was_natsm:
+                # native applies bypassed notify_raft_last_applied; catch
+                # raft's applied view up or has_config_change_to_apply()
+                # (committed > applied) would silently refuse every
+                # campaign after the eject — the failover wedge
+                self.peer.notify_raft_last_applied(self.sm.get_last_applied())
             r = self.peer.raft
             log = r.log
             # stable window: native entries are in the LogDB already
@@ -1077,6 +1109,10 @@ class Node:
                         self.peer.notify_raft_last_applied(applied)
                 self.sm.set_batched_last_applied(applied)
                 self.pending_reads.applied(applied)
+                if self._natsm_attached and self.fastlane is not None:
+                    # lift the native-SM attach barrier: the native plane
+                    # applies only past what Python has applied
+                    self.fastlane.nat.note_applied(self.cluster_id, applied)
                 self.nh.engine.set_step_ready(self.cluster_id)
 
     def _save_snapshot(self, t: Task) -> None:

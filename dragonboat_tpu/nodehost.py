@@ -142,20 +142,10 @@ class NodeHost:
                 type=SystemEventType.LOGDB_COMPACTED, cluster_id=cid, node_id=nid
             )
         )
-        # TPU quorum plugin (the north star's plugin/tpuquorum boundary):
-        # "tpu"/"auto" route hot-path tallying through the batched device
-        # engine; "scalar" leaves the pure-host path untouched
-        expert = nhconfig.expert
-        self.quorum_coordinator = None
-        if expert.quorum_engine in ("tpu", "auto"):
-            from .tpuquorum import TpuQuorumCoordinator
-
-            self.quorum_coordinator = TpuQuorumCoordinator(
-                capacity=expert.engine_block_groups
-                or Soft.quorum_engine_block_groups,
-            )
         # native replication fast lane (ExpertConfig.fast_lane): enrolled
-        # groups' steady-state replication runs in C++ (fastlane.py)
+        # groups' steady-state replication runs in C++ (fastlane.py).
+        # Built BEFORE the engine choice: "auto" depends on it.
+        expert = nhconfig.expert
         self.fastlane = None
         if expert.fast_lane:
             from .fastlane import FastLaneManager
@@ -163,6 +153,35 @@ class NodeHost:
             mgr = FastLaneManager(self)
             if mgr.enabled:
                 self.fastlane = mgr
+        # TPU quorum plugin (the north star's plugin/tpuquorum boundary):
+        # "tpu" routes hot-path tallying through the batched device engine;
+        # "scalar" leaves the pure-host path untouched; "auto" picks by
+        # deployment shape + measured dispatch budget (r4 A/B at rung 3:
+        # with the fast lane at ~1.0 enrollment duty the device engine's
+        # per-tick dispatches are pure CPU competition — 6.3k vs 8.8k w/s —
+        # so auto uses the device only when the lane is NOT carrying
+        # steady state, and only when a dispatch fits the latency budget)
+        self.quorum_coordinator = None
+        engine_choice = expert.quorum_engine
+        if engine_choice == "auto":
+            if self.fastlane is not None:
+                engine_choice = "scalar"
+            else:
+                engine_choice = (
+                    "tpu" if self._dispatch_within_budget() else "scalar"
+                )
+            plog.info(
+                "quorum_engine=auto resolved to %s (fast_lane=%s)",
+                engine_choice, self.fastlane is not None,
+            )
+        self.quorum_engine_resolved = engine_choice
+        if engine_choice == "tpu":
+            from .tpuquorum import TpuQuorumCoordinator
+
+            self.quorum_coordinator = TpuQuorumCoordinator(
+                capacity=expert.engine_block_groups
+                or Soft.quorum_engine_block_groups,
+            )
         # engine
         workers = expert.step_worker_count or 4
         self.engine = Engine(
@@ -177,6 +196,49 @@ class NodeHost:
             target=self._tick_worker_main, name="tick-worker", daemon=True
         )
         self._tick_thread.start()
+
+    @staticmethod
+    def _dispatch_within_budget(budget_ms: float = 5.0) -> bool:
+        """Probe one tiny batched-engine dispatch round trip.  A tunneled
+        backend costs ~70ms per dispatch (r2 measurement) — useless for a
+        per-tick engine targeting <5ms commit p99; a local backend costs
+        ~0.2ms.  Only runs for quorum_engine="auto" without the fast lane.
+
+        Runs in a KILLABLE subprocess: backend init can HANG (not just
+        fail) when a tunneled device is unreachable, and NodeHost
+        construction must never block on it."""
+        import subprocess
+        import sys as _sys
+
+        code = (
+            "import time\n"
+            "from dragonboat_tpu.ops.engine import BatchedQuorumEngine\n"
+            "eng = BatchedQuorumEngine(8, 3, event_cap=16)\n"
+            "eng.add_group(1, node_ids=[1, 2, 3], self_id=1)\n"
+            "eng.set_leader(1, term=1, term_start=1, last_index=1)\n"
+            "eng.step(do_tick=True)\n"
+            "ts = []\n"
+            "for _ in range(3):\n"
+            "    t0 = time.perf_counter(); eng.step(do_tick=True)\n"
+            "    ts.append(time.perf_counter() - t0)\n"
+            "ts.sort(); print(ts[1] * 1e3)\n"
+        )
+        try:
+            r = subprocess.run(
+                [_sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=60.0,
+            )
+            if r.returncode != 0 or not r.stdout.strip():
+                plog.warning(
+                    "auto-engine dispatch probe failed: rc=%s", r.returncode
+                )
+                return False
+            p50_ms = float(r.stdout.strip().splitlines()[-1])
+            plog.info("auto-engine dispatch probe: p50 %.2fms", p50_ms)
+            return p50_ms <= budget_ms
+        except Exception as e:
+            plog.warning("auto-engine dispatch probe failed: %r", e)
+            return False
 
     # ---- dirs ----
 

@@ -221,6 +221,16 @@ class StateMachine:
 
     # ---- watermarks ----
 
+    def advance_applied_native(self, index: int, term: int) -> None:
+        """Acknowledge entries applied by the NATIVE plane (fast lane +
+        natsm): the shared SM instance already holds their effects; only
+        the watermark moves here.  Monotonic — a lagging completion batch
+        arriving after an eject-time catch-up must not regress it."""
+        with self._mu:
+            if index > self.last_applied:
+                self.last_applied = index
+                self.last_applied_term = max(self.last_applied_term, term)
+
     def get_last_applied(self) -> int:
         with self._mu:
             return self.last_applied

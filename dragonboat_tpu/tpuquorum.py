@@ -229,13 +229,19 @@ class TpuQuorumCoordinator:
         self._tick_seq += 1
         self._pending.set()
 
-    def _drain_locked(self) -> None:
+    def _drain_locked(self) -> list:
         """Apply staged ops to the engine in staging order (so a
         transition's queued-event purge still covers exactly the events
-        staged before it)."""
+        staged before it).  Returns the cids needing a row recovery —
+        recovery takes node.raft_mu, and the lock order everywhere else is
+        raft_mu -> coord._mu (register's contract), so acquiring raft_mu
+        HERE (under _mu) deadlocks against fast_eject -> register (seen
+        live in the tpu+fastlane chaos run); the caller recovers after
+        releasing _mu."""
         with self._stage_mu:
             ops, self._staged = self._staged, []
             self._contacted.clear()
+        recover = []
         for op in ops:
             kind, cid = op[0], op[1]
             if cid not in self.eng.groups:
@@ -260,24 +266,30 @@ class TpuQuorumCoordinator:
                 elif kind == "follower":
                     self.eng.set_follower(cid, term=op[2])
                 else:  # resync
-                    self._recover_row(cid)
+                    recover.append(cid)
             except (ValueError, KeyError):
                 # unknown peer slot / index past the rebase window: rebuild
                 # the row from scalar state (rare)
-                self._recover_row(cid)
+                recover.append(cid)
+        return recover
 
     def _recover_row(self, cluster_id: int) -> None:
+        """Rebuild a row from scalar state.  Lock order: raft_mu FIRST,
+        then _mu (matching register/fast_eject) — never call under _mu."""
         node = self._nodes.get(cluster_id)
         if node is None:
             return
         with node.raft_mu:
             if node.peer is None:
                 return
-            try:
-                self.eng.rebase(cluster_id)
-            except Exception:
-                pass
-            self._sync_row_locked(node)
+            with self._mu:
+                if cluster_id not in self.eng.groups:
+                    return
+                try:
+                    self.eng.rebase(cluster_id)
+                except Exception:
+                    pass
+                self._sync_row_locked(node)
 
     # ------------------------------------------------------------------
     # the round
@@ -296,6 +308,18 @@ class TpuQuorumCoordinator:
                 plog.exception("tpu quorum round failed")
 
     def _round(self) -> None:
+        recover: list = []
+        try:
+            self._round_inner(recover)
+        finally:
+            if recover:
+                # rare-path row rebuilds, OUTSIDE _mu (lock order: raft_mu
+                # then _mu); the recovered rows step next round
+                for cid in dict.fromkeys(recover):
+                    self._recover_row(cid)
+                self._pending.set()
+
+    def _round_inner(self, recover: list) -> None:
         with self._mu:
             seq = self._tick_seq
             # catch up missed ticks (a slow round — first jit compile,
@@ -305,7 +329,7 @@ class TpuQuorumCoordinator:
             deficit = min(seq - self._tick_seen, 4) if self.drive_ticks else 0
             do_tick = deficit > 0
             self._tick_seen = seq
-            self._drain_locked()
+            recover.extend(self._drain_locked())
             if not (
                 do_tick
                 or self.eng._acks

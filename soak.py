@@ -1,0 +1,509 @@
+"""Soak driver — the Drummer analog (reference ``docs/test.md:6-36``).
+
+Opt-in, minutes-long chaos soak over the REAL deployment shape: three
+NodeHost processes on framed TCP with durable native storage and the fast
+lane on, G Raft groups replicated across all three.  For N minutes the
+parent repeatedly ``kill -9``s a random rank and restarts it against the
+same data dirs (WAL replay + snapshot catch-up), while every rank runs
+continuous client load.  Aggressive snapshot settings keep snapshot
+save/compact/stream churning throughout.
+
+Verification, continuously and at the end:
+
+- **cross-replica state hashes** (reference ``monkey.go:110-144``): at
+  every converge window the parent pauses load, waits for equal applied
+  indices on every live rank, and compares per-group state hashes;
+- **linearizability** (reference Jepsen/Knossos role): every rank records
+  an invoke/response history of puts and linearizable reads on per-group
+  shared keys (wall-clock timestamps — one box); the parent merges all
+  histories and runs ``linearizability.check_linearizable`` per key;
+- **fast-lane invariants**: dropped apply spans must be 0 on every rank.
+
+On failure the run's artifacts (per-rank histories, rank stderr logs, the
+failure report) are preserved in the run directory and its path printed.
+
+Usage::
+
+    python soak.py --minutes 10 --groups 16        # the make soak target
+    python soak.py --minutes 1 --groups 8          # quick smoke
+
+Exit code 0 = green.  Prints one JSON summary line last.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+# --------------------------------------------------------------------- rank
+
+
+class _KVSM:
+    def __init__(self, cluster_id, node_id):
+        self.kv = {}
+
+    def update(self, cmd):
+        k, v = cmd.decode().split("=", 1)
+        self.kv[k] = v
+        from dragonboat_tpu import Result
+
+        return Result(value=len(self.kv))
+
+    def lookup(self, query):
+        return self.kv.get(query)
+
+    def save_snapshot(self, w, files, done):
+        data = json.dumps(sorted(self.kv.items())).encode()
+        w.write(len(data).to_bytes(8, "little") + data)
+
+    def recover_from_snapshot(self, r, files, done):
+        n = int.from_bytes(r.read(8), "little")
+        self.kv = dict(json.loads(r.read(n).decode()))
+
+    def close(self):
+        pass
+
+
+def rank_main() -> int:
+    from dragonboat_tpu import Config, NodeHost, NodeHostConfig
+    from dragonboat_tpu.config import ExpertConfig
+
+    rank = int(os.environ["SOAK_RANK"])
+    groups = int(os.environ["SOAK_GROUPS"])
+    threads = int(os.environ.get("SOAK_THREADS", "4"))
+    addrs = {
+        i + 1: a for i, a in enumerate(os.environ["SOAK_ADDRS"].split(","))
+    }
+    base = os.environ["SOAK_DIR"]
+    nid = rank + 1
+
+    nh = NodeHost(
+        NodeHostConfig(
+            node_host_dir=os.path.join(base, f"nh{rank}"),
+            rtt_millisecond=20,
+            raft_address=addrs[nid],
+            expert=ExpertConfig(fast_lane=True, logdb_shards=2),
+        )
+    )
+    cids = list(range(1, groups + 1))
+    user_sms = {}
+
+    def _mk_sm(cluster_id, node_id):
+        sm = _KVSM(cluster_id, node_id)
+        user_sms[cluster_id] = sm
+        return sm
+
+    for cid in cids:
+        nh.start_cluster(
+            addrs, False, _mk_sm,
+            Config(
+                cluster_id=cid, node_id=nid, election_rtt=10,
+                heartbeat_rtt=1,
+                # aggressive: constant snapshot + compaction churn, and a
+                # restarted replica far behind catches up via streaming
+                snapshot_entries=100, compaction_overhead=20,
+            ),
+        )
+
+    hist_path = os.path.join(base, f"history.r{rank}.{os.getpid()}.jsonl")
+    hist_f = open(hist_path, "a", buffering=1)
+    hist_mu = threading.Lock()
+
+    def record(client, kind, key, value, t0, t1, ok):
+        with hist_mu:
+            hist_f.write(json.dumps({
+                "client": client, "kind": kind, "key": key,
+                "value": value, "invoke": t0, "ret": t1, "ok": ok,
+            }) + "\n")
+
+    paused = threading.Event()
+    stopped = threading.Event()
+    # linearizability histories only for SAMPLED groups, written by ONE
+    # paced client per rank: the Wing & Gong search cost scales with
+    # per-key history length and concurrency, so the recorded stream is
+    # deliberately low-rate while the unrecorded load threads provide the
+    # actual stress (reference: Drummer checks sampled keys too)
+    sampled = cids[: max(1, int(os.environ.get("SOAK_SAMPLE", "4")))]
+
+    def history_client():
+        client = rank
+        rng = random.Random(client * 7919 + os.getpid())
+        session = {}
+        while not stopped.is_set():
+            if paused.is_set():
+                time.sleep(0.05)
+                continue
+            cid = rng.choice(sampled)
+            node = nh.get_node(cid)
+            if node is None or not node.is_leader():
+                time.sleep(0.05)
+                continue
+            key = f"g{cid}:x{rng.randrange(2)}"
+            t0 = time.time()
+            is_put = rng.random() < 0.6
+            try:
+                if is_put:
+                    val = f"r{rank}n{rng.randrange(1 << 30)}"
+                    s = session.get(cid)
+                    if s is None:
+                        s = session[cid] = nh.get_noop_session(cid)
+                    rs = nh.propose(s, f"{key}={val}".encode(), timeout=5.0)
+                    r = rs.wait(5.0)
+                    record(client, "put", key, val, t0, time.time()
+                           if r.completed else None, bool(r.completed))
+                else:
+                    v = nh.sync_read(cid, key, timeout=5.0)
+                    record(client, "get", key, v, t0, time.time(), True)
+            except Exception:
+                # timeout/dropped: outcome unknown — the checker treats a
+                # None ret as an op concurrent with everything after it
+                record(client, "put" if is_put else "get",
+                       key, val if is_put else None, t0, None, False)
+            time.sleep(0.4)  # pace: bounded per-key history length
+
+    def load(tid):
+        rng = random.Random((rank * 100 + tid) * 104729 + os.getpid())
+        session = {}
+        while not stopped.is_set():
+            if paused.is_set():
+                time.sleep(0.05)
+                continue
+            cid = rng.choice(cids)
+            node = nh.get_node(cid)
+            if node is None or not node.is_leader():
+                time.sleep(0.002)
+                continue
+            try:
+                s = session.get(cid)
+                if s is None:
+                    s = session[cid] = nh.get_noop_session(cid)
+                k = f"w{rng.randrange(64)}"
+                rs = nh.propose(
+                    s, f"{k}=t{tid}n{rng.randrange(1 << 30)}".encode(),
+                    timeout=5.0,
+                )
+                rs.wait(5.0)
+                if rng.random() < 0.1:
+                    nh.sync_read(cid, k, timeout=5.0)
+            except Exception:
+                time.sleep(0.02)
+
+    threading.Thread(target=history_client, daemon=True).start()
+    for tid in range(threads):
+        threading.Thread(target=load, args=(tid,), daemon=True).start()
+
+    def emit(tag, obj=None):
+        sys.stdout.write(tag + (" " + json.dumps(obj) if obj else "") + "\n")
+        sys.stdout.flush()
+
+    emit("READY", {"rank": rank, "pid": os.getpid()})
+    try:
+        for line in sys.stdin:
+            cmd = line.strip()
+            if cmd == "PAUSE":
+                paused.set()
+                time.sleep(0.3)  # let in-flight ops drain
+                emit("PAUSED")
+            elif cmd == "RESUME":
+                paused.clear()
+                emit("RESUMED")
+            elif cmd == "HASHES":
+                import zlib
+
+                out = {}
+                for cid in cids:
+                    node = nh.get_node(cid)
+                    sm = node.sm
+                    # manager hash (sessions+applied+membership) PLUS the
+                    # user SM content hash — the manager hash alone would
+                    # miss divergent KV state at equal applied indices
+                    # (kvtest.go GetHash role)
+                    user = user_sms.get(cid)
+                    kv_hash = (
+                        zlib.crc32(repr(sorted(user.kv.items())).encode())
+                        if user is not None
+                        else 0
+                    )
+                    out[cid] = [
+                        sm.get_last_applied(), sm.get_hash(), kv_hash
+                    ]
+                fl = nh.fastlane
+                emit("HASHES", {
+                    "rank": rank, "groups": out,
+                    "dropped_spans": fl.dropped_spans if fl else 0,
+                    "enrolled": (
+                        fl.stats().get("enrolled_replicas", 0) if fl else 0
+                    ),
+                })
+            elif cmd == "EXIT":
+                break
+    finally:
+        stopped.set()
+        hist_f.close()
+        try:
+            nh.stop()
+        except Exception:
+            pass
+    return 0
+
+
+# ------------------------------------------------------------------- parent
+
+
+def _ports(n):
+    out = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        out.append(s.getsockname()[1])
+        s.close()
+    return out
+
+
+class Rank:
+    def __init__(self, idx, env, logdir):
+        self.idx = idx
+        self.env = env
+        self.logdir = logdir
+        self.proc = None
+        self.log = None
+        self.lines = None
+
+    def start(self):
+        import queue as _q
+
+        self.log = open(
+            os.path.join(self.logdir, f"rank{self.idx}.{int(time.time())}.log"),
+            "w",
+        )
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--rank"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=self.log, env=self.env, text=True,
+        )
+        self.lines = _q.Queue()
+
+        def _reader(p, q):
+            for ln in p.stdout:
+                q.put(ln)
+            q.put(None)
+
+        threading.Thread(
+            target=_reader, args=(self.proc, self.lines), daemon=True
+        ).start()
+
+    def expect(self, tag, timeout):
+        import queue as _q
+
+        deadline = time.time() + timeout
+        while True:
+            left = deadline - time.time()
+            if left <= 0:
+                raise TimeoutError(f"rank{self.idx}: no {tag} in {timeout}s")
+            try:
+                ln = self.lines.get(timeout=min(left, 1.0))
+            except _q.Empty:
+                continue
+            if ln is None:
+                raise RuntimeError(f"rank{self.idx} died waiting for {tag}")
+            if ln.startswith(tag):
+                rest = ln[len(tag):].strip()
+                return json.loads(rest) if rest else None
+
+    def send(self, cmd):
+        self.proc.stdin.write(cmd + "\n")
+        self.proc.stdin.flush()
+
+    def kill9(self):
+        self.proc.kill()  # SIGKILL
+        self.proc.wait()
+        self.log.close()
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+
+def _converge_check(ranks, groups, timeout=90.0):
+    """Pause load everywhere, wait for equal applied indices per group on
+    every live rank, compare state hashes.  Returns the hash map or raises."""
+    live = [r for r in ranks if r.alive()]
+    for r in live:
+        r.send("PAUSE")
+    for r in live:
+        r.expect("PAUSED", 30)
+    deadline = time.time() + timeout
+    last = None
+    try:
+        while True:
+            reports = []
+            for r in live:
+                r.send("HASHES")
+                reports.append(r.expect("HASHES", 30))
+            for rep in reports:
+                assert rep["dropped_spans"] == 0, (
+                    f"rank{rep['rank']} dropped apply spans"
+                )
+            bad = []
+            for cid in range(1, groups + 1):
+                cells = [rep["groups"][str(cid)] for rep in reports]
+                applied = {c[0] for c in cells}
+                hashes = {tuple(c[1:]) for c in cells}  # manager + user SM
+                if len(applied) != 1 or len(hashes) != 1:
+                    bad.append((cid, cells))
+            last = bad
+            if not bad:
+                return reports
+            if time.time() > deadline:
+                raise AssertionError(
+                    f"replicas diverged after {timeout}s settle: "
+                    f"{len(bad)} groups, sample {bad[:3]}"
+                )
+            time.sleep(1.0)
+    finally:
+        for r in live:
+            if r.alive():
+                r.send("RESUME")
+                r.expect("RESUMED", 30)
+
+
+def _check_histories(base, groups):
+    from dragonboat_tpu.linearizability import Op, check_linearizable
+
+    INF = float("inf")
+    ops = []
+    for fn in sorted(os.listdir(base)):
+        if not fn.startswith("history."):
+            continue
+        with open(os.path.join(base, fn)) as f:
+            for ln in f:
+                try:
+                    d = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue  # torn final line from a kill -9
+                ops.append(Op(
+                    client=d["client"], kind=d["kind"], key=d["key"],
+                    value=d["value"], invoke=d["invoke"],
+                    ret=d["ret"] if d["ret"] is not None else INF,
+                    ok=bool(d["ok"]),
+                ))
+    ok, bad = check_linearizable(ops)
+    return ok, bad, len(ops)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--minutes", type=float, default=10.0)
+    ap.add_argument("--groups", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the run dir even on success")
+    args = ap.parse_args()
+
+    rng = random.Random(args.seed or int(time.time()))
+    base = tempfile.mkdtemp(prefix="dbtpu-soak-")
+    ports = _ports(3)
+    addrs = ",".join(f"127.0.0.1:{p}" for p in ports)
+    print(f"# soak: {args.minutes} min, {args.groups} groups, dir {base}",
+          file=sys.stderr)
+
+    ranks = []
+    for i in range(3):
+        env = dict(os.environ)
+        env.update({
+            "SOAK_RANK": str(i), "SOAK_GROUPS": str(args.groups),
+            "SOAK_ADDRS": addrs, "SOAK_DIR": base,
+        })
+        ranks.append(Rank(i, env, base))
+    t0 = time.time()
+    deadline = t0 + args.minutes * 60
+    kills = 0
+    converges = 0
+    failure = None
+    try:
+        for r in ranks:
+            r.start()
+        for r in ranks:
+            r.expect("READY", 120)
+        time.sleep(5.0)  # initial elections + load ramp
+
+        next_kill = time.time() + rng.uniform(10, 25)
+        next_converge = time.time() + 30.0
+        while time.time() < deadline:
+            time.sleep(1.0)
+            now = time.time()
+            if now >= next_kill:
+                victim = rng.choice(ranks)
+                print(f"# t+{now - t0:.0f}s kill -9 rank{victim.idx}",
+                      file=sys.stderr)
+                victim.kill9()
+                kills += 1
+                time.sleep(rng.uniform(2, 8))
+                victim.start()
+                victim.expect("READY", 180)
+                next_kill = time.time() + rng.uniform(15, 40)
+            if now >= next_converge:
+                print(f"# t+{now - t0:.0f}s converge check", file=sys.stderr)
+                _converge_check(ranks, args.groups)
+                converges += 1
+                next_converge = time.time() + rng.uniform(30, 60)
+
+        # final: settle, converge, stop cleanly, check histories
+        print("# final converge", file=sys.stderr)
+        reports = _converge_check(ranks, args.groups, timeout=120.0)
+        converges += 1
+        enrolled = [rep.get("enrolled", 0) for rep in reports]
+        for r in ranks:
+            if r.alive():
+                r.send("EXIT")
+        for r in ranks:
+            try:
+                r.proc.wait(timeout=20)
+            except Exception:
+                r.proc.kill()
+        ok, bad, n_ops = _check_histories(base, args.groups)
+        if not ok:
+            failure = f"history not linearizable on keys {bad[:8]}"
+    except Exception as e:  # noqa: BLE001 — summarize, keep artifacts
+        failure = f"{type(e).__name__}: {e}"
+        ok = False
+        n_ops = 0
+        enrolled = []
+    finally:
+        for r in ranks:
+            try:
+                if r.alive():
+                    r.proc.kill()
+            except Exception:
+                pass
+
+    summary = {
+        "soak_ok": failure is None,
+        "minutes": args.minutes,
+        "groups": args.groups,
+        "kills": kills,
+        "converge_checks": converges,
+        "history_ops": n_ops,
+        "enrolled_final": enrolled,
+        "error": failure,
+        "artifacts": base if (failure or args.keep) else None,
+    }
+    if failure is None and not args.keep:
+        import shutil
+
+        shutil.rmtree(base, ignore_errors=True)
+    print(json.dumps(summary))
+    return 0 if failure is None else 1
+
+
+if __name__ == "__main__":
+    if "--rank" in sys.argv:
+        sys.exit(rank_main())
+    sys.exit(main())
