@@ -3,9 +3,12 @@
 
 PY ?= python
 
-.PHONY: test native soak soak-smoke bench dryrun
+.PHONY: test test-all native soak soak-smoke bench dryrun
 
 test: native
+	$(PY) -m pytest tests/ -x -q -m "not slow"
+
+test-all: native
 	$(PY) -m pytest tests/ -x -q
 
 native:

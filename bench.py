@@ -323,6 +323,51 @@ def _slim_e2e(e2e: dict) -> dict:
     return out
 
 
+def _run_rung4(n_groups: int = 65_536, rounds: int = 8) -> dict:
+    """Rung-4 batched-engine numbers (BASELINE.md ladder): 64k groups ×
+    5 peer slots — every group commits once per round via the vectorized
+    ack_block ingest (quorum of 5 = self + 2 acks), with sampled
+    commit-watermark queries interleaved as the read-side probe.  The
+    correctness twin (differential vs scalar oracles + membership/leader
+    churn, and the genuinely mixed-load variant) is tests/test_rung4.py."""
+    from dragonboat_tpu.ops.engine import BatchedQuorumEngine
+
+    eng = BatchedQuorumEngine(n_groups, 5, event_cap=4 * n_groups)
+    peers = [1, 2, 3, 4, 5]
+    for cid in range(1, n_groups + 1):
+        eng.add_group(cid, node_ids=peers, self_id=1)
+        eng.set_leader(cid, term=1, term_start=1, last_index=1)
+    eng._upload_dirty()
+    rows = np.arange(n_groups, dtype=np.int32)
+    rows3 = np.concatenate([rows, rows, rows])
+    slots = np.concatenate([
+        np.zeros(n_groups, np.int32), np.ones(n_groups, np.int32),
+        np.full(n_groups, 2, np.int32),
+    ])
+    # warmup (compile)
+    eng.ack_block(rows3, slots, np.full(3 * n_groups, 2, np.int32))
+    eng.step(do_tick=False)
+    reads = writes = 0
+    read_cids = list(range(1, n_groups + 1, max(1, n_groups // 576)))
+    t0 = time.perf_counter()
+    for rnd in range(3, rounds + 3):
+        eng.ack_block(rows3, slots, np.full(3 * n_groups, rnd, np.int32))
+        eng.step(do_tick=False)
+        writes += n_groups
+        for cid in read_cids:
+            eng.committed_index(cid)
+            reads += 1
+    elapsed = time.perf_counter() - t0
+    assert eng.committed_index(1) == rounds + 2
+    return {
+        "groups": n_groups,
+        "peer_slots": 5,
+        "rounds": rounds,
+        "writes_per_sec": round(writes / elapsed, 1),
+        "reads_per_sec": round(reads / elapsed, 1),
+    }
+
+
 def main() -> None:
     # ---- e2e NodeHost numbers first (ladder rung 3; VERDICT r2 item 1).
     # The TPU chip is free at this point — the probe subprocess exits and
@@ -415,6 +460,15 @@ def main() -> None:
         )
     except Exception as e:
         detail["host_loop"] = {"error": repr(e)}
+
+    # rung 4 of the config ladder (BASELINE.md): 64k groups × 5 peer slots
+    try:
+        detail["rung4"] = _run_rung4(
+            int(os.environ.get("BENCH_RUNG4_GROUPS", "65536")),
+            int(os.environ.get("BENCH_RUNG4_ROUNDS", "8")),
+        )
+    except Exception as e:
+        detail["rung4"] = {"error": repr(e)}
 
     # full detail (per-rank stats and all) goes to a FILE; the stdout line
     # stays small enough that the driver's 2000-char tail capture can never

@@ -423,7 +423,12 @@ class FastLaneManager:
         for i in range(len(cids)):
             per.setdefault(int(cids[i]), []).append(i)
         for cid, idxs in per.items():
-            node = self.nh.get_node(cid)
+            # dict lookup, NOT nh.get_node (which RAISES for a removed
+            # cluster — an exception here would drop the whole popped
+            # batch, or abort an eject between nat.eject and the blob
+            # enqueue; NodeHost.stop clears _clusters before node stops,
+            # making that deterministic at shutdown)
+            node = self.nh._clusters.get(cid)
             if node is None:
                 continue
             last = idxs[-1]
