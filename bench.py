@@ -49,7 +49,7 @@ def _note(msg: str) -> None:
 PROBE_LOG: list = []
 
 
-def _probe_tpu(timeout: float = 120.0, tries: int = 3):
+def _probe_tpu(timeout: float = 90.0, tries: int = 2):
     """Probe the default (TPU) backend in a SUBPROCESS with a timeout.
 
     The tunneled axon backend can hang (not just fail) during init —
