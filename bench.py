@@ -83,7 +83,8 @@ def _probe_tpu(timeout: float = 90.0, tries: int = 2):
             rec["timeout_s"] = timeout
         PROBE_LOG.append(rec)
         _note(f"tpu probe attempt failed: {rec}")
-        time.sleep(2.0 * (attempt + 1))
+        if attempt + 1 < tries:
+            time.sleep(2.0 * (attempt + 1))
     return None
 
 

@@ -377,7 +377,8 @@ struct Remote {
   // flush_remotes runs concurrently on the round thread and the shard
   // committers, and the swap and append are separate mu sections — without
   // this, a later-queued REPLICATE could be appended before an earlier one
-  // on the single ordered stream, tripping EV_GAP ejects on followers
+  // on the single ordered stream, forcing gap punts + step-path ejects on
+  // followers
   std::mutex flush_mu;
   std::condition_variable cv;
   std::string buf;          // complete frames
@@ -406,7 +407,7 @@ enum EventCode {
   // protocol sub-causes (diagnostics; all handled as EV_PROTOCOL)
   EV_TERM_MISMATCH = 5,
   EV_WRONG_ROLE = 6,
-  EV_GAP = 7,
+  EV_GAP = 7,   // historical (gaps now punt to the router re-ingest path)
   EV_PREV_TERM = 8,
   EV_REJECT_RESP = 9,
   EV_UNKNOWN_PEER = 10,
@@ -1178,7 +1179,12 @@ struct Engine {
   // ------------------------------------------------------------ ingest
 
   // Handle one fast-path message for an ACTIVE group.  Returns false when
-  // the message must go to Python (group flips to EJECTING first).
+  // the message must go to Python.  Most refusals flip the group to
+  // EJECTING first, but a REPLICATE past the local tail PUNTS while the
+  // group stays ACTIVE: the missing frames are usually queued in order
+  // behind the Python router (they took the leftover path during a
+  // (re)enrollment window), so the enrolled step re-ingests the sequence
+  // with no eject — a false return does NOT imply an eject is underway.
   bool handle_fast(Group* g, const ParsedMsg& m, const uint8_t* d) {
     std::lock_guard<std::mutex> lk(g->mu);
     if (g->state != G_ACTIVE) return false;
@@ -1220,7 +1226,13 @@ struct Engine {
           return true;
         }
         if (m.log_index > g->last_index) {
-          begin_eject(g, EV_GAP);  // gap: needs Python retry logic
+          // gap: the missing frames usually took the leftover path while
+          // this group was (re)enrolling and are queued IN ORDER behind
+          // the router/mq — punting this frame onto the same path lets
+          // the enrolled step re-ingest everything in sequence with no
+          // eject.  A genuine loss still converges: the re-ingest refuses
+          // again and the step path ejects (step-msgs), and the leader's
+          // progress-timeout resend covers the rest.
           return false;
         }
         // prev-term check where verifiable (enrollment guarantees
