@@ -530,8 +530,12 @@ def rank_main() -> int:
                 engine_block_groups=max(groups, 64),
                 logdb_shards=int(os.environ.get("E2E_SHARDS", "4")),
                 fast_lane=fast_lane,
+                # 4ms: the round-4 sweep (0.5/2/4/6/8ms at rung 3, native
+                # SM) found the best throughput/latency balance here —
+                # w=4 gave 17.3k w/s at p50 10ms / p99 60ms vs 15k at
+                # p99 90-120ms for the old 2ms (PERF.md)
                 fast_lane_commit_window_ms=float(
-                    os.environ.get("E2E_COMMIT_WINDOW_MS", "2.0")
+                    os.environ.get("E2E_COMMIT_WINDOW_MS", "4.0")
                 ),
             ),
         )
