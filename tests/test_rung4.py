@@ -80,6 +80,26 @@ class FakeNode:
         pass
 
 
+def _assert_parity(eng, oracles, cids, tag, timeout=8.0):
+    """commitIndex bit-identity with callback-timing tolerance: the
+    coordinator's background round thread delivers offload_commit OUTSIDE
+    its lock, so the oracle may trail the engine by one callback for a
+    moment — the VALUES still must match exactly at quiescence."""
+    deadline = time.time() + timeout
+    while True:
+        bad = []
+        for cid in cids:
+            got = eng.committed_index(cid)
+            want = oracles[cid].peer.raft.log.committed
+            if got != want:
+                bad.append((cid, got, want))
+        if not bad:
+            return
+        if time.time() > deadline:
+            raise AssertionError((tag, bad[:4]))
+        time.sleep(0.01)
+
+
 def _mk_oracle(cid):
     r = new_test_raft(1, PEERS, 10, 1, InMemLogDB())
     r.cluster_id = cid
@@ -149,10 +169,7 @@ def test_rung4_64k_groups_mixed_load_with_churn():
                 eng.committed_index(cid)
                 reads += 1
             # bit-identity on every sampled group, every round
-            for cid, node in oracles.items():
-                got = eng.committed_index(cid)
-                want = node.peer.raft.log.committed
-                assert got == want, (rnd, cid, got, want)
+            _assert_parity(eng, oracles, list(oracles), f"round {rnd}")
         elapsed = time.perf_counter() - t0
         # every bulk group committed every round
         for g in (SAMPLE, SAMPLE + n_bulk // 2, N - 1):
@@ -213,11 +230,9 @@ def test_rung4_64k_groups_mixed_load_with_churn():
             coord.ack(cid, 2, idx)
             coord.ack(cid, 3, idx)
         coord.flush()
+        _assert_parity(eng, oracles, changed, "membership-change")
         for cid in changed:
-            got = eng.committed_index(cid)
-            want = oracles[cid].peer.raft.log.committed
-            assert got == want, (cid, got, want)
-            assert want >= 1 + rounds + 1
+            assert oracles[cid].peer.raft.log.committed >= 1 + rounds + 1
 
         # --- leader transfer on sampled groups: step down, win a new
         # election at a higher term, commit again
@@ -244,9 +259,14 @@ def test_rung4_64k_groups_mixed_load_with_churn():
                 ))
                 coord.vote(cid, p, True)
         coord.flush()
+        deadline = time.time() + 8
         for cid in transferred:
             node = oracles[cid]
             r = node.peer.raft
+            # the won-flag callback (offload_election) is delivered outside
+            # the coordinator lock; poll briefly like _assert_parity
+            while not r.is_leader() and time.time() < deadline:
+                time.sleep(0.01)
             assert r.is_leader(), cid
             coord.set_leader(
                 cid, term=r.term, term_start=r.log.last_index(),
@@ -263,9 +283,6 @@ def test_rung4_64k_groups_mixed_load_with_churn():
                 ))
                 coord.ack(cid, p, idx)
         coord.flush()
-        for cid in transferred:
-            got = eng.committed_index(cid)
-            want = oracles[cid].peer.raft.log.committed
-            assert got == want, (cid, got, want)
+        _assert_parity(eng, oracles, transferred, "leader-transfer")
     finally:
         coord.stop()
