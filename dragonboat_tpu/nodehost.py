@@ -125,7 +125,22 @@ class NodeHost:
             self._push_snapshot_status,
             push_delay_ms=Soft.snapshot_status_push_delay_ms,
         )
-        # transport
+        # transport.  The listener accepts connections the moment it binds,
+        # and a restarted host's peers reconnect INSTANTLY under load — the
+        # router must drop inbound batches until construction completes
+        # (raft resends cover the gap; round-4 soak: dispatching into a
+        # half-built NodeHost killed receiver threads with AttributeError)
+        self._router_ready = False
+        self._router_gated_drops = 0
+        # quorum_engine="auto" may need a probe dispatch (a killable
+        # subprocess, up to 60s against a hung tunneled backend).  Run it
+        # BEFORE the listener binds whenever the fast lane cannot be on —
+        # inside the gated window it would silently black-hole inbound
+        # traffic for the whole probe
+        expert = nhconfig.expert
+        self._probe_ok = None
+        if expert.quorum_engine == "auto" and not expert.fast_lane:
+            self._probe_ok = self._dispatch_within_budget()
         self.node_registry = Registry()
         self.transport: Transport = create_transport(
             nhconfig,
@@ -145,7 +160,6 @@ class NodeHost:
         # native replication fast lane (ExpertConfig.fast_lane): enrolled
         # groups' steady-state replication runs in C++ (fastlane.py).
         # Built BEFORE the engine choice: "auto" depends on it.
-        expert = nhconfig.expert
         self.fastlane = None
         if expert.fast_lane:
             from .fastlane import FastLaneManager
@@ -167,9 +181,14 @@ class NodeHost:
             if self.fastlane is not None:
                 engine_choice = "scalar"
             else:
-                engine_choice = (
-                    "tpu" if self._dispatch_within_budget() else "scalar"
+                # usually probed pre-listener; the fallback covers a fast
+                # lane that was requested but could not enable
+                ok = (
+                    self._probe_ok
+                    if self._probe_ok is not None
+                    else self._dispatch_within_budget()
                 )
+                engine_choice = "tpu" if ok else "scalar"
             plog.info(
                 "quorum_engine=auto resolved to %s (fast_lane=%s)",
                 engine_choice, self.fastlane is not None,
@@ -196,6 +215,7 @@ class NodeHost:
             target=self._tick_worker_main, name="tick-worker", daemon=True
         )
         self._tick_thread.start()
+        self._router_ready = True
 
     @staticmethod
     def _dispatch_within_budget(budget_ms: float = 5.0) -> bool:
@@ -743,6 +763,15 @@ class NodeHost:
         Messages are queued first and step-readiness is signalled once per
         touched group — a batch regularly carries several messages for the
         same group and per-message wakeups are measurable overhead."""
+        if not self._router_ready:
+            # mid-construction: drop, the senders retry.  Visible, not
+            # silent — a long gated window looks like a dead peer
+            self._router_gated_drops += 1
+            if self._router_gated_drops == 1:
+                plog.warning(
+                    "inbound batch dropped: NodeHost still constructing"
+                )
+            return
         touched = {}
         src = batch.source_address
         for m in batch.requests:

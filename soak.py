@@ -232,8 +232,13 @@ def rank_main() -> int:
                         if user is not None
                         else 0
                     )
+                    r = node.peer.raft if node.peer is not None else None
                     out[cid] = [
-                        sm.get_last_applied(), sm.get_hash(), kv_hash
+                        sm.get_last_applied(), sm.get_hash(), kv_hash,
+                        # diagnostics (not compared): raft view + lane state
+                        r.log.committed if r else -1,
+                        r.state.name if r else "?",
+                        int(node.fast_lane),
                     ]
                 fl = nh.fastlane
                 emit("HASHES", {
@@ -355,7 +360,7 @@ def _converge_check(ranks, groups, timeout=90.0):
             for cid in range(1, groups + 1):
                 cells = [rep["groups"][str(cid)] for rep in reports]
                 applied = {c[0] for c in cells}
-                hashes = {tuple(c[1:]) for c in cells}  # manager + user SM
+                hashes = {tuple(c[1:3]) for c in cells}  # manager + user SM
                 if len(applied) != 1 or len(hashes) != 1:
                     bad.append((cid, cells))
             last = bad
