@@ -229,6 +229,22 @@ class Engine:
         cache[idx] = (csi, mine)
         return mine
 
+    def _rearm_unknown(self, ready, nodes, work_ready) -> None:
+        """Defense in depth against lost wakeups: a ready bit consumed for
+        a cid the worker's map does not know is RE-ARMED when the
+        authoritative map knows it (a signal racing cluster registration
+        would otherwise be dropped — consumed bit, no retry — and a
+        one-shot wakeup like the initial-recovery task is lost forever).
+        A cid unknown to the authoritative map (stopped cluster) stays
+        dropped."""
+        missing = [cid for cid in ready if cid not in nodes]
+        if not missing:
+            return
+        _, all_nodes = self.get_nodes()
+        for cid in missing:
+            if cid in all_nodes:
+                work_ready.cluster_ready(cid)
+
     # ---- step path (reference stepWorkerMain/processSteps :860-1010) ----
 
     def _step_worker_main(self, idx: int) -> None:
@@ -248,6 +264,7 @@ class Engine:
                 self._step_cache, idx, self.step_ready.partitioner
             )
             ready = self.step_ready.get_ready(idx)
+            self._rearm_unknown(ready, nodes, self.step_ready)
             active = [nodes[cid] for cid in ready if cid in nodes]
             if active:
                 try:
@@ -356,6 +373,7 @@ class Engine:
                 self._apply_cache, idx, self.apply_ready.partitioner
             )
             ready = self.apply_ready.get_ready(idx)
+            self._rearm_unknown(ready, nodes, self.apply_ready)
             for cid in ready:
                 n = nodes.get(cid)
                 if n is None:

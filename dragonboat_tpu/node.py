@@ -154,7 +154,12 @@ class Node:
                 self.peer.raft.device_ticks = True
             coord.register(self)
         # queue initial recovery so the apply worker restores the newest
-        # local snapshot before any new entries apply
+        # local snapshot before any new entries apply.  The WAKEUP is the
+        # caller's job AFTER registering the node (reference
+        # nodehost.go:1584-1587 clusters.Store -> csi++ -> setApplyReady):
+        # signalling here races the busy apply workers, which consume the
+        # ready bit, find no node in their map, and silently drop it — the
+        # node then never initializes (soak-caught restart wedge)
         self.to_apply.enqueue(
             Task(
                 cluster_id=self.cluster_id,
@@ -164,7 +169,6 @@ class Node:
                 new_node=new_node,
             )
         )
-        self.nh.engine.set_apply_ready(self.cluster_id)
 
     # ---- TPU quorum plugin appliers (called from the coordinator round
     # thread; every effect re-checked under raftMu with scalar guards) ----
@@ -997,6 +1001,18 @@ class Node:
                 self.nh.send_message(m)
 
     def process_raft_update(self, ud: Update) -> None:
+        # a restore update can carry BOTH the snapshot and the log tail
+        # past it: the snapshot must move the logreader window FIRST or the
+        # append trips the gap check and the committer retries the same
+        # update forever (soak-caught: restarted follower wedged with
+        # "gap in log" after a streamed snapshot install).  Reference
+        # ordering: node.go applySnapshotAndUpdate runs the snapshot half
+        # before entry processing.
+        if not is_empty_snapshot(ud.snapshot):
+            try:
+                self.logreader.apply_snapshot(ud.snapshot)
+            except Exception as e:  # SnapshotOutOfDate
+                plog.warning("%s apply_snapshot: %s", self.describe(), e)
         self.logreader.append(ud.entries_to_save)
         for m in ud.messages:
             if m.type == MT.REPLICATE:
@@ -1017,10 +1033,8 @@ class Node:
             plog.info(
                 "%s installing snapshot index %d", self.describe(), ss.index
             )
-            try:
-                self.logreader.apply_snapshot(ss)
-            except Exception as e:  # SnapshotOutOfDate
-                plog.warning("%s apply_snapshot: %s", self.describe(), e)
+            # the logreader window already moved at the top of
+            # process_raft_update (before the entry append)
             self.to_apply.enqueue(
                 Task(
                     cluster_id=self.cluster_id,

@@ -180,15 +180,19 @@ class NodeHost:
         if engine_choice == "auto":
             if self.fastlane is not None:
                 engine_choice = "scalar"
+            elif self._probe_ok is not None:
+                engine_choice = "tpu" if self._probe_ok else "scalar"
             else:
-                # usually probed pre-listener; the fallback covers a fast
-                # lane that was requested but could not enable
-                ok = (
-                    self._probe_ok
-                    if self._probe_ok is not None
-                    else self._dispatch_within_budget()
+                # fast lane requested but could not enable, and no
+                # pre-listener probe ran: probing NOW would black-hole
+                # inbound traffic behind the router gate for up to the
+                # probe timeout — default to scalar instead (the log
+                # makes the unusual configuration visible)
+                plog.warning(
+                    "quorum_engine=auto: fast lane unavailable and no "
+                    "pre-listener probe; defaulting to scalar"
                 )
-                engine_choice = "tpu" if ok else "scalar"
+                engine_choice = "scalar"
             plog.info(
                 "quorum_engine=auto resolved to %s (fast_lane=%s)",
                 engine_choice, self.fastlane is not None,
@@ -431,6 +435,10 @@ class NodeHost:
         with self._mu:
             self._clusters[cluster_id] = node
             self._csi += 1
+        # signal only AFTER the store + csi bump: the workers reload their
+        # node maps on csi change, so the wakeup now always finds the node
+        # (the apply signal drives the queued initial-recovery task)
+        self.engine.set_apply_ready(cluster_id)
         self.engine.set_step_ready(cluster_id)
 
     def _unreserve_cluster(self, cluster_id: int) -> None:

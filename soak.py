@@ -73,6 +73,12 @@ class _KVSM:
 
 
 def rank_main() -> int:
+    import faulthandler
+
+    # divergence triage: the parent sends SIGUSR2 before teardown so the
+    # rank's stderr log captures every thread's stack at failure time
+    faulthandler.register(signal.SIGUSR2, all_threads=True)
+
     from dragonboat_tpu import Config, NodeHost, NodeHostConfig
     from dragonboat_tpu.config import ExpertConfig
 
@@ -367,6 +373,12 @@ def _converge_check(ranks, groups, timeout=90.0):
             if not bad:
                 return reports
             if time.time() > deadline:
+                for r in live:  # stack dumps into the rank logs
+                    try:
+                        r.proc.send_signal(signal.SIGUSR2)
+                    except Exception:
+                        pass
+                time.sleep(1.0)
                 raise AssertionError(
                     f"replicas diverged after {timeout}s settle: "
                     f"{len(bad)} groups, sample {bad[:3]}"
