@@ -87,10 +87,18 @@ class BatchedQuorumEngine:
         n_peers: int,
         event_cap: int = DEFAULT_EVENT_CAP,
         sharding=None,
+        device_ticks: bool = True,
     ):
         self.n_groups = n_groups
         self.n_peers = n_peers
         self.event_cap = event_cap
+        #: whether this engine EVER runs tick_step on device.  Contact
+        #: events (leader_contact zero-acks) are one-shot, so a ticking
+        #: engine must apply the election-clock reset on every round —
+        #: including do_tick=False rounds that drain staged acks between
+        #: host ticks.  Engines that never tick (host-driven clocks) skip
+        #: the reset scatter entirely (it is dead work there).
+        self.device_ticks = device_ticks
         self.mirror = HostMirror(n_groups, n_peers)
         self.sharding = sharding
         self.dev: QuorumState = self.mirror.to_device(sharding)
@@ -526,6 +534,7 @@ class BatchedQuorumEngine:
             jnp.asarray(vv, dtype=jnp.int8),
             jnp.asarray(vvalid),
             do_tick=do_tick,
+            track_contact=self.device_ticks,
         )
         self.dev = out.state
         return out

@@ -160,6 +160,7 @@ def quorum_step_impl(
     vote_grant: jax.Array,  # (K,) i8 — 1 grant / 0 reject
     vote_valid: jax.Array,  # (K,) bool
     do_tick: bool = True,
+    track_contact: bool = True,
 ) -> StepOutputs:
     """ONE fused dispatch for a whole engine round (SURVEY.md §7).
 
@@ -181,14 +182,24 @@ def quorum_step_impl(
     # election clock (twin: leader_is_available / raft.go follower
     # heartbeat handling) — the host stages a zero-value ack when a
     # follower hears from its leader, so device-tick followers don't
-    # campaign against a healthy leader
-    contacted = (
-        jnp.zeros((g_total + 1,), bool).at[ag].set(True)[:g_total]
-    )
-    nonleader = (st.node_state != LEADER) & st.live
-    election_tick = jnp.where(
-        contacted & nonleader, 0, st.election_tick
-    )
+    # campaign against a healthy leader.  Contact events are ONE-SHOT
+    # (consumed by whichever round drains them), so the reset must run on
+    # every round of a ticking engine — including its do_tick=False
+    # rounds — or an idle follower's clock would climb to elect_due and
+    # spam spurious (scalar-rejected) election flags.  Only an engine
+    # that NEVER ticks on device (host-driven clocks: drive_ticks=False
+    # coordinators, the bench host-loop/rung sections) may compile the
+    # scatter out (~8% of the multistep round at 131k groups).
+    if track_contact or do_tick:
+        contacted = (
+            jnp.zeros((g_total + 1,), bool).at[ag].set(True)[:g_total]
+        )
+        nonleader = (st.node_state != LEADER) & st.live
+        election_tick = jnp.where(
+            contacted & nonleader, 0, st.election_tick
+        )
+    else:
+        election_tick = st.election_tick
     # self-acks raise last_index (leader append); followers never exceed it
     self_match = jnp.take_along_axis(match, st.self_slot[:, None], axis=1)[:, 0]
     last_index = jnp.maximum(st.last_index, self_match)
@@ -232,7 +243,9 @@ def quorum_step_impl(
 
 
 quorum_step = jax.jit(
-    quorum_step_impl, static_argnames=("do_tick",), donate_argnums=(0,)
+    quorum_step_impl,
+    static_argnames=("do_tick", "track_contact"),
+    donate_argnums=(0,),
 )
 
 
@@ -247,6 +260,7 @@ def quorum_multistep_impl(
     vote_grant: jax.Array,
     vote_valid: jax.Array,
     do_tick: bool = True,
+    track_contact: bool = True,
 ) -> StepOutputs:
     """R engine rounds in ONE dispatch via ``lax.scan``.
 
@@ -261,7 +275,9 @@ def quorum_multistep_impl(
     """
 
     def body(carry, ev):
-        out = quorum_step_impl(carry, *ev, do_tick=do_tick)
+        out = quorum_step_impl(
+            carry, *ev, do_tick=do_tick, track_contact=track_contact
+        )
         acc = (out.won, out.lost, out.flags)
         return out.state, acc
 
@@ -281,5 +297,7 @@ def quorum_multistep_impl(
 
 
 quorum_multistep = jax.jit(
-    quorum_multistep_impl, static_argnames=("do_tick",), donate_argnums=(0,)
+    quorum_multistep_impl,
+    static_argnames=("do_tick", "track_contact"),
+    donate_argnums=(0,),
 )

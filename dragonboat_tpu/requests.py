@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from dataclasses import dataclass, field
 from enum import IntEnum
 from typing import Dict, List, Optional, Tuple
@@ -120,6 +121,7 @@ class RequestState:
         "_event",
         "_result",
         "read_index",
+        "completed_at",
     )
 
     def __init__(self, key: int = 0, deadline: int = 0):
@@ -130,8 +132,13 @@ class RequestState:
         self._event = threading.Event()
         self._result: Optional[RequestResult] = None
         self.read_index = 0
+        #: perf_counter() at notify time — lets a pipelined client report
+        #: the request's true completion latency instead of the (later)
+        #: moment it got around to observing the result
+        self.completed_at: Optional[float] = None
 
     def notify(self, result: RequestResult) -> None:
+        self.completed_at = time.perf_counter()
         self._result = result
         self._event.set()
 

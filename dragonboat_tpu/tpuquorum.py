@@ -57,7 +57,8 @@ class TpuQuorumCoordinator:
         from .ops.engine import BatchedQuorumEngine
 
         self.eng = BatchedQuorumEngine(
-            capacity, n_peers, event_cap=max(4 * capacity, 4096)
+            capacity, n_peers, event_cap=max(4 * capacity, 4096),
+            device_ticks=drive_ticks,
         )
         self.capacity = capacity
         # device-tick mode: the per-tick firing decisions (election due,

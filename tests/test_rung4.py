@@ -20,6 +20,7 @@ Marked slow: one full run is a few minutes on the 8-vCPU CI box.
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 
@@ -80,19 +81,24 @@ class FakeNode:
         pass
 
 
-def _assert_parity(eng, oracles, cids, tag, timeout=8.0):
+def _assert_parity(eng, oracles, cids, tag, timeout=8.0, mu=None):
     """commitIndex bit-identity with callback-timing tolerance: the
     coordinator's background round thread delivers offload_commit OUTSIDE
     its lock, so the oracle may trail the engine by one callback for a
-    moment — the VALUES still must match exactly at quiescence."""
+    moment — the VALUES still must match exactly at quiescence.
+
+    ``mu`` (the coordinator lock) guards the device reads: a concurrent
+    step() donates the previous device state, so an unlocked
+    ``committed_index`` could touch a deleted buffer mid-dispatch."""
     deadline = time.time() + timeout
     while True:
         bad = []
-        for cid in cids:
-            got = eng.committed_index(cid)
-            want = oracles[cid].peer.raft.log.committed
-            if got != want:
-                bad.append((cid, got, want))
+        with (mu if mu is not None else contextlib.nullcontext()):
+            for cid in cids:
+                got = eng.committed_index(cid)
+                want = oracles[cid].peer.raft.log.committed
+                if got != want:
+                    bad.append((cid, got, want))
         if not bad:
             return
         if time.time() > deadline:
@@ -164,17 +170,22 @@ def test_rung4_64k_groups_mixed_load_with_churn():
             coord.flush()
             writes += n_bulk + SAMPLE
             # mixed 9:1: reads are commit-watermark queries (the
-            # coordinator's read-side role); sample across the space
-            for cid in range(1, N + 1, max(1, N // (9 * 64))):
-                eng.committed_index(cid)
-                reads += 1
+            # coordinator's read-side role); sample across the space —
+            # under coord._mu (step() donates the previous device state)
+            with coord._mu:
+                for cid in range(1, N + 1, max(1, N // (9 * 64))):
+                    eng.committed_index(cid)
+                    reads += 1
             # bit-identity on every sampled group, every round
-            _assert_parity(eng, oracles, list(oracles), f"round {rnd}")
+            _assert_parity(
+                eng, oracles, list(oracles), f"round {rnd}", mu=coord._mu
+            )
         elapsed = time.perf_counter() - t0
         # every bulk group committed every round
-        for g in (SAMPLE, SAMPLE + n_bulk // 2, N - 1):
-            cid = 1 + g
-            assert eng.committed_index(cid) == 1 + rounds, cid
+        with coord._mu:
+            for g in (SAMPLE, SAMPLE + n_bulk // 2, N - 1):
+                cid = 1 + g
+                assert eng.committed_index(cid) == 1 + rounds, cid
         print(
             f"\nrung4: {N} groups x {rounds} rounds: "
             f"{writes / elapsed:.0f} writes/s {reads / elapsed:.0f} reads/s "
@@ -204,10 +215,11 @@ def test_rung4_64k_groups_mixed_load_with_churn():
                 np.full(3 * 4096, 2, np.int32),
             )
         coord.flush()
-        for i in (0, 2048, 4095):
-            assert eng.committed_index(200_000 + i) == 2
-        # survivors untouched by the recycling
-        assert eng.committed_index(1 + SAMPLE + 4096) == 1 + rounds
+        with coord._mu:
+            for i in (0, 2048, 4095):
+                assert eng.committed_index(200_000 + i) == 2
+            # survivors untouched by the recycling
+            assert eng.committed_index(1 + SAMPLE + 4096) == 1 + rounds
 
         # --- membership change on sampled oracles: 5 -> 4 voters, commit
         # quorum math must follow (resync via membership_changed)
@@ -230,7 +242,7 @@ def test_rung4_64k_groups_mixed_load_with_churn():
             coord.ack(cid, 2, idx)
             coord.ack(cid, 3, idx)
         coord.flush()
-        _assert_parity(eng, oracles, changed, "membership-change")
+        _assert_parity(eng, oracles, changed, "membership-change", mu=coord._mu)
         for cid in changed:
             assert oracles[cid].peer.raft.log.committed >= 1 + rounds + 1
 
@@ -283,6 +295,6 @@ def test_rung4_64k_groups_mixed_load_with_churn():
                 ))
                 coord.ack(cid, p, idx)
         coord.flush()
-        _assert_parity(eng, oracles, transferred, "leader-transfer")
+        _assert_parity(eng, oracles, transferred, "leader-transfer", mu=coord._mu)
     finally:
         coord.stop()
