@@ -149,6 +149,8 @@ enum MsgType : uint64_t {
   MT_REPLICATE_RESP = 13,
   MT_HEARTBEAT = 17,
   MT_HEARTBEAT_RESP = 18,
+  MT_READ_INDEX = 19,       // follower-forwarded ReadIndex (raft.go:1258)
+  MT_READ_INDEX_RESP = 20,  // leader's confirmation back to the origin
 };
 constexpr uint8_t kFlagSnapshot = 1;
 constexpr uint8_t kFlagReject = 2;
@@ -467,6 +469,7 @@ struct Group {
     uint64_t low, high, index;
     uint32_t acks;      // self counts as one
     uint32_t peer_mask; // peers already counted
+    uint64_t origin = 0;  // requesting node for forwarded reads (0 = local)
   };
   std::vector<PendRead> reads;
   // raft.go:1079: a leader may serve ReadIndex only once an entry of its
@@ -1193,14 +1196,19 @@ struct Engine {
       begin_eject(g, EV_TERM_MISMATCH);
       return false;
     }
-    if (m.term < g->term) {
+    if (m.term < g->term &&
+        !(m.type == MT_READ_INDEX && m.term == 0)) {
       // stale-term fast-path message: a deposed leader's tail or a late
       // response from the pre-enrollment term.  Scalar raft ignores stale
       // responses and answers stale leaders only to depose them — and the
       // deposed peer independently recovers via the NEW leader's
       // higher-term traffic plus its own quorum/commit-stall watchdogs.
       // Consuming (dropping) instead of ejecting removes a post-churn
-      // eject storm (round 3: term-mismatch ejects on every late RESP)
+      // eject storm (round 3: term-mismatch ejects on every late RESP).
+      // Exception: READ_INDEX is a termless REQUEST in this protocol
+      // (is_request_message raft.py:73 — finalize_message_term leaves it
+      // at 0), so a scalar peer's forwarded read must fall through to
+      // the handler, not be swallowed as stale.
       stale_dropped++;
       return true;
     }
@@ -1386,17 +1394,75 @@ struct Engine {
               if (i == done && pr.acks >= quorum) done++;
             }
             if (done) {
-              std::lock_guard<std::mutex> rlk(rmu);
+              bool fwd = false;
+              {
+                std::lock_guard<std::mutex> rlk(rmu);
+                bool local = false;
+                for (size_t i = 0; i < done; i++) {
+                  auto& pr = g->reads[i];
+                  if (pr.origin == 0 || pr.origin == g->nid) {
+                    readyq.push_back({g->cid, pr.low, pr.high, pr.index});
+                    local = true;
+                  }
+                }
+                if (local) rcv.notify_one();
+              }
+              // forwarded contexts answer their origin (scalar twin:
+              // handle_read_index_leader_confirmation raft.py:1185).
+              // Sent DIRECTLY, not via g->resps: the resps queue gates on
+              // the local fsync (run_effects), but a quorum of echoes has
+              // already confirmed leadership — a read confirmation must
+              // not wait on the leader's disk.
               for (size_t i = 0; i < done; i++) {
                 auto& pr = g->reads[i];
-                readyq.push_back({g->cid, pr.low, pr.high, pr.index});
+                if (pr.origin != 0 && pr.origin != g->nid) {
+                  int oslot = peer_slot(g, pr.origin);
+                  if (oslot >= 0) {
+                    std::string b;
+                    put_msg_header(b, MT_READ_INDEX_RESP, 0, pr.origin,
+                                   g->nid, g->cid, g->term, 0, pr.index, 0,
+                                   pr.low, pr.high, 0);
+                    queue_msg(oslot, b);
+                    fwd = true;
+                  }
+                }
               }
-              rcv.notify_one();
               g->reads.erase(g->reads.begin(), g->reads.begin() + done);
+              if (fwd) mark_dirty(g);  // flush the confirmations promptly
             }
           }
         }
         if (pr0.match < g->last_index) mark_dirty(g);
+        return true;
+      }
+      case MT_READ_INDEX: {
+        // linearizable read forwarded by an enrolled follower (scalar
+        // twins: handle_leader_read_index raft.py:1095 on the leader,
+        // handle_follower_read_index raft.py:1258 re-forward elsewhere).
+        // Unservable requests are DROPPED — the origin's client retries
+        // (report_dropped_read_index semantics) — never ejected.
+        if (g->leader) {
+          reg_read(g, m.hint, m.hint_high, m.from);
+        } else if (g->leader_id != 0 && g->leader_id != m.from) {
+          int slot = peer_slot(g, g->leader_id);
+          if (slot >= 0) {
+            std::string b;
+            put_msg_header(b, MT_READ_INDEX, 0, g->leader_id, m.from, g->cid,
+                           g->term, 0, 0, 0, m.hint, m.hint_high, 0);
+            queue_msg(slot, b);
+            mark_dirty(g);
+          }
+        }
+        return true;
+      }
+      case MT_READ_INDEX_RESP: {
+        // confirmation for a read this node forwarded (scalar twin:
+        // handle_follower_read_index_resp raft.py:1271) — may come from
+        // a native leader or a Python-scalar leader over the same stream
+        if (m.from == g->leader_id) g->leader_contact_ms = now;
+        std::lock_guard<std::mutex> rlk(rmu);
+        readyq.push_back({g->cid, m.hint, m.hint_high, m.log_index});
+        rcv.notify_one();
         return true;
       }
       default:
@@ -1409,6 +1475,25 @@ struct Engine {
     for (auto& p : g->peers)
       if (p.id == id) return p.slot;
     return -1;
+  }
+
+  // Register a leader-side ReadIndex context (thesis 6.4) and broadcast
+  // the hinted heartbeats whose echoes confirm it.  g->mu held.
+  // origin != 0 marks a follower-forwarded request (the scalar twin is
+  // handle_leader_read_index, raft.py:1095); the confirmation fan-out
+  // answers those with MT_READ_INDEX_RESP instead of the local readyq.
+  bool reg_read(Group* g, uint64_t low, uint64_t high, uint64_t origin) {
+    if (!g->leader || !g->term_commit_ok) return false;
+    if (g->reads.size() >= 1024) return false;
+    g->reads.push_back({low, high, g->commit, 1, 0, origin});
+    for (auto& p : g->peers) {
+      std::string b;
+      put_msg_header(b, MT_HEARTBEAT, 0, p.id, g->nid, g->cid, g->term, 0, 0,
+                     std::min(p.match, g->commit), low, high, 0);
+      queue_msg(p.slot, b);
+    }
+    mark_dirty(g);  // flush the hinted heartbeats promptly
+    return true;
   }
 };
 
@@ -1740,7 +1825,8 @@ static long long ingest_batch(Engine* e, const uint8_t* d, size_t len,
     if (!parse_message(d, len, pos, m)) return -1;
     bool fast = false;
     if (m.type == MT_REPLICATE || m.type == MT_REPLICATE_RESP ||
-        m.type == MT_HEARTBEAT || m.type == MT_HEARTBEAT_RESP) {
+        m.type == MT_HEARTBEAT || m.type == MT_HEARTBEAT_RESP ||
+        m.type == MT_READ_INDEX || m.type == MT_READ_INDEX_RESP) {
       std::shared_ptr<Group> g = e->find(m.cluster_id);
       if (g) fast = e->handle_fast(g.get(), m, d);
     }
@@ -2220,17 +2306,31 @@ uint64_t natr_read_index(void* h, uint64_t cid, uint64_t low, uint64_t high) {
   Group* g = sp.get();
   if (!g || low == 0) return 0;
   std::lock_guard<std::mutex> lk(g->mu);
-  if (g->state != G_ACTIVE || !g->leader || !g->term_commit_ok) return 0;
-  if (g->reads.size() >= 1024) return 0;
-  g->reads.push_back({low, high, g->commit, 1, 0});
-  for (auto& p : g->peers) {
-    std::string b;
-    put_msg_header(b, MT_HEARTBEAT, 0, p.id, g->nid, g->cid, g->term, 0, 0,
-                   std::min(p.match, g->commit), low, high, 0);
-    e->queue_msg(p.slot, b);
-  }
-  e->mark_dirty(g);  // flush the hinted heartbeats promptly
+  if (g->state != G_ACTIVE) return 0;
+  if (!e->reg_read(g, low, high, 0)) return 0;
   return g->commit;
+}
+
+// Forward a linearizable read from an enrolled FOLLOWER to its leader
+// (scalar twin: handle_follower_read_index raft.py:1258).  Returns 1 when
+// the forward went out natively — the confirmation arrives as
+// MT_READ_INDEX_RESP and completes through natr_next_read — else 0 and
+// the caller falls back to the scalar path (eject).
+int natr_read_fwd(void* h, uint64_t cid, uint64_t low, uint64_t high) {
+  Engine* e = (Engine*)h;
+  std::shared_ptr<Group> sp = e->find(cid);
+  Group* g = sp.get();
+  if (!g || low == 0) return 0;
+  std::lock_guard<std::mutex> lk(g->mu);
+  if (g->state != G_ACTIVE || g->leader || g->leader_id == 0) return 0;
+  int slot = Engine::peer_slot(g, g->leader_id);
+  if (slot < 0) return 0;
+  std::string b;
+  put_msg_header(b, MT_READ_INDEX, 0, g->leader_id, g->nid, g->cid, g->term,
+                 0, 0, 0, low, high, 0);
+  e->queue_msg(slot, b);
+  e->mark_dirty(g);  // flush promptly
+  return 1;
 }
 
 // Next confirmed read context; 1 filled, 0 timeout, -1 stopped.

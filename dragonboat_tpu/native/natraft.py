@@ -113,6 +113,10 @@ def _load():
         lib.natr_read_index.argtypes = [
             c.c_void_p, c.c_uint64, c.c_uint64, c.c_uint64,
         ]
+        lib.natr_read_fwd.restype = c.c_int
+        lib.natr_read_fwd.argtypes = [
+            c.c_void_p, c.c_uint64, c.c_uint64, c.c_uint64,
+        ]
         lib.natr_next_read.restype = c.c_int
         lib.natr_next_read.argtypes = [
             c.c_void_p, c.c_int, c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),
@@ -394,6 +398,14 @@ class NatRaft:
         index (>0) or 0 when the group is not natively serving."""
         return int(
             self._lib.natr_read_index(self._h, cluster_id, low, high)
+        )
+
+    def read_fwd(self, cluster_id: int, low: int, high: int) -> bool:
+        """Forward a follower-side ReadIndex to the leader natively;
+        False when the group cannot forward (caller falls back to the
+        scalar path)."""
+        return bool(
+            self._lib.natr_read_fwd(self._h, cluster_id, low, high)
         )
 
     def next_read(self, timeout_ms: int = 200):

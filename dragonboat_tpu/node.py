@@ -63,7 +63,8 @@ plog = get_logger("node")
 MT = MessageType
 # wire types the native fast lane serves (natraft.cpp handle_fast)
 _FAST_WIRE_TYPES = frozenset(
-    (MT.REPLICATE, MT.REPLICATE_RESP, MT.HEARTBEAT, MT.HEARTBEAT_RESP)
+    (MT.REPLICATE, MT.REPLICATE_RESP, MT.HEARTBEAT, MT.HEARTBEAT_RESP,
+     MT.READ_INDEX, MT.READ_INDEX_RESP)
 )
 
 
@@ -399,6 +400,12 @@ class Node:
                 return rs  # a concurrent reader's context covers this one
             if fl.nat.read_index(self.cluster_id, ctx.low, ctx.high):
                 return rs
+            # not the leader: forward natively (READ_INDEX to the leader,
+            # confirmation returns as READ_INDEX_RESP through the read
+            # pump) so follower reads stay in the lane instead of costing
+            # an eject/re-enroll cycle
+            if fl.nat.read_fwd(self.cluster_id, ctx.low, ctx.high):
+                return rs
             # native cannot serve (ejecting / no current-term commit yet):
             # hand back to scalar raft, which runs the full protocol
             self._count_eject("read")
@@ -538,7 +545,10 @@ class Node:
             ctx = self.pending_reads.next_ctx()
             if not self.pending_reads.take_pending(ctx):
                 break
-            if not fl.nat.read_index(self.cluster_id, ctx.low, ctx.high):
+            if not (
+                fl.nat.read_index(self.cluster_id, ctx.low, ctx.high)
+                or fl.nat.read_fwd(self.cluster_id, ctx.low, ctx.high)
+            ):
                 self._count_eject("read-fallback")
                 self.fast_eject()
                 self.peer.read_index(ctx)

@@ -318,3 +318,32 @@ def test_propose_batch_both_paths(tmp_path):
             assert sm.applied == base
     finally:
         _stop_all(nhs)
+
+
+def test_follower_read_served_natively_no_eject(tmp_path):
+    """A linearizable read on an enrolled FOLLOWER forwards natively
+    (READ_INDEX to the leader, READ_INDEX_RESP back — natraft twins of
+    handle_follower_read_index / handle_follower_read_index_resp,
+    raft.py:1258,1271) and completes without costing the group an
+    eject/re-enroll cycle."""
+    sms = {}
+    nhs, _ = _cluster(tmp_path, sms)
+    try:
+        lid, leader = _leader(nhs)
+        _propose_all(leader, [b"a", b"b", b"c"])
+        fid = next(i for i in nhs if i != lid)
+        follower = nhs[fid]
+        assert _wait_enrolled(follower)
+        node = follower.get_node(CID)
+        before = dict(follower.fastlane.eject_reasons)
+        for _ in range(5):
+            got = follower.sync_read(CID, None, timeout=10.0)
+            assert len(got) == 3
+        assert node.fast_lane, "follower read should not leave the lane"
+        after = follower.fastlane.eject_reasons
+        assert after.get("read", 0) == before.get("read", 0)
+        assert after.get("read-fallback", 0) == before.get("read-fallback", 0)
+        # the leader meanwhile keeps its own native read service
+        assert len(leader.sync_read(CID, None, timeout=10.0)) == 3
+    finally:
+        _stop_all(nhs)

@@ -1,0 +1,147 @@
+"""Native-unit ReadIndex protocol tests against a bare NatRaft engine.
+
+These pin the wire-level contract of the follower-forwarded ReadIndex
+path (natraft twins of ``handle_leader_read_index`` raft.py:1095,
+``handle_follower_read_index`` raft.py:1258,
+``handle_follower_read_index_resp`` raft.py:1271) without the full
+NodeHost stack: enroll one group as leader, inject encoded frames via
+``natr_ingest``, and observe the readyq / outbound queues directly —
+deterministic, no sleeps, no sockets.
+"""
+from __future__ import annotations
+
+import tempfile
+
+import pytest
+
+from dragonboat_tpu.native import NativeKV, natraft
+from dragonboat_tpu.wire import Message, MessageBatch, MessageType as MT
+from dragonboat_tpu.wire.codec import encode_message_batch, decode_message_batch
+
+pytestmark = pytest.mark.skipif(
+    not natraft.available(), reason="libnatraft unavailable"
+)
+
+DEP = 7
+CID = 9
+
+
+def _leader_engine():
+    kv = NativeKV(tempfile.mkdtemp())
+    nat = natraft.NatRaft("127.0.0.1:1", deployment_id=DEP, bin_ver=1)
+    nat.set_shards([kv._h])
+    nat.add_remote()  # slot 0 -> peer 1
+    nat.add_remote()  # slot 1 -> peer 3
+    nat.start()
+    assert nat.enroll(
+        cluster_id=CID, node_id=2, term=2, vote=2, leader_id=2,
+        is_leader=True, last_index=3, commit=3, processed=3, log_first=4,
+        prev_term=2, shard=0, hb_period_ms=50, elect_timeout_ms=1000,
+        term_commit_ok=True,
+        peers=[(1, 0, 3, 4), (3, 1, 3, 4)], tail=b"",
+    )
+    return nat, kv
+
+
+def _batch(*msgs):
+    return encode_message_batch(MessageBatch(
+        requests=list(msgs), deployment_id=DEP,
+        source_address="127.0.0.1:9",
+    ))
+
+
+def _echo(from_, low, high):
+    return Message(type=MT.HEARTBEAT_RESP, to=2, from_=from_,
+                   cluster_id=CID, term=2, hint=low, hint_high=high)
+
+
+def _drain_sends(nat, slot, n=20):
+    out = []
+    for _ in range(n):
+        b = nat.take_send(slot, 50)
+        if b is None:
+            break
+        out.append(bytes(b))
+    return out
+
+
+def _sent_types(nat, slot):
+    """take_send returns framed wire bytes (tcp.py layout:
+    magic(2) method(2) size(8) payload_crc(4) header_crc(4) payload);
+    one buffer may carry several frames."""
+    import struct
+
+    types = []
+    for raw in _drain_sends(nat, slot):
+        pos = 0
+        while pos + 20 <= len(raw):
+            magic, _method, size = struct.unpack_from(">HHQ", raw, pos)
+            assert magic == 0xAE7D, hex(magic)
+            payload = raw[pos + 20:pos + 20 + size]
+            mb = decode_message_batch(payload)
+            types.extend((m.type, m) for m in mb.requests)
+            pos += 20 + size
+    return types
+
+
+def test_forwarded_read_confirms_to_origin():
+    """A peer's READ_INDEX registers an origin-tagged ctx; the echo
+    quorum answers the ORIGIN with READ_INDEX_RESP (not the local
+    readyq), directly — not behind the fsync-gated ack queue."""
+    nat, _kv = _leader_engine()
+    try:
+        n, left = nat.ingest(_batch(Message(
+            type=MT.READ_INDEX, to=2, from_=1, cluster_id=CID, term=2,
+            hint=1234, hint_high=5678,
+        )))
+        assert (n, left) == (1, None)
+        _drain_sends(nat, 0)  # hinted heartbeats out to peer 1
+        _drain_sends(nat, 1)
+        # echo quorum: leader (self) + one peer suffices for 3 voters
+        n, left = nat.ingest(_batch(_echo(1, 1234, 5678)))
+        assert (n, left) == (1, None)
+        # the confirmation must NOT land in the local readyq...
+        assert nat.next_read(100) is None
+        # ...but go out to the origin as READ_INDEX_RESP with the index
+        sent = _sent_types(nat, 0)
+        resps = [m for t, m in sent if t == MT.READ_INDEX_RESP]
+        assert resps, [t.name for t, _ in sent]
+        assert resps[0].log_index == 3
+        assert resps[0].hint == 1234 and resps[0].hint_high == 5678
+    finally:
+        nat.stop()
+
+
+def test_termless_scalar_read_index_not_swallowed():
+    """Scalar raft sends READ_INDEX with term 0 (a termless REQUEST —
+    is_request_message raft.py:73); the native stale-term gate must not
+    swallow it (regression: mixed scalar-follower/native-leader reads
+    stranded until client timeout)."""
+    nat, _kv = _leader_engine()
+    try:
+        n, left = nat.ingest(_batch(Message(
+            type=MT.READ_INDEX, to=2, from_=1, cluster_id=CID, term=0,
+            hint=77, hint_high=88,
+        )))
+        assert (n, left) == (1, None)
+        _drain_sends(nat, 0)
+        _drain_sends(nat, 1)
+        nat.ingest(_batch(_echo(1, 77, 88)))
+        resps = [m for t, m in _sent_types(nat, 0)
+                 if t == MT.READ_INDEX_RESP]
+        assert resps and resps[0].log_index == 3
+    finally:
+        nat.stop()
+
+
+def test_local_read_still_served_via_readyq():
+    nat, _kv = _leader_engine()
+    try:
+        assert nat.read_index(CID, 42, 43) == 3
+        _drain_sends(nat, 0)
+        _drain_sends(nat, 1)
+        nat.ingest(_batch(_echo(3, 42, 43)))
+        got = nat.next_read(500)
+        assert got == (CID, 42, 43, 3)
+    finally:
+        nat.stop()
