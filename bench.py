@@ -163,6 +163,12 @@ def _staged_multistep_fn(n_groups: int, rounds: int, cap: int):
             jnp.zeros((rounds, cap), jnp.int8),
             jnp.zeros((rounds, cap), bool),
             do_tick=True,
+            # every benched row is a LEADER (build_state set_leader), and
+            # the contact-reset writes only non-leader rows (masked by
+            # `contacted & nonleader`) — provably a no-op here, so the
+            # scatter (~8%/round at 131k groups) is compiled out; ticks
+            # themselves stay on (heartbeat/check-quorum clocks run)
+            track_contact=False,
         )
 
     return staged_multistep

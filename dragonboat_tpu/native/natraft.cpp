@@ -1443,15 +1443,8 @@ struct Engine {
         // (report_dropped_read_index semantics) — never ejected.
         if (g->leader) {
           reg_read(g, m.hint, m.hint_high, m.from);
-        } else if (g->leader_id != 0 && g->leader_id != m.from) {
-          int slot = peer_slot(g, g->leader_id);
-          if (slot >= 0) {
-            std::string b;
-            put_msg_header(b, MT_READ_INDEX, 0, g->leader_id, m.from, g->cid,
-                           g->term, 0, 0, 0, m.hint, m.hint_high, 0);
-            queue_msg(slot, b);
-            mark_dirty(g);
-          }
+        } else {
+          fwd_read(g, m.from, m.hint, m.hint_high);
         }
         return true;
       }
@@ -1475,6 +1468,23 @@ struct Engine {
     for (auto& p : g->peers)
       if (p.id == id) return p.slot;
     return -1;
+  }
+
+  // Forward a READ_INDEX toward this follower's leader on behalf of
+  // `origin` (g->mu held).  Shared by natr_read_fwd (origin == self) and
+  // the handle_fast re-forward (origin == the requesting peer) so the
+  // frame layout lives in one place.
+  bool fwd_read(Group* g, uint64_t origin, uint64_t low, uint64_t high) {
+    if (g->leader || g->leader_id == 0 || g->leader_id == origin)
+      return false;
+    int slot = peer_slot(g, g->leader_id);
+    if (slot < 0) return false;
+    std::string b;
+    put_msg_header(b, MT_READ_INDEX, 0, g->leader_id, origin, g->cid,
+                   g->term, 0, 0, 0, low, high, 0);
+    queue_msg(slot, b);
+    mark_dirty(g);  // flush promptly
+    return true;
   }
 
   // Register a leader-side ReadIndex context (thesis 6.4) and broadcast
@@ -2322,15 +2332,8 @@ int natr_read_fwd(void* h, uint64_t cid, uint64_t low, uint64_t high) {
   Group* g = sp.get();
   if (!g || low == 0) return 0;
   std::lock_guard<std::mutex> lk(g->mu);
-  if (g->state != G_ACTIVE || g->leader || g->leader_id == 0) return 0;
-  int slot = Engine::peer_slot(g, g->leader_id);
-  if (slot < 0) return 0;
-  std::string b;
-  put_msg_header(b, MT_READ_INDEX, 0, g->leader_id, g->nid, g->cid, g->term,
-                 0, 0, 0, low, high, 0);
-  e->queue_msg(slot, b);
-  e->mark_dirty(g);  // flush promptly
-  return 1;
+  if (g->state != G_ACTIVE) return 0;
+  return e->fwd_read(g, g->nid, low, high) ? 1 : 0;
 }
 
 // Next confirmed read context; 1 filled, 0 timeout, -1 stopped.

@@ -534,7 +534,10 @@ class BatchedQuorumEngine:
             jnp.asarray(vv, dtype=jnp.int8),
             jnp.asarray(vvalid),
             do_tick=do_tick,
-            track_contact=self.device_ticks,
+            # ticking rounds must track contact even on a device_ticks=False
+            # engine (defensive: a stray do_tick=True call would otherwise
+            # consume one-shot contact acks without the reset)
+            track_contact=self.device_ticks or do_tick,
         )
         self.dev = out.state
         return out

@@ -186,11 +186,14 @@ def quorum_step_impl(
     # (consumed by whichever round drains them), so the reset must run on
     # every round of a ticking engine — including its do_tick=False
     # rounds — or an idle follower's clock would climb to elect_due and
-    # spam spurious (scalar-rejected) election flags.  Only an engine
-    # that NEVER ticks on device (host-driven clocks: drive_ticks=False
-    # coordinators, the bench host-loop/rung sections) may compile the
-    # scatter out (~8% of the multistep round at 131k groups).
-    if track_contact or do_tick:
+    # spam spurious (scalar-rejected) election flags; the ENGINE therefore
+    # passes track_contact = device_ticks OR do_tick.  Compiling the
+    # scatter out (~8% of the multistep round at 131k groups) is legal
+    # only when the engine never ticks on device (host-driven clocks:
+    # drive_ticks=False coordinators, the bench host-loop/rung sections)
+    # OR no benched row is a non-leader (the reset writes are masked by
+    # `contacted & nonleader` — the headline bench's explicit False).
+    if track_contact:
         contacted = (
             jnp.zeros((g_total + 1,), bool).at[ag].set(True)[:g_total]
         )

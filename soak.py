@@ -149,12 +149,19 @@ def rank_main() -> int:
                 continue
             cid = rng.choice(sampled)
             node = nh.get_node(cid)
-            if node is None or not node.is_leader():
+            if node is None:
+                time.sleep(0.05)
+                continue
+            is_put = rng.random() < 0.6
+            # puts go to the leader; linearizable GETs run at ANY replica
+            # (follower-forwarded native ReadIndex) — history checking
+            # then covers cross-replica read consistency, not just the
+            # leader's own view
+            if is_put and not node.is_leader():
                 time.sleep(0.05)
                 continue
             key = f"g{cid}:x{rng.randrange(2)}"
             t0 = time.time()
-            is_put = rng.random() < 0.6
             try:
                 if is_put:
                     val = f"r{rank}n{rng.randrange(1 << 30)}"
@@ -338,6 +345,14 @@ class Rank:
         self.proc.wait()
         self.log.close()
 
+    def pause(self):
+        """SIGSTOP: the partition analog — the rank goes silent without
+        dying (peers see timeouts; its own threads freeze mid-state)."""
+        self.proc.send_signal(signal.SIGSTOP)
+
+    def resume(self):
+        self.proc.send_signal(signal.SIGCONT)
+
     def alive(self):
         return self.proc is not None and self.proc.poll() is None
 
@@ -442,6 +457,7 @@ def main() -> int:
     t0 = time.time()
     deadline = t0 + args.minutes * 60
     kills = 0
+    pauses = 0
     converges = 0
     failure = None
     try:
@@ -452,10 +468,26 @@ def main() -> int:
         time.sleep(5.0)  # initial elections + load ramp
 
         next_kill = time.time() + rng.uniform(10, 25)
+        next_pause = time.time() + rng.uniform(20, 35)
         next_converge = time.time() + 30.0
         while time.time() < deadline:
             time.sleep(1.0)
             now = time.time()
+            if now >= next_pause:
+                # partition-freeze fault: SIGSTOP a rank for 2-6s (long
+                # enough to cross election timeouts sometimes), then wake
+                # it into a world that moved on — exercises check-quorum,
+                # elections without a crash, post-wake stale-term traffic
+                # and fast-lane eject/re-enroll on both sides
+                victim = rng.choice(ranks)
+                dur = rng.uniform(2, 6)
+                print(f"# t+{now - t0:.0f}s SIGSTOP rank{victim.idx} "
+                      f"for {dur:.1f}s", file=sys.stderr)
+                victim.pause()
+                time.sleep(dur)
+                victim.resume()
+                pauses += 1
+                next_pause = time.time() + rng.uniform(20, 45)
             if now >= next_kill:
                 victim = rng.choice(ranks)
                 print(f"# t+{now - t0:.0f}s kill -9 rank{victim.idx}",
@@ -506,6 +538,7 @@ def main() -> int:
         "minutes": args.minutes,
         "groups": args.groups,
         "kills": kills,
+        "pauses": pauses,
         "converge_checks": converges,
         "history_ops": n_ops,
         "enrolled_final": enrolled,
