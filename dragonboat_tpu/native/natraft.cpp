@@ -426,6 +426,10 @@ struct PeerP {
   int64_t contact_ms = 0;
   int64_t progress_ms = 0;  // last match advance / resend reset
   int64_t hb_sent_us = 0;   // outstanding heartbeat send time (RTT diag)
+  // observers (reference nonVoting members) replicate and heartbeat like
+  // voters but count toward NO quorum: commit tally, check-quorum and
+  // ReadIndex confirmation all skip them
+  bool voting = true;
 };
 
 struct PendResp {
@@ -486,6 +490,7 @@ struct Group {
   int64_t last_hb_ms = 0;            // leader: last heartbeat broadcast
   int64_t leader_contact_ms = 0;     // follower: last leader contact
   int64_t quorum_ok_ms = 0;          // leader: last time a quorum was in contact
+  uint32_t nvoting = 0;              // voting PEERS (excludes self)
 
   uint64_t term_of(uint64_t index) const {
     // only called for index >= enroll_last (enrollment guarantees older
@@ -749,7 +754,8 @@ struct Engine {
     uint64_t m[17];
     size_t n = 0;
     m[n++] = g->fsynced;
-    for (auto& p : g->peers) m[n++] = p.match;
+    for (auto& p : g->peers)
+      if (p.voting) m[n++] = p.match;  // observers carry no quorum weight
     std::sort(m, m + n);
     size_t quorum = n / 2 + 1;
     return m[n - quorum];
@@ -1131,12 +1137,12 @@ struct Engine {
             p.hb_sent_us = mono_us();
           }
         }
-        // check-quorum (leaderHasQuorum raft.go:380-390): count peers
-        // heard from inside the election window
+        // check-quorum (leaderHasQuorum raft.go:380-390): count VOTING
+        // peers heard from inside the election window
         size_t active = 1;
         for (auto& p : g->peers)
-          if (now - p.contact_ms < g->elect_timeout_ms) active++;
-        size_t quorum = (g->peers.size() + 1) / 2 + 1;
+          if (p.voting && now - p.contact_ms < g->elect_timeout_ms) active++;
+        size_t quorum = (g->nvoting + 1) / 2 + 1;
         if (active >= quorum) g->quorum_ok_ms = now;
         if (now - g->quorum_ok_ms > 2 * g->elect_timeout_ms)
           begin_eject(g, EV_QUORUM_LOST);
@@ -1382,8 +1388,10 @@ struct Engine {
               break;
             }
           }
-          if (pos < g->reads.size()) {
-            uint32_t quorum = (uint32_t)(g->peers.size() + 1) / 2 + 1;
+          if (pos < g->reads.size() && pr0.voting) {
+            // only voting echoes prove leadership (observers confirm
+            // nothing — readindex.go confirm semantics)
+            uint32_t quorum = (g->nvoting + 1) / 2 + 1;
             size_t done = 0;
             for (size_t i = 0; i <= pos; i++) {
               auto& pr = g->reads[i];
@@ -1497,6 +1505,8 @@ struct Engine {
     if (g->reads.size() >= 1024) return false;
     g->reads.push_back({low, high, g->commit, 1, 0, origin});
     for (auto& p : g->peers) {
+      if (!p.voting) continue;  // observer echoes confirm nothing —
+                                // don't spend a hint per read on them
       std::string b;
       put_msg_header(b, MT_HEARTBEAT, 0, p.id, g->nid, g->cid, g->term, 0, 0,
                      std::min(p.match, g->commit), low, high, 0);
@@ -1588,6 +1598,7 @@ int natr_enroll(void* h, uint64_t cid, uint64_t nid, uint64_t term,
                 int term_commit_ok,
                 const uint64_t* peer_ids, const int32_t* peer_slots,
                 const uint64_t* peer_match, const uint64_t* peer_next,
+                const int32_t* peer_voting,
                 int npeers, const uint8_t* tail, size_t tail_len) {
   Engine* e = (Engine*)h;
   if (shard >= e->shards.size() || npeers > 16) return -1;
@@ -1646,10 +1657,15 @@ int natr_enroll(void* h, uint64_t cid, uint64_t nid, uint64_t term,
     p.slot = peer_slots[i];
     p.match = peer_match[i];
     p.next = peer_next[i];
+    p.voting = peer_voting == nullptr || peer_voting[i] != 0;
     if (p.next < log_first || p.match > last_index) return -4;
     p.contact_ms = now;
     g->peers.push_back(p);
+    if (p.voting) g->nvoting++;
   }
+  // self must be a voter (observers/witnesses never enroll), so the
+  // quorum base is nvoting peers + 1
+  if (g->nvoting + 1 < 2) return -4;
   {
     std::lock_guard<std::mutex> lk(e->gmu);
     auto& slot = e->groups[cid];

@@ -64,7 +64,8 @@ def _load():
             c.c_uint64, c.c_uint64, c.c_uint32, c.c_int64, c.c_int64,
             c.c_int,
             c.POINTER(c.c_uint64), c.POINTER(c.c_int32),
-            c.POINTER(c.c_uint64), c.POINTER(c.c_uint64), c.c_int,
+            c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),
+            c.POINTER(c.c_int32), c.c_int,
             c.c_char_p, c.c_size_t,
         ]
         lib.natr_propose.restype = c.c_uint64
@@ -237,19 +238,25 @@ class NatRaft:
         hb_period_ms: int,
         elect_timeout_ms: int,
         term_commit_ok: bool,
-        peers: List[Tuple[int, int, int, int]],  # (id, slot, match, next)
+        # (id, slot, match, next[, voting]) — voting defaults True;
+        # observers (nonVoting members) pass False: they replicate and
+        # heartbeat but carry no quorum weight
+        peers: List[Tuple],
         tail: bytes,  # concatenated encodings of (log_first..last_index]
     ) -> bool:
         ids = (ctypes.c_uint64 * len(peers))(*[p[0] for p in peers])
         slots = (ctypes.c_int32 * len(peers))(*[p[1] for p in peers])
         match = (ctypes.c_uint64 * len(peers))(*[p[2] for p in peers])
         nxt = (ctypes.c_uint64 * len(peers))(*[p[3] for p in peers])
+        voting = (ctypes.c_int32 * len(peers))(
+            *[1 if (len(p) < 5 or p[4]) else 0 for p in peers]
+        )
         rc = self._lib.natr_enroll(
             self._h, cluster_id, node_id, term, vote, leader_id,
             1 if is_leader else 0, last_index, commit, processed, log_first,
             prev_term, shard, hb_period_ms, elect_timeout_ms,
             1 if term_commit_ok else 0, ids, slots,
-            match, nxt, len(peers), tail, len(tail),
+            match, nxt, voting, len(peers), tail, len(tail),
         )
         if rc == 0:
             self._peer_order[cluster_id] = [p[0] for p in peers]

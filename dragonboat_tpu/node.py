@@ -612,9 +612,14 @@ class Node:
         r = self.peer.raft
         if not (r.is_leader() or (r.is_follower() and r.leader_id != 0)):
             return
-        if r.is_observer() or r.is_witness() or r.observers or r.witnesses:
+        # observer-BEARING groups enroll (observers become non-voting
+        # native replication targets); observer/witness REPLICAS and
+        # witness-bearing groups stay on the scalar path
+        if r.is_observer() or r.is_witness() or r.witnesses:
             return
-        if len(r.remotes) < 2 or len(r.remotes) > 16:
+        if len(r.remotes) < 2:
+            return
+        if len(r.remotes) + len(r.observers) > 16:
             return
         if (
             r.has_pending_config_change()
@@ -651,10 +656,13 @@ class Node:
 
         peers = []
         min_next = li + 1
-        for nid in sorted(r.remotes):
+        members = [(nid, r.remotes[nid], True) for nid in sorted(r.remotes)]
+        members += [
+            (nid, r.observers[nid], False) for nid in sorted(r.observers)
+        ]
+        for nid, rp, voting in members:
             if nid == self.node_id:
                 continue
-            rp = r.remotes[nid]
             if rp.state == RemoteState.SNAPSHOT or rp.match > li:
                 return
             addr = self.nh.node_registry.resolve(self.cluster_id, nid)
@@ -665,7 +673,7 @@ class Node:
                 return
             nxt = min(max(rp.next, rp.match + 1), li + 1)
             min_next = min(min_next, nxt)
-            peers.append((nid, slot, rp.match, nxt))
+            peers.append((nid, slot, rp.match, nxt, voting))
         # the native log must cover everything a resend or an apply
         # hand-off can still need
         log_first = min(processed + 1, min_next)
@@ -805,7 +813,9 @@ class Node:
             log.committed = st.commit
             log.processed = st.commit
             for nid, (match, _next) in st.peers.items():
-                rp = r.remotes.get(nid)
+                # observers enroll as non-voting peers; restore their
+                # progress into the observers dict
+                rp = r.remotes.get(nid) or r.observers.get(nid)
                 if rp is None:
                     continue
                 rp.match = match

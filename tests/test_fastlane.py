@@ -63,7 +63,8 @@ def _ports(n):
     return out
 
 
-def _mk(i, addrs, tmp_path, sms, snapshot_entries=0):
+def _mk(i, addrs, tmp_path, sms, snapshot_entries=0, join=False,
+        is_observer=False, initial=None):
     nh = NodeHost(
         NodeHostConfig(
             node_host_dir=str(tmp_path / f"nh{i}"),
@@ -80,9 +81,11 @@ def _mk(i, addrs, tmp_path, sms, snapshot_entries=0):
         return sm
 
     nh.start_cluster(
-        addrs, False, create,
+        {} if join else (initial if initial is not None else addrs),
+        join, create,
         Config(cluster_id=CID, node_id=i, election_rtt=10, heartbeat_rtt=1,
-               snapshot_entries=snapshot_entries, compaction_overhead=5),
+               snapshot_entries=snapshot_entries, compaction_overhead=5,
+               is_observer=is_observer),
     )
     return nh
 
@@ -345,5 +348,54 @@ def test_follower_read_served_natively_no_eject(tmp_path):
         assert after.get("read-fallback", 0) == before.get("read-fallback", 0)
         # the leader meanwhile keeps its own native read service
         assert len(leader.sync_read(CID, None, timeout=10.0)) == 3
+    finally:
+        _stop_all(nhs)
+
+
+def test_observer_group_enrolls_and_replicates(tmp_path):
+    """A group WITH an observer still enrolls (observers become
+    non-voting native replication targets — reference nonVoting member
+    semantics); proposals commit at voter quorum through the lane, and
+    the observer's SM catches up from natively-proposed entries."""
+    sms = {}
+    ports = _ports(4)
+    addrs = {i + 1: f"127.0.0.1:{ports[i]}" for i in range(4)}
+    voters = {i: addrs[i] for i in (1, 2, 3)}
+    nhs = {i: _mk(i, addrs, tmp_path, sms, initial=voters) for i in (1, 2, 3)}
+    try:
+        lid, leader = _leader(nhs)
+        _propose_all(leader, [b"a", b"b"])
+        leader.sync_request_add_observer(CID, 4, addrs[4], timeout=10.0)
+        nhs[4] = _mk(4, addrs, tmp_path, sms, join=True, is_observer=True)
+        # the config change ejected; the group must RE-enroll with the
+        # observer present (the old eligibility refused observer-bearing
+        # groups outright)
+        assert _wait_enrolled(leader), "observer-bearing group never enrolled"
+        st0 = leader.fastlane.stats()
+        _propose_all(leader, [b"c%d" % i for i in range(30)])
+        st1 = leader.fastlane.stats()
+        assert st1["proposed"] > st0["proposed"], (
+            "proposals bypassed the native lane"
+        )
+        # the observer (never part of quorum) still receives everything
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if sms.get(4) is not None and len(sms[4].applied) == 32:
+                break
+            time.sleep(0.05)
+        assert sms.get(4) is not None and len(sms[4].applied) == 32, (
+            "observer did not catch up through the native lane"
+        )
+        # quorum stays voter-only: stop BOTH non-leader voters; with only
+        # the leader + observer alive a proposal must NOT complete
+        for i in (1, 2, 3):
+            if i != lid:
+                nhs[i].stop()
+                del nhs[i]
+        s = nhs[lid].get_noop_session(CID)
+        rs = nhs[lid].propose(s, b"never", timeout=2.0)
+        assert not rs.wait(3.0).completed, (
+            "observer was counted toward the commit quorum"
+        )
     finally:
         _stop_all(nhs)

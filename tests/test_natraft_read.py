@@ -6,11 +6,13 @@ path (natraft twins of ``handle_leader_read_index`` raft.py:1095,
 ``handle_follower_read_index_resp`` raft.py:1271) without the full
 NodeHost stack: enroll one group as leader, inject encoded frames via
 ``natr_ingest``, and observe the readyq / outbound queues directly —
-deterministic, no sleeps, no sockets.
+no sockets; the only wait is the bounded negative-assertion window in
+the observer quorum test (the commit tally runs on the round thread).
 """
 from __future__ import annotations
 
 import tempfile
+import time
 
 import pytest
 
@@ -143,5 +145,78 @@ def test_local_read_still_served_via_readyq():
         nat.ingest(_batch(_echo(3, 42, 43)))
         got = nat.next_read(500)
         assert got == (CID, 42, 43, 3)
+    finally:
+        nat.stop()
+
+
+def _observer_engine():
+    """Leader with ONE voting peer (1) and ONE observer (3): quorum 2 of
+    the 2 voters (self + peer 1)."""
+    kv = NativeKV(tempfile.mkdtemp())
+    nat = natraft.NatRaft("127.0.0.1:1", deployment_id=DEP, bin_ver=1)
+    nat.set_shards([kv._h])
+    nat.add_remote()
+    nat.add_remote()
+    nat.start()
+    assert nat.enroll(
+        cluster_id=CID, node_id=2, term=2, vote=2, leader_id=2,
+        is_leader=True, last_index=3, commit=3, processed=3, log_first=4,
+        prev_term=2, shard=0, hb_period_ms=50, elect_timeout_ms=1000,
+        term_commit_ok=True,
+        peers=[(1, 0, 3, 4, True), (3, 1, 3, 4, False)], tail=b"",
+    )
+    return nat, kv
+
+
+def _resp(from_, idx):
+    return Message(type=MT.REPLICATE_RESP, to=2, from_=from_,
+                   cluster_id=CID, term=2, log_index=idx)
+
+
+def test_observer_ack_carries_no_commit_weight():
+    """An observer's REPLICATE_RESP advances its progress (flow control)
+    but never the commit index; a voter's ack commits (tally counts only
+    voting members — reference nonVoting semantics)."""
+    nat, _kv = _observer_engine()
+    try:
+        idx = nat.propose(CID, key=1, client_id=0, series_id=0,
+                          responded_to=0, etype=0, cmd=b"")
+        assert idx == 4
+        # observer ack: commit must stay at 3 (read_index reports commit).
+        # Negative assertion is necessarily time-bounded: the commit tally
+        # runs on the round thread, so give it a bounded window to
+        # (wrongly) commit before checking — the POSITIVE half below then
+        # re-checks that commit was still 3 at voter-ack time
+        nat.ingest(_batch(_resp(3, 4)))
+        time.sleep(0.5)
+        assert nat.read_index(CID, 1, 2) == 3, (
+            "observer ack advanced the commit index"
+        )
+        # voter ack: commit advances to 4 once the leader's fsync covers it
+        nat.ingest(_batch(_resp(1, 4)))
+        deadline = time.time() + 5.0
+        got = 0
+        while time.time() < deadline:
+            got = nat.read_index(CID, 3, 4)
+            if got == 4:
+                break
+            time.sleep(0.01)
+        assert got == 4, f"voter quorum did not commit (commit={got})"
+    finally:
+        nat.stop()
+
+
+def test_observer_echo_confirms_no_read():
+    """ReadIndex confirmation needs a VOTING echo quorum; the observer's
+    heartbeat echo proves nothing (readindex.go confirm semantics)."""
+    nat, _kv = _observer_engine()
+    try:
+        assert nat.read_index(CID, 42, 43) == 3
+        _drain_sends(nat, 0)
+        _drain_sends(nat, 1)
+        nat.ingest(_batch(_echo(3, 42, 43)))  # observer echo
+        assert nat.next_read(300) is None, "observer echo confirmed a read"
+        nat.ingest(_batch(_echo(1, 42, 43)))  # voter echo -> quorum 2/2
+        assert nat.next_read(500) == (CID, 42, 43, 3)
     finally:
         nat.stop()
