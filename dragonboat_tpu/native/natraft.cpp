@@ -184,6 +184,25 @@ struct NEntry {
   std::string enc;  // canonical wire encoding (codec.encode_entry)
 };
 
+// Witness replication twin (make_metadata_entries raft.py:104, reference
+// raft.go:744-758): every entry becomes a METADATA-only encoding (same
+// term/index, no payload) EXCEPT CONFIG_CHANGE, which passes verbatim —
+// the enrollment tail can hold already-committed config entries.
+static void append_witness_entry(std::string& b, const NEntry& en) {
+  const uint8_t* d = (const uint8_t*)en.enc.data();
+  size_t len = en.enc.size(), pos = 0;
+  uint64_t term, index, etype;
+  if (get_uvarint(d, len, pos, term) && get_uvarint(d, len, pos, index) &&
+      get_uvarint(d, len, pos, etype) && etype == 1 /*CONFIG_CHANGE*/) {
+    b += en.enc;
+    return;
+  }
+  put_uvarint(b, en.term);
+  put_uvarint(b, en.index);
+  put_uvarint(b, 3);  // EntryType.METADATA
+  for (int i = 0; i < 5; i++) put_uvarint(b, 0);  // key/cid/sid/resp/len
+}
+
 static inline int64_t mono_us() {
   struct timespec ts;
   clock_gettime(CLOCK_MONOTONIC, &ts);
@@ -430,6 +449,9 @@ struct PeerP {
   // voters but count toward NO quorum: commit tally, check-quorum and
   // ReadIndex confirmation all skip them
   bool voting = true;
+  // witnesses vote and ack like voters but replicate METADATA-ONLY
+  // entries (reference raft.go:744-758): same term/index, no payload
+  bool witness = false;
 };
 
 struct PendResp {
@@ -895,8 +917,14 @@ struct Engine {
       std::string b;
       put_msg_header(b, MT_REPLICATE, 0, p.id, g->nid, g->cid, g->term,
                      prev_term, prev, g->commit, 0, 0, last - first + 1);
-      for (uint64_t i = first; i <= last; i++)
-        b += g->log[i - g->log_first].enc;
+      for (uint64_t i = first; i <= last; i++) {
+        NEntry& en = g->log[i - g->log_first];
+        if (p.witness) {
+          append_witness_entry(b, en);
+        } else {
+          b += en.enc;
+        }
+      }
       queue_msg(p.slot, b);
       dbg_ev(g, "send", first, last);
       p.next = last + 1;
@@ -1657,7 +1685,11 @@ int natr_enroll(void* h, uint64_t cid, uint64_t nid, uint64_t term,
     p.slot = peer_slots[i];
     p.match = peer_match[i];
     p.next = peer_next[i];
-    p.voting = peer_voting == nullptr || peer_voting[i] != 0;
+    // role values: 0 = observer (non-voting), 1 = voter, 2 = witness
+    // (voting, metadata-only replication)
+    int role = peer_voting == nullptr ? 1 : peer_voting[i];
+    p.voting = role != 0;
+    p.witness = role == 2;
     if (p.next < log_first || p.match > last_index) return -4;
     p.contact_ms = now;
     g->peers.push_back(p);

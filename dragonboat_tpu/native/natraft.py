@@ -238,9 +238,10 @@ class NatRaft:
         hb_period_ms: int,
         elect_timeout_ms: int,
         term_commit_ok: bool,
-        # (id, slot, match, next[, voting]) — voting defaults True;
-        # observers (nonVoting members) pass False: they replicate and
-        # heartbeat but carry no quorum weight
+        # (id, slot, match, next[, role]) — role defaults voter (1);
+        # observers (nonVoting members) pass 0/False: replicate and
+        # heartbeat, no quorum weight; witnesses pass 2: vote and ack,
+        # receive metadata-only entries
         peers: List[Tuple],
         tail: bytes,  # concatenated encodings of (log_first..last_index]
     ) -> bool:
@@ -249,7 +250,7 @@ class NatRaft:
         match = (ctypes.c_uint64 * len(peers))(*[p[2] for p in peers])
         nxt = (ctypes.c_uint64 * len(peers))(*[p[3] for p in peers])
         voting = (ctypes.c_int32 * len(peers))(
-            *[1 if (len(p) < 5 or p[4]) else 0 for p in peers]
+            *[1 if len(p) < 5 else int(p[4]) for p in peers]
         )
         rc = self._lib.natr_enroll(
             self._h, cluster_id, node_id, term, vote, leader_id,

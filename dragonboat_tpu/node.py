@@ -612,14 +612,15 @@ class Node:
         r = self.peer.raft
         if not (r.is_leader() or (r.is_follower() and r.leader_id != 0)):
             return
-        # observer-BEARING groups enroll (observers become non-voting
-        # native replication targets); observer/witness REPLICAS and
-        # witness-bearing groups stay on the scalar path
-        if r.is_observer() or r.is_witness() or r.witnesses:
+        # observer/witness-BEARING groups enroll (observers become
+        # non-voting native replication targets; witnesses vote natively
+        # and receive metadata-only entries); observer/witness REPLICAS
+        # themselves stay on the scalar path
+        if r.is_observer() or r.is_witness():
             return
         if len(r.remotes) < 2:
             return
-        if len(r.remotes) + len(r.observers) > 16:
+        if len(r.remotes) + len(r.observers) + len(r.witnesses) > 16:
             return
         if (
             r.has_pending_config_change()
@@ -656,11 +657,15 @@ class Node:
 
         peers = []
         min_next = li + 1
-        members = [(nid, r.remotes[nid], True) for nid in sorted(r.remotes)]
+        # role: 1 = voter, 0 = observer, 2 = witness (natr_enroll contract)
+        members = [(nid, r.remotes[nid], 1) for nid in sorted(r.remotes)]
         members += [
-            (nid, r.observers[nid], False) for nid in sorted(r.observers)
+            (nid, r.observers[nid], 0) for nid in sorted(r.observers)
         ]
-        for nid, rp, voting in members:
+        members += [
+            (nid, r.witnesses[nid], 2) for nid in sorted(r.witnesses)
+        ]
+        for nid, rp, role in members:
             if nid == self.node_id:
                 continue
             if rp.state == RemoteState.SNAPSHOT or rp.match > li:
@@ -673,7 +678,7 @@ class Node:
                 return
             nxt = min(max(rp.next, rp.match + 1), li + 1)
             min_next = min(min_next, nxt)
-            peers.append((nid, slot, rp.match, nxt, voting))
+            peers.append((nid, slot, rp.match, nxt, role))
         # the native log must cover everything a resend or an apply
         # hand-off can still need
         log_first = min(processed + 1, min_next)
@@ -813,9 +818,13 @@ class Node:
             log.committed = st.commit
             log.processed = st.commit
             for nid, (match, _next) in st.peers.items():
-                # observers enroll as non-voting peers; restore their
-                # progress into the observers dict
-                rp = r.remotes.get(nid) or r.observers.get(nid)
+                # observers/witnesses enroll as flagged peers; restore
+                # their progress into the matching membership dict
+                rp = (
+                    r.remotes.get(nid)
+                    or r.observers.get(nid)
+                    or r.witnesses.get(nid)
+                )
                 if rp is None:
                     continue
                 rp.match = match

@@ -64,7 +64,7 @@ def _ports(n):
 
 
 def _mk(i, addrs, tmp_path, sms, snapshot_entries=0, join=False,
-        is_observer=False, initial=None):
+        is_observer=False, is_witness=False, initial=None):
     nh = NodeHost(
         NodeHostConfig(
             node_host_dir=str(tmp_path / f"nh{i}"),
@@ -85,7 +85,7 @@ def _mk(i, addrs, tmp_path, sms, snapshot_entries=0, join=False,
         join, create,
         Config(cluster_id=CID, node_id=i, election_rtt=10, heartbeat_rtt=1,
                snapshot_entries=snapshot_entries, compaction_overhead=5,
-               is_observer=is_observer),
+               is_observer=is_observer, is_witness=is_witness),
     )
     return nh
 
@@ -183,7 +183,10 @@ def test_enroll_and_native_replication(tmp_path):
         _stop_all(nhs)
 
 
-def test_read_index_ejects_and_reenrolls(tmp_path):
+def test_leader_read_index_served_natively(tmp_path):
+    """Historic name: reads used to force an eject; since the native
+    ReadIndex (hinted heartbeats + echo quorum) the leader serves them
+    in-lane — assert the read completes AND costs no eject."""
     sms = {}
     nhs, _ = _cluster(tmp_path, sms)
     try:
@@ -191,11 +194,11 @@ def test_read_index_ejects_and_reenrolls(tmp_path):
         assert _wait_enrolled(leader)
         _propose_all(leader, [b"a", b"b", b"c"])
         node = leader.get_node(CID)
-        # linearizable read forces an eject...
+        before = dict(leader.fastlane.eject_reasons)
         got = leader.sync_read(CID, None, timeout=10.0)
         assert len(got) == 3
-        # ...and the group re-enrolls once quiescent again
-        assert _wait_enrolled(leader), "no re-enroll after read"
+        assert node.fast_lane, "leader read should not leave the lane"
+        assert leader.fastlane.eject_reasons == before
         _propose_all(leader, [b"d"])
         _wait_converged(sms, 4)
         assert not node._stopped.is_set()
@@ -397,5 +400,55 @@ def test_observer_group_enrolls_and_replicates(tmp_path):
         assert not rs.wait(3.0).completed, (
             "observer was counted toward the commit quorum"
         )
+    finally:
+        _stop_all(nhs)
+
+
+def test_witness_group_enrolls_and_witness_ack_commits(tmp_path):
+    """A witness-bearing group enrolls; the witness receives metadata-only
+    native replication and its ack CARRIES quorum weight: with one voter
+    stopped, leader + witness keep committing (reference witness role)."""
+    sms = {}
+    ports = _ports(3)
+    addrs = {i + 1: f"127.0.0.1:{ports[i]}" for i in range(3)}
+    voters = {i: addrs[i] for i in (1, 2)}
+    nhs = {i: _mk(i, addrs, tmp_path, sms, initial=voters) for i in (1, 2)}
+    try:
+        lid, leader = _leader(nhs)
+        _propose_all(leader, [b"pre"])
+        leader.sync_request_add_witness(CID, 3, addrs[3], timeout=10.0)
+        nhs[3] = _mk(3, addrs, tmp_path, sms, join=True, is_witness=True)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            m = leader.sync_get_cluster_membership(CID, timeout=10.0)
+            if 3 in m.witnesses:
+                break
+            time.sleep(0.1)
+        assert 3 in m.witnesses
+        assert _wait_enrolled(leader), "witness-bearing group never enrolled"
+        st0 = leader.fastlane.stats()
+        _propose_all(leader, [b"w%d" % i for i in range(20)])
+        assert leader.fastlane.stats()["proposed"] > st0["proposed"]
+        # the witness's scalar log holds only metadata twins
+        r3 = nhs[3].get_node(CID).peer.raft
+        deadline = time.time() + 20
+        while time.time() < deadline and r3.log.last_index() < 22:
+            time.sleep(0.05)
+        from dragonboat_tpu.wire import EntryType
+
+        ents = r3.log.get_entries(
+            r3.log.first_index(), r3.log.last_index() + 1, 1 << 62
+        )
+        assert ents and all(
+            e.type in (EntryType.METADATA, EntryType.CONFIG_CHANGE)
+            or not e.cmd
+            for e in ents
+        ), "witness received payload bytes through the native lane"
+        # stop the OTHER voter: leader + witness = 2 of 3 voting members,
+        # proposals must still complete (the witness ack is the quorum)
+        other = next(i for i in (1, 2) if i != lid)
+        nhs[other].stop()
+        del nhs[other]
+        _propose_all(nhs[lid], [b"after-voter-loss"], timeout=30.0)
     finally:
         _stop_all(nhs)

@@ -220,3 +220,62 @@ def test_observer_echo_confirms_no_read():
         assert nat.next_read(500) == (CID, 42, 43, 3)
     finally:
         nat.stop()
+
+
+def test_witness_gets_metadata_entries_and_counts_in_quorum():
+    """A witness peer (role 2) receives METADATA-only twins of each
+    entry (make_metadata_entries raft.py:104) but its ack IS quorum
+    weight — reference witness semantics."""
+    from dragonboat_tpu.wire import EntryType
+
+    kv = NativeKV(tempfile.mkdtemp())
+    nat = natraft.NatRaft("127.0.0.1:1", deployment_id=DEP, bin_ver=1)
+    nat.set_shards([kv._h])
+    nat.add_remote()
+    nat.add_remote()
+    nat.start()
+    assert nat.enroll(
+        cluster_id=CID, node_id=2, term=2, vote=2, leader_id=2,
+        is_leader=True, last_index=3, commit=3, processed=3, log_first=4,
+        prev_term=2, shard=0, hb_period_ms=50, elect_timeout_ms=1000,
+        term_commit_ok=True,
+        peers=[(1, 0, 3, 4, 1), (3, 1, 3, 4, 2)], tail=b"",
+    )
+    try:
+        idx = nat.propose(CID, key=1, client_id=0, series_id=0,
+                          responded_to=0, etype=0, cmd=b"payload-bytes")
+        assert idx == 4
+
+        # voter (slot 0) gets the real entry; witness (slot 1) metadata
+        def entries_on(slot):
+            out = []
+            for t, m in _sent_types(nat, slot):
+                if t == MT.REPLICATE and m.entries:
+                    out.extend(m.entries)
+            return out
+
+        deadline = time.time() + 5
+        ve = we = None
+        while time.time() < deadline and not (ve and we):
+            ve = ve or (entries_on(0) or None)
+            we = we or (entries_on(1) or None)
+            time.sleep(0.02)
+        assert ve and we, (ve, we)
+        assert ve[0].index == 4 and ve[0].cmd, "voter entry lost payload"
+        assert we[0].index == 4 and we[0].term == ve[0].term
+        assert we[0].type == EntryType.METADATA and not we[0].cmd, (
+            "witness did not get a metadata twin"
+        )
+        # witness ack counts toward commit (3 voting members: self +
+        # witness = quorum 2)
+        nat.ingest(_batch(_resp(3, 4)))
+        deadline = time.time() + 5.0
+        got = 0
+        while time.time() < deadline:
+            got = nat.read_index(CID, 9, 10)
+            if got == 4:
+                break
+            time.sleep(0.01)
+        assert got == 4, f"witness ack did not count toward commit ({got})"
+    finally:
+        nat.stop()
