@@ -8,6 +8,13 @@ PY ?= python
 test: native
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 
+# parallel run: heavy multi-NodeHost modules carry
+# xdist_group("heavy-multiprocess") and serialize on one worker while
+# the light majority fans out (4 workers x multiprocess clusters
+# starve each other on the 8-vCPU box otherwise)
+test-par: native
+	$(PY) -m pytest tests/ -q -n auto --dist loadgroup
+
 test-all: native
 	$(PY) -m pytest tests/ -x -q
 

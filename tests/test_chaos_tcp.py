@@ -25,6 +25,12 @@ from dragonboat_tpu import Config, NodeHost, NodeHostConfig, Result
 from dragonboat_tpu.linearizability import HistoryRecorder, check_linearizable
 from dragonboat_tpu.monkey import get_applied_index, get_state_hash
 
+# heavy multi-NodeHost tests serialize on one xdist worker
+# (--dist loadgroup): 4-way-parallel multiprocess clusters
+# starve each other on an 8-vCPU box
+pytestmark = pytest.mark.xdist_group("heavy-multiprocess")
+
+
 RTT = 20
 CID = 9
 SHARED_KEYS = ["x0", "x1", "x2", "x3"]

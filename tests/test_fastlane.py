@@ -3,8 +3,9 @@
 Three NodeHosts over the real framed-TCP transport with the durable native
 LogDB — the deployment shape where `ExpertConfig.fast_lane` activates.
 Covers: enrollment at quiescence, native steady-state replication with
-client completion, ReadIndex forcing eject + re-enroll, follower and
-leader kill/restart recovery through the eject protocol, and full-cluster
+client completion, in-lane ReadIndex on both leader and followers (zero
+ejects), observer/witness-bearing enrollment, follower and leader
+kill/restart recovery through the eject protocol, and full-cluster
 restart replaying natively written WAL records through the Python path.
 """
 from __future__ import annotations
@@ -18,9 +19,12 @@ from dragonboat_tpu import Config, NodeHost, NodeHostConfig, Result
 from dragonboat_tpu.config import ExpertConfig
 from dragonboat_tpu.native import natraft
 
-pytestmark = pytest.mark.skipif(
+# heavy multi-NodeHost tests serialize on one xdist worker
+# (--dist loadgroup): 4-way-parallel multiprocess clusters
+# starve each other on an 8-vCPU box
+pytestmark = [pytest.mark.skipif(
     not natraft.available(), reason="libnatraft unavailable"
-)
+), pytest.mark.xdist_group("heavy-multiprocess")]
 
 RTT = 20
 CID = 31
@@ -121,11 +125,17 @@ def _wait_enrolled(nh, timeout=15.0, want=True):
     return False
 
 
-def _propose_all(nh, payloads, timeout=30.0):
+def _propose_all(nh, payloads, deadline_s=180.0):
+    """Exact-count helper: every payload must complete exactly once, so
+    timed-out proposes are NOT retried (outcome unknown -> duplicate
+    risk); instead the tick budget is generous and completion is waited
+    to a shared wall deadline, so CI starvation stretches runtime, not
+    the verdict."""
     s = nh.get_noop_session(CID)
-    pending = [nh.propose(s, p, timeout=10.0) for p in payloads]
+    deadline = time.time() + deadline_s
+    pending = [nh.propose(s, p, timeout=60.0) for p in payloads]
     for rs in pending:
-        r = rs.wait(timeout)
+        r = rs.wait(max(0.1, deadline - time.time()))
         assert r.completed, r
     return len(pending)
 
@@ -441,14 +451,13 @@ def test_witness_group_enrolls_and_witness_ack_commits(tmp_path):
         )
         assert ents and all(
             e.type in (EntryType.METADATA, EntryType.CONFIG_CHANGE)
-            or not e.cmd
             for e in ents
-        ), "witness received payload bytes through the native lane"
+        ), "witness log must hold only METADATA/CONFIG_CHANGE entries"
         # stop the OTHER voter: leader + witness = 2 of 3 voting members,
         # proposals must still complete (the witness ack is the quorum)
         other = next(i for i in (1, 2) if i != lid)
         nhs[other].stop()
         del nhs[other]
-        _propose_all(nhs[lid], [b"after-voter-loss"], timeout=30.0)
+        _propose_all(nhs[lid], [b"after-voter-loss"])
     finally:
         _stop_all(nhs)

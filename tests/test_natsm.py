@@ -20,10 +20,13 @@ from dragonboat_tpu.config import ExpertConfig
 from dragonboat_tpu.native import natraft, natsm
 from dragonboat_tpu.native.natsm import NativeKVStateMachine
 
-pytestmark = pytest.mark.skipif(
+# heavy multi-NodeHost tests serialize on one xdist worker
+# (--dist loadgroup): 4-way-parallel multiprocess clusters
+# starve each other on an 8-vCPU box
+pytestmark = [pytest.mark.skipif(
     not (natraft.available() and natsm.available()),
     reason="native libraries unavailable",
-)
+), pytest.mark.xdist_group("heavy-multiprocess")]
 
 RTT = 20
 CID = 41
@@ -167,19 +170,19 @@ def test_native_apply_end_to_end(tmp_path):
         s = leader.get_noop_session(CID)
         # first writes may ride the scalar plane (pre-enrollment)
         pend = [
-            leader.propose(s, f"k{j}=v{j}".encode(), timeout=10.0)
+            leader.propose(s, f"k{j}=v{j}".encode(), timeout=60.0)
             for j in range(200)
         ]
         for rs in pend:
-            assert rs.wait(30.0).completed
+            assert rs.wait(120.0).completed
         assert _wait_native_applies(nhs), "native SM never attached"
         # these complete through the NATIVE apply + completion pump
         pend = [
-            leader.propose(s, f"n{j}=w{j}".encode(), timeout=10.0)
+            leader.propose(s, f"n{j}=w{j}".encode(), timeout=60.0)
             for j in range(300)
         ]
         for rs in pend:
-            assert rs.wait(30.0).completed
+            assert rs.wait(120.0).completed
         assert leader.sync_read(CID, "n299", timeout=10.0) == "w299"
         _converged_hashes(sms)
         for i, nh in nhs.items():
@@ -204,8 +207,8 @@ def test_native_apply_eject_and_snapshot(tmp_path):
         lid, leader = _leader(nhs)
         s = leader.get_noop_session(CID)
         for j in range(150):  # crosses several snapshot boundaries
-            rs = leader.propose(s, f"s{j}=x{j}".encode(), timeout=10.0)
-            assert rs.wait(30.0).completed
+            rs = leader.propose(s, f"s{j}=x{j}".encode(), timeout=60.0)
+            assert rs.wait(120.0).completed
         assert leader.sync_read(CID, "s149", timeout=10.0) == "x149"
         _converged_hashes(sms)
         # the lane must still be usable after the snapshot eject cycles
@@ -223,8 +226,8 @@ def test_native_apply_leader_kill_failover(tmp_path):
         lid, leader = _leader(nhs)
         s = leader.get_noop_session(CID)
         for j in range(100):
-            rs = leader.propose(s, f"a{j}=b{j}".encode(), timeout=10.0)
-            assert rs.wait(30.0).completed
+            rs = leader.propose(s, f"a{j}=b{j}".encode(), timeout=60.0)
+            assert rs.wait(120.0).completed
         assert _wait_native_applies(nhs)
         leader.stop()
         del nhs[lid]
@@ -232,8 +235,8 @@ def test_native_apply_leader_kill_failover(tmp_path):
         assert new_lid != lid
         s2 = new_leader.get_noop_session(CID)
         for j in range(50):
-            rs = new_leader.propose(s2, f"c{j}=d{j}".encode(), timeout=10.0)
-            assert rs.wait(30.0).completed
+            rs = new_leader.propose(s2, f"c{j}=d{j}".encode(), timeout=60.0)
+            assert rs.wait(120.0).completed
         assert new_leader.sync_read(CID, "c49", timeout=20.0) == "d49"
         # restart the killed rank against its dirs; all three converge
         sms2 = dict(sms)

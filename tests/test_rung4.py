@@ -33,7 +33,10 @@ from dragonboat_tpu.wire import Entry, Message, MessageType as MT
 
 from tests.raft_harness import new_test_raft
 
-pytestmark = pytest.mark.slow
+# heavy multi-NodeHost tests serialize on one xdist worker
+# (--dist loadgroup): 4-way-parallel multiprocess clusters
+# starve each other on an 8-vCPU box
+pytestmark = [pytest.mark.slow, pytest.mark.xdist_group("heavy-multiprocess")]
 
 N = 65_536
 SAMPLE = 256

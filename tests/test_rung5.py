@@ -26,7 +26,10 @@ from dragonboat_tpu.wire import Entry, Message, MessageType as MT
 
 from tests.test_rung4 import FakeNode, _assert_parity, _mk_oracle
 
-pytestmark = pytest.mark.slow
+# heavy multi-NodeHost tests serialize on one xdist worker
+# (--dist loadgroup): 4-way-parallel multiprocess clusters
+# starve each other on an 8-vCPU box
+pytestmark = [pytest.mark.slow, pytest.mark.xdist_group("heavy-multiprocess")]
 
 N = 100_000
 SAMPLE = 128
