@@ -314,19 +314,22 @@ def _slim_e2e(e2e: dict) -> dict:
     if isinstance(fl, list):
         ranks = [r for r in fl if isinstance(r, dict)]
         if ranks:
+            # scalars only: three e2e sections ride one stdout line and
+            # the per-rank lists overflowed the driver's 2000-char tail
+            # (full per-rank stats live in BENCH_DETAIL.json)
+            duties = [
+                r.get("enroll_duty") for r in ranks
+                if isinstance(r.get("enroll_duty"), (int, float))
+            ]
             out["fastlane"] = {
-                # led-only (round-3-comparable) and all-replica populations
-                "enrolled_now": [r.get("enrolled_now") for r in ranks],
-                "led": [r.get("led") for r in ranks],
-                "enrolled_replicas": [
-                    r.get("enrolled_replicas") for r in ranks
-                ],
-                "enroll_duty": [r.get("enroll_duty") for r in ranks],
-                "ejects": [
+                "enroll_duty_min": min(duties) if duties else None,
+                "ejects": sum(
                     sum((r.get("eject_reasons") or {}).values())
                     for r in ranks
-                ],
-                "dropped_spans": [r.get("dropped_spans") for r in ranks],
+                ),
+                "dropped_spans": sum(
+                    r.get("dropped_spans") or 0 for r in ranks
+                ),
             }
     if e2e.get("rank_errors"):
         out["rank_errors"] = len(e2e["rank_errors"])
@@ -630,7 +633,10 @@ def main() -> None:
             slim[k] = _slim_e2e(slim[k])
     slim.pop("tpu_probe", None)
     if not on_tpu and PROBE_LOG:
-        slim["tpu_probe_last"] = PROBE_LOG[-1]
+        last = dict(PROBE_LOG[-1])
+        if "stderr" in last:  # full stderr stays in BENCH_DETAIL.json
+            last["stderr"] = last["stderr"][-160:]
+        slim["tpu_probe_last"] = last
     tpu_required = os.environ.get("BENCH_PLATFORM") != "cpu"
     record = {
         "metric": "quorum_engine_writes_per_sec",
