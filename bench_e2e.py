@@ -435,7 +435,10 @@ def run(
        rest stay idle — the propose→applied commit-latency distribution
        (BASELINE.md's P99 commit latency axis).
     """
-    payload = b"0123456789abcdef"  # 16B (BASELINE.md ladder payload)
+    payload = b"0123456789abcdef" * max(
+        1, int(os.environ.get("E2E_PAYLOAD", "16")) // 16
+    )  # 16B default (BASELINE.md ladder payload); E2E_PAYLOAD=1024 for
+    # the reference latency table's large-payload axis
     tmp = None
     dirs = None
     if durable:
@@ -689,7 +692,9 @@ def rank_main() -> int:
     rc = 0
     stage = "TPUT"  # tag the parent is blocked on; errors must carry it
     try:
-        payload = b"0123456789abcdef"
+        payload = b"0123456789abcdef" * max(
+            1, int(os.environ.get("E2E_PAYLOAD", "16")) // 16
+        )
         # phase 1: throughput — every led group, window in flight
         plan = expect("RUN")
         while time.time() < plan["t0"]:
@@ -996,7 +1001,9 @@ def run_mp(
             "sm": os.environ.get("E2E_SM", "python"),
             "leader_mode": leader_mode,
             "durable": durable,
-            "payload_bytes": 16,
+            "payload_bytes": 16 * max(
+                1, int(os.environ.get("E2E_PAYLOAD", "16")) // 16
+            ),
             "setup_s": round(setup_s, 1),
             "led_groups": led_total,
             "writes_per_sec": writes_per_sec,
