@@ -83,6 +83,14 @@ class CounterSM:
         pass
 
 
+def _payload() -> bytes:
+    """E2E_PAYLOAD bytes (default 16; 1024 for the reference latency
+    table's large-payload axis), rounded down to a 16B multiple."""
+    return b"0123456789abcdef" * max(
+        1, int(os.environ.get("E2E_PAYLOAD", "16")) // 16
+    )
+
+
 BASE_CID = 1000
 
 
@@ -435,10 +443,7 @@ def run(
        rest stay idle — the propose→applied commit-latency distribution
        (BASELINE.md's P99 commit latency axis).
     """
-    payload = b"0123456789abcdef" * max(
-        1, int(os.environ.get("E2E_PAYLOAD", "16")) // 16
-    )  # 16B default (BASELINE.md ladder payload); E2E_PAYLOAD=1024 for
-    # the reference latency table's large-payload axis
+    payload = _payload()  # 16B default (BASELINE.md ladder payload)
     tmp = None
     dirs = None
     if durable:
@@ -692,9 +697,7 @@ def rank_main() -> int:
     rc = 0
     stage = "TPUT"  # tag the parent is blocked on; errors must carry it
     try:
-        payload = b"0123456789abcdef" * max(
-            1, int(os.environ.get("E2E_PAYLOAD", "16")) // 16
-        )
+        payload = _payload()
         # phase 1: throughput — every led group, window in flight
         plan = expect("RUN")
         while time.time() < plan["t0"]:
@@ -1001,9 +1004,7 @@ def run_mp(
             "sm": os.environ.get("E2E_SM", "python"),
             "leader_mode": leader_mode,
             "durable": durable,
-            "payload_bytes": 16 * max(
-                1, int(os.environ.get("E2E_PAYLOAD", "16")) // 16
-            ),
+            "payload_bytes": len(_payload()),
             "setup_s": round(setup_s, 1),
             "led_groups": led_total,
             "writes_per_sec": writes_per_sec,

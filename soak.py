@@ -123,11 +123,30 @@ def rank_main() -> int:
     hist_f = open(hist_path, "a", buffering=1)
     hist_mu = threading.Lock()
 
-    def record(client, kind, key, value, t0, t1, ok):
+    # WRITE-AHEAD history (Jepsen-style invoke/ret pairs): the invoke
+    # line lands on disk BEFORE the operation is issued, so a kill -9
+    # between "proposal committed server-side" and "completion recorded"
+    # leaves an unmatched invoke that the checker treats as an op with
+    # UNKNOWN outcome — not a hole.  (A 32-group soak caught exactly
+    # this: a killed rank's committed put vanished from its history and
+    # two other ranks' reads of it looked like phantom values.)
+    op_seq = [0]
+
+    def record_invoke(client, kind, key, value, t0):
+        with hist_mu:
+            op_seq[0] += 1
+            oid = op_seq[0]
+            hist_f.write(json.dumps({
+                "ev": "inv", "id": oid, "client": client, "kind": kind,
+                "key": key, "value": value, "invoke": t0,
+            }) + "\n")
+            return oid
+
+    def record_ret(oid, value, t1, ok):
         with hist_mu:
             hist_f.write(json.dumps({
-                "client": client, "kind": kind, "key": key,
-                "value": value, "invoke": t0, "ret": t1, "ok": ok,
+                "ev": "ret", "id": oid, "value": value, "ret": t1,
+                "ok": ok,
             }) + "\n")
 
     paused = threading.Event()
@@ -162,24 +181,28 @@ def rank_main() -> int:
                 continue
             key = f"g{cid}:x{rng.randrange(2)}"
             t0 = time.time()
+            if is_put:
+                val = f"r{rank}n{rng.randrange(1 << 30)}"
+                oid = record_invoke(client, "put", key, val, t0)
+            else:
+                val = None
+                oid = record_invoke(client, "get", key, None, t0)
             try:
                 if is_put:
-                    val = f"r{rank}n{rng.randrange(1 << 30)}"
                     s = session.get(cid)
                     if s is None:
                         s = session[cid] = nh.get_noop_session(cid)
                     rs = nh.propose(s, f"{key}={val}".encode(), timeout=5.0)
                     r = rs.wait(5.0)
-                    record(client, "put", key, val, t0, time.time()
-                           if r.completed else None, bool(r.completed))
+                    record_ret(oid, val, time.time()
+                               if r.completed else None, bool(r.completed))
                 else:
                     v = nh.sync_read(cid, key, timeout=5.0)
-                    record(client, "get", key, v, t0, time.time(), True)
+                    record_ret(oid, v, time.time(), True)
             except Exception:
                 # timeout/dropped: outcome unknown — the checker treats a
                 # None ret as an op concurrent with everything after it
-                record(client, "put" if is_put else "get",
-                       key, val if is_put else None, t0, None, False)
+                record_ret(oid, val, None, False)
             time.sleep(0.4)  # pace: bounded per-key history length
 
     def load(tid):
@@ -414,18 +437,38 @@ def _check_histories(base, groups):
     for fn in sorted(os.listdir(base)):
         if not fn.startswith("history."):
             continue
+        # write-ahead pairs: "inv" lines land BEFORE the op is issued,
+        # "ret" lines after.  An inv with no ret (the rank was killed
+        # mid-op, or its ret line was torn) is an op with UNKNOWN
+        # outcome — a killed rank's committed-but-unrecorded put must
+        # stay representable or other ranks' reads of it look phantom.
+        pend = {}
         with open(os.path.join(base, fn)) as f:
-            for ln in f:
-                try:
-                    d = json.loads(ln)
-                except json.JSONDecodeError:
-                    continue  # torn final line from a kill -9
+            lines = f.readlines()
+        for ln in lines:
+            try:
+                d = json.loads(ln)
+            except json.JSONDecodeError:
+                continue  # torn final line from a kill -9
+            if d.get("ev") == "inv":
+                pend[d["id"]] = d
+            elif d.get("ev") == "ret":
+                inv = pend.pop(d["id"], None)
+                if inv is None:
+                    continue  # ret whose inv line was torn: drop
                 ops.append(Op(
-                    client=d["client"], kind=d["kind"], key=d["key"],
-                    value=d["value"], invoke=d["invoke"],
+                    client=inv["client"], kind=inv["kind"],
+                    key=inv["key"], value=d["value"],
+                    invoke=inv["invoke"],
                     ret=d["ret"] if d["ret"] is not None else INF,
                     ok=bool(d["ok"]),
                 ))
+        for inv in pend.values():  # unmatched: unknown outcome
+            ops.append(Op(
+                client=inv["client"], kind=inv["kind"], key=inv["key"],
+                value=inv["value"], invoke=inv["invoke"],
+                ret=INF, ok=False,
+            ))
     ok, bad = check_linearizable(ops)
     return ok, bad, len(ops)
 
