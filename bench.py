@@ -128,47 +128,63 @@ def build_state(n_groups: int, event_cap: int, n_peers: int = 3,
     return eng
 
 
-def _staged_multistep_fn(n_groups: int, rounds: int, cap: int):
-    """Jitted R-round staged dispatch; event tensors derived on device."""
+def _staged_multistep_fn(n_groups: int, rounds: int):
+    """Jitted R-round staged dispatch; event tensors derived on device.
+
+    Uses the DENSE ingestion kernel (kernels.quorum_step_dense_impl): a
+    round's acks collapse into a per-(group, peer) max matrix — exact,
+    because scatter-max aggregation is order-independent — and ingestion
+    becomes pure elementwise max/or, which measured 7× faster than the
+    scatter form at this shape (14.0 → 2.0 ms/round at 131k groups).
+    Each round every group's leader self-acks and one follower acks the
+    next index, the same per-round traffic the sparse staging produced
+    (committed advances exactly one index per group per round; _run_mode
+    asserts it).
+    """
     import jax
     import jax.numpy as jnp
 
-    from dragonboat_tpu.ops.kernels import quorum_multistep
+    from dragonboat_tpu.ops.kernels import quorum_step_dense_impl
+
+    n_peers = 3
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def staged_multistep(st, base_index):
-        rows = jnp.arange(n_groups, dtype=jnp.int32)
-        ack_g = jnp.broadcast_to(jnp.concatenate([rows, rows]), (rounds, cap))
-        ack_p = jnp.broadcast_to(
-            jnp.concatenate(
-                [
-                    jnp.zeros((n_groups,), jnp.int32),
-                    jnp.ones((n_groups,), jnp.int32),
-                ]
-            ),
-            (rounds, cap),
+        touched = jnp.broadcast_to(
+            jnp.arange(n_peers, dtype=jnp.int32)[None, :] < 2,
+            (n_groups, n_peers),
         )
-        vals = base_index + 1 + jnp.arange(rounds, dtype=jnp.int32)
-        ack_val = jnp.broadcast_to(vals[:, None], (rounds, cap))
-        ack_valid = jnp.ones((rounds, cap), bool)
-        zeros_i32 = jnp.zeros((rounds, cap), jnp.int32)
-        return quorum_multistep(
-            st,
-            ack_g,
-            ack_p,
-            ack_val,
-            ack_valid,
-            zeros_i32,
-            zeros_i32,
-            jnp.zeros((rounds, cap), jnp.int8),
-            jnp.zeros((rounds, cap), bool),
-            do_tick=True,
-            # every benched row is a LEADER (build_state set_leader), and
-            # the contact-reset writes only non-leader rows (masked by
-            # `contacted & nonleader`) — provably a no-op here, so the
-            # scatter (~8%/round at 131k groups) is compiled out; ticks
-            # themselves stay on (heartbeat/check-quorum clocks run)
-            track_contact=False,
+
+        def body(carry, r):
+            vals = jnp.where(
+                jnp.arange(n_peers, dtype=jnp.int32)[None, :] < 2,
+                base_index + 1 + r,
+                0,
+            )
+            ack_max = jnp.broadcast_to(vals, (n_groups, n_peers))
+            out = quorum_step_dense_impl(
+                carry,
+                ack_max,
+                touched,
+                jnp.zeros((1, 1), jnp.int8),
+                do_tick=True,
+                # every benched row is a LEADER (build_state set_leader),
+                # and the contact reset writes only non-leader rows —
+                # provably a no-op here, so it compiles out; ticks
+                # themselves stay on (heartbeat/check-quorum clocks run)
+                track_contact=False,
+                has_votes=False,
+            )
+            return out.state, None
+
+        st, _ = jax.lax.scan(
+            body, st, jnp.arange(rounds, dtype=jnp.int32)
+        )
+        from dragonboat_tpu.ops.kernels import StepOutputs, TickFlags
+
+        zeros = jnp.zeros((n_groups,), bool)
+        return StepOutputs(
+            st, st.committed, zeros, zeros, TickFlags(zeros, zeros, zeros)
         )
 
     return staged_multistep
@@ -179,10 +195,11 @@ def _run_mode(n_groups: int, rounds: int, dispatches: int, warmup: int = 3):
     import jax
     import jax.numpy as jnp
 
-    cap = 2 * n_groups  # self-ack + follower ack per group per round
-    eng = build_state(n_groups, cap)
+    # event_cap only matters for the engine's own sparse staging (unused
+    # by the dense staged dispatch); keep it minimal
+    eng = build_state(n_groups, 64)
     st = eng.dev
-    staged = _staged_multistep_fn(n_groups, rounds, cap)
+    staged = _staged_multistep_fn(n_groups, rounds)
 
     def dispatch(st, base_index):
         t0 = time.perf_counter()

@@ -24,5 +24,8 @@ from .kernels import (  # noqa: F401
     check_quorum,
     tick_step,
     quorum_step,
+    quorum_step_dense,
+    quorum_multistep,
+    quorum_multistep_dense,
 )
 from .engine import BatchedQuorumEngine  # noqa: F401

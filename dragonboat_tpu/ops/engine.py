@@ -88,10 +88,28 @@ class BatchedQuorumEngine:
         event_cap: int = DEFAULT_EVENT_CAP,
         sharding=None,
         device_ticks: bool = True,
+        dense_ingest: str | bool = "auto",
     ):
         self.n_groups = n_groups
         self.n_peers = n_peers
         self.event_cap = event_cap
+        #: dense-ingestion policy: collapse a round's acks into a (G,P)
+        #: max matrix and dispatch the scatter-free dense kernel (see
+        #: kernels.quorum_step_dense_impl — ~7× at full occupancy on TPU).
+        #: "auto" picks per dispatch by byte volume: dense uploads
+        #: 6·G·P bytes vs ~13 per sparse event, so dense wins once the
+        #: staged acks outnumber ~G·P/2.  True forces dense, False never.
+        # identity checks: `1 in (True, ...)` would pass by int equality
+        if not (
+            dense_ingest is True
+            or dense_ingest is False
+            or dense_ingest == "auto"
+        ):
+            raise ValueError(
+                f"dense_ingest must be True, False, or 'auto', got {dense_ingest!r}"
+            )
+        self.dense_ingest = dense_ingest
+        self._dense_threshold = (n_groups * n_peers) // 2
         #: whether this engine EVER runs tick_step on device.  Contact
         #: events (leader_contact zero-acks) are one-shot, so a ticking
         #: engine must apply the election-clock reset on every round —
@@ -429,20 +447,33 @@ class BatchedQuorumEngine:
         prev_committed = np.asarray(self.dev.committed)
 
         ack_g, ack_p, ack_v = self._gather_acks()
-        pos = 0
-        while (ack_g.size - pos) > self.event_cap or len(self._votes) > self.event_cap:
-            take = min(self.event_cap, ack_g.size - pos)
-            self._dispatch(
-                (ack_g[pos : pos + take], ack_p[pos : pos + take],
-                 ack_v[pos : pos + take]),
-                self._votes[: self.event_cap],
-                False,
+        # dense mode collapses ANY number of acks/votes into (G,P)
+        # matrices — no cap, no chunk loop (votes are already first-wins
+        # deduped per cell, so a dense matrix holds a whole round)
+        if self.dense_ingest is True or (
+            self.dense_ingest == "auto"
+            and (
+                ack_g.size >= self._dense_threshold
+                or ack_g.size > self.event_cap
+                or len(self._votes) > self.event_cap
             )
-            pos += take
-            del self._votes[: self.event_cap]
-        out = self._dispatch(
-            (ack_g[pos:], ack_p[pos:], ack_v[pos:]), self._votes, do_tick
-        )
+        ):
+            out = self._dispatch_dense(ack_g, ack_p, ack_v, self._votes, do_tick)
+        else:
+            pos = 0
+            while (ack_g.size - pos) > self.event_cap or len(self._votes) > self.event_cap:
+                take = min(self.event_cap, ack_g.size - pos)
+                self._dispatch(
+                    (ack_g[pos : pos + take], ack_p[pos : pos + take],
+                     ack_v[pos : pos + take]),
+                    self._votes[: self.event_cap],
+                    False,
+                )
+                pos += take
+                del self._votes[: self.event_cap]
+            out = self._dispatch(
+                (ack_g[pos:], ack_p[pos:], ack_v[pos:]), self._votes, do_tick
+            )
         self._votes.clear()
         self._voted_cells.clear()
 
@@ -522,7 +553,14 @@ class BatchedQuorumEngine:
             ag, ap, av, avalid = self._pad_ack_arrays(*acks)
         else:
             ag, ap, av, avalid = self._pad(acks, 3)
-        vg, vp, vv, vvalid = self._pad(votes, 1)
+        if votes:
+            vg, vp, vv, vvalid = self._pad(votes, 1)
+        else:
+            # vote-free round: the has_votes=False variant compiles the
+            # vote scatter out entirely; the args are unused dummies
+            vg = vp = np.zeros((1,), np.int32)
+            vv = np.zeros((1,), np.int8)
+            vvalid = np.zeros((1,), bool)
         out = quorum_step(
             self.dev,
             jnp.asarray(ag),
@@ -538,6 +576,41 @@ class BatchedQuorumEngine:
             # engine (defensive: a stray do_tick=True call would otherwise
             # consume one-shot contact acks without the reset)
             track_contact=self.device_ticks or do_tick,
+            has_votes=bool(votes),
+        )
+        self.dev = out.state
+        return out
+
+    def _dispatch_dense(self, ag, ap, av, votes, do_tick: bool):
+        """Aggregate a round's events into (G,P) matrices and run the
+        scatter-free dense kernel (kernels.quorum_step_dense_impl)."""
+        from .kernels import quorum_step_dense
+
+        g, p = self.n_groups, self.n_peers
+        ack_max = np.zeros((g, p), np.int32)
+        touched = np.zeros((g, p), bool)
+        if ag.size:
+            # max-aggregation == scatter-max: order-independent, exact.
+            # Flat 1-D indexing keeps ufunc.at on numpy's contiguous fast
+            # path (the 2-D tuple form is several× slower at the very
+            # occupancies that select the dense path).
+            cell = ag.astype(np.int64) * p + ap
+            np.maximum.at(ack_max.reshape(-1), cell, av)
+            touched.reshape(-1)[cell] = True
+        if votes:
+            vote_new = np.full((g, p), VOTE_NONE, np.int8)
+            cols = np.array(votes, dtype=np.int64).T
+            vote_new[cols[0], cols[1]] = cols[2].astype(np.int8)
+        else:
+            vote_new = np.zeros((1, 1), np.int8)  # unused dummy
+        out = quorum_step_dense(
+            self.dev,
+            jnp.asarray(ack_max),
+            jnp.asarray(touched),
+            jnp.asarray(vote_new),
+            do_tick=do_tick,
+            track_contact=self.device_ticks or do_tick,
+            has_votes=bool(votes),
         )
         self.dev = out.state
         return out

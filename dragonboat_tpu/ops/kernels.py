@@ -42,10 +42,28 @@ from .state import (
 
 
 def _kth_largest(values: jax.Array, mask: jax.Array, k: jax.Array) -> jax.Array:
-    """Row-wise k-th largest of masked values; k is 1-based, (G,)."""
+    """Row-wise k-th largest of masked values; k is 1-based, (G,).
+
+    Rank-select instead of sort: with P peer slots, each element's
+    descending rank is the count of elements that beat it (value, then
+    slot index as the stable tie-break), a (G,P,P) elementwise compare
+    that the VPU eats — ``jnp.sort`` over a tiny trailing axis compiles
+    to a padded bitonic network that measured ~2.4ms/round at 131k
+    groups on TPU vs ~0.5ms for the rank form.  Ranks are a permutation
+    of 0..P-1 (ties broken by slot), so exactly one element has rank
+    k-1 and a masked sum selects it; the selected *value* is identical
+    to the sort formulation's (ties share the value).
+    """
     masked = jnp.where(mask, values, INDEX_MIN)
-    desc = jnp.flip(jnp.sort(masked, axis=1), axis=1)
-    return jnp.take_along_axis(desc, (k - 1)[:, None], axis=1)[:, 0]
+    v_i = masked[:, :, None]  # candidate
+    v_j = masked[:, None, :]  # competitor
+    slot = jnp.arange(masked.shape[1], dtype=I32)
+    beats = (v_j > v_i) | (
+        (v_j == v_i) & (slot[None, None, :] < slot[None, :, None])
+    )
+    rank = jnp.sum(beats, axis=2).astype(I32)  # 0-based, descending, unique
+    sel = rank == (k - 1)[:, None]
+    return jnp.sum(jnp.where(sel, masked, 0), axis=1)
 
 
 def commit_quorum(
@@ -161,6 +179,7 @@ def quorum_step_impl(
     vote_valid: jax.Array,  # (K,) bool
     do_tick: bool = True,
     track_contact: bool = True,
+    has_votes: bool = True,
 ) -> StepOutputs:
     """ONE fused dispatch for a whole engine round (SURVEY.md §7).
 
@@ -168,15 +187,24 @@ def quorum_step_impl(
     votes, tally elections, advance commits, then tick clocks.  Ack
     ingestion uses scatter-max (``remote.try_update`` keeps only forward
     progress, so max is exact and order-independent → deterministic).
+
+    ``has_votes=False`` (static) compiles out the vote-event scatter and
+    gather for the common vote-free round; the tally over the standing
+    ``st.votes`` still runs (flags stay idempotent across rounds exactly
+    as with an empty vote batch).  The vote_* args may then be dummies.
     """
     g_total = st.term.shape[0]
     # route invalid events out of bounds; XLA drops them
     ag = jnp.where(ack_valid, ack_g, g_total)
-    vg = jnp.where(vote_valid, vote_g, g_total)
 
     # --- ack ingestion (twin: handleLeaderReplicateResp raft.go:1671) ---
     match = st.match.at[ag, ack_p].max(ack_val, mode="drop")
-    next_ = st.next.at[ag, ack_p].max(ack_val + 1, mode="drop")
+    # remote.next >= remote.match + 1 is a raft invariant every writer
+    # preserves (make_state, set_leader's reset_remotes, rebase, and this
+    # kernel), so the scatter-max of ack_val+1 into ``next`` equals a
+    # dense max against the freshly scattered match — one scatter fewer
+    # (~1ms/round at 131k groups)
+    next_ = jnp.maximum(st.next, match + 1)
     active = st.active.at[ag, ack_p].set(True, mode="drop")
     # leader contact: any event touching a NON-leader row resets its
     # election clock (twin: leader_is_available / raft.go follower
@@ -208,10 +236,31 @@ def quorum_step_impl(
     last_index = jnp.maximum(st.last_index, self_match)
 
     # --- vote ingestion (first vote per peer per term wins) -------------
-    cur = st.votes[vg.clip(0, g_total - 1), vote_p]
-    newv = jnp.where(cur == VOTE_NONE, vote_grant, cur)
-    votes = st.votes.at[vg, vote_p].set(newv, mode="drop")
+    if has_votes:
+        vg = jnp.where(vote_valid, vote_g, g_total)
+        cur = st.votes[vg.clip(0, g_total - 1), vote_p]
+        newv = jnp.where(cur == VOTE_NONE, vote_grant, cur)
+        votes = st.votes.at[vg, vote_p].set(newv, mode="drop")
+    else:
+        votes = st.votes
 
+    return _finish_step(
+        st, match, next_, active, votes, election_tick, last_index, do_tick
+    )
+
+
+def _finish_step(
+    st: QuorumState,
+    match: jax.Array,
+    next_: jax.Array,
+    active: jax.Array,
+    votes: jax.Array,
+    election_tick: jax.Array,
+    last_index: jax.Array,
+    do_tick: bool,
+) -> StepOutputs:
+    """Tally/commit/tick tail shared by the sparse and dense steps — the
+    ingestion front-ends differ, the raft semantics must not."""
     # --- election tally (twin: handleVoteResp / campaign) ---------------
     granted, rejected = vote_tally(votes, st.voting, st.quorum)
     is_cand = (st.node_state == CANDIDATE) & st.live
@@ -247,7 +296,70 @@ def quorum_step_impl(
 
 quorum_step = jax.jit(
     quorum_step_impl,
-    static_argnames=("do_tick", "track_contact"),
+    static_argnames=("do_tick", "track_contact", "has_votes"),
+    donate_argnums=(0,),
+)
+
+
+def quorum_step_dense_impl(
+    st: QuorumState,
+    ack_max: jax.Array,      # (G,P) i32 — max acked rel index, 0 where untouched
+    ack_touched: jax.Array,  # (G,P) bool — slot received ≥1 event this round
+    vote_new: jax.Array,     # (G,P) i8 — VOTE_NONE where no vote event
+    do_tick: bool = True,
+    track_contact: bool = True,
+    has_votes: bool = True,
+) -> StepOutputs:
+    """Dense-ingestion twin of :func:`quorum_step_impl` — zero scatters.
+
+    Scatter-max aggregation is order-independent, so a round's sparse ack
+    events collapse exactly into a per-(group, peer) **max matrix** plus a
+    touched mask; ingestion becomes pure elementwise ``maximum``/``or`` on
+    ``(G, P)`` arrays, which the VPU streams at HBM speed.  Measured on the
+    131k-group headline shape: 14.0 → 2.0 ms/round vs the scatter form —
+    TPU scatters serialize per update window while this form is shape-
+    oblivious.  The engine picks dense vs sparse per dispatch by event
+    occupancy (`BatchedQuorumEngine.step`); both produce bit-identical
+    states (differential: ``tests/test_ops_quorum.py``).
+
+    Caller contract: ``ack_max`` holds 0 in untouched cells (rel indexes
+    are non-negative, so 0 is a max no-op — `ack()` clamps below-base
+    retransmits the same way); ``vote_new`` holds first-wins-deduped vote
+    events (engine.vote dedups within a batch, the kernel guards against
+    standing votes).
+    """
+    # --- ack ingestion ---------------------------------------------------
+    match = jnp.maximum(st.match, jnp.where(ack_touched, ack_max, 0))
+    # next >= match + 1 invariant (see quorum_step_impl)
+    next_ = jnp.maximum(st.next, match + 1)
+    active = st.active | ack_touched
+    if track_contact:
+        contacted = jnp.any(ack_touched, axis=1)
+        nonleader = (st.node_state != LEADER) & st.live
+        election_tick = jnp.where(contacted & nonleader, 0, st.election_tick)
+    else:
+        election_tick = st.election_tick
+    self_match = jnp.take_along_axis(match, st.self_slot[:, None], axis=1)[:, 0]
+    last_index = jnp.maximum(st.last_index, self_match)
+
+    # --- vote ingestion (first vote per peer per term wins) --------------
+    if has_votes:
+        votes = jnp.where(
+            (st.votes == VOTE_NONE) & (vote_new != VOTE_NONE),
+            vote_new,
+            st.votes,
+        )
+    else:
+        votes = st.votes
+
+    return _finish_step(
+        st, match, next_, active, votes, election_tick, last_index, do_tick
+    )
+
+
+quorum_step_dense = jax.jit(
+    quorum_step_dense_impl,
+    static_argnames=("do_tick", "track_contact", "has_votes"),
     donate_argnums=(0,),
 )
 
@@ -264,6 +376,7 @@ def quorum_multistep_impl(
     vote_valid: jax.Array,
     do_tick: bool = True,
     track_contact: bool = True,
+    has_votes: bool = True,
 ) -> StepOutputs:
     """R engine rounds in ONE dispatch via ``lax.scan``.
 
@@ -278,17 +391,30 @@ def quorum_multistep_impl(
     """
 
     def body(carry, ev):
+        if has_votes:
+            args = ev
+        else:
+            # vote args are NOT scanned when has_votes=False; the step
+            # accepts dummies of any shape there
+            z32 = jnp.zeros((1,), I32)
+            args = ev + (z32, z32, jnp.zeros((1,), jnp.int8),
+                         jnp.zeros((1,), jnp.bool_))
         out = quorum_step_impl(
-            carry, *ev, do_tick=do_tick, track_contact=track_contact
+            carry,
+            *args,
+            do_tick=do_tick,
+            track_contact=track_contact,
+            has_votes=has_votes,
         )
         acc = (out.won, out.lost, out.flags)
         return out.state, acc
 
-    st, (won, lost, flags) = jax.lax.scan(
-        body,
-        st,
-        (ack_g, ack_p, ack_val, ack_valid, vote_g, vote_p, vote_grant, vote_valid),
+    xs = (
+        (ack_g, ack_p, ack_val, ack_valid, vote_g, vote_p, vote_grant, vote_valid)
+        if has_votes
+        else (ack_g, ack_p, ack_val, ack_valid)
     )
+    st, (won, lost, flags) = jax.lax.scan(body, st, xs)
     any_ = lambda x: jnp.any(x, axis=0)  # noqa: E731
     return StepOutputs(
         st,
@@ -301,6 +427,63 @@ def quorum_multistep_impl(
 
 quorum_multistep = jax.jit(
     quorum_multistep_impl,
-    static_argnames=("do_tick", "track_contact"),
+    static_argnames=("do_tick", "track_contact", "has_votes"),
+    donate_argnums=(0,),
+)
+
+
+def quorum_multistep_dense_impl(
+    st: QuorumState,
+    ack_max: jax.Array,      # (R,G,P)
+    ack_touched: jax.Array,  # (R,G,P)
+    vote_new: jax.Array,     # (R,G,P) i8
+    do_tick: bool = True,
+    track_contact: bool = True,
+    has_votes: bool = True,
+) -> StepOutputs:
+    """R dense rounds in ONE dispatch (see :func:`quorum_multistep_impl`).
+
+    Stacked ``(R, G, P)`` inputs are only practical when R·G·P stays small
+    or the rounds are derived on device (the headline bench synthesizes
+    them inside its own jit and calls :func:`quorum_step_dense_impl` in a
+    scan directly); this wrapper serves host-staged short pipelines and
+    the differential tests.
+    """
+
+    def body(carry, ev):
+        if has_votes:
+            am, at_, vn = ev
+        else:
+            # vote_new is NOT scanned when has_votes=False (the caller may
+            # pass a dummy of any shape, per the step contract)
+            am, at_ = ev
+            vn = jnp.zeros((1, 1), jnp.int8)
+        out = quorum_step_dense_impl(
+            carry,
+            am,
+            at_,
+            vn,
+            do_tick=do_tick,
+            track_contact=track_contact,
+            has_votes=has_votes,
+        )
+        acc = (out.won, out.lost, out.flags)
+        return out.state, acc
+
+    xs = (ack_max, ack_touched, vote_new) if has_votes else (ack_max, ack_touched)
+    st, (won, lost, flags) = jax.lax.scan(body, st, xs)
+    any_ = lambda x: jnp.any(x, axis=0)  # noqa: E731
+    return StepOutputs(
+        st,
+        st.committed,
+        any_(won),
+        any_(lost),
+        TickFlags(*(any_(f) for f in flags)),
+    )
+
+
+quorum_multistep_dense = jax.jit(
+    quorum_multistep_dense_impl,
+    static_argnames=("do_tick", "track_contact", "has_votes"),
     donate_argnums=(0,),
 )

@@ -492,3 +492,243 @@ def test_ack_block_equivalent_to_per_event_acks():
     with pytest.raises(ValueError):
         a.ack_block(np.array([99], np.int32), np.array([0], np.int32),
                     np.array([1], np.int32))
+
+
+# ----------------------------------------------------------------------
+# dense-ingestion kernel: bit-identity with the sparse scatter kernel
+# ----------------------------------------------------------------------
+
+
+def _random_engine(rng, n_groups=24, n_peers=3, cap=256):
+    eng = BatchedQuorumEngine(n_groups, n_peers, event_cap=cap)
+    for cid in range(1, n_groups + 1):
+        peers = list(range(1, n_peers + 1))
+        eng.add_group(cid, node_ids=peers, self_id=1)
+        role = rng.random()
+        if role < 0.6:
+            eng.set_leader(cid, term=2, term_start=3, last_index=3 + rng.randrange(4))
+        elif role < 0.8:
+            eng.set_candidate(cid, term=2)
+        # else: stays follower
+    eng._upload_dirty()
+    return eng
+
+
+def _state_equal(a, b):
+    for name, va in a._asdict().items():
+        vb = getattr(b, name)
+        assert np.array_equal(np.asarray(va), np.asarray(vb)), name
+
+
+@pytest.mark.parametrize("do_tick", [False, True])
+def test_dense_kernel_matches_sparse_kernel(do_tick):
+    """quorum_step_dense(aggregated batch) ≡ quorum_step(sparse batch).
+
+    Scatter-max aggregation is order-independent, so collapsing a round's
+    events into per-cell maxima must leave every state field and output
+    flag bit-identical — including duplicate acks, stale (lower) acks,
+    zero-value heartbeat acks, and first-wins-deduped votes.
+    """
+    from dragonboat_tpu.ops.kernels import quorum_step, quorum_step_dense
+
+    rng = random.Random(1234 + do_tick)
+    g, p, cap = 24, 3, 256
+    sparse_eng = _random_engine(rng, g, p, cap)
+    dense_eng = _random_engine(random.Random(1234 + do_tick), g, p, cap)
+    _state_equal(sparse_eng.dev, dense_eng.dev)
+
+    for round_no in range(6):
+        # random ack batch: duplicates, stale values, heartbeat zero-acks
+        n_acks = rng.randrange(0, 64)
+        acks = [
+            (rng.randrange(g), rng.randrange(p), rng.choice([0, 1, 2, 5, 9]))
+            for _ in range(n_acks)
+        ]
+        # votes: first-wins per cell (the engine dedups within a batch;
+        # duplicate sparse vote scatters would be scatter-order-defined)
+        vote_cells = {}
+        for _ in range(rng.randrange(0, 8)):
+            cell = (rng.randrange(g), rng.randrange(p))
+            vote_cells.setdefault(cell, rng.choice([0, 1]))
+        votes = [(r, s, v) for (r, s), v in vote_cells.items()]
+
+        # sparse dispatch
+        ag = np.zeros((cap,), np.int32)
+        ap = np.zeros((cap,), np.int32)
+        av = np.zeros((cap,), np.int32)
+        avalid = np.zeros((cap,), bool)
+        for i, (r, s, v) in enumerate(acks):
+            ag[i], ap[i], av[i], avalid[i] = r, s, v, True
+        vg = np.zeros((cap,), np.int32)
+        vp = np.zeros((cap,), np.int32)
+        vv = np.zeros((cap,), np.int8)
+        vvalid = np.zeros((cap,), bool)
+        for i, (r, s, v) in enumerate(votes):
+            vg[i], vp[i], vv[i], vvalid[i] = r, s, v, True
+        out_s = quorum_step(
+            sparse_eng.dev,
+            jnp.asarray(ag), jnp.asarray(ap), jnp.asarray(av),
+            jnp.asarray(avalid), jnp.asarray(vg), jnp.asarray(vp),
+            jnp.asarray(vv), jnp.asarray(vvalid),
+            do_tick=do_tick, track_contact=True, has_votes=True,
+        )
+        sparse_eng.dev = out_s.state
+
+        # dense dispatch of the SAME events, host-aggregated
+        ack_max = np.zeros((g, p), np.int32)
+        touched = np.zeros((g, p), bool)
+        for r, s, v in acks:
+            ack_max[r, s] = max(ack_max[r, s], v)
+            touched[r, s] = True
+        vote_new = np.full((g, p), -1, np.int8)
+        for r, s, v in votes:
+            vote_new[r, s] = v
+        out_d = quorum_step_dense(
+            dense_eng.dev,
+            jnp.asarray(ack_max), jnp.asarray(touched), jnp.asarray(vote_new),
+            do_tick=do_tick, track_contact=True, has_votes=True,
+        )
+        dense_eng.dev = out_d.state
+
+        _state_equal(out_s.state, out_d.state)
+        for field_ in ("committed", "won", "lost"):
+            assert np.array_equal(
+                np.asarray(getattr(out_s, field_)),
+                np.asarray(getattr(out_d, field_)),
+            ), (field_, round_no)
+        for i, fname in enumerate(("elect_due", "hb_due", "checkq_demote")):
+            assert np.array_equal(
+                np.asarray(out_s.flags[i]), np.asarray(out_d.flags[i])
+            ), (fname, round_no)
+
+
+def test_engine_dense_ingest_matches_sparse():
+    """The engine's dense auto-path must be observationally identical to
+    the sparse path across multi-round workloads with ticks."""
+    rng_seed = 77
+
+    def run(dense):
+        rng = random.Random(rng_seed)
+        eng = BatchedQuorumEngine(16, 3, event_cap=128, dense_ingest=dense)
+        for cid in range(1, 17):
+            eng.add_group(cid, node_ids=[1, 2, 3], self_id=1)
+            eng.set_leader(cid, term=1, term_start=1, last_index=1)
+        results = []
+        idx = {cid: 1 for cid in range(1, 17)}
+        for _ in range(8):
+            for _ in range(rng.randrange(4, 40)):
+                cid = rng.randrange(1, 17)
+                idx[cid] += 1
+                eng.ack(cid, 1, idx[cid])
+                if rng.random() < 0.8:
+                    eng.ack(cid, 2, idx[cid])
+                if rng.random() < 0.2:
+                    eng.heartbeat_resp(cid, 3)
+            res = eng.step(do_tick=True)
+            results.append((dict(res.commit), list(res.heartbeat)))
+        return results, {cid: eng.committed_index(cid) for cid in range(1, 17)}
+
+    res_sparse, final_sparse = run(False)
+    res_dense, final_dense = run(True)
+    assert res_sparse == res_dense
+    assert final_sparse == final_dense
+
+
+def test_has_votes_false_matches_empty_vote_batch():
+    """has_votes=False (compiled-out vote ingest) ≡ an empty vote batch."""
+    from dragonboat_tpu.ops.kernels import quorum_step
+
+    eng_a = _random_engine(random.Random(9), 12, 3, 64)
+    eng_b = _random_engine(random.Random(9), 12, 3, 64)
+    cap = 64
+    ag = np.array([0, 1, 2, 5] + [0] * (cap - 4), np.int32)
+    ap = np.array([1, 2, 0, 1] + [0] * (cap - 4), np.int32)
+    av = np.array([4, 5, 6, 7] + [0] * (cap - 4), np.int32)
+    avalid = np.array([True] * 4 + [False] * (cap - 4))
+    zero_votes = (
+        jnp.zeros((cap,), jnp.int32), jnp.zeros((cap,), jnp.int32),
+        jnp.zeros((cap,), jnp.int8), jnp.zeros((cap,), bool),
+    )
+    dummy_votes = (
+        jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32),
+        jnp.zeros((1,), jnp.int8), jnp.zeros((1,), bool),
+    )
+    out_a = quorum_step(
+        eng_a.dev, jnp.asarray(ag), jnp.asarray(ap), jnp.asarray(av),
+        jnp.asarray(avalid), *zero_votes, do_tick=True, has_votes=True,
+    )
+    out_b = quorum_step(
+        eng_b.dev, jnp.asarray(ag), jnp.asarray(ap), jnp.asarray(av),
+        jnp.asarray(avalid), *dummy_votes, do_tick=True, has_votes=False,
+    )
+    _state_equal(out_a.state, out_b.state)
+    assert np.array_equal(np.asarray(out_a.committed), np.asarray(out_b.committed))
+
+
+def test_multistep_has_votes_false_accepts_dummies():
+    """Both multisteps must accept arbitrary-shape vote dummies when
+    has_votes=False and match the has_votes=True/empty-votes result."""
+    from dragonboat_tpu.ops.kernels import (
+        quorum_multistep,
+        quorum_multistep_dense,
+    )
+
+    g, p, cap, r = 8, 3, 16, 4
+    eng_a = _random_engine(random.Random(3), g, p, cap)
+    eng_b = _random_engine(random.Random(3), g, p, cap)
+
+    rows = np.arange(g, dtype=np.int32)
+    ag = np.broadcast_to(np.concatenate([rows, rows]), (r, cap)).copy()
+    ap = np.broadcast_to(
+        np.concatenate([np.zeros(g, np.int32), np.ones(g, np.int32)]), (r, cap)
+    ).copy()
+    av = np.broadcast_to(
+        4 + np.arange(r, dtype=np.int32)[:, None], (r, cap)
+    ).copy()
+    avalid = np.ones((r, cap), bool)
+    zi = np.zeros((r, cap), np.int32)
+    z8 = np.zeros((r, cap), np.int8)
+    zb = np.zeros((r, cap), bool)
+
+    out_t = quorum_multistep(
+        eng_a.dev, *(jnp.asarray(x) for x in (ag, ap, av, avalid, zi, zi, z8, zb)),
+        do_tick=True, has_votes=True,
+    )
+    out_f = quorum_multistep(
+        eng_b.dev, jnp.asarray(ag), jnp.asarray(ap), jnp.asarray(av),
+        jnp.asarray(avalid),
+        # dummies of unrelated shape — must not be scanned
+        jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32),
+        jnp.zeros((1,), jnp.int8), jnp.zeros((1,), bool),
+        do_tick=True, has_votes=False,
+    )
+    _state_equal(out_t.state, out_f.state)
+
+    # dense multistep: same contract
+    eng_c = _random_engine(random.Random(3), g, p, cap)
+    eng_d = _random_engine(random.Random(3), g, p, cap)
+    ack_max = np.zeros((r, g, p), np.int32)
+    touched = np.zeros((r, g, p), bool)
+    for rr in range(r):
+        ack_max[rr, :, 0] = 4 + rr
+        ack_max[rr, :, 1] = 4 + rr
+        touched[rr, :, :2] = True
+    vt = np.full((r, g, p), -1, np.int8)
+    out_dt = quorum_multistep_dense(
+        eng_c.dev, jnp.asarray(ack_max), jnp.asarray(touched), jnp.asarray(vt),
+        do_tick=True, has_votes=True,
+    )
+    out_df = quorum_multistep_dense(
+        eng_d.dev, jnp.asarray(ack_max), jnp.asarray(touched),
+        jnp.zeros((1, 1), jnp.int8),  # dummy, not scanned
+        do_tick=True, has_votes=False,
+    )
+    _state_equal(out_dt.state, out_df.state)
+    _state_equal(out_t.state, out_dt.state)  # sparse ≡ dense end state
+
+
+def test_engine_dense_ingest_validation():
+    with pytest.raises(ValueError):
+        BatchedQuorumEngine(4, 3, dense_ingest=1)
+    with pytest.raises(ValueError):
+        BatchedQuorumEngine(4, 3, dense_ingest="always")
