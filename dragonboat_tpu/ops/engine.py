@@ -119,9 +119,21 @@ class BatchedQuorumEngine:
         self.device_ticks = device_ticks
         self.mirror = HostMirror(n_groups, n_peers)
         self.sharding = sharding
-        self.dev: QuorumState = self.mirror.to_device(sharding)
+        self._dev: QuorumState = self.mirror.to_device(sharding)
+        self._cache_stale = False
         self.groups: Dict[int, GroupInfo] = {}
         self.rows: Dict[int, GroupInfo] = {}
+        # vectorized row→(cluster_id, base) translation for egress: at
+        # full occupancy tens of thousands of rows change per round, and
+        # a per-row Python dict walk dominates the host loop
+        self._row_cid = np.full((n_groups,), -1, np.int64)
+        self._row_base = np.zeros((n_groups,), np.int64)
+        #: host twin of dev.committed — device state changes only through
+        #: _dispatch (whose egress refreshes this) and _upload_dirty
+        #: (which syncs the dirty rows), so step() never needs a device
+        #: readback just to learn the PREVIOUS watermarks (that readback
+        #: was a full extra round trip per step on a network-attached TPU)
+        self._committed_cache = np.zeros((n_groups,), np.int32)
         self._free = list(range(n_groups - 1, -1, -1))
         self._dirty: set[int] = set()
         # pending event buffers (grow unbounded host-side; chunked at dispatch)
@@ -130,6 +142,19 @@ class BatchedQuorumEngine:
         self._voted_cells: set[Tuple[int, int]] = set()  # within-buffer dedup
         # vectorized bulk-ingest blocks (ack_block): (rows, slots, rels)
         self._ack_blocks: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+
+    @property
+    def dev(self) -> QuorumState:
+        return self._dev
+
+    @dev.setter
+    def dev(self, st: QuorumState) -> None:
+        """External state assignment (hybrid direct-dispatch callers, e.g.
+        the bench's staged multistep) — the host committed twin can no
+        longer be trusted, so the next step() re-reads it from the device
+        once instead of mis-reporting commit deltas."""
+        self._dev = st
+        self._cache_stale = True
 
     # ------------------------------------------------------------------
     # group lifecycle (rare path, host scalar)
@@ -159,6 +184,8 @@ class BatchedQuorumEngine:
         gi = GroupInfo(cluster_id, row, slots, node_ids=all_ids)
         self.groups[cluster_id] = gi
         self.rows[row] = gi
+        self._row_cid[row] = cluster_id
+        self._row_base[row] = 0
 
         a = self.mirror.arrays
         a["live"][row] = True
@@ -217,6 +244,7 @@ class BatchedQuorumEngine:
         # purge queued events so a future tenant of this row never receives
         # the dead group's acks/votes
         self._purge_row_events(gi.row)
+        self._row_cid[gi.row] = -1
         self._free.append(gi.row)
 
     # ------------------------------------------------------------------
@@ -312,6 +340,7 @@ class BatchedQuorumEngine:
         if shift <= 0:
             return
         gi.base += shift
+        self._row_base[row] = gi.base
         for f in ("committed", "last_index", "term_start"):
             a[f][row] = max(0, int(a[f][row]) - shift)
         a["match"][row, :] = np.maximum(a["match"][row, :] - shift, 0)
@@ -419,7 +448,9 @@ class BatchedQuorumEngine:
         for k, host in self.mirror.arrays.items():
             dev_arr = getattr(st, k)
             updates[k] = dev_arr.at[rows].set(jnp.asarray(host[rows]))
-        self.dev = QuorumState(**updates)
+        self._dev = QuorumState(**updates)
+        # keep the host committed twin coherent with the rows just written
+        self._committed_cache[rows] = self.mirror.arrays["committed"][rows]
         self._dirty.clear()
 
     def _pad(self, events, width):
@@ -444,7 +475,16 @@ class BatchedQuorumEngine:
         the jit program never recompiles for a new batch size.
         """
         self._upload_dirty()
-        prev_committed = np.asarray(self.dev.committed)
+        # host twin, not a device readback (a full extra round trip per
+        # step on a network-attached chip); _upload_dirty and the egress
+        # below keep it coherent.  An external `eng.dev = ...` assignment
+        # marks it stale and forces a one-time device re-read here.
+        if self._cache_stale:
+            self._committed_cache = np.array(
+                np.asarray(self._dev.committed), dtype=np.int32
+            )
+            self._cache_stale = False
+        prev_committed = self._committed_cache
 
         ack_g, ack_p, ack_v = self._gather_acks()
         # dense mode collapses ANY number of acks/votes into (G,P)
@@ -491,10 +531,19 @@ class BatchedQuorumEngine:
             )
         )
         changed = np.nonzero(committed != prev_committed)[0]
-        for row in changed:
-            gi = self.rows.get(int(row))
-            if gi is not None:
-                res.commit[gi.cluster_id] = int(gi.base) + int(committed[row])
+        # device_get arrays are read-only; the cache must stay writable
+        # for _upload_dirty's row sync
+        self._committed_cache = np.array(committed, dtype=np.int32)
+        if changed.size:
+            # vectorized row→(cid, abs index) translation: dead rows carry
+            # cid -1 and are dropped (their committed can flip when a row
+            # is reused mid-buffer)
+            cids = self._row_cid[changed]
+            live_mask = cids >= 0
+            abs_commit = self._row_base[changed] + committed[changed]
+            res.commit = dict(
+                zip(cids[live_mask].tolist(), abs_commit[live_mask].tolist())
+            )
         for name, arr in (
             ("won", won),
             ("lost", lost),
@@ -504,11 +553,8 @@ class BatchedQuorumEngine:
         ):
             idx = np.nonzero(np.asarray(arr))[0]
             if idx.size:
-                lst = getattr(res, name)
-                for row in idx:
-                    gi = self.rows.get(int(row))
-                    if gi is not None:
-                        lst.append(gi.cluster_id)
+                cids = self._row_cid[idx]
+                getattr(res, name).extend(cids[cids >= 0].tolist())
         return res
 
     def _gather_acks(self):
@@ -578,7 +624,7 @@ class BatchedQuorumEngine:
             track_contact=self.device_ticks or do_tick,
             has_votes=bool(votes),
         )
-        self.dev = out.state
+        self._dev = out.state
         return out
 
     def _dispatch_dense(self, ag, ap, av, votes, do_tick: bool):
@@ -612,7 +658,7 @@ class BatchedQuorumEngine:
             track_contact=self.device_ticks or do_tick,
             has_votes=bool(votes),
         )
-        self.dev = out.state
+        self._dev = out.state
         return out
 
     # ------------------------------------------------------------------
