@@ -732,3 +732,41 @@ def test_engine_dense_ingest_validation():
         BatchedQuorumEngine(4, 3, dense_ingest=1)
     with pytest.raises(ValueError):
         BatchedQuorumEngine(4, 3, dense_ingest="always")
+
+
+def test_kth_largest_network_all_widths():
+    """_kth_largest across every specialized width (P=1..8 use the
+    elementwise compare-exchange network; P=9 exercises the (G,P,P)
+    rank-select fallback) against a NumPy sort oracle, including
+    all-masked rows, ties, and every valid k."""
+    from dragonboat_tpu.ops.kernels import _kth_largest
+    from dragonboat_tpu.ops.state import INDEX_MIN
+
+    rng = random.Random(23)
+    for P in range(1, 10):
+        G = 160
+        vals = np.zeros((G, P), np.int32)
+        mask = np.zeros((G, P), bool)
+        k = np.ones((G,), np.int32)
+        expected = np.zeros((G,), np.int32)
+        for g in range(G):
+            n = rng.randrange(0, P + 1)
+            slots = rng.sample(range(P), n)
+            # small value range forces ties; non-masked slots hold noise
+            for s in range(P):
+                vals[g, s] = rng.randrange(0, 6)
+            for s in slots:
+                mask[g, s] = True
+            masked = sorted(
+                (vals[g, s] for s in slots), reverse=True
+            )
+            if n == 0:
+                k[g] = 1
+                expected[g] = INDEX_MIN  # all-masked row: min sentinel
+            else:
+                k[g] = rng.randrange(1, n + 1)
+                expected[g] = masked[k[g] - 1]
+        got = np.asarray(
+            _kth_largest(jnp.asarray(vals), jnp.asarray(mask), jnp.asarray(k))
+        )
+        np.testing.assert_array_equal(got, expected, err_msg=f"P={P}")
