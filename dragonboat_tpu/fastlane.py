@@ -418,7 +418,8 @@ class FastLaneManager:
         holding it."""
         from .statemachine import Result
 
-        cids, indexes, terms, keys, results, leaders = got
+        cids, indexes, terms, keys, results, client_ids, series_ids, \
+            leaders, statuses = got
         per: Dict[int, list] = {}
         for i in range(len(cids)):
             per.setdefault(int(cids[i]), []).append(i)
@@ -436,10 +437,13 @@ class FastLaneManager:
                 int(indexes[last]), int(terms[last])
             )
             for i in idxs:
-                if leaders[i] and keys[i]:
+                # status 2 = ignored (client already responded): the
+                # future is deliberately NOT completed — Node.apply_update
+                # semantics for has_responded duplicates
+                if leaders[i] and keys[i] and statuses[i] != 2:
                     node.pending_proposals.applied(
-                        int(keys[i]), 0, 0,
-                        Result(value=int(results[i])), False,
+                        int(keys[i]), int(client_ids[i]), int(series_ids[i]),
+                        Result(value=int(results[i])), statuses[i] == 1,
                     )
             node.pending_reads.applied(node.sm.get_last_applied())
 

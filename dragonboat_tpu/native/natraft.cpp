@@ -481,6 +481,14 @@ struct Group {
   // hop) and only batched completion records cross the GIL boundary
   void* sm = nullptr;
   uint64_t (*sm_update)(void*, const uint8_t*, size_t) = nullptr;
+  // native exactly-once session store (natsm.cpp SessStore): when
+  // attached, session-managed entries apply natively too — register/
+  // unregister, dedup against the per-series response history, and the
+  // responded_to watermark all mirror StateMachineManager's handling
+  // (_handle_session_entry); without it they eject (EV_SM)
+  void* sess = nullptr;
+  int (*sess_apply)(void*, void*, uint64_t, uint64_t, uint64_t,
+                    const uint8_t*, size_t, uint64_t*) = nullptr;
   // order barrier vs the scalar plane: entries <= apply_barrier were
   // handed to the PYTHON apply queue before enrollment; native applies
   // hold off until Python reports them applied (py_applied)
@@ -562,7 +570,13 @@ struct Engine {
   // watermark records (key==0); drained in batches by the Python pump
   struct Completion {
     uint64_t cid, index, term, key, result;
+    // session identity for pending-proposal matching (requests.py
+    // applied() validates client_id/series_id); 0/0 for noop entries
+    uint64_t client_id, series_id;
     uint8_t leader;
+    // 0 completed, 1 rejected (no session / unregister miss), 2 ignored
+    // (client already responded — the future is NOT completed)
+    uint8_t status;
   };
   std::mutex cmu;
   std::condition_variable ccv;
@@ -855,14 +869,29 @@ struct Engine {
         begin_eject(g, EV_SM);
         break;
       }
+      uint64_t result = 0;
+      uint8_t status = 0;
       if (cid_ != 0) {
-        begin_eject(g, EV_SM);
-        break;
+        // session-managed: exactly-once dedup through the shared native
+        // session store (twin: _handle_session_entry) — register (sid 0),
+        // unregister (sid ~0), duplicate suppression, responded_to GC
+        if (g->sess == nullptr || g->sess_apply == nullptr) {
+          begin_eject(g, EV_SM);
+          break;
+        }
+        int stc = g->sess_apply(g->sess, g->sm, cid_, sid, resp, payload,
+                                plen, &result);
+        if (stc == 3) {  // cached response carries a payload: Python-only
+          begin_eject(g, EV_SM);
+          break;
+        }
+        status = (uint8_t)stc;
+      } else {
+        result = g->sm_update(g->sm, payload, plen);
       }
-      uint64_t result = g->sm_update(g->sm, payload, plen);
       g->applied_handed = i;
       if (g->leader) {
-        batch.push_back({g->cid, i, term, key, result, 1});
+        batch.push_back({g->cid, i, term, key, result, cid_, sid, 1, status});
         lat_emit_us += now - e2.born_us;
         lat_count++;
       } else {
@@ -875,7 +904,7 @@ struct Engine {
       // (ReadIndex completion, snapshot triggers) but no futures complete
       uint64_t hi = g->applied_handed;
       batch.push_back(
-          {g->cid, hi, g->term_of(hi), 0, 0, 0});
+          {g->cid, hi, g->term_of(hi), 0, 0, 0, 0, 0, 0});
     }
     if (!batch.empty()) {
       std::lock_guard<std::mutex> lk(cmu);
@@ -1718,7 +1747,7 @@ int natr_enroll(void* h, uint64_t cid, uint64_t nid, uint64_t term,
 // (natr_note_applied).  py_applied0 = the Python RSM manager's current
 // last_applied.  Returns 1 on success, 0 when the group is not enrolled.
 int natr_attach_sm(void* h, uint64_t cid, void* sm, void* update_fn,
-                   uint64_t py_applied0) {
+                   uint64_t py_applied0, void* sess, void* sess_apply_fn) {
   Engine* e = (Engine*)h;
   std::shared_ptr<Group> sp = e->find(cid);
   Group* g = sp.get();
@@ -1727,6 +1756,11 @@ int natr_attach_sm(void* h, uint64_t cid, void* sm, void* update_fn,
   if (g->state != G_ACTIVE) return 0;
   g->sm = sm;
   g->sm_update = (uint64_t (*)(void*, const uint8_t*, size_t))update_fn;
+  if (sess != nullptr && sess_apply_fn != nullptr) {
+    g->sess = sess;
+    g->sess_apply = (int (*)(void*, void*, uint64_t, uint64_t, uint64_t,
+                             const uint8_t*, size_t, uint64_t*))sess_apply_fn;
+  }
   g->apply_barrier = g->applied_handed;
   // max: a racing natr_note_applied may already have reported fresher
   // Python progress than the caller's snapshot — never clobber a lift
@@ -1752,7 +1786,9 @@ void natr_note_applied(void* h, uint64_t cid, uint64_t applied) {
 long long natr_next_completions(void* h, int timeout_ms, uint64_t* cids,
                                 uint64_t* indexes, uint64_t* terms,
                                 uint64_t* keys, uint64_t* results,
-                                uint8_t* leaders, long long cap) {
+                                uint64_t* client_ids, uint64_t* series_ids,
+                                uint8_t* leaders, uint8_t* statuses,
+                                long long cap) {
   Engine* e = (Engine*)h;
   std::unique_lock<std::mutex> lk(e->cmu);
   if (e->complq.empty() && !e->stopped.load())
@@ -1766,7 +1802,10 @@ long long natr_next_completions(void* h, int timeout_ms, uint64_t* cids,
     terms[n] = c.term;
     keys[n] = c.key;
     results[n] = c.result;
+    client_ids[n] = c.client_id;
+    series_ids[n] = c.series_id;
     leaders[n] = c.leader;
+    statuses[n] = c.status;
     e->complq.pop_front();
     n++;
   }

@@ -154,6 +154,7 @@ def _load():
         lib.natr_attach_sm.restype = c.c_int
         lib.natr_attach_sm.argtypes = [
             c.c_void_p, c.c_uint64, c.c_void_p, c.c_void_p, c.c_uint64,
+            c.c_void_p, c.c_void_p,
         ]
         lib.natr_note_applied.argtypes = [c.c_void_p, c.c_uint64, c.c_uint64]
         lib.natr_next_completions.restype = c.c_longlong
@@ -161,7 +162,9 @@ def _load():
             c.c_void_p, c.c_int,
             c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),
             c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),
-            c.POINTER(c.c_uint64), c.POINTER(c.c_uint8), c.c_longlong,
+            c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),
+            c.POINTER(c.c_uint64), c.POINTER(c.c_uint8),
+            c.POINTER(c.c_uint8), c.c_longlong,
         ]
         _lib = lib
         return lib
@@ -504,14 +507,18 @@ class NatRaft:
     # ---- native C-ABI state machine (natsm.cpp) ----
 
     def attach_sm(
-        self, cid: int, sm_handle: int, update_fn: int, py_applied: int
+        self, cid: int, sm_handle: int, update_fn: int, py_applied: int,
+        sess_handle: int = 0, sess_apply_fn: int = 0,
     ) -> bool:
         """Attach a native SM to an enrolled group; committed application
         entries then apply in C++ with only batched completion records
-        crossing the GIL."""
+        crossing the GIL.  With a session store handle (natsm.cpp
+        SessStore + its ``natsm_sess_apply`` pointer), session-managed
+        entries apply natively too — exactly-once dedup included."""
         return (
             self._lib.natr_attach_sm(
-                self._h, cid, sm_handle, update_fn, py_applied
+                self._h, cid, sm_handle, update_fn, py_applied,
+                sess_handle, sess_apply_fn,
             )
             == 1
         )
@@ -524,25 +531,27 @@ class NatRaft:
 
     def next_completions(self, timeout_ms: int = 200):
         """Batch of native-SM apply completions as parallel lists
-        (cids, indexes, terms, keys, results, leader_flags); None on
-        timeout; raises on stop."""
+        (cids, indexes, terms, keys, results, client_ids, series_ids,
+        leader_flags, statuses); None on timeout; raises on stop.
+        Status: 0 completed, 1 rejected, 2 ignored (already responded —
+        no future completion, mirroring Node.apply_update)."""
         cap = self._COMPL_CAP
         if not hasattr(self, "_cbufs"):
             u64 = ctypes.c_uint64 * cap
+            u8 = ctypes.c_uint8 * cap
             self._cbufs = (
-                u64(), u64(), u64(), u64(), u64(), (ctypes.c_uint8 * cap)()
+                u64(), u64(), u64(), u64(), u64(), u64(), u64(), u8(), u8()
             )
         b = self._cbufs
         n = self._lib.natr_next_completions(
-            self._h, timeout_ms, b[0], b[1], b[2], b[3], b[4], b[5], cap
+            self._h, timeout_ms, b[0], b[1], b[2], b[3], b[4], b[5], b[6],
+            b[7], b[8], cap
         )
         if n < 0:
             raise ConnectionError("natraft stopped")
         if n == 0:
             return None
-        return (
-            b[0][:n], b[1][:n], b[2][:n], b[3][:n], b[4][:n], b[5][:n]
-        )
+        return tuple(buf[:n] for buf in b)
 
     def close_conn(self, conn_id: int) -> None:
         self._lib.natr_close_conn(self._h, conn_id)

@@ -23,9 +23,11 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <list>
 #include <map>
 #include <mutex>
 #include <string>
+#include <unordered_map>
 
 namespace {
 
@@ -60,6 +62,112 @@ void put_u32(std::string& b, uint32_t v) {
 uint32_t get_u32(const uint8_t* p) {
   return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
          ((uint32_t)p[3] << 24);
+}
+
+// uvarint twins of wire/codec.py _write_uvarint/_read_uvarint — the
+// session image must be BYTE-IDENTICAL to rsm/session.py's so snapshots
+// interop across planes and the cross-replica session hash matches.
+void put_uvarint(std::string& b, uint64_t v) {
+  while (true) {
+    uint8_t x = v & 0x7F;
+    v >>= 7;
+    if (v) b.push_back((char)(x | 0x80));
+    else { b.push_back((char)x); return; }
+  }
+}
+bool get_uvarint(const uint8_t* d, size_t len, size_t& pos, uint64_t& out) {
+  out = 0;
+  for (int shift = 0; shift < 64; shift += 7) {
+    if (pos >= len) return false;
+    uint8_t x = d[pos++];
+    out |= (uint64_t)(x & 0x7F) << shift;
+    if (!(x & 0x80)) return true;
+  }
+  return false;
+}
+
+// ---------------------------------------------------------------- sessions
+//
+// Native twin of rsm/session.py (reference internal/rsm/session.go +
+// lrusession.go + sessionmanager.go): exactly-once dedup state shared by
+// BOTH planes so session-managed clients keep the native apply path.
+// Semantics mirrored exactly — LRU order (register/lookup move-to-back,
+// eviction pops the front at > maxn), responded_up_to watermark, per-
+// series response history, and the deterministic serialization (LRU
+// order, history sorted by series id).
+
+constexpr uint64_t kSeriesRegister = 0;
+constexpr uint64_t kSeriesUnregister = ~0ULL;
+
+struct NatSession {
+  uint64_t client_id = 0;
+  uint64_t responded_up_to = 0;
+  // value + data; native applies only ever store empty data (the C-ABI
+  // SM result is a u64) but images loaded from the Python plane may
+  // carry payloads — kept verbatim for round-trip fidelity
+  std::map<uint64_t, std::pair<uint64_t, std::string>> history;
+};
+
+struct SessStore {
+  std::mutex mu;
+  size_t maxn;
+  std::list<NatSession> order;  // front = least recently used
+  std::unordered_map<uint64_t, std::list<NatSession>::iterator> idx;
+
+  explicit SessStore(size_t m) : maxn(m) {}
+
+  NatSession* touch(uint64_t cid) {  // mu held; moves to MRU
+    auto it = idx.find(cid);
+    if (it == idx.end()) return nullptr;
+    order.splice(order.end(), order, it->second);
+    return &*it->second;
+  }
+  NatSession* peek(uint64_t cid) {  // mu held; no LRU move
+    auto it = idx.find(cid);
+    return it == idx.end() ? nullptr : &*it->second;
+  }
+};
+
+void sess_save_locked(SessStore* s, std::string& b) {
+  put_uvarint(b, s->order.size());
+  for (auto& sess : s->order) {
+    put_uvarint(b, sess.client_id);
+    put_uvarint(b, sess.responded_up_to);
+    put_uvarint(b, sess.history.size());
+    for (auto& [sid, r] : sess.history) {  // std::map: sorted by sid
+      put_uvarint(b, sid);
+      put_uvarint(b, r.first);
+      put_uvarint(b, r.second.size());
+      b += r.second;
+    }
+  }
+}
+
+uint64_t sess_register_locked(SessStore* s, uint64_t cid) {
+  if (s->touch(cid) != nullptr) return cid;  // re-register: LRU refresh
+  s->order.emplace_back();
+  s->order.back().client_id = cid;
+  s->idx[cid] = std::prev(s->order.end());
+  if (s->order.size() > s->maxn) {  // evict LRU (OrderedDict popitem(0))
+    s->idx.erase(s->order.front().client_id);
+    s->order.pop_front();
+  }
+  return cid;
+}
+
+uint64_t sess_unregister_locked(SessStore* s, uint64_t cid) {
+  auto it = s->idx.find(cid);
+  if (it == s->idx.end()) return 0;
+  s->order.erase(it->second);
+  s->idx.erase(it);
+  return cid;
+}
+
+void sess_clear_to_locked(NatSession* sess, uint64_t sid) {
+  if (sid <= sess->responded_up_to) return;
+  sess->history.erase(sess->history.begin(),
+                      sess->history.upper_bound(sid));
+  sess->responded_up_to = sid;
 }
 
 }  // namespace
@@ -164,5 +272,182 @@ void natsm_buf_free(uint8_t* p) { free(p); }
 // core (natr_enroll's sm_update parameter) through Python without the two
 // libraries linking against each other.
 void* natsm_update_ptr() { return (void*)&natsm_update; }
+
+// ---------------------------------------------------------------- sessions
+
+void* natsm_sess_create(uint64_t maxn) { return new SessStore(maxn); }
+void natsm_sess_close(void* h) { delete (SessStore*)h; }
+
+uint64_t natsm_sess_register(void* h, uint64_t cid) {
+  SessStore* s = (SessStore*)h;
+  std::lock_guard<std::mutex> lk(s->mu);
+  return sess_register_locked(s, cid);
+}
+
+uint64_t natsm_sess_unregister(void* h, uint64_t cid) {
+  SessStore* s = (SessStore*)h;
+  std::lock_guard<std::mutex> lk(s->mu);
+  return sess_unregister_locked(s, cid);
+}
+
+// client_registered twin: 1 when present (and refreshes LRU), 0 otherwise.
+int natsm_sess_registered(void* h, uint64_t cid) {
+  SessStore* s = (SessStore*)h;
+  std::lock_guard<std::mutex> lk(s->mu);
+  return s->touch(cid) != nullptr ? 1 : 0;
+}
+
+int natsm_sess_has_responded(void* h, uint64_t cid, uint64_t sid) {
+  SessStore* s = (SessStore*)h;
+  std::lock_guard<std::mutex> lk(s->mu);
+  NatSession* sess = s->peek(cid);
+  return (sess != nullptr && sid <= sess->responded_up_to) ? 1 : 0;
+}
+
+// Cached response lookup: 1 found (*value set, *out/dlen hold a malloc'd
+// copy of the data payload — empty ⇒ *out NULL), 0 absent.
+int natsm_sess_get_response(void* h, uint64_t cid, uint64_t sid,
+                            uint64_t* value, uint8_t** out, size_t* dlen) {
+  SessStore* s = (SessStore*)h;
+  std::lock_guard<std::mutex> lk(s->mu);
+  NatSession* sess = s->peek(cid);
+  if (sess == nullptr) return 0;
+  auto it = sess->history.find(sid);
+  if (it == sess->history.end()) return 0;
+  *value = it->second.first;
+  const std::string& d = it->second.second;
+  *dlen = d.size();
+  if (d.empty()) {
+    *out = nullptr;
+  } else {
+    *out = (uint8_t*)malloc(d.size());
+    memcpy(*out, d.data(), d.size());
+  }
+  return 1;
+}
+
+void natsm_sess_add_response(void* h, uint64_t cid, uint64_t sid,
+                             uint64_t value, const uint8_t* data,
+                             size_t dlen) {
+  SessStore* s = (SessStore*)h;
+  std::lock_guard<std::mutex> lk(s->mu);
+  NatSession* sess = s->peek(cid);
+  if (sess == nullptr) return;  // evicted since lookup: drop (see .py note)
+  sess->history.emplace(sid,
+                        std::make_pair(value, std::string((const char*)data,
+                                                          dlen)));
+}
+
+void natsm_sess_clear_to(void* h, uint64_t cid, uint64_t sid) {
+  SessStore* s = (SessStore*)h;
+  std::lock_guard<std::mutex> lk(s->mu);
+  NatSession* sess = s->peek(cid);
+  if (sess != nullptr) sess_clear_to_locked(sess, sid);
+}
+
+uint64_t natsm_sess_len(void* h) {
+  SessStore* s = (SessStore*)h;
+  std::lock_guard<std::mutex> lk(s->mu);
+  return (uint64_t)s->order.size();
+}
+
+long long natsm_sess_save(void* h, uint8_t** out) {
+  SessStore* s = (SessStore*)h;
+  std::string b;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    sess_save_locked(s, b);
+  }
+  *out = (uint8_t*)malloc(b.size() ? b.size() : 1);
+  memcpy(*out, b.data(), b.size());
+  return (long long)b.size();
+}
+
+int natsm_sess_recover(void* h, const uint8_t* data, size_t len) {
+  SessStore* s = (SessStore*)h;
+  std::list<NatSession> order;
+  std::unordered_map<uint64_t, std::list<NatSession>::iterator> idx;
+  size_t pos = 0;
+  uint64_t n;
+  if (!get_uvarint(data, len, pos, n)) return -1;
+  for (uint64_t i = 0; i < n; i++) {
+    NatSession sess;
+    uint64_t hn;
+    if (!get_uvarint(data, len, pos, sess.client_id) ||
+        !get_uvarint(data, len, pos, sess.responded_up_to) ||
+        !get_uvarint(data, len, pos, hn))
+      return -1;
+    for (uint64_t j = 0; j < hn; j++) {
+      uint64_t sid, val, dl;
+      if (!get_uvarint(data, len, pos, sid) ||
+          !get_uvarint(data, len, pos, val) ||
+          !get_uvarint(data, len, pos, dl) || dl > len - pos)
+        return -1;
+      sess.history.emplace(
+          sid, std::make_pair(val, std::string((const char*)data + pos, dl)));
+      pos += dl;
+    }
+    order.push_back(std::move(sess));
+    idx[order.back().client_id] = std::prev(order.end());
+  }
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->order = std::move(order);
+  s->idx = std::move(idx);
+  return 0;
+}
+
+// zlib.crc32 of the save image (== SessionManager.hash()).
+uint64_t natsm_sess_hash(void* h) {
+  SessStore* s = (SessStore*)h;
+  std::string b;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    sess_save_locked(s, b);
+  }
+  return (uint64_t)crc32ieee(0, (const uint8_t*)b.data(), b.size());
+}
+
+// The fast lane's one-call apply for a session-managed entry, mirroring
+// StateMachineManager._handle_session_entry exactly.  Returns the
+// completion status: 0 completed (*result set), 1 rejected, 2 ignored
+// (client already responded — the future is NOT completed, matching
+// Node.apply_update's `ignored` arm), 3 punt (cached response carries a
+// data payload the u64 completion record cannot deliver — caller ejects
+// to the Python plane; unreachable for natsm-applied groups, whose
+// results are all value-only).
+int natsm_sess_apply(void* sess_h, void* kv_h, uint64_t cid, uint64_t sid,
+                     uint64_t responded_to, const uint8_t* cmd, size_t len,
+                     uint64_t* result) {
+  SessStore* s = (SessStore*)sess_h;
+  *result = 0;
+  std::unique_lock<std::mutex> lk(s->mu);
+  if (sid == kSeriesRegister) {
+    *result = sess_register_locked(s, cid);
+    return *result == 0 ? 1 : 0;
+  }
+  if (sid == kSeriesUnregister) {
+    *result = sess_unregister_locked(s, cid);
+    return *result == 0 ? 1 : 0;
+  }
+  NatSession* sess = s->touch(cid);
+  if (sess == nullptr) return 1;  // not registered: reject
+  if (sid <= sess->responded_up_to) return 2;  // already responded
+  auto it = sess->history.find(sid);
+  if (it != sess->history.end()) {  // duplicate: cached response
+    if (!it->second.second.empty()) return 3;
+    *result = it->second.first;
+    return 0;
+  }
+  // first sight: apply through the shared KV, then record the response.
+  // The store lock is held across the update so a concurrent snapshot
+  // save cannot capture the response without the SM mutation (the KV has
+  // its own mutex; lock order sess->kv is the only one used).
+  *result = natsm_update(kv_h, cmd, len);
+  sess->history.emplace(sid, std::make_pair(*result, std::string()));
+  if (responded_to > 0) sess_clear_to_locked(sess, responded_to);
+  return 0;
+}
+
+void* natsm_sess_apply_ptr() { return (void*)&natsm_sess_apply; }
 
 }  // extern "C"

@@ -66,6 +66,46 @@ def _load():
         lib.natsm_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
         lib.natsm_close.argtypes = [ctypes.c_void_p]
         lib.natsm_update_ptr.restype = ctypes.c_void_p
+        # session store (exactly-once dedup shared by both planes)
+        lib.natsm_sess_create.restype = ctypes.c_void_p
+        lib.natsm_sess_create.argtypes = [ctypes.c_uint64]
+        lib.natsm_sess_close.argtypes = [ctypes.c_void_p]
+        for fn in (lib.natsm_sess_register, lib.natsm_sess_unregister):
+            fn.restype = ctypes.c_uint64
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.natsm_sess_registered.restype = ctypes.c_int
+        lib.natsm_sess_registered.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.natsm_sess_has_responded.restype = ctypes.c_int
+        lib.natsm_sess_has_responded.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64
+        ]
+        lib.natsm_sess_get_response.restype = ctypes.c_int
+        lib.natsm_sess_get_response.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.natsm_sess_add_response.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        lib.natsm_sess_clear_to.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64
+        ]
+        lib.natsm_sess_len.restype = ctypes.c_uint64
+        lib.natsm_sess_len.argtypes = [ctypes.c_void_p]
+        lib.natsm_sess_save.restype = ctypes.c_longlong
+        lib.natsm_sess_save.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))
+        ]
+        lib.natsm_sess_recover.restype = ctypes.c_int
+        lib.natsm_sess_recover.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t
+        ]
+        lib.natsm_sess_hash.restype = ctypes.c_uint64
+        lib.natsm_sess_hash.argtypes = [ctypes.c_void_p]
+        lib.natsm_sess_apply_ptr.restype = ctypes.c_void_p
         _lib = lib
         return lib
 
@@ -87,6 +127,16 @@ class NativeKVStateMachine:
         #: raw handle + update fn pointer for natr_attach_sm
         self.natsm_handle: int = self._lib.natsm_kv_create()
         self.natsm_update_fn: int = self._lib.natsm_update_ptr()
+        # exactly-once session store, shared by both planes: the RSM
+        # manager detects these attributes and swaps its Python
+        # SessionManager for a :class:`NativeSessionManager` fronting the
+        # same handle the enrolled native core applies through
+        from ..settings import Hard
+
+        self.natsm_sess_handle: int = self._lib.natsm_sess_create(
+            Hard.lru_max_session_count
+        )
+        self.natsm_sess_apply_fn: int = self._lib.natsm_sess_apply_ptr()
 
     # ---- user SM protocol (scalar plane) ----
 
@@ -130,3 +180,114 @@ class NativeKVStateMachine:
         if self.natsm_handle:
             self._lib.natsm_close(self.natsm_handle)
             self.natsm_handle = 0
+        if self.natsm_sess_handle:
+            self._lib.natsm_sess_close(self.natsm_sess_handle)
+            self.natsm_sess_handle = 0
+
+
+class _NativeSession:
+    """Session proxy with the surface :class:`rsm.session.Session` exposes
+    to ``_handle_session_entry`` (has_responded / get_response /
+    add_response / clear_to), executing against the native store.  Only
+    materialized by :meth:`NativeSessionManager.client_registered`, which
+    has already refreshed the LRU slot — these calls deliberately do NOT
+    move it again (Python semantics)."""
+
+    __slots__ = ("_lib", "_h", "client_id")
+
+    def __init__(self, lib, handle: int, client_id: int) -> None:
+        self._lib = lib
+        self._h = handle
+        self.client_id = client_id
+
+    def has_responded(self, series_id: int) -> bool:
+        return bool(
+            self._lib.natsm_sess_has_responded(self._h, self.client_id, series_id)
+        )
+
+    def get_response(self, series_id: int):
+        value = ctypes.c_uint64()
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        dlen = ctypes.c_size_t()
+        ok = self._lib.natsm_sess_get_response(
+            self._h, self.client_id, series_id,
+            ctypes.byref(value), ctypes.byref(out), ctypes.byref(dlen),
+        )
+        if not ok:
+            return None, False
+        data = b""
+        if out:
+            try:
+                data = bytes(ctypes.string_at(out, dlen.value))
+            finally:
+                self._lib.natsm_buf_free(out)
+        return Result(value=int(value.value), data=data), True
+
+    def add_response(self, series_id: int, result: Result) -> None:
+        d = result.data or b""
+        self._lib.natsm_sess_add_response(
+            self._h, self.client_id, series_id, result.value, d, len(d)
+        )
+
+    def clear_to(self, series_id: int) -> None:
+        self._lib.natsm_sess_clear_to(self._h, self.client_id, series_id)
+
+
+class NativeSessionManager:
+    """Drop-in for :class:`rsm.session.SessionManager` over the native
+    store owned by a :class:`NativeKVStateMachine` — both planes dedup
+    against the SAME state, so enroll/eject transitions carry no session
+    hand-off.  Serialization and hash are byte-identical to the Python
+    manager's (``natsm_sess_save`` mirrors ``SessionManager.save``), so
+    snapshots interop across plane and SM kinds."""
+
+    def __init__(self, user_sm: "NativeKVStateMachine") -> None:
+        self._lib = user_sm._lib
+        # keep the SM alive: it owns the handle's lifetime
+        self._owner = user_sm
+        self._h = user_sm.natsm_sess_handle
+
+    def __len__(self) -> int:
+        return int(self._lib.natsm_sess_len(self._h))
+
+    def register_client_id(self, client_id: int) -> Result:
+        return Result(value=int(self._lib.natsm_sess_register(self._h, client_id)))
+
+    def unregister_client_id(self, client_id: int) -> Result:
+        return Result(
+            value=int(self._lib.natsm_sess_unregister(self._h, client_id))
+        )
+
+    def client_registered(self, client_id: int) -> Optional[_NativeSession]:
+        if not self._lib.natsm_sess_registered(self._h, client_id):
+            return None
+        return _NativeSession(self._lib, self._h, client_id)
+
+    def update_required(self, session, series_id: int):
+        if session.has_responded(series_id):
+            return None, False
+        cached, ok = session.get_response(series_id)
+        if ok:
+            return cached, False
+        return None, True
+
+    def add_response(self, session, series_id: int, result: Result) -> None:
+        session.add_response(series_id, result)
+
+    def save(self) -> bytes:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.natsm_sess_save(self._h, ctypes.byref(out))
+        try:
+            return bytes(ctypes.string_at(out, n))
+        finally:
+            self._lib.natsm_buf_free(out)
+
+    def recover_image(self, data: bytes) -> None:
+        """In-place snapshot restore (the native handle stays shared with
+        the replication core, so the store is replaced by content, not by
+        identity — the manager-swap the Python path does on recover)."""
+        if self._lib.natsm_sess_recover(self._h, bytes(data), len(data)) != 0:
+            raise ValueError("malformed native session image")
+
+    def hash(self) -> int:
+        return int(self._lib.natsm_sess_hash(self._h))

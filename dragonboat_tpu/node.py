@@ -381,6 +381,20 @@ class Node:
             session.client_id, session.series_id, b"",
             self._timeout_ticks(timeout_s),
         )
+        # register/unregister ride the fast lane like any proposal when
+        # the native session store is attached (the native apply handles
+        # sid 0 / sid ~0); otherwise the apply-side would eject per
+        # session op, so go scalar directly
+        if (
+            self.fast_lane
+            and self._natsm_attached
+            and self.fastlane is not None
+            and self.fastlane.nat.propose(
+                self.cluster_id, entry.key, entry.client_id,
+                entry.series_id, entry.responded_to, int(entry.type), b"",
+            )
+        ):
+            return rs
         if not self.entry_q.add(entry):
             self.pending_proposals.dropped(entry.key)
             raise SystemBusyError()
@@ -752,7 +766,12 @@ class Node:
             # TOCTOU
             self._natsm_attached = True
             if not fl.nat.attach_sm(
-                self.cluster_id, handle, fn, self.sm.get_last_applied()
+                self.cluster_id, handle, fn, self.sm.get_last_applied(),
+                # session store: lets session-managed entries (register/
+                # dedup/unregister) apply natively too — the RSM manager
+                # already fronts the same store for the scalar plane
+                getattr(user, "natsm_sess_handle", 0),
+                getattr(user, "natsm_sess_apply_fn", 0),
             ):
                 self._natsm_attached = False
 

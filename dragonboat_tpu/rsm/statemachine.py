@@ -170,6 +170,14 @@ class StateMachine:
         self.is_witness = is_witness
         self.snapshot_compression = snapshot_compression
         self.sessions = SessionManager()
+        # native C-ABI SM (natsm.py): dedup against the SAME store the
+        # enrolled native core applies through, so enroll/eject carries no
+        # session hand-off and cross-plane session hashes agree
+        user = getattr(managed, "sm", None)
+        if getattr(user, "natsm_sess_handle", 0):
+            from ..native.natsm import NativeSessionManager
+
+            self.sessions = NativeSessionManager(user)
         self.members = MembershipState(cluster_id, node_id, ordered_config_change)
         self._mu = threading.RLock()
         # regular (non-concurrent) SMs must not be mutated while a snapshot
@@ -494,11 +502,18 @@ class StateMachine:
         """Restore sessions + SM image from an open snapshot reader."""
         session_data = reader.read_session()
         if not (self.on_disk or self.is_witness):
-            self.sessions = (
-                SessionManager.load(session_data)
-                if session_data
-                else SessionManager()
-            )
+            if hasattr(self.sessions, "recover_image"):
+                # native-backed store: replace CONTENT in place — the
+                # handle is shared with the enrolled native core, so
+                # identity must survive recover (image format is byte-
+                # compatible between the two managers)
+                self.sessions.recover_image(session_data or b"\x00")
+            else:
+                self.sessions = (
+                    SessionManager.load(session_data)
+                    if session_data
+                    else SessionManager()
+                )
         if not ss.witness and not ss.dummy:
             self.managed.recover_from_snapshot(reader, list(ss.files), self.stopc)
 

@@ -254,3 +254,159 @@ def test_native_apply_leader_kill_failover(tmp_path):
                 nh.stop()
             except Exception:
                 pass
+
+
+# ------------------------------------------------------- native sessions
+
+
+def test_native_session_manager_differential():
+    """NativeSessionManager mirrors the Python SessionManager op-for-op:
+    LRU registration/eviction, dedup history, clear_to GC — with BYTE-
+    identical serialization (snapshots interop across planes) and equal
+    hashes, checked after every op."""
+    import random
+
+    from dragonboat_tpu.native.natsm import NativeSessionManager
+    from dragonboat_tpu.rsm.session import SessionManager
+    from dragonboat_tpu.statemachine import Result
+
+    user = NativeKVStateMachine(1, 1)
+    try:
+        nat = NativeSessionManager(user)
+        py = SessionManager()
+        rng = random.Random(77)
+        for step in range(400):
+            cid = rng.randrange(1, 40)
+            op = rng.randrange(6)
+            if op == 0:
+                assert (
+                    nat.register_client_id(cid).value
+                    == py.register_client_id(cid).value
+                )
+            elif op == 1:
+                assert (
+                    nat.unregister_client_id(cid).value
+                    == py.unregister_client_id(cid).value
+                )
+            else:
+                a = nat.client_registered(cid)
+                b = py.client_registered(cid)
+                assert (a is None) == (b is None)
+                if a is None:
+                    continue
+                sid = rng.randrange(1, 9)
+                assert a.has_responded(sid) == b.has_responded(sid)
+                ra, oka = a.get_response(sid)
+                rb, okb = b.get_response(sid)
+                assert oka == okb
+                if oka:
+                    assert ra.value == rb.value and ra.data == rb.data
+                elif not a.has_responded(sid):
+                    v = rng.randrange(1000)
+                    a.add_response(sid, Result(value=v))
+                    b.add_response(sid, Result(value=v))
+                if rng.random() < 0.25:
+                    ct = rng.randrange(1, 7)
+                    a.clear_to(ct)
+                    b.clear_to(ct)
+            assert len(nat) == len(py)
+            assert nat.save() == py.save(), f"image diverged at step {step}"
+        assert nat.hash() == py.hash()
+        # cross-plane snapshot interop, both directions
+        img = py.save()
+        nat.recover_image(img)
+        assert nat.save() == img
+        py2 = SessionManager.load(nat.save())
+        assert py2.save() == nat.save()
+    finally:
+        user.close()
+
+
+def test_native_session_lru_eviction_parity():
+    """Eviction at the LRU cap replays identically native vs Python."""
+    from dragonboat_tpu.native.natsm import NativeSessionManager
+    from dragonboat_tpu.rsm.session import SessionManager
+
+    user = NativeKVStateMachine(1, 1)
+    try:
+        nat = NativeSessionManager(user)
+        py = SessionManager()
+        cap = py._max
+        for cid in range(1, cap + 10):
+            nat.register_client_id(cid)
+            py.register_client_id(cid)
+        # touch a survivor so LRU order differs from insertion order
+        assert nat.client_registered(cap // 2 + 8) is not None
+        assert py.client_registered(cap // 2 + 8) is not None
+        for cid in range(cap + 10, cap + 20):
+            nat.register_client_id(cid)
+            py.register_client_id(cid)
+        assert len(nat) == len(py) == cap
+        assert nat.save() == py.save()
+    finally:
+        user.close()
+
+
+def test_native_session_exactly_once_end_to_end(tmp_path):
+    """Session-managed clients stay on the native apply path: register,
+    dedup (a re-proposed series returns the cached result and applies the
+    command ONCE), responded_to GC, and unregister all complete natively
+    — zero sm-punt ejects, session hashes equal across replicas."""
+    sms = {}
+    nhs, addrs = _cluster(tmp_path, sms)
+    try:
+        nhs[1].get_node(CID).request_campaign()
+        lid, leader = _leader(nhs)
+        # make sure the lane is up before session traffic (otherwise the
+        # scalar plane serves it — also correct, but not what we test)
+        s0 = leader.get_noop_session(CID)
+        for j in range(20):
+            assert leader.propose(
+                s0, f"w{j}=v{j}".encode(), timeout=60.0
+            ).wait(120.0).completed
+        assert _wait_native_applies(nhs)
+
+        sess = leader.sync_get_session(CID, timeout=60.0)
+        first = leader.propose(sess, b"k=1", timeout=60.0)
+        r1 = first.wait(120.0)
+        assert r1.completed
+        # duplicate retry of the SAME series id: cached result, no
+        # re-apply — proposed with a DIFFERENT command so a re-apply
+        # would be visible in the KV
+        dup = leader.propose(sess, b"leaked=1", timeout=60.0)
+        r2 = dup.wait(120.0)
+        assert r2.completed
+        assert r2.result.value == r1.result.value
+        assert leader.sync_read(CID, "leaked", timeout=20.0) is None
+        sess.proposal_completed()
+        # next series: applies; the responded_to watermark GCs the history
+        nxt = leader.propose(sess, b"k2=2", timeout=60.0)
+        r3 = nxt.wait(120.0)
+        assert r3.completed
+        sess.proposal_completed()
+        assert leader.sync_read(CID, "k", timeout=20.0) == "1"
+        assert leader.sync_read(CID, "k2", timeout=20.0) == "2"
+        leader.sync_close_session(sess, timeout=60.0)
+
+        # the lane never punted: no sm-punt ejects anywhere, the leader
+        # is still enrolled, and the session stores converged
+        # (register/apply/unregister replicated)
+        assert leader.get_node(CID).fast_lane
+        for nh in nhs.values():
+            st = nh.fastlane.stats()
+            assert st["eject_reasons"].get("sm-punt", 0) == 0, st
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            hs = {
+                i: nh.get_node(CID).sm.get_session_hash()
+                for i, nh in nhs.items()
+            }
+            if len(set(hs.values())) == 1:
+                break
+            time.sleep(0.1)
+        assert len(set(hs.values())) == 1, hs
+        sizes = {i: len(nh.get_node(CID).sm.sessions) for i, nh in nhs.items()}
+        assert set(sizes.values()) == {0}, sizes  # closed session evicted
+    finally:
+        for nh in nhs.values():
+            nh.stop()
