@@ -33,6 +33,16 @@ soak: native
 soak-smoke: native
 	$(PY) soak.py --minutes 1 --groups 8
 
+# native-plane soak: C-ABI KV + native exactly-once session store under
+# the same churn — session-managed history clients retry unknown
+# outcomes against the dedup store (at-most-once apply), and session
+# hashes join the cross-replica convergence check
+soak-native: native
+	SOAK_NATIVE_SM=1 SOAK_SESSIONS=1 $(PY) soak.py --minutes 10 --groups 16
+
+soak-native-smoke: native
+	SOAK_NATIVE_SM=1 SOAK_SESSIONS=1 $(PY) soak.py --minutes 1 --groups 8
+
 bench: native
 	$(PY) bench.py
 

@@ -383,12 +383,24 @@ int natsm_sess_recover(void* h, const uint8_t* data, size_t len) {
           !get_uvarint(data, len, pos, val) ||
           !get_uvarint(data, len, pos, dl) || dl > len - pos)
         return -1;
-      sess.history.emplace(
+      // insert_or_assign, not emplace: a duplicate series id (corrupted
+      // image) must keep the LAST occurrence like Python's dict load
+      sess.history.insert_or_assign(
           sid, std::make_pair(val, std::string((const char*)data + pos, dl)));
       pos += dl;
     }
-    order.push_back(std::move(sess));
-    idx[order.back().client_id] = std::prev(order.end());
+    // duplicate client_id (only reachable from a corrupted/adversarial
+    // image — save() can't produce one): mirror SessionManager.load's
+    // OrderedDict semantics exactly — the FIRST occurrence keeps its
+    // position, the value is replaced — so both planes load any image
+    // to the identical store
+    auto found = idx.find(sess.client_id);
+    if (found != idx.end()) {
+      *found->second = std::move(sess);
+    } else {
+      order.push_back(std::move(sess));
+      idx[order.back().client_id] = std::prev(order.end());
+    }
   }
   std::lock_guard<std::mutex> lk(s->mu);
   s->order = std::move(order);

@@ -102,8 +102,18 @@ def rank_main() -> int:
     cids = list(range(1, groups + 1))
     user_sms = {}
 
+    # SOAK_NATIVE_SM=1: the C-ABI KV + native session store — enrolled
+    # groups then apply (and dedup) natively, so the churn exercises the
+    # native apply/session path instead of the Python RSM rim
+    native_sm = os.environ.get("SOAK_NATIVE_SM") == "1"
+    if native_sm:
+        from dragonboat_tpu.native.natsm import NativeKVStateMachine
+
     def _mk_sm(cluster_id, node_id):
-        sm = _KVSM(cluster_id, node_id)
+        if native_sm:
+            sm = NativeKVStateMachine(cluster_id, node_id)
+        else:
+            sm = _KVSM(cluster_id, node_id)
         user_sms[cluster_id] = sm
         return sm
 
@@ -158,6 +168,15 @@ def rank_main() -> int:
     # actual stress (reference: Drummer checks sampled keys too)
     sampled = cids[: max(1, int(os.environ.get("SOAK_SAMPLE", "4")))]
 
+    # SOAK_SESSIONS=1: history puts use REGISTERED sessions (exactly-once).
+    # The payoff under kill -9 churn: an op whose first attempt times out
+    # can be RETRIED with the same series id — the dedup store guarantees
+    # at-most-once apply, so a successful retry RESOLVES the outcome
+    # (committed, cached result) instead of leaving it unknown to the
+    # checker.  Noop sessions can never do that (a retry would double-
+    # apply).  Reference: client session semantics, session.go.
+    use_sessions = os.environ.get("SOAK_SESSIONS") == "1"
+
     def history_client():
         client = rank
         rng = random.Random(client * 7919 + os.getpid())
@@ -191,11 +210,35 @@ def rank_main() -> int:
                 if is_put:
                     s = session.get(cid)
                     if s is None:
-                        s = session[cid] = nh.get_noop_session(cid)
-                    rs = nh.propose(s, f"{key}={val}".encode(), timeout=5.0)
-                    r = rs.wait(5.0)
-                    record_ret(oid, val, time.time()
-                               if r.completed else None, bool(r.completed))
+                        if use_sessions:
+                            s = nh.sync_get_session(cid, timeout=5.0)
+                        else:
+                            s = nh.get_noop_session(cid)
+                        session[cid] = s
+                    cmd = f"{key}={val}".encode()
+                    attempts = 3 if not s.is_noop_session() else 1
+                    done = False
+                    for a in range(attempts):
+                        try:
+                            r = nh.propose(s, cmd, timeout=5.0).wait(5.0)
+                        except Exception:
+                            if a + 1 == attempts:
+                                raise
+                            continue
+                        if r.completed:
+                            done = True
+                            break
+                        # rejected/dropped with a session: the series was
+                        # never applied under this id — safe to re-propose
+                    if done and not s.is_noop_session():
+                        s.proposal_completed()
+                    record_ret(oid, val, time.time() if done else None, done)
+                    if not done and not s.is_noop_session():
+                        # unknown outcome on a session: the series id is
+                        # burned (a later reuse could dedup against a
+                        # quietly-committed first attempt and break the
+                        # exactly-once bookkeeping) — re-register
+                        session.pop(cid, None)
                 else:
                     v = nh.sync_read(cid, key, timeout=5.0)
                     record_ret(oid, v, time.time(), True)
@@ -203,6 +246,8 @@ def rank_main() -> int:
                 # timeout/dropped: outcome unknown — the checker treats a
                 # None ret as an op concurrent with everything after it
                 record_ret(oid, val, None, False)
+                if is_put:
+                    session.pop(cid, None)
             time.sleep(0.4)  # pace: bounded per-key history length
 
     def load(tid):
@@ -263,14 +308,21 @@ def rank_main() -> int:
                     # miss divergent KV state at equal applied indices
                     # (kvtest.go GetHash role)
                     user = user_sms.get(cid)
-                    kv_hash = (
-                        zlib.crc32(repr(sorted(user.kv.items())).encode())
-                        if user is not None
-                        else 0
-                    )
+                    if user is None:
+                        kv_hash = 0
+                    elif native_sm:
+                        kv_hash = user.get_hash()
+                    else:
+                        kv_hash = zlib.crc32(
+                            repr(sorted(user.kv.items())).encode()
+                        )
                     r = node.peer.raft if node.peer is not None else None
                     out[cid] = [
                         sm.get_last_applied(), sm.get_hash(), kv_hash,
+                        # exactly-once session store (compared too: a
+                        # diverging dedup history is a consistency bug
+                        # even while the KV content still agrees)
+                        sm.get_session_hash(),
                         # diagnostics (not compared): raft view + lane state
                         r.log.committed if r else -1,
                         r.state.name if r else "?",
@@ -404,7 +456,8 @@ def _converge_check(ranks, groups, timeout=90.0):
             for cid in range(1, groups + 1):
                 cells = [rep["groups"][str(cid)] for rep in reports]
                 applied = {c[0] for c in cells}
-                hashes = {tuple(c[1:3]) for c in cells}  # manager + user SM
+                # manager + user SM + session store
+                hashes = {tuple(c[1:4]) for c in cells}
                 if len(applied) != 1 or len(hashes) != 1:
                     bad.append((cid, cells))
             last = bad

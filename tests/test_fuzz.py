@@ -395,3 +395,96 @@ def test_fuzz_natraft_stream_chunking_invariance():
             assert got == frames_in
     finally:
         nat.close()
+
+
+def test_fuzz_native_session_image_never_crashes():
+    """natsm_sess_recover on adversarial snapshot images: random bytes,
+    truncations of a valid image, and huge-varint length prefixes must
+    reject cleanly (rc -1) or load — never crash, never accept an image
+    whose re-serialization disagrees with a clean reload."""
+    import random
+
+    from dragonboat_tpu.native import natsm as natsm_mod
+
+    if not natsm_mod.available():
+        import pytest as _pytest
+
+        _pytest.skip("native natsm unavailable")
+    from dragonboat_tpu.native.natsm import (
+        NativeKVStateMachine, NativeSessionManager,
+    )
+    from dragonboat_tpu.rsm.session import SessionManager
+    from dragonboat_tpu.statemachine import Result
+
+    rng = random.Random(123)
+    py = SessionManager()
+    for cid in range(1, 30):
+        py.register_client_id(cid)
+        s = py.client_registered(cid)
+        for sid in range(1, rng.randrange(2, 6)):
+            s.add_response(sid, Result(value=rng.randrange(1000),
+                                       data=bytes(rng.randrange(20))))
+    valid = py.save()
+    user = NativeKVStateMachine(1, 1)
+    try:
+        nat = NativeSessionManager(user)
+        # random garbage
+        for _ in range(300):
+            blob = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 200)))
+            try:
+                nat.recover_image(blob)
+                assert nat.save() is not None  # loaded: must re-serialize
+            except ValueError:
+                pass
+        # truncations and single-byte mutations of a valid image; when
+        # BOTH planes accept a mutated image they must load the IDENTICAL
+        # store (duplicate-client-id images exercised the OrderedDict
+        # replace-in-place semantics the native side now mirrors)
+        for _ in range(300):
+            if rng.random() < 0.5:
+                blob = valid[: rng.randrange(len(valid))]
+            else:
+                b = bytearray(valid)
+                b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+                blob = bytes(b)
+            nat_ok = py_ok = False
+            try:
+                nat.recover_image(blob)
+                nat_ok = True
+            except ValueError:
+                pass
+            try:
+                py_twin = SessionManager.load(blob)
+                py_ok = True
+            except Exception:
+                py_ok = False
+            if nat_ok and py_ok:
+                assert nat.save() == py_twin.save()
+                assert nat.hash() == py_twin.hash()
+                assert len(nat) == len(py_twin)
+        # huge varint count prefix (the 2^64-length class of attack)
+        for pfx in (b"\xff" * 9 + b"\x01", b"\x80" * 10, b"\xff\xff\xff\xff\xff\xff\xff\xff\xff\x7f"):
+            try:
+                nat.recover_image(pfx + valid)
+            except ValueError:
+                pass
+        # crafted duplicate-client-id image: first occurrence keeps its
+        # position, value replaced — both planes must agree byte-for-byte
+        dup = SessionManager()
+        dup.register_client_id(2)
+        dup.register_client_id(3)
+        img = bytearray(dup.save())
+        # rewrite the second session's client_id (3) to 2 in the image
+        pos = img.rindex(3)
+        img[pos] = 2
+        crafted = bytes(img)
+        nat.recover_image(crafted)
+        twin = SessionManager.load(crafted)
+        assert len(nat) == len(twin) == 1
+        assert nat.save() == twin.save()
+        # and the store still works after all that
+        nat.recover_image(valid)
+        assert nat.save() == valid
+        assert nat.hash() == py.hash()
+    finally:
+        user.close()
