@@ -71,6 +71,10 @@ class FastLaneManager:
         # serializes completion-batch draining: the pump and the eject-path
         # drain share the native call's reusable buffers
         self._compl_mu = threading.Lock()
+        # nodes whose applied delta crossed snapshot_entries during native
+        # applies (see _process_completions); ejected by the pump OUTSIDE
+        # _compl_mu so the periodic snapshot machinery can run scalar-side
+        self._snapshot_due: list = []
         self._duty_mu = threading.Lock()
         self._enroll_t0: Dict[int, float] = {}
         self._enrolled_gs = 0.0
@@ -446,6 +450,18 @@ class FastLaneManager:
                         Result(value=int(results[i])), statuses[i] == 1,
                     )
             node.pending_reads.applied(node.sm.get_last_applied())
+            # periodic snapshot trigger (reference saveSnapshotRequired):
+            # the scalar trigger rides process_raft_update, which is IDLE
+            # while the group applies natively — without this check an
+            # enrolled group under sustained load never auto-snapshots
+            # and its LogDB grows without bound until some other eject.
+            # Queue the node; the pump ejects OUTSIDE _compl_mu (the
+            # eject path holds raftMu while draining completions, so
+            # ejecting in here would invert that lock order) and the
+            # scalar window runs the normal save + compaction machinery,
+            # after which the group re-enrolls mid-load.
+            if node.snapshot_due():
+                self._snapshot_due.append(node)
 
     def _completion_pump(self) -> None:
         # Processing happens WHILE HOLDING _compl_mu: the eject-path drain
@@ -459,6 +475,14 @@ class FastLaneManager:
                     got = self.nat.next_completions(20)
                     if got is not None:
                         self._process_completions(got)
+                    # swap under _compl_mu: the eject-path drain (another
+                    # thread) appends under this lock — swapping outside
+                    # could discard its freshly queued node forever
+                    due, self._snapshot_due = self._snapshot_due, []
+                for node in due:  # ejects OUTSIDE the lock (order: raftMu
+                    if node.fast_lane:  # -> _compl_mu, never the reverse)
+                        self.count_eject("snapshot-due")
+                        node.fast_eject()
             except ConnectionError:
                 return
             except Exception:

@@ -599,12 +599,22 @@ class Node:
             or self.pending_leader_transfer.pending() is not None
         ):
             return True
-        se = self.config.snapshot_entries
-        if se:
-            applied = self.sm.get_last_applied()
-            if applied - self.sm.get_snapshot_index() >= se:
-                return True
+        if self.snapshot_due():
+            return True
         return False
+
+    def snapshot_due(self) -> bool:
+        """Applied delta crossed ``snapshot_entries`` (reference
+        ``saveSnapshotRequired``) — the one predicate shared by the
+        periodic-save trigger, the enrollment gate, and the fast lane's
+        completion-pump eject trigger; a divergence between those sites
+        would desynchronize eject from re-enroll."""
+        se = self.config.snapshot_entries
+        return bool(
+            se
+            and self.sm.get_last_applied() - self.sm.get_snapshot_index()
+            >= se
+        )
 
     def _maybe_enroll(self) -> None:
         """Enroll this group into the native fast lane (under raftMu, at a
@@ -1108,11 +1118,7 @@ class Node:
     def _save_snapshot_required(self) -> None:
         """Auto snapshot every ``snapshot_entries`` applied (reference
         ``node.go:605`` ``saveSnapshotRequired``)."""
-        se = self.config.snapshot_entries
-        if se == 0:
-            return
-        applied = self.sm.get_last_applied()
-        if applied - self.sm.get_snapshot_index() < se:
+        if not self.snapshot_due():
             return
         # held until the queued PERIODIC save completes (_save_snapshot
         # releases it), so duplicate save tasks never pile up

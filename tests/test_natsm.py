@@ -410,3 +410,54 @@ def test_native_session_exactly_once_end_to_end(tmp_path):
     finally:
         for nh in nhs.values():
             nh.stop()
+
+
+def test_periodic_snapshot_triggers_while_enrolled(tmp_path):
+    """The periodic snapshot trigger rides the scalar update path, which
+    is idle during native steady state — this pins the completion-pump
+    trigger: sustained native-applied load must advance the snapshot
+    index (bounding the log) with NO manual snapshot request, and the
+    group must re-enroll afterwards."""
+    sms = {}
+    ports = _ports(3)
+    addrs = {i + 1: f"127.0.0.1:{ports[i]}" for i in range(3)}
+    nhs = {i: _mk(i, addrs, tmp_path, sms, snapshot_entries=64)
+           for i in addrs}
+    try:
+        nhs[1].get_node(CID).request_campaign()
+        lid, leader = _leader(nhs)
+        s = leader.get_noop_session(CID)
+        # warm the lane, then record the snapshot index once enrolled
+        for j in range(30):
+            assert leader.propose(
+                s, f"a{j}=b{j}".encode(), timeout=60.0
+            ).wait(120.0).completed
+        assert _wait_native_applies(nhs)
+        node = leader.get_node(CID)
+        si0 = node.sm.get_snapshot_index()
+        # several snapshot_entries worth of writes through the native lane
+        for j in range(300):
+            assert leader.propose(
+                s, f"k{j % 50}=v{j}".encode(), timeout=60.0
+            ).wait(120.0).completed
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if node.sm.get_snapshot_index() > si0:
+                break
+            time.sleep(0.1)
+        assert node.sm.get_snapshot_index() > si0, (
+            "periodic snapshot never fired under enrolled load"
+        )
+        # the eject that made the scalar window was counted, and the
+        # group came back to the lane
+        assert leader.fastlane.stats()["eject_reasons"].get(
+            "snapshot-due", 0
+        ) >= 1
+        deadline = time.time() + 30
+        while time.time() < deadline and not node.fast_lane:
+            time.sleep(0.1)
+        assert node.fast_lane, "group did not re-enroll after the snapshot"
+        _converged_hashes(sms)
+    finally:
+        for nh in nhs.values():
+            nh.stop()
