@@ -55,6 +55,9 @@ class FastLaneManager:
         self._nodes_mu = threading.Lock()
         self._slots: Dict[str, int] = {}
         self._slots_mu = threading.Lock()
+        # injected netsplits (set_partition); mirrored into the native
+        # engine and consulted by the transport's partition_filter
+        self._blocked_addrs: set = set()
         # ordering gate between the apply pump and eject hand-off: spans are
         # popped from the native queue only under this lock, so an eject can
         # atomically drain the remainder and keep per-group apply order
@@ -214,7 +217,10 @@ class FastLaneManager:
                 if method == 100:
                     transport.handle_request(decode_message_batch(payload))
                 elif method == 200:
-                    if not transport.chunks.add_chunk(decode_chunk(payload)):
+                    # _add_chunk_filtered, NOT chunks.add_chunk: chunks
+                    # arriving on native-served connections must respect
+                    # an injected partition too
+                    if not transport._add_chunk_filtered(decode_chunk(payload)):
                         # a rejected chunk must fail the stream visibly:
                         # close the connection so the sender reports a
                         # failed snapshot instead of believing it landed
@@ -276,6 +282,30 @@ class FastLaneManager:
                 t.start()
                 self._threads.append(t)
             return slot
+
+    def set_partition(self, addr: str, on: bool) -> None:
+        """Symmetric partition from the remote NodeHost at ``addr``
+        (monkey.go:184-213 parity at the REAL wire): inbound raft batches
+        from it are dropped at the native ingest choke point, outbound
+        passes to it at flush, and the paths that do NOT ride the native
+        streams — Python-socket sends, snapshot jobs, inbound chunks —
+        are blocked by the transport's partition_filter (wired to
+        :meth:`is_partitioned` at NodeHost construction).  ``on=False``
+        heals; recovery is the protocol's own machinery (progress-timeout
+        resends, contact-loss/check-quorum ejects, re-enrollment)."""
+        # allocate the slot on demand: a never-yet-contacted remote must
+        # still be blocked SYMMETRICALLY, not inbound-only
+        slot = self.slot_for(addr)
+        with self._slots_mu:
+            if on:
+                self._blocked_addrs.add(addr)
+            else:
+                self._blocked_addrs.discard(addr)
+        self.nat.set_partition(addr, slot, on)
+
+    def is_partitioned(self, addr: str) -> bool:
+        with self._slots_mu:
+            return addr in self._blocked_addrs
 
     def register_node(self, node) -> None:
         with self._nodes_mu:

@@ -627,6 +627,13 @@ struct Engine {
   std::atomic<uint64_t> lat_resp_us{0}, lat_respn{0};  // follower: born->resp flushed
   std::atomic<uint64_t> rtt_us{0}, rttn{0}, rtt_max_us{0};  // hb echo round trip
   std::atomic<uint64_t> stale_dropped{0};  // stale-term fast frames consumed
+  // partition injection (natr_set_partition): blocked inbound source
+  // addresses + outbound remote-slot bitmask, with drop counters
+  std::mutex block_mu;
+  std::vector<std::string> blocked_in;
+  std::atomic<uint64_t> blocked_in_n{0};  // lock-free emptiness guard
+  std::atomic<uint64_t> blocked_out{0};   // bit per remote slot
+  std::atomic<uint64_t> part_in_dropped{0}, part_out_dropped{0};
   // single-group debug timeline (natr_debug)
   std::atomic<uint64_t> debug_cid{0};
   std::mutex dbg_mu;
@@ -732,6 +739,7 @@ struct Engine {
   // publish it to the pump (tcp.py frame layout: >HHQII + payload).
   void flush_remotes() {
     int n = nremotes.load();
+    uint64_t blocked = blocked_out.load(std::memory_order_relaxed);
     for (int ri = 0; ri < n; ri++) {
       Remote* r = remotes[ri].get();
       std::lock_guard<std::mutex> flk(r->flush_mu);
@@ -743,6 +751,11 @@ struct Engine {
         msgs.swap(r->msgs);
         count = r->msg_count;
         r->msg_count = 0;
+      }
+      if (ri < 64 && (blocked >> ri) & 1) {
+        // partitioned remote: the pass's messages vanish on the floor
+        part_out_dropped += count;
+        continue;
       }
       std::string payload;
       payload.reserve(msgs.size() + source_address.size() + 24);
@@ -1914,6 +1927,20 @@ static long long ingest_batch(Engine* e, const uint8_t* d, size_t len,
   size_t src_end = pos;
   if (!get_uvarint(d, len, pos, bin_ver)) return -1;
   if (!get_uvarint(d, len, pos, count)) return -1;
+  if (e->blocked_in_n.load(std::memory_order_relaxed)) {
+    // src span = uvarint(len) + bytes; re-parse the length for the compare
+    size_t sp = src_start;
+    uint64_t slen = 0;
+    if (get_uvarint(d, len, sp, slen) && sp + slen <= len) {
+      std::lock_guard<std::mutex> lk(e->block_mu);
+      for (const std::string& a : e->blocked_in)
+        if (a.size() == slen && memcmp(a.data(), d + sp, slen) == 0) {
+          // partitioned: the whole batch vanishes, leftovers included
+          e->part_in_dropped += count;
+          return (long long)count;
+        }
+    }
+  }
   long long consumed = 0;
   std::string left;
   uint64_t left_count = 0;
@@ -1950,6 +1977,30 @@ static long long ingest_batch(Engine* e, const uint8_t* d, size_t len,
   return consumed;
 }
 
+// Partition injection (monkey.go:184-213 at the real transport).  `addr`
+// blocks INBOUND raft batches whose source address matches (NULL = skip);
+// `slot` >= 0 blocks OUTBOUND passes to that remote.  on=0 heals.  The
+// protocol recovers by itself afterwards (resends, ejects, re-enrolls).
+void natr_set_partition(void* h, const char* addr, int slot, int on) {
+  Engine* e = (Engine*)h;
+  if (addr != nullptr && addr[0]) {
+    std::lock_guard<std::mutex> lk(e->block_mu);
+    std::string a(addr);
+    auto& v = e->blocked_in;
+    auto it = std::find(v.begin(), v.end(), a);
+    if (on && it == v.end()) v.push_back(a);
+    if (!on && it != v.end()) v.erase(it);
+    e->blocked_in_n.store(v.size(), std::memory_order_relaxed);
+  }
+  if (slot >= 0 && slot < 64) {
+    uint64_t bit = 1ULL << slot;
+    if (on)
+      e->blocked_out.fetch_or(bit);
+    else
+      e->blocked_out.fetch_and(~bit);
+  }
+}
+
 long long natr_ingest(void* h, const uint8_t* d, size_t len, uint8_t** leftover,
                       size_t* leftover_len) {
   Engine* e = (Engine*)h;
@@ -1977,6 +2028,19 @@ long long natr_ingest(void* h, const uint8_t* d, size_t len, uint8_t** leftover,
 struct ConnState {
   std::string pending;
 };
+
+// ---- partition injection (monkey.go:184-213 parity, but at the REAL
+// transport: in fast-lane deployments every raft message for a remote —
+// both planes — rides the single ordered native stream).  Inbound raft
+// batches from a blocked source address are consumed and dropped at the
+// single ingest choke point (leftovers included — nothing leaks to the
+// Python router); outbound passes for a blocked remote slot are dropped
+// at flush.  Traffic that does NOT ride these streams — snapshot jobs,
+// inbound chunks, Python-socket sends — is blocked by the Python
+// transport's partition_filter (transport.py), wired to the same
+// fastlane.set_partition call.  Healing is the protocol's own job:
+// progress-timeout resends, check-quorum/contact-loss ejects,
+// re-enrollment.
 
 void* natr_conn_new(void* h) { return new ConnState(); }
 
@@ -2497,7 +2561,9 @@ void natr_stats(void* h, uint64_t* out12) {  // array of 24 u64
   out12[18] = nrt ? (e->rtt_us.load() / nrt) : 0;
   out12[19] = e->rtt_max_us.load();
   out12[20] = e->stale_dropped.load();
-  out12[21] = out12[22] = out12[23] = 0;  // reserved
+  out12[21] = e->part_in_dropped.load();   // partition-dropped inbound msgs
+  out12[22] = e->part_out_dropped.load();  // partition-dropped outbound msgs
+  out12[23] = 0;  // reserved
 }
 
 void natr_set_debug_cid(void* h, uint64_t cid) {

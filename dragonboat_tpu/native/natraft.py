@@ -126,6 +126,9 @@ def _load():
         lib.natr_active.restype = c.c_int
         lib.natr_active.argtypes = [c.c_void_p, c.c_uint64]
         lib.natr_set_commit_window.argtypes = [c.c_void_p, c.c_int64]
+        lib.natr_set_partition.argtypes = [
+            c.c_void_p, c.c_char_p, c.c_int, c.c_int
+        ]
         lib.natr_conn_new.restype = c.c_void_p
         lib.natr_conn_new.argtypes = [c.c_void_p]
         lib.natr_conn_free.argtypes = [c.c_void_p, c.c_void_p]
@@ -561,6 +564,14 @@ class NatRaft:
         (0 = flush as fast as the device allows)."""
         self._lib.natr_set_commit_window(self._h, us)
 
+    def set_partition(self, addr: str, slot: int, on: bool) -> None:
+        """Partition injection at the native transport (monkey.go parity):
+        block inbound raft batches from ``addr`` and/or outbound passes to
+        remote ``slot`` (-1 = inbound only).  ``on=False`` heals."""
+        self._lib.natr_set_partition(
+            self._h, addr.encode() if addr else b"", slot, 1 if on else 0
+        )
+
     def stats(self) -> dict:
         out = (ctypes.c_uint64 * 24)()
         self._lib.natr_stats(self._h, out)
@@ -586,6 +597,8 @@ class NatRaft:
             "hb_rtt_avg_us": int(out[18]),
             "hb_rtt_max_us": int(out[19]),
             "stale_dropped": int(out[20]),
+            "part_in_dropped": int(out[21]),
+            "part_out_dropped": int(out[22]),
         }
 
     def stop(self) -> None:

@@ -167,6 +167,10 @@ class NodeHost:
             mgr = FastLaneManager(self)
             if mgr.enabled:
                 self.fastlane = mgr
+                # netsplit injection coverage for the paths that do NOT
+                # ride the native streams (snapshot jobs, chunks,
+                # Python-socket sends) — see fastlane.set_partition
+                self.transport.partition_filter = mgr.is_partitioned
         # TPU quorum plugin (the north star's plugin/tpuquorum boundary):
         # "tpu" routes hot-path tallying through the batched device engine;
         # "scalar" leaves the pure-host path untouched; "auto" picks by

@@ -86,6 +86,12 @@ class Transport:
         self._breakers: Dict[str, CircuitBreaker] = {}
         self._stopped = threading.Event()
         self._queue_len = max_send_queue_size or Soft.send_queue_length
+        # partition injection (monkey.go:82 transport drop-hook role):
+        # addr -> blocked predicate, wired by the chaos harness (the fast
+        # lane blocks its native streams itself; this filter covers the
+        # paths that do NOT ride them — Python-socket sends, snapshot
+        # jobs, inbound chunks and Python-received batches)
+        self.partition_filter: Optional[Callable[[str], bool]] = None
         self._snapshot_count_mu = threading.Lock()
         self._snapshot_jobs = 0
         from .bandwidth import TokenBucket
@@ -123,9 +129,19 @@ class Transport:
             on_received=_snapshot_received,
         )
         self.rpc = raft_rpc_factory(
-            source_address, self.handle_request, self.chunks.add_chunk
+            source_address, self.handle_request, self._add_chunk_filtered
         )
         self.rpc.start()
+
+    def _add_chunk_filtered(self, c) -> bool:
+        """Inbound snapshot chunks from a partitioned sender are refused
+        (False poisons the transfer connection — what a netsplit does)."""
+        pf = self.partition_filter
+        if pf is not None:
+            addr = self.registry.resolve(c.cluster_id, c.from_)
+            if addr is not None and pf(addr):
+                return False
+        return self.chunks.add_chunk(c)
 
     # ---- send path ----
 
@@ -143,6 +159,9 @@ class Transport:
         addr = self.registry.resolve(m.cluster_id, m.to)
         if addr is None:
             return False
+        pf = self.partition_filter
+        if pf is not None and pf(addr):
+            return False  # injected netsplit: unreachable
         b = self.breaker(addr)
         if not b.ready():
             return False
@@ -246,6 +265,9 @@ class Transport:
         addr = self.registry.resolve(m.cluster_id, m.to)
         if addr is None:
             return False
+        pf = self.partition_filter
+        if pf is not None and pf(addr):
+            return False  # injected netsplit: snapshot path blocked too
         with self._snapshot_count_mu:
             if self._snapshot_jobs >= Soft.max_snapshot_connections:
                 return False
@@ -359,6 +381,10 @@ class Transport:
             )
             self.metrics.message_receive_dropped(len(batch.requests))
             return
+        pf = self.partition_filter
+        if pf is not None and batch.source_address and pf(batch.source_address):
+            self.metrics.message_receive_dropped(len(batch.requests))
+            return  # injected netsplit: Python-received batch dropped
         self.metrics.message_received(len(batch.requests))
         self.message_handler(batch)
 

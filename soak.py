@@ -336,6 +336,21 @@ def rank_main() -> int:
                         fl.stats().get("enrolled_replicas", 0) if fl else 0
                     ),
                 })
+            elif cmd.startswith("PART "):
+                # "PART <addr> <0|1>": (un)block the remote at the native
+                # transport — a true netsplit over TCP (both planes ride
+                # the native streams; see fastlane.set_partition).  A rank
+                # without a fast lane must NOT ack success: the parent
+                # would count a netsplit that was never injected.
+                _, part_addr, on = cmd.split()
+                # the reply echoes the command so the parent can match
+                # acks to requests (a timed-out attempt's late ack must
+                # not satisfy a LATER command's wait)
+                if nh.fastlane is not None:
+                    nh.fastlane.set_partition(part_addr, on == "1")
+                    emit("PART", {"ok": True, "addr": part_addr, "on": on})
+                else:
+                    emit("PART", {"ok": False, "addr": part_addr, "on": on})
             elif cmd == "EXIT":
                 break
     finally:
@@ -554,6 +569,7 @@ def main() -> int:
     deadline = t0 + args.minutes * 60
     kills = 0
     pauses = 0
+    splits = 0
     converges = 0
     failure = None
     try:
@@ -565,7 +581,60 @@ def main() -> int:
 
         next_kill = time.time() + rng.uniform(10, 25)
         next_pause = time.time() + rng.uniform(20, 35)
+        next_split = time.time() + rng.uniform(25, 40)
         next_converge = time.time() + 30.0
+        addr_list = addrs.split(",")
+
+        def set_split(victim, on):
+            """Symmetric netsplit {victim} | {others} at the native wire
+            (the reference monkey's partitionTests shape).  Returns True
+            when every live rank confirmed the change.  A rank that fails
+            to HEAL is kill -9'd and restarted: its blocks live in process
+            memory, so the restart clears them — a stale block would
+            otherwise fail every later converge check with a misleading
+            divergence report."""
+            flag = "1" if on else "0"
+            ok = True
+
+            def apply_one(r):
+                cmds = (
+                    [a for j, a in enumerate(addr_list) if j != victim.idx]
+                    if r is victim
+                    else [addr_list[victim.idx]]
+                )
+                for a in cmds:
+                    r.send(f"PART {a} {flag}")
+                    # match the echoed command: a late ack from a timed-out
+                    # earlier attempt must not satisfy this wait
+                    deadline_ack = time.time() + 10
+                    while True:
+                        rep = r.expect("PART", max(0.1, deadline_ack - time.time()))
+                        if rep and rep.get("addr") == a and rep.get("on") == flag:
+                            break
+                    if not rep.get("ok"):
+                        raise RuntimeError("partition injection refused")
+
+            for r in ranks:
+                if not r.alive():
+                    continue  # a killed rank holds no blocks
+                for attempt in (1, 2):
+                    try:
+                        apply_one(r)
+                        break
+                    except Exception:
+                        if attempt == 2:
+                            ok = False
+                            if not on and r.alive():
+                                print(
+                                    f"# rank{r.idx} failed to heal; "
+                                    "kill -9 to clear its blocks",
+                                    file=sys.stderr,
+                                )
+                                r.kill9()
+                                time.sleep(1.0)
+                                r.start()
+                                r.expect("READY", 180)
+            return ok
         while time.time() < deadline:
             time.sleep(1.0)
             now = time.time()
@@ -584,6 +653,17 @@ def main() -> int:
                 victim.resume()
                 pauses += 1
                 next_pause = time.time() + rng.uniform(20, 45)
+            if now >= next_split:
+                victim = rng.choice(ranks)
+                dur = rng.uniform(2, 8)
+                print(f"# t+{now - t0:.0f}s netsplit rank{victim.idx} "
+                      f"for {dur:.1f}s", file=sys.stderr)
+                injected = set_split(victim, True)
+                time.sleep(dur)
+                set_split(victim, False)
+                if injected:  # only count splits that actually happened
+                    splits += 1
+                next_split = time.time() + rng.uniform(25, 50)
             if now >= next_kill:
                 victim = rng.choice(ranks)
                 print(f"# t+{now - t0:.0f}s kill -9 rank{victim.idx}",
@@ -635,6 +715,7 @@ def main() -> int:
         "groups": args.groups,
         "kills": kills,
         "pauses": pauses,
+        "netsplits": splits,
         "converge_checks": converges,
         "history_ops": n_ops,
         "enrolled_final": enrolled,
