@@ -25,6 +25,25 @@ test-all: native
 native:
 	$(MAKE) -C dragonboat_tpu/native
 
+# race-detection gate for the C++ engine (the reference's RACE=1 make
+# test role, docs Makefile:122-127): native suites under ThreadSanitizer.
+# Scoped to the timing-robust modules — TSAN's 5-15x slowdown makes the
+# enrollment-pacing chaos tests assert on scheduling, not races.
+TSAN_RT := $(shell $(CXX) -print-file-name=libtsan.so)
+TSAN_ENV = DBTPU_NATIVE_LIB_DIR=$(CURDIR)/dragonboat_tpu/native/tsan \
+	LD_PRELOAD=$(TSAN_RT) \
+	TSAN_OPTIONS="halt_on_error=0 report_thread_leaks=0 exitcode=66"
+test-tsan:
+	test -f "$(TSAN_RT)"  # libtsan runtime must exist
+	$(MAKE) -C dragonboat_tpu/native tsan
+	# the targeted suites skip themselves when the libs fail to load —
+	# assert loadability FIRST so a broken TSAN env can't pass vacuously
+	$(TSAN_ENV) $(PY) -c "from dragonboat_tpu.native import natraft, natsm, available; \
+	    assert available() and natraft.available() and natsm.available(), \
+	    'TSAN native libs failed to load'"
+	$(TSAN_ENV) $(PY) -m pytest tests/test_natsm.py tests/test_partition_tcp.py \
+	    tests/test_nativekv.py -q
+
 # Drummer-analog chaos soak (docs/test.md:6-36): kill -9/restart churn,
 # continuous cross-replica hash checks, linearizability on sampled keys
 soak: native

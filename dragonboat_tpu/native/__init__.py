@@ -23,7 +23,12 @@ from typing import Iterator, Optional, Tuple
 from ..logdb.kv import KVWriteBatch
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SO = os.path.join(_DIR, "libnativekv.so")
+# DBTPU_NATIVE_LIB_DIR: load the .so set from an alternate build dir —
+# the TSAN race-detection gate (make test-tsan) points it at
+# -fsanitize=thread builds, the analog of the reference's RACE=1 make
+# test (docs Makefile:122-127)
+_LIB_DIR = os.environ.get("DBTPU_NATIVE_LIB_DIR") or _DIR
+_SO = os.path.join(_LIB_DIR, "libnativekv.so")
 _SRC = os.path.join(_DIR, "nativekv.cpp")
 
 _lib = None
@@ -38,8 +43,13 @@ def _load():
             return _lib
         if _build_error is not None:
             raise RuntimeError(_build_error)
-        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
-            _SRC
+        # build-on-demand applies only to the default lib dir: an explicit
+        # DBTPU_NATIVE_LIB_DIR override is load-only (make would rebuild
+        # the DEFAULT .so and this would then silently load a stale
+        # override build — the TSAN gate rebuilds its own dir explicitly)
+        if _LIB_DIR == _DIR and (
+            not os.path.exists(_SO)
+            or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
         ):
             proc = subprocess.run(
                 ["make", "-C", _DIR, "libnativekv.so"],

@@ -2246,12 +2246,16 @@ int natr_remote_connect(void* h, int slot, const char* host, int port) {
           off += (size_t)n;
         }
       }
+      bool was_closed;
       {
         std::lock_guard<std::mutex> lk(r->mu);
         r->fd = -1;
+        // read under r->mu: stop() writes it there, and the naked read
+        // here was the one data race a TSAN sweep found in the engine
+        was_closed = r->closed;
       }
       close(fd);
-      if (r->closed) return;
+      if (was_closed) return;
     }
   });
   return 0;

@@ -14,9 +14,10 @@ import threading
 from typing import List, Optional, Tuple
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SO = os.path.join(_DIR, "libnatraft.so")
+_LIB_DIR = os.environ.get("DBTPU_NATIVE_LIB_DIR") or _DIR  # see native/__init__.py
+_SO = os.path.join(_LIB_DIR, "libnatraft.so")
 _SRC = os.path.join(_DIR, "natraft.cpp")
-_NKV_SO = os.path.join(_DIR, "libnativekv.so")
+_NKV_SO = os.path.join(_LIB_DIR, "libnativekv.so")
 
 _lib = None
 _lib_mu = threading.Lock()
@@ -30,8 +31,10 @@ def _load():
             return _lib
         if _build_error is not None:
             raise RuntimeError(_build_error)
-        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
-            _SRC
+        # override dirs are load-only (see native/__init__.py)
+        if _LIB_DIR == _DIR and (
+            not os.path.exists(_SO)
+            or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
         ):
             proc = subprocess.run(
                 ["make", "-C", _DIR, "libnatraft.so"],

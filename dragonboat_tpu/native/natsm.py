@@ -33,9 +33,14 @@ def _load():
     with _lib_mu:
         if _lib is not None:
             return _lib
-        path = os.path.join(os.path.dirname(__file__), "libnatsm.so")
-        if not os.path.exists(path):
-            # build on demand like the sibling libraries (__init__.py)
+        lib_dir = (
+            os.environ.get("DBTPU_NATIVE_LIB_DIR")
+            or os.path.dirname(__file__)
+        )  # see native/__init__.py (TSAN build override)
+        path = os.path.join(lib_dir, "libnatsm.so")
+        if not os.path.exists(path) and lib_dir == os.path.dirname(__file__):
+            # build on demand like the sibling libraries (__init__.py);
+            # override dirs are load-only
             import subprocess
 
             subprocess.run(

@@ -234,6 +234,15 @@ def test_partition_blocks_snapshot_catchup_until_heal(tmp_path):
             ).wait(120.0).completed
 
         victim = [i for i in nhs if i != lid][0]
+        # settle BEFORE partitioning: pre-split entries may still be in
+        # the victim's apply pipeline, and a baseline captured mid-flight
+        # would later read as a "leak" when they finish applying
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if len({sm.get_hash() for sm in sms.values()}) == 1:
+                break
+            time.sleep(0.1)
+        assert len({sm.get_hash() for sm in sms.values()}) == 1
         for i in nhs:
             if i != victim:
                 nhs[i].fastlane.set_partition(addrs[victim], True)
