@@ -8,7 +8,7 @@ protocol state on TPU via JAX (see ``dragonboat_tpu.ops``).
 """
 from .client import Session  # noqa: F401
 from .config import Config, ExpertConfig, LogDBConfig, NodeHostConfig  # noqa: F401
-from .nodehost import NodeHost  # noqa: F401
+from .nodehost import ClusterInfo, NodeHost, NodeHostInfo  # noqa: F401
 from .requests import (  # noqa: F401
     ClusterAlreadyExistError,
     ClusterNotFoundError,

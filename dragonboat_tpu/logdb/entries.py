@@ -88,9 +88,12 @@ class PlainEntries:
         )
 
     def compact_range(self, cluster_id: int, node_id: int, index: int) -> None:
+        # end key is exclusive (index + 1); a full-range request at
+        # MAX_INDEX (RequestCompaction for a removed node) must clamp
+        # instead of overflowing the u64 key pack
         self.kv.compact_entries(
             keys.entry_key(cluster_id, node_id, 0),
-            keys.entry_key(cluster_id, node_id, index + 1),
+            keys.entry_key(cluster_id, node_id, min(index + 1, keys.MAX_INDEX)),
         )
 
 

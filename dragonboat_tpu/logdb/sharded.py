@@ -12,11 +12,14 @@ import queue
 import threading
 from typing import Callable, List, Optional, Tuple
 
+from ..logger import get_logger
 from ..settings import Hard
 from ..wire import Bootstrap, Entry, Snapshot, Update
 from .entries import has_entry_records
 from .kv import IKVStore, InMemKV, WalKV
 from .rdb import RDB, NodeInfo, RaftState
+
+plog = get_logger("logdb")
 
 _STOP = object()
 
@@ -178,6 +181,14 @@ class ShardedDB:
                 )
                 if self.on_compaction is not None:
                     self.on_compaction(cluster_id, node_id)
+            except Exception:
+                # the worker must survive a failed compaction: letting the
+                # exception kill this thread would silently disable ALL
+                # future compaction (the queue drains nowhere) — found by
+                # the RequestCompaction full-range overflow test
+                plog.exception(
+                    "compaction %d:%d to %d failed", cluster_id, node_id, index
+                )
             finally:
                 if len(item) > 3:
                     item[3].set()

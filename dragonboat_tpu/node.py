@@ -122,6 +122,12 @@ class Node:
         self._natsm_attached = False  # native C-ABI SM wired to the lane
         self._next_enroll_try = 0.0
         self._tick_count_pending = 0
+        # last auto-compacted watermark, consumed by request_compaction
+        # (reference snapshotState.compactedTo, swap-to-zero semantics);
+        # the lock makes the swap atomic against _compact_log's store
+        # (the reference uses atomic.SwapUint64)
+        self._compacted_to = 0
+        self._compacted_to_mu = threading.Lock()
         self._snapshotting = threading.Lock()
         self._apply_serial = threading.Lock()
         self.leader_id = 0
@@ -1303,6 +1309,8 @@ class Node:
         except Exception:
             return
         self.logdb.remove_entries_to(self.cluster_id, self.node_id, compact_to)
+        with self._compacted_to_mu:
+            self._compacted_to = compact_to
         self._publish_event(SystemEventType.LOG_COMPACTED, index=compact_to)
 
     def _recover_from_snapshot(self, t: Task) -> None:
@@ -1432,6 +1440,24 @@ class Node:
     def is_leader(self) -> bool:
         with self.raft_mu:
             return self.peer is not None and self.peer.raft.is_leader()
+
+    def request_compaction(self) -> threading.Event:
+        """User-requested LogDB compaction up to the last auto-compacted
+        watermark (reference ``node.go:912-927`` requestCompaction —
+        swap-to-zero, so back-to-back requests don't recompact).  Raises
+        RejectedError when nothing has been compacted since the last
+        request."""
+        with self._compacted_to_mu:
+            compact_to, self._compacted_to = self._compacted_to, 0
+        if compact_to == 0:
+            from .requests import RejectedError
+
+            raise RejectedError("nothing to compact")
+        # the compaction worker publishes LOGDB_COMPACTED on completion
+        # (logdb.on_compaction, wired by NodeHost)
+        return self.logdb.compact_entries_to(
+            self.cluster_id, self.node_id, compact_to
+        )
 
     def describe(self) -> str:
         return f"node {self.cluster_id}:{self.node_id}"

@@ -10,6 +10,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from dataclasses import dataclass, field
 from typing import Callable, Dict, Tuple
 
 from . import vfs
@@ -58,6 +59,35 @@ from .wire import (
 )
 
 plog = get_logger("nodehost")
+
+
+@dataclass
+class ClusterInfo:
+    """Snapshot of one managed Raft cluster node (reference
+    ``nodehost.go:163`` ``ClusterInfo``)."""
+
+    cluster_id: int = 0
+    node_id: int = 0
+    nodes: Dict[int, str] = field(default_factory=dict)
+    observers: Dict[int, str] = field(default_factory=dict)
+    witnesses: Dict[int, str] = field(default_factory=dict)
+    config_change_index: int = 0
+    state_machine_type: StateMachineType = StateMachineType.REGULAR
+    is_leader: bool = False
+    is_observer: bool = False
+    is_witness: bool = False
+    pending: bool = False  # nothing applied yet — details unavailable
+
+
+@dataclass
+class NodeHostInfo:
+    """Host-wide inventory (reference ``nodehost.go:193``
+    ``NodeHostInfo``): the managed clusters plus every (cluster, node)
+    with raft state in the LogDB."""
+
+    raft_address: str = ""
+    cluster_info_list: list = field(default_factory=list)
+    log_info: list = field(default_factory=list)  # [(cluster_id, node_id)]
 
 
 class NodeHost:
@@ -566,6 +596,72 @@ class NodeHost:
                 time.sleep(self.nhconfig.rtt_millisecond / 1000.0)
                 continue
             return r
+
+    def request_compaction(self, cluster_id: int, node_id: int):
+        """User-requested LogDB compaction (reference ``nodehost.go:980``
+        ``RequestCompaction``).  Returns a ``threading.Event`` set when
+        the compaction completes.  For a cluster already removed from
+        this host (e.g. after ``remove_data``) the whole log range is
+        compacted; for a live node, compaction runs up to the last
+        auto-compacted watermark (RejectedError when there is none)."""
+        with self._mu:
+            node = self._clusters.get(cluster_id)
+            starting = node is None and cluster_id in self._clusters
+        if starting:
+            # in-flight start_cluster reservation (the None placeholder):
+            # NOT removed data — refuse rather than full-range compact a
+            # cluster that is coming up
+            raise ClusterNotFoundError(f"cluster {cluster_id} is starting")
+        if node is None:
+            # removed via remove_data: compact everything it left behind
+            return self.logdb.compact_entries_to(
+                cluster_id, node_id, (1 << 64) - 1
+            )
+        if node.node_id != node_id:
+            raise ClusterNotFoundError(f"{cluster_id}:{node_id}")
+        done = node.request_compaction()
+        self.engine.set_step_ready(cluster_id)
+        return done
+
+    def has_node_info(self, cluster_id: int, node_id: int) -> bool:
+        """True when this host holds bootstrap state for the replica
+        (reference ``nodehost.go:1319`` ``HasNodeInfo``)."""
+        return self.logdb.get_bootstrap_info(cluster_id, node_id) is not None
+
+    def get_node_host_info(self, skip_log_info: bool = False) -> "NodeHostInfo":
+        """Details of this host and every Raft cluster it manages
+        (reference ``nodehost.go:1333`` ``GetNodeHostInfo``)."""
+        infos = []
+        with self._mu:
+            # skip in-flight start_cluster reservations (None placeholders)
+            nodes = [n for n in self._clusters.values() if n is not None]
+        for n in nodes:
+            try:
+                m = n.sm.get_membership()
+                pending = not m.addresses and not m.observers and not m.witnesses
+                infos.append(ClusterInfo(
+                    cluster_id=n.cluster_id,
+                    node_id=n.node_id,
+                    nodes=dict(m.addresses),
+                    observers=dict(m.observers),
+                    witnesses=dict(m.witnesses),
+                    config_change_index=m.config_change_id,
+                    state_machine_type=n.sm.sm_type,
+                    is_leader=n.is_leader(),
+                    is_observer=n.config.is_observer,
+                    is_witness=n.config.is_witness,
+                    pending=pending,
+                ))
+            except Exception:  # a node racing stop: report it as pending
+                infos.append(ClusterInfo(
+                    cluster_id=n.cluster_id, node_id=n.node_id, pending=True
+                ))
+        log_info = [] if skip_log_info else self.logdb.list_node_info()
+        return NodeHostInfo(
+            raft_address=self.raft_address(),
+            cluster_info_list=infos,
+            log_info=[(ni.cluster_id, ni.node_id) for ni in log_info],
+        )
 
     def stale_read(self, cluster_id: int, query):
         return self.get_node(cluster_id).stale_read(query)

@@ -250,3 +250,82 @@ def test_remove_node_membership_change(cluster3):
     s = nhs[0].get_noop_session(100)
     nhs[0].sync_propose(s, b"still=works", timeout=5.0)
     assert nhs[0].sync_read(100, "still", timeout=5.0) == "works"
+
+
+def test_node_host_info_and_has_node_info(cluster3):
+    """get_node_host_info / has_node_info (reference GetNodeHostInfo /
+    HasNodeInfo, nodehost.go:1319-1345)."""
+    nhs, sms, addrs, router = cluster3
+    lid = wait_for_leader(nhs, 100)
+    leader = nhs[lid - 1]
+    s = leader.get_noop_session(100)
+    deadline = time.time() + 20
+    j = 0
+    while j < 5:  # early proposes can be DROPPED while leadership settles
+        try:
+            leader.sync_propose(s, f"k{j}=v{j}".encode(), timeout=5.0)
+            j += 1
+        except Exception:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.1)
+
+    info = leader.get_node_host_info()
+    assert info.raft_address == addrs[lid]
+    assert len(info.cluster_info_list) == 1
+    ci = info.cluster_info_list[0]
+    assert ci.cluster_id == 100 and ci.node_id == lid
+    assert ci.nodes == addrs and not ci.pending
+    assert ci.is_leader and not ci.is_observer and not ci.is_witness
+    assert (100, lid) in info.log_info
+    assert leader.get_node_host_info(skip_log_info=True).log_info == []
+
+    assert leader.has_node_info(100, lid)
+    assert not leader.has_node_info(100, 99)
+    assert not leader.has_node_info(999, lid)
+
+
+def test_request_compaction(tmp_path):
+    """request_compaction (reference RequestCompaction nodehost.go:980):
+    rejected before any auto-compaction, completes after snapshots have
+    moved the compaction watermark, and compacts removed-node data."""
+    from dragonboat_tpu.requests import RejectedError
+
+    router = ChanRouter()
+    addrs = {1: "nh1:1"}
+    nh = make_nodehost(addrs[1], router, tmpdir=str(tmp_path / "nh1"))
+    sms = {}
+
+    def create(cluster_id, node_id):
+        sm = KVSM(cluster_id, node_id)
+        sms[node_id] = sm
+        return sm
+
+    nh.start_cluster(
+        addrs, False, create,
+        group_config(100, 1, snapshot_entries=20, compaction_overhead=5),
+    )
+    try:
+        wait_for_leader([nh], 100)
+        with pytest.raises(RejectedError):
+            nh.request_compaction(100, 1)
+        s = nh.get_noop_session(100)
+        for j in range(80):  # crosses several snapshot+compaction points
+            nh.sync_propose(s, f"a{j}=b{j}".encode(), timeout=5.0)
+        deadline = time.time() + 30
+        ev = None
+        while ev is None and time.time() < deadline:
+            try:
+                ev = nh.request_compaction(100, 1)
+            except RejectedError:
+                time.sleep(0.1)  # snapshot/compaction still in flight
+        assert ev is not None, "compaction watermark never advanced"
+        assert ev.wait(30), "compaction never completed"
+        # swap-to-zero: an immediate second request has nothing to do
+        with pytest.raises(RejectedError):
+            nh.request_compaction(100, 1)
+        # removed-node form: full-range compaction completes
+        ev2 = nh.request_compaction(321, 9)
+        assert ev2.wait(30)
+    finally:
+        nh.stop()
