@@ -592,7 +592,8 @@ def rank_main() -> int:
 
         sm_factory = NativeKVStateMachine
     cids = [BASE_CID + g for g in range(groups)]
-    for cid in cids:
+
+    def _start_one(cid):
         nh.start_cluster(
             addrs,
             False,
@@ -605,6 +606,18 @@ def rank_main() -> int:
                 snapshot_entries=0,
             ),
         )
+
+    # start_cluster is thread-safe (the id is reserved under the NodeHost
+    # lock); at 4k+ groups the serial loop is the setup bottleneck (round
+    # 4: 223s for 12,288 replicas) — the cost is IO/lock waits, which a
+    # small pool overlaps
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(
+        max_workers=int(os.environ.get("E2E_START_THREADS", "4"))
+    ) as ex:
+        for _ in ex.map(_start_one, cids):
+            pass
 
     def preferred(cid):
         return 0 if leader_mode == "rank0" else cid % procs
@@ -639,16 +652,27 @@ def rank_main() -> int:
     expect("CAMPAIGN")
 
     t_campaign = time.perf_counter()
-    for cid in mine:
-        nh.get_node(cid).request_campaign()
     deadline = time.time() + leader_timeout
     led = set()
+    # staggered initial campaigns (round-4 election storm: 3,049/4,096
+    # elected in 300s when every group campaigned at once — simultaneous
+    # campaigns collide on the wire and their vote responses starve behind
+    # each other's Replicate/noop traffic).  Keep at most `wave` un-won
+    # campaigns in flight; each completed election frees a slot.
+    wave = int(os.environ.get("E2E_CAMPAIGN_WAVE", "512"))
+    to_campaign = list(reversed(mine))
+    inflight: set = set()
     next_retry = time.time() + 3.0
     next_report = time.time() + 5.0
     while len(led) < len(mine) and time.time() < deadline:
-        for cid in mine:
-            if cid not in led and nh.get_node(cid).is_leader():
+        for cid in list(inflight):
+            if nh.get_node(cid).is_leader():
                 led.add(cid)
+                inflight.discard(cid)
+        while to_campaign and len(inflight) < wave:
+            cid = to_campaign.pop()
+            nh.get_node(cid).request_campaign()
+            inflight.add(cid)
         if len(led) < len(mine):
             if time.time() >= next_report:
                 # election progress to stderr so a slow tunneled-TPU run
@@ -660,9 +684,7 @@ def rank_main() -> int:
                 )
                 next_report = time.time() + 5.0
             if time.time() >= next_retry:
-                for cid in mine:
-                    if cid in led:
-                        continue
+                for cid in inflight:
                     node = nh.get_node(cid)
                     # don't restart a campaign whose votes are still in
                     # flight (e.g. riding a busy engine round): bumping the

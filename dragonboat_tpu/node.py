@@ -110,6 +110,15 @@ class Node:
         self._stopped = threading.Event()
         self._initialized = threading.Event()
         self.current_tick = 0
+        # lazy tick delivery: nodes whose raft clock is NOT host-driven
+        # (native fast lane, device tick kernel) skip the per-tick wakeup
+        # from the tick worker and catch up on elapsed ticks — read from
+        # the NodeHost's global tick counter — at their next step.  This
+        # turns the tick worker's per-RTT Python cost from O(groups) into
+        # O(scalar-clocked groups), the scaling axis the reference covers
+        # with quiesce (quiesce.go) and the device engine covers with one
+        # fused tick dispatch for the whole mass.
+        self._seen_tick = nh.tick_count
         # True while this group's latest update sits in the engine's commit
         # pipeline; the step worker skips the group until the committer
         # clears it (per-group round ordering, see engine._Committer)
@@ -225,6 +234,7 @@ class Node:
             if self.peer is None or self.fast_lane:
                 return
             r = self.peer.raft
+            self._catch_up_and_tick()
             if (
                 r.device_ticks
                 and not r.is_leader()
@@ -252,6 +262,7 @@ class Node:
             if self.peer is None or self.fast_lane:
                 return
             r = self.peer.raft
+            self._catch_up_and_tick()
             if r.device_ticks and r.is_leader():
                 r.heartbeat_tick = 0
                 r.handle(Message(from_=self.node_id, type=MT.LEADER_HEARTBEAT))
@@ -268,6 +279,7 @@ class Node:
             if self.peer is None or self.fast_lane:
                 return
             r = self.peer.raft
+            self._catch_up_and_tick()
             if r.device_ticks and r.is_leader() and r.check_quorum:
                 r.election_tick = 0
                 r.handle(Message(from_=self.node_id, type=MT.CHECK_QUORUM))
@@ -486,6 +498,66 @@ class Node:
         self.mq.add(Message(type=MT.LOCAL_TICK))
         self.nh.engine.set_step_ready(self.cluster_id)
 
+    # ---- lazy tick delivery (tick-lite) ----
+
+    def tick_lite(self) -> bool:
+        """True when the tick worker may skip this node's per-tick wakeup:
+        the raft clock is owned by the native fast lane or the device tick
+        kernel, so the only per-tick host work left (pending-request
+        timeout GC, tick counters) tolerates batched delivery at the next
+        step (``_catch_up_ticks``)."""
+        if not self._initialized.is_set():
+            return False
+        if self.fast_lane:
+            return True
+        p = self.peer
+        return p is not None and p.raft.device_ticks
+
+    def has_pending_requests(self) -> bool:
+        """Cheap unlocked check used by the tick worker's staleness sweep:
+        a lite node with possibly-timed-out requests gets a wakeup so GC
+        runs.  New requests always arrive with their own step wakeup, so a
+        racy miss here only delays GC by one sweep period."""
+        return (
+            self.pending_proposals.has_pending()
+            or self.pending_reads.has_pending()
+            or self.pending_config_change.pending() is not None
+            or self.pending_snapshot.pending() is not None
+            or self.pending_leader_transfer.pending() is not None
+        )
+
+    def _catch_up_ticks(self) -> int:
+        """Elapsed global ticks since this node last stepped (under
+        raftMu).  Capped so a long stall delivers enough ticks to fire any
+        timeout-driven behavior without looping unboundedly."""
+        nt = self.nh.tick_count
+        delta = nt - self._seen_tick
+        if delta <= 0:
+            return 0
+        self._seen_tick = nt
+        return min(delta, max(4 * self.config.election_rtt, 16))
+
+    def _tracker_ticks(self, delta: int) -> int:
+        """How many of a catch-up delta the pending-request clocks get.
+
+        While requests are pending the sweep wakes the node within
+        ``lazy_tick_sweep_ticks``, so the tracker clock never lags real
+        time by more than that; a larger delta means the backlog predates
+        every live request, and delivering it would erode a
+        just-registered request's deadline by idle time during which it
+        did not exist (it can even expire it instantly)."""
+        return min(delta, Soft.lazy_tick_sweep_ticks)
+
+    def _catch_up_and_tick(self) -> None:
+        """Shared preamble of the offload_tick_* handlers (under raftMu):
+        an idle device-ticked group's scalar clock only advances at step
+        time, and the device flag may be its first step in many ticks —
+        catch up so the scalar-agreement guards compare a current clock."""
+        if self.peer.raft.device_ticks and self.initialized():
+            delta = self._catch_up_ticks()
+            if delta:
+                self._tick(delta, tracker_count=self._tracker_ticks(delta))
+
     def request_campaign(self) -> None:
         """Immediately start an election on this replica (etcd's
         ``raft.Campaign`` / MsgHup; our ``MT.ELECTION`` is the same local
@@ -517,9 +589,17 @@ class Node:
                 return None
             if not self.initialized():
                 return None
-            if self.fast_lane and not self._fast_lane_step():
-                return None
-            self._handle_events()
+            delta = self._catch_up_ticks()
+            if self.fast_lane:
+                if not self._fast_lane_step(delta):
+                    return None
+                delta = 0  # consumed by the fast-lane step
+            elif not self.peer.raft.device_ticks:
+                # scalar-clocked groups receive real LOCAL_TICK messages;
+                # the counter sync above only prevents a stale delta from
+                # double-delivering after a lite→scalar transition
+                delta = 0
+            self._handle_events(extra_ticks=delta)
             more = self.to_apply.more_entries_to_apply()
             if self.peer.has_update(more):
                 ud = self.peer.get_update(more, self.sm.get_last_applied())
@@ -529,7 +609,7 @@ class Node:
 
     # ---- native fast lane (fastlane.py) ----
 
-    def _fast_lane_step(self) -> bool:
+    def _fast_lane_step(self, extra_ticks: int = 0) -> bool:
         """Enrolled-mode step (under raftMu): ticks feed only the pending
         trackers (the native core owns heartbeat/election clocks); queued
         proposals and in-flight fast-path messages are fed to the native
@@ -555,9 +635,9 @@ class Node:
                 fl.count_drop("stale-vote-resp")
             else:
                 others.append(m)
-        if ticks:
-            self.current_tick += ticks
-            self._tick_trackers(ticks)
+        if ticks or extra_ticks:
+            self.current_tick += ticks + extra_ticks
+            self._tick_trackers(ticks + self._tracker_ticks(extra_ticks))
         # reads registered while (re)enrolling are served natively here
         # (the same protocol Node.read drives; ejecting for them would
         # defeat the native ReadIndex path)
@@ -910,18 +990,18 @@ class Node:
                     r.election_tick = r.randomized_election_timeout
         self.nh.engine.set_step_ready(self.cluster_id)
 
-    def _handle_events(self) -> None:
-        self._handle_received_messages()
+    def _handle_events(self, extra_ticks: int = 0) -> None:
+        self._handle_received_messages(extra_ticks)
         self._handle_read_index()
         self._handle_config_change()
         self._handle_proposals()
         self._handle_leader_transfer()
         self._handle_snapshot_request()
 
-    def _handle_received_messages(self) -> None:
-        self._process_messages(self.mq.get())
+    def _handle_received_messages(self, extra_ticks: int = 0) -> None:
+        self._process_messages(self.mq.get(), extra_ticks)
 
-    def _process_messages(self, msgs) -> None:
+    def _process_messages(self, msgs, extra_ticks: int = 0) -> None:
         ticks = 0
         for m in msgs:
             if m.type == MT.LOCAL_TICK:
@@ -950,8 +1030,13 @@ class Node:
                     self._handle_install_snapshot(m)
                 else:
                     self.peer.handle(m)
-        if ticks:
-            self._tick(ticks)
+        if ticks or extra_ticks:
+            # real LOCAL_TICKs count fully; the lazy catch-up portion is
+            # capped for the pending-request clocks (see _tracker_ticks)
+            self._tick(
+                ticks + extra_ticks,
+                tracker_count=ticks + self._tracker_ticks(extra_ticks),
+            )
         if self.quiesce_mgr.just_entered_quiesce():
             self._broadcast_quiesce()
 
@@ -971,7 +1056,7 @@ class Node:
                     )
                 )
 
-    def _tick(self, count: int) -> None:
+    def _tick(self, count: int, tracker_count: Optional[int] = None) -> None:
         for _ in range(count):
             self.current_tick += 1
             self.quiesce_mgr.increase_quiesce_tick()
@@ -979,7 +1064,7 @@ class Node:
                 self.peer.quiesced_tick()
             else:
                 self.peer.tick()
-        self._tick_trackers(count)
+        self._tick_trackers(count if tracker_count is None else tracker_count)
         self._update_leader_info()
 
     def _tick_trackers(self, count: int) -> None:

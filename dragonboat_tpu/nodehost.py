@@ -101,6 +101,10 @@ class NodeHost:
         self._clusters: Dict[int, Node] = {}
         self._csi = 0  # cluster-set change counter (reference clusterMu.csi)
         self._stopped = threading.Event()
+        # global tick counter (lazy tick delivery): nodes with native/
+        # device-owned raft clocks read this at step time instead of
+        # receiving one LOCAL_TICK message per RTT each
+        self.tick_count = 0
         # filesystem the snapshot paths go through (ExpertConfig.fs lets
         # tests run diskless via vfs.MemFS or inject faults via vfs.ErrorFS,
         # which is auto-detected like the reference nodehost.go:321-327)
@@ -971,12 +975,29 @@ class NodeHost:
     def _tick_worker_main(self) -> None:
         interval = self.nhconfig.rtt_millisecond / 1000.0
         ticks = 0
+        sweep = Soft.lazy_tick_sweep_ticks
         while not self._stopped.wait(interval):
             ticks += 1
+            self.tick_count += 1
+            now_tick = self.tick_count
             with self._mu:
                 nodes = list(self._clusters.values())
             for n in nodes:
-                if n is not None:
+                if n is None:
+                    continue
+                if n.tick_lite():
+                    # lazy delivery: the native core / device tick kernel
+                    # owns this group's raft clock; wake it only when its
+                    # pending-request GC could be overdue.  This is the
+                    # O(groups)→O(active) tick-cost cut that lets one
+                    # process hold tens of thousands of groups (reference
+                    # quiesce.go solves the same scaling axis).
+                    if (
+                        now_tick - n._seen_tick >= sweep
+                        and n.has_pending_requests()
+                    ):
+                        self.engine.set_step_ready(n.cluster_id)
+                else:
                     n.request_tick()
             if self.quorum_coordinator is not None:
                 # one device tick round per RTT for ALL registered groups

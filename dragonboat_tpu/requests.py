@@ -290,6 +290,10 @@ class PendingProposal:
                     rs.notify(RequestResult(code=RequestResultCode.TERMINATED))
                 shard.clear()
 
+    def has_pending(self) -> bool:
+        """Unlocked emptiness probe (tick-lite sweep heuristic)."""
+        return any(self._shards)
+
     def tick(self) -> None:
         self._clock.advance()
         now = self._clock.tick
@@ -341,6 +345,10 @@ class PendingReadIndex:
     def peep(self) -> bool:
         # GIL-atomic read; polled every step round for every group
         return bool(self._pending)
+
+    def has_pending(self) -> bool:
+        """Unlocked emptiness probe (tick-lite sweep heuristic)."""
+        return bool(self._pending or self._batches or self._confirmed)
 
     def next_ctx(self) -> SystemCtx:
         return SystemCtx(

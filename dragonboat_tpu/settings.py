@@ -69,6 +69,10 @@ class SoftSettings:
     # nodehost
     sync_op_default_timeout_ms: int = 5000
     pending_proposal_shards: int = 16
+    # tick-lite staleness bound: a node with native/device-owned raft
+    # clocks and pending requests is woken at least once per this many
+    # ticks so pending-request timeout GC runs (lazy tick delivery)
+    lazy_tick_sweep_ticks: int = 4
     # batched quorum engine (new, TPU-specific)
     quorum_engine_max_peers: int = 8
     quorum_engine_block_groups: int = 1024
