@@ -593,6 +593,8 @@ def rank_main() -> int:
         sm_factory = NativeKVStateMachine
     cids = [BASE_CID + g for g in range(groups)]
 
+    election_rtt = int(os.environ.get("E2E_ELECTION_RTT", "20"))
+
     def _start_one(cid):
         nh.start_cluster(
             addrs,
@@ -601,7 +603,7 @@ def rank_main() -> int:
             Config(
                 cluster_id=cid,
                 node_id=rank + 1,
-                election_rtt=20,
+                election_rtt=election_rtt,
                 heartbeat_rtt=1,
                 snapshot_entries=0,
             ),
@@ -653,46 +655,108 @@ def rank_main() -> int:
 
     t_campaign = time.perf_counter()
     deadline = time.time() + leader_timeout
-    led = set()
     # staggered initial campaigns (round-4 election storm: 3,049/4,096
     # elected in 300s when every group campaigned at once — simultaneous
     # campaigns collide on the wire and their vote responses starve behind
-    # each other's Replicate/noop traffic).  Keep at most `wave` un-won
-    # campaigns in flight; each completed election frees a slot.
-    wave = int(os.environ.get("E2E_CAMPAIGN_WAVE", "512"))
+    # each other's Replicate/noop traffic).  Keep at most `wave` unresolved
+    # campaigns in flight; each resolved election frees a slot.
+    #
+    # A campaign is RESOLVED when the group has any leader — not
+    # necessarily this rank's replica: under storm pressure another
+    # replica's own randomized timeout can win the election first, and
+    # re-campaigning against that healthy leader just deposes it (a term
+    # war that stalled the round-4 tail indefinitely).  Whoever leads,
+    # drives: the final scan below picks up every locally-led group,
+    # preferred or adopted.
+    wave = int(os.environ.get("E2E_CAMPAIGN_WAVE", "384"))
+    # Explicit campaigns are only a bootstrap accelerant; the tail is
+    # raft's own job.  Two measured anti-patterns shaped this: (1)
+    # aggressive restarts bump terms and invalidate in-flight votes
+    # (475/1,365 resolved at 171s); (2) a log-behind replica can NEVER
+    # win (vote rejections, raft §5.4.1) and each of its campaigns resets
+    # its peers' election clocks (term bump → become_follower → etick=0),
+    # so retrying it forever starves the replica that could win (32
+    # groups/rank wedged at term 40).  So: up to `attempts_max` spaced
+    # campaigns per preferred group, then hands off to the replicas'
+    # randomized election timeouts, with the resolution scan accepting a
+    # leader wherever it emerges.
+    attempts_max = int(os.environ.get("E2E_CAMPAIGN_ATTEMPTS", "3"))
     to_campaign = list(reversed(mine))
-    inflight: set = set()
-    next_retry = time.time() + 3.0
+    inflight: dict = {}  # cid -> [last campaign wall time, attempts]
+    resolved = 0
+    next_retry = time.time() + 2.0
     next_report = time.time() + 5.0
-    while len(led) < len(mine) and time.time() < deadline:
-        for cid in list(inflight):
-            if nh.get_node(cid).is_leader():
-                led.add(cid)
-                inflight.discard(cid)
+    # wait until every LOCAL replica sees LIVE leadership — self-led, or
+    # follower with leader known and a fresh election clock (a stale
+    # leader_id with a growing clock means the leader died post-election;
+    # its replicas will re-elect naturally and the scan keeps waiting)
+    def _resolved(cid):
+        r = nh.get_node(cid).peer.raft
+        return r.leader_id != 0 and (
+            r.is_leader() or r.election_tick < r.election_timeout
+        )
+
+    leaderless = set(cids)
+    all_live = False
+    next_scan = 0.0
+    while not all_live and time.time() < deadline:
+        now = time.time()
+        for cid in list(leaderless):
+            # raw raft read (GIL-atomic): Node.leader_id is the scalar
+            # tick path's change cache and goes quiet once the group
+            # enrolls in the fast lane
+            if nh.get_node(cid).peer.raft.leader_id != 0:
+                leaderless.discard(cid)
+                inflight.pop(cid, None)
+                if preferred(cid) == rank:
+                    resolved += 1
+        if not leaderless and now >= next_scan:
+            all_live = all(_resolved(cid) for cid in cids)
+            next_scan = now + 2.0
         while to_campaign and len(inflight) < wave:
             cid = to_campaign.pop()
+            if cid not in leaderless:
+                continue
             nh.get_node(cid).request_campaign()
-            inflight.add(cid)
-        if len(led) < len(mine):
-            if time.time() >= next_report:
-                # election progress to stderr so a slow tunneled-TPU run
-                # is diagnosable from the driver capture
-                print(
-                    f"rank{rank}: led {len(led)}/{len(mine)} at "
-                    f"{time.perf_counter() - t_campaign:.1f}s",
-                    file=sys.stderr, flush=True,
-                )
-                next_report = time.time() + 5.0
-            if time.time() >= next_retry:
-                for cid in inflight:
-                    node = nh.get_node(cid)
-                    # don't restart a campaign whose votes are still in
-                    # flight (e.g. riding a busy engine round): bumping the
-                    # term would invalidate the staged tally and thrash
-                    if not node.peer.raft.is_candidate():
-                        node.request_campaign()
-                next_retry = time.time() + 3.0
-            time.sleep(0.05)
+            inflight[cid] = [now, 1]
+        if now >= next_retry:
+            for cid, slot in list(inflight.items()):
+                t0, attempts = slot
+                node = nh.get_node(cid)
+                if attempts >= attempts_max or node.peer.raft.is_candidate():
+                    continue
+                if now - t0 >= 2.0:
+                    node.request_campaign()
+                    slot[0], slot[1] = now, attempts + 1
+            next_retry = now + 2.0
+        if time.time() >= next_report:
+            # election progress to stderr so a slow tunneled-TPU run
+            # is diagnosable from the driver capture
+            print(
+                f"rank{rank}: resolved {resolved}/{len(mine)} at "
+                f"{time.perf_counter() - t_campaign:.1f}s",
+                file=sys.stderr, flush=True,
+            )
+            next_report = time.time() + 5.0
+        time.sleep(0.05)
+    # unresolved-tail diagnostics: every replica of every leaderless
+    # group, so the three rank logs together give the full picture
+    for cid in cids:
+        node = nh.get_node(cid)
+        r = node.peer.raft
+        if r.leader_id != 0:
+            continue
+        print(
+            f"rank{rank}: STUCK cid={cid} state={r.state} term={r.term} "
+            f"voted_for={r.vote} votes={dict(r.votes)} "
+            f"etick={r.election_tick}/{r.randomized_election_timeout} "
+            f"fastlane={node.fast_lane} "
+            f"mq={len(node.mq._left) + len(node.mq._right)} "
+            f"trace={list(r.vote_trace)}",
+            file=sys.stderr, flush=True,
+        )
+    # drive every group THIS rank leads, preferred or adopted
+    led = {cid for cid in cids if nh.get_node(cid).is_leader()}
     leaders = {cid: nh for cid in led}
     setup_s = time.perf_counter() - t_setup
 
@@ -720,7 +784,14 @@ def rank_main() -> int:
     stage = "TPUT"  # tag the parent is blocked on; errors must carry it
     try:
         payload = _payload()
-        # phase 1: throughput — every led group, window in flight
+        # phase 1: throughput — every led group, window in flight.  The
+        # per-group window is capped so AGGREGATE in-flight per rank stays
+        # bounded: at 4k+ groups a fixed per-group window floods the
+        # pipeline with 100k+ queued proposals and the measurement window
+        # only sees the queue ramp (Little's law: latency = inflight/rate),
+        # not steady-state throughput.
+        target_inflight = int(os.environ.get("E2E_TARGET_INFLIGHT", "16384"))
+        window = max(1, min(window, target_inflight // max(1, len(led))))
         plan = expect("RUN")
         while time.time() < plan["t0"]:
             time.sleep(0.005)
@@ -739,6 +810,7 @@ def rank_main() -> int:
         duty_gs += _dgs() - _w_g0
         duty_el += time.monotonic() - _w_t0
         tput_lats = tput.pop("_lats")
+        tput["window"] = window  # effective (aggregate-inflight-capped)
         emit(
             "TPUT",
             {
@@ -904,15 +976,21 @@ def run_mp(
     )
     children = []
     try:
+        rank_log_dir = os.environ.get("E2E_RANK_LOG_DIR", "")
         for rank in range(procs):
             cenv = dict(env)
             cenv["E2E_RANK"] = str(rank)
+            stderr_to = subprocess.DEVNULL
+            if rank_log_dir:
+                stderr_to = open(
+                    os.path.join(rank_log_dir, f"rank{rank}.err"), "w"
+                )
             children.append(
                 subprocess.Popen(
                     [sys.executable, os.path.abspath(__file__), "--rank"],
                     stdin=subprocess.PIPE,
                     stdout=subprocess.PIPE,
-                    stderr=subprocess.DEVNULL,
+                    stderr=stderr_to,
                     env=cenv,
                     text=True,
                     cwd=os.path.dirname(os.path.abspath(__file__)),
@@ -959,8 +1037,11 @@ def run_mp(
                     pass  # an errored rank may already have exited
 
         # barrier 1: all ranks started → campaign
-        for i in range(len(children)):
+        started = [
             read_tagged(i, "STARTED", hard_deadline - 30)
+            for i in range(len(children))
+        ]
+        print(f"e2e mp started={started}", file=sys.stderr)
         broadcast("CAMPAIGN", {})
         readies = [
             read_tagged(i, "READY", hard_deadline - 20)
@@ -1037,7 +1118,11 @@ def run_mp(
                 "errors": tput_errs,
                 "abandoned": abandoned,
                 "latency_ms": _percentiles(tput_lats),
-                "window": window,
+                # effective per-rank windows (the aggregate-inflight cap
+                # depends on each rank's led count)
+                "window": sorted(
+                    r["tput"].get("window", window) for r in tput_oks
+                ) or [window],
             },
             "latency_phase": {
                 "completed": lat_done,

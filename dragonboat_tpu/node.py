@@ -1002,6 +1002,15 @@ class Node:
         self._process_messages(self.mq.get(), extra_ticks)
 
     def _process_messages(self, msgs, extra_ticks: int = 0) -> None:
+        # lazy catch-up ticks represent time that elapsed BEFORE this step
+        # — deliver them ahead of the messages so term-filter guards that
+        # read the election clock (the section-6 vote-drop lease,
+        # raft.py drop_request_vote_from_high_term_node) compare a current
+        # clock, exactly as the offload_tick_* handlers do
+        if extra_ticks:
+            self._tick(
+                extra_ticks, tracker_count=self._tracker_ticks(extra_ticks)
+            )
         ticks = 0
         for m in msgs:
             if m.type == MT.LOCAL_TICK:
@@ -1030,13 +1039,8 @@ class Node:
                     self._handle_install_snapshot(m)
                 else:
                     self.peer.handle(m)
-        if ticks or extra_ticks:
-            # real LOCAL_TICKs count fully; the lazy catch-up portion is
-            # capped for the pending-request clocks (see _tracker_ticks)
-            self._tick(
-                ticks + extra_ticks,
-                tracker_count=ticks + self._tracker_ticks(extra_ticks),
-            )
+        if ticks:
+            self._tick(ticks)
         if self.quiesce_mgr.just_entered_quiesce():
             self._broadcast_quiesce()
 

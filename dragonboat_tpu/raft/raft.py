@@ -24,6 +24,7 @@ Design deltas from the reference (TPU-first build):
 """
 from __future__ import annotations
 
+import collections as _collections
 import enum
 import random as _random
 from typing import Callable, Dict, List, Optional, Tuple
@@ -169,6 +170,13 @@ class Raft:
         self.device_ticks = False
         # first index of the current leadership term (set at promotion)
         self.term_start_index = 0
+        # ring buffer of recent election-related events (campaigns, vote
+        # grants/rejections, state transitions) — near-free and invaluable
+        # when diagnosing wedged elections at 4k+ group scale
+        self.vote_trace: _collections.deque = _collections.deque(maxlen=24)
+        # elapsed election clock stashed across a REQUEST_VOTE step-down
+        # (consumed by handle_node_request_vote's log-behind restore)
+        self._stepdown_etick: Optional[int] = None
         self.has_not_applied_config_change: Optional[Callable[[], bool]] = None
         # deterministic, seedable randomness (design delta; see module docstring)
         self.prng = _random.Random(
@@ -641,6 +649,7 @@ class Raft:
         if self.is_witness():
             raise RuntimeError("transitioning to follower from witness state")
         self.state = RaftState.FOLLOWER
+        self.vote_trace.append(("fol", term, leader_id))
         self.reset(term)
         self.set_leader_id(leader_id)
         if self.offload is not None:
@@ -742,6 +751,7 @@ class Raft:
     def campaign(self) -> None:
         # reference raft.go:1082-1117
         self.become_candidate()
+        self.vote_trace.append(("camp", self.term))
         term = self.term
         if self.events is not None:
             self.events.campaign_launched(self.cluster_id, self.node_id, term)
@@ -963,6 +973,13 @@ class Raft:
             leader_id = NO_LEADER
             if is_leader_message(m.type):
                 leader_id = m.from_
+            # Stash the elapsed election clock across the step-down: if
+            # this REQUEST_VOTE turns out to come from a log-behind
+            # candidate, handle_node_request_vote restores the clock (see
+            # there).  Everything else keeps etcd's full reset+resample.
+            self._stepdown_etick = (
+                self.election_tick if m.type == MT.REQUEST_VOTE else None
+            )
             if self.is_observer():
                 self.become_observer(m.term, leader_id)
             elif self.is_witness():
@@ -983,6 +1000,7 @@ class Raft:
     def handle(self, m: Message) -> None:
         """Main entry: term-filter then dispatch (reference ``Handle``
         ``raft.go:1454-1461``)."""
+        self._stepdown_etick = None
         if not self.on_message_term_not_matched(m):
             self.double_check_term_matched(m.term)
             handler = _HANDLERS[self.state].get(m.type)
@@ -1014,11 +1032,30 @@ class Raft:
         resp = Message(to=m.from_, type=MT.REQUEST_VOTE_RESP)
         can_grant = self.can_grant_vote(m)
         is_up_to_date = self.log.up_to_date(m.log_index, m.log_term)
+        self.vote_trace.append(
+            ("rv", m.from_, m.term, can_grant, is_up_to_date)
+        )
         if can_grant and is_up_to_date:
             self.election_tick = 0
             self.vote = m.from_
         else:
             resp.reject = True
+            if not is_up_to_date and self._stepdown_etick is not None:
+                # Liveness at scale: a log-behind candidate can never win
+                # (§5.4.1) yet re-campaigns every timeout, and if each
+                # doomed campaign zeroed its healthy peers' clocks (term
+                # bump → become_follower → reset) the replica that COULD
+                # win fires first only with p≈1/n per cycle — measured as
+                # 11/4,096 groups wedged 200s+.  Restore the elapsed
+                # clock for exactly this case; healthy collisions (vote
+                # already spent) keep the full reset+resample, which is
+                # what desynchronizes colliding candidates.  Safety never
+                # depends on clock resets — this is PreVote's protection
+                # folded into the clock instead of a new RPC round.
+                self.election_tick = min(
+                    self._stepdown_etick, self.randomized_election_timeout
+                )
+        self._stepdown_etick = None
         self.send(resp)
 
     def handle_node_config_change(self, m: Message) -> None:
@@ -1322,6 +1359,7 @@ class Raft:
         # reference raft.go:1965-1984
         if m.from_ in self.observers:
             return
+        self.vote_trace.append(("rvr", m.from_, m.term, m.reject))
         count = self.handle_vote_resp(m.from_, m.reject)
         if self.offload is not None:
             # the device tallies; won/lost lands via node.offload_election
