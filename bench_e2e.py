@@ -977,6 +977,8 @@ def run_mp(
     children = []
     try:
         rank_log_dir = os.environ.get("E2E_RANK_LOG_DIR", "")
+        if rank_log_dir:
+            os.makedirs(rank_log_dir, exist_ok=True)
         for rank in range(procs):
             cenv = dict(env)
             cenv["E2E_RANK"] = str(rank)
@@ -996,6 +998,8 @@ def run_mp(
                     cwd=os.path.dirname(os.path.abspath(__file__)),
                 )
             )
+            if stderr_to is not subprocess.DEVNULL:
+                stderr_to.close()  # the child holds its own duplicated fd
 
         import queue as _queue
 

@@ -128,6 +128,18 @@ class Node:
         # native core owns the group's steady-state data plane
         self.fastlane = None  # FastLaneManager, set by NodeHost
         self.fast_lane = False
+        # device-engine effect flags (written by the coordinator round
+        # thread, max-merged/idempotent, applied under raftMu by
+        # _apply_offload_effects on a step worker).  _off_mu guards the
+        # writer-vs-swap-and-clear race: without it a flag written between
+        # the consumer's load and its clearing store is silently lost, and
+        # the engine's edge-triggered commit reporting never resends it.
+        self._off_mu = threading.Lock()
+        self._off_commit = 0
+        self._off_election = None
+        self._off_hb = False
+        self._off_elect = False
+        self._off_demote = False
         self._natsm_attached = False  # native C-ABI SM wired to the lane
         self._next_enroll_try = 0.0
         self._tick_count_pending = 0
@@ -186,106 +198,99 @@ class Node:
             )
         )
 
-    # ---- TPU quorum plugin appliers (called from the coordinator round
-    # thread; every effect re-checked under raftMu with scalar guards) ----
+    # ---- TPU quorum plugin appliers ----
+    #
+    # The coordinator round thread only FLAGS effects here (max-merged,
+    # idempotent attribute writes under the GIL) and wakes the group; the
+    # partitioned step workers apply them under raftMu via
+    # _apply_offload_effects.  Applying effects synchronously on the round
+    # thread serialized every leader's heartbeat broadcast behind one
+    # thread — at 1,365 device-ticked leaders per host that thread needed
+    # ~1s of raftMu work per 1s tick, heartbeats stalled, and followers
+    # deposed freshly elected leaders (measured at the 4k-group rung).
+    # Spreading application across step workers is exactly the
+    # reference's partitioned-worker model (execengine.go:654-706).
 
     def offload_commit(self, q: int) -> None:
-        """Apply a device-computed commit watermark.  ``log.try_commit``
-        re-applies the current-term rule (raft paper p8), so a stale result
-        from before a leadership change is rejected, keeping commit outputs
-        bit-identical to the scalar path."""
-        advanced = False
-        with self.raft_mu:
-            if self.peer is None or self.fast_lane:
-                return
-            r = self.peer.raft
-            if r.is_leader() and r.log.try_commit(q, r.term):
-                r.broadcast_replicate_message()
-                advanced = True
-        if advanced:
-            self.nh.engine.set_step_ready(self.cluster_id)
+        """Flag a device-computed commit watermark (applied in
+        ``_apply_offload_effects`` where ``log.try_commit`` re-applies the
+        current-term rule, raft paper p8, so stale results are rejected
+        and commit outputs stay bit-identical to the scalar path)."""
+        with self._off_mu:
+            if q > self._off_commit:
+                self._off_commit = q
+        self.nh.engine.set_step_ready(self.cluster_id)
 
     def offload_election(self, won: bool, term: int) -> None:
-        """Apply a device-tallied election outcome (twin of the scalar
-        promotion in ``handle_candidate_request_vote_resp``).  ``term``
-        pins the outcome to the campaign it tallied: a flag staged before
-        the campaign restarted at a higher term is discarded."""
-        changed = False
-        with self.raft_mu:
-            if self.peer is None or self.fast_lane:
-                return
-            r = self.peer.raft
+        """Flag a device-tallied election outcome.  ``term`` pins the
+        outcome to the campaign it tallied: a flag staged before the
+        campaign restarted at a higher term is discarded at apply time."""
+        with self._off_mu:
+            self._off_election = (won, term)
+        self.nh.engine.set_step_ready(self.cluster_id)
+
+    def offload_tick_elect(self) -> None:
+        with self._off_mu:
+            self._off_elect = True
+        self.nh.engine.set_step_ready(self.cluster_id)
+
+    def offload_tick_heartbeat(self) -> None:
+        with self._off_mu:
+            self._off_hb = True
+        self.nh.engine.set_step_ready(self.cluster_id)
+
+    def offload_tick_demote(self) -> None:
+        with self._off_mu:
+            self._off_demote = True
+        self.nh.engine.set_step_ready(self.cluster_id)
+
+    def _apply_offload_effects(self) -> None:
+        """Apply flagged device-engine effects (under raftMu, from a step
+        worker).  Every effect re-runs its scalar guards, so a stale flag
+        is rejected, never applied."""
+        r = self.peer.raft
+        with self._off_mu:
+            commit_q, self._off_commit = self._off_commit, 0
+            election, self._off_election = self._off_election, None
+            hb, self._off_hb = self._off_hb, False
+            elect, self._off_elect = self._off_elect, False
+            demote, self._off_demote = self._off_demote, False
+        if self.fast_lane:
+            return  # native core owns the group; flags are stale
+        if commit_q and r.is_leader() and r.log.try_commit(commit_q, r.term):
+            r.broadcast_replicate_message()
+        if election is not None:
+            won, term = election
             if r.is_candidate() and r.term == term:
                 if won:
                     r.become_leader()
                     r.broadcast_replicate_message()
                 else:
                     r.become_follower(r.term, 0)
-                changed = True
-        if changed:
-            self.nh.engine.set_step_ready(self.cluster_id)
-
-    def offload_tick_elect(self) -> None:
-        """Device tick kernel says this group's election timeout fired
-        (twin of the fire site in ``non_leader_tick``); all campaign guards
-        re-run inside the scalar ELECTION handler."""
-        fired = False
-        with self.raft_mu:
-            if self.peer is None or self.fast_lane:
-                return
-            r = self.peer.raft
+        if (elect or hb or demote) and r.device_ticks:
             self._catch_up_and_tick()
-            if (
-                r.device_ticks
-                and not r.is_leader()
-                and not r.is_observer()
-                and not r.is_witness()
-                and not r.self_removed()
-                and not self.quiesce_mgr.quiesced()
-                # scalar clock must agree: it resets synchronously under
-                # raftMu on leader contact, so a device row whose staged
-                # contact reset is still riding a round cannot disrupt a
-                # healthy leader (same pattern as the commit term guard)
-                and r.time_for_election()
-            ):
-                r.election_tick = 0
-                r.handle(Message(from_=self.node_id, type=MT.ELECTION))
-                fired = True
-        if fired:
-            self.nh.engine.set_step_ready(self.cluster_id)
-
-    def offload_tick_heartbeat(self) -> None:
-        """Device tick kernel says a leader heartbeat is due (twin of the
-        LEADER_HEARTBEAT fire site in ``leader_tick``)."""
-        fired = False
-        with self.raft_mu:
-            if self.peer is None or self.fast_lane:
-                return
-            r = self.peer.raft
-            self._catch_up_and_tick()
-            if r.device_ticks and r.is_leader():
-                r.heartbeat_tick = 0
-                r.handle(Message(from_=self.node_id, type=MT.LEADER_HEARTBEAT))
-                fired = True
-        if fired:
-            self.nh.engine.set_step_ready(self.cluster_id)
-
-    def offload_tick_demote(self) -> None:
-        """Device check-quorum window expired without a quorum of active
-        followers; the scalar CHECK_QUORUM handler re-verifies before any
-        demotion happens."""
-        fired = False
-        with self.raft_mu:
-            if self.peer is None or self.fast_lane:
-                return
-            r = self.peer.raft
-            self._catch_up_and_tick()
-            if r.device_ticks and r.is_leader() and r.check_quorum:
-                r.election_tick = 0
-                r.handle(Message(from_=self.node_id, type=MT.CHECK_QUORUM))
-                fired = True
-        if fired:
-            self.nh.engine.set_step_ready(self.cluster_id)
+        if (
+            elect
+            and r.device_ticks
+            and not r.is_leader()
+            and not r.is_observer()
+            and not r.is_witness()
+            and not r.self_removed()
+            and not self.quiesce_mgr.quiesced()
+            # scalar clock must agree: it resets synchronously under
+            # raftMu on leader contact, so a device row whose staged
+            # contact reset is still riding a round cannot disrupt a
+            # healthy leader (same pattern as the commit term guard)
+            and r.time_for_election()
+        ):
+            r.election_tick = 0
+            r.handle(Message(from_=self.node_id, type=MT.ELECTION))
+        if hb and r.device_ticks and r.is_leader():
+            r.heartbeat_tick = 0
+            r.handle(Message(from_=self.node_id, type=MT.LEADER_HEARTBEAT))
+        if demote and r.device_ticks and r.is_leader() and r.check_quorum:
+            r.election_tick = 0
+            r.handle(Message(from_=self.node_id, type=MT.CHECK_QUORUM))
 
     def _publish_event(
         self, type: SystemEventType, index: int = 0, from_: int = 0
@@ -589,6 +594,14 @@ class Node:
                 return None
             if not self.initialized():
                 return None
+            if (
+                self._off_commit
+                or self._off_election is not None
+                or self._off_hb
+                or self._off_elect
+                or self._off_demote
+            ):
+                self._apply_offload_effects()
             delta = self._catch_up_ticks()
             if self.fast_lane:
                 if not self._fast_lane_step(delta):

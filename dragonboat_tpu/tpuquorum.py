@@ -243,6 +243,18 @@ class TpuQuorumCoordinator:
             ops, self._staged = self._staged, []
             self._contacted.clear()
         recover = []
+        # bulk-pull every row a transition below will mutate: one device
+        # gather per field for the whole set, instead of ~20 single-row
+        # reads inside each set_* call (the dominant cost of election
+        # bursts at 4k+ groups)
+        sync_rows = []
+        for op in ops:
+            if op[0] in ("leader", "candidate", "follower", "randto"):
+                gi = self.eng.groups.get(op[1])
+                if gi is not None:
+                    sync_rows.append(gi.row)
+        if sync_rows:
+            self.eng.sync_rows(sync_rows)
         for op in ops:
             kind, cid = op[0], op[1]
             if cid not in self.eng.groups:
@@ -336,7 +348,13 @@ class TpuQuorumCoordinator:
                 or self.eng._acks
                 or self.eng._ack_blocks
                 or self.eng._votes
-                or self.eng._dirty
+                # dirty-only rounds (row registrations, transition
+                # replays with no queued events) need no dispatch when
+                # ticks drive regular rounds anyway: the upload
+                # piggybacks on the next event/tick round.  Bulk
+                # registration of thousands of groups otherwise
+                # interleaves a dispatch between every few registers.
+                or (self.eng._dirty and not self.drive_ticks)
             ):
                 return
             res = self.eng.step(do_tick=do_tick)
