@@ -87,6 +87,13 @@ class ExpertConfig:
 
     quorum_engine: str = "scalar"
     engine_block_groups: int = 0  # 0 = use Soft.quorum_engine_block_groups
+    # shard the quorum engine's group axis over a jax.sharding.Mesh of
+    # this many devices (ops/sharding.py): state tensors split on the
+    # group axis, event batches replicated, zero collectives in steady
+    # state — the multi-chip twin of the reference's clusterID%workers
+    # partitioning (execengine.go:654-706).  0 = single device; capped at
+    # the available device count; capacity rounds up to a multiple.
+    engine_mesh_devices: int = 0
     step_worker_count: int = 0  # 0 = use Hard.step_engine_worker_count
     logdb_shards: int = 0  # 0 = use Hard.logdb_pool_size
     # native replication fast lane (fastlane.py + native/natraft.cpp): the

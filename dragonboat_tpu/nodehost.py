@@ -242,6 +242,7 @@ class NodeHost:
             self.quorum_coordinator = TpuQuorumCoordinator(
                 capacity=expert.engine_block_groups
                 or Soft.quorum_engine_block_groups,
+                mesh_devices=expert.engine_mesh_devices,
             )
         # engine
         workers = expert.step_worker_count or 4

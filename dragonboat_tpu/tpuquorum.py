@@ -53,12 +53,38 @@ class TpuQuorumCoordinator:
         n_peers: int = 8,
         interval_s: float = 0.002,
         drive_ticks: bool = True,
+        mesh_devices: int = 0,
     ):
         from .ops.engine import BatchedQuorumEngine
 
+        # group-axis mesh sharding (ExpertConfig.engine_mesh_devices):
+        # every kernel op is row-wise over groups, so GSPMD partitions the
+        # whole fused step with zero steady-state collectives — each chip
+        # steps its slice of groups (ops/sharding.py design note)
+        sharding = None
+        mesh_n = 0  # effective shard count (0 = unsharded)
+        if mesh_devices > 1:
+            import jax
+            import numpy as _np
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from .ops.sharding import GROUP_AXIS, make_mesh
+
+            devs = jax.devices()
+            n = min(mesh_devices, len(devs))
+            if n > 1:
+                capacity = ((capacity + n - 1) // n) * n
+                mesh = make_mesh(_np.array(devs[:n]))
+                sharding = NamedSharding(mesh, P(GROUP_AXIS))
+                mesh_n = n
+                plog.info(
+                    "quorum engine sharded over %d devices (%d rows)",
+                    n, capacity,
+                )
+        self.mesh_devices = mesh_n
         self.eng = BatchedQuorumEngine(
             capacity, n_peers, event_cap=max(4 * capacity, 4096),
-            device_ticks=drive_ticks,
+            device_ticks=drive_ticks, sharding=sharding,
         )
         self.capacity = capacity
         # device-tick mode: the per-tick firing decisions (election due,

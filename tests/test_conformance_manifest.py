@@ -1,0 +1,32 @@
+"""Enforce the etcd conformance manifest: every live reference test
+function must map to a port that actually exists in this suite
+(SURVEY.md §4.1 — the etcd-derived corpus is the protocol core's
+conformance oracle)."""
+import os
+import re
+
+from etcd_conformance_manifest import MANIFEST
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _defined_tests(fname):
+    with open(os.path.join(HERE, fname)) as f:
+        return set(re.findall(r"^def (test\w+)", f.read(), flags=re.M))
+
+
+def test_manifest_complete_and_ports_exist():
+    by_file = {}
+    gaps = []
+    for ref_file, ref_fn, port_file, port_fn in MANIFEST:
+        if port_fn is None:
+            gaps.append((ref_file, ref_fn))
+            continue
+        if port_file not in by_file:
+            by_file[port_file] = _defined_tests(port_file)
+        assert port_fn in by_file[port_file], (
+            f"manifest maps {ref_fn} -> {port_file}::{port_fn}, "
+            f"which does not exist"
+        )
+    assert not gaps, f"unported reference tests: {gaps}"
+    assert len(MANIFEST) >= 125  # the live corpus size at porting time
