@@ -45,7 +45,6 @@ def run_sharded_stack_check(
     writes_per_group: int = 5,
     rtt_ms: int = 20,
     election_wait_s: float = 90.0,
-    sm_factory=CounterSM,
 ) -> int:
     """3 in-process NodeHosts (chan transport) whose quorum engines are
     group-sharded over ``n_devices`` (``ExpertConfig.engine_mesh_devices``):
@@ -56,30 +55,34 @@ def run_sharded_stack_check(
 
     router = ChanRouter()
     addrs = {i: f"mc{i}:1" for i in (1, 2, 3)}
-    nhs = [
-        NodeHost(NodeHostConfig(
-            node_host_dir=":memory:", rtt_millisecond=rtt_ms,
-            raft_address=addrs[i],
-            raft_rpc_factory=lambda s, rh, ch: ChanTransport(
-                s, rh, ch, router=router
-            ),
-            expert=ExpertConfig(
-                quorum_engine="tpu", engine_block_groups=groups,
-                engine_mesh_devices=n_devices,
-            ),
-        ))
-        for i in (1, 2, 3)
-    ]
     cids = list(range(500, 500 + groups))
+    nhs = []
     try:
+        for i in (1, 2, 3):
+            nhs.append(NodeHost(NodeHostConfig(
+                node_host_dir=":memory:", rtt_millisecond=rtt_ms,
+                raft_address=addrs[i],
+                raft_rpc_factory=lambda s, rh, ch: ChanTransport(
+                    s, rh, ch, router=router
+                ),
+                expert=ExpertConfig(
+                    quorum_engine="tpu", engine_block_groups=groups,
+                    engine_mesh_devices=n_devices,
+                ),
+            )))
         for nh in nhs:
-            spec = nh.quorum_coordinator.eng.dev.match.sharding.spec
+            # defensive: SingleDeviceSharding has no .spec, and the
+            # coordinator silently falls back to unsharded on 1-device
+            # hosts — fail with the diagnostic, not an AttributeError
+            spec = getattr(
+                nh.quorum_coordinator.eng.dev.match.sharding, "spec", None
+            )
             assert spec and spec[0] == GROUP_AXIS, (
                 f"engine not group-sharded: {spec}"
             )
         for i, nh in enumerate(nhs, 1):
             for cid in cids:
-                nh.start_cluster(addrs, False, sm_factory, Config(
+                nh.start_cluster(addrs, False, CounterSM, Config(
                     cluster_id=cid, node_id=i, election_rtt=10,
                     heartbeat_rtt=1,
                 ))

@@ -118,35 +118,6 @@ def test_coordinator_shards_when_configured():
         coord.stop()
 
 
-class KVSM:
-    def __init__(self, cluster_id, node_id):
-        self.kv = {}
-        self.n = 0
-
-    def update(self, cmd):
-        k, v = cmd.decode().split("=", 1)
-        self.kv[k] = v
-        self.n += 1
-        return Result(value=self.n)
-
-    def lookup(self, q):
-        return self.kv.get(q)
-
-    def save_snapshot(self, w, files, done):
-        data = repr(sorted(self.kv.items())).encode()
-        w.write(len(data).to_bytes(8, "little") + data)
-
-    def recover_from_snapshot(self, r, files, done):
-        import ast
-
-        n = int.from_bytes(r.read(8), "little")
-        self.kv = dict(ast.literal_eval(r.read(n).decode()))
-        self.n = len(self.kv)
-
-    def close(self):
-        pass
-
-
 def test_full_stack_sharded_engine():
     """3 NodeHosts, each with an 8-way group-sharded engine, 24 groups:
     device-tick elections + committed proposals through the full stack
