@@ -262,15 +262,22 @@ class FastLaneManager:
                 return -1
             self._slots[addr] = slot
             # outbound: a native sender thread when the address is a plain
-            # IPv4 literal (the GIL-free fast plane); Python pump otherwise
+            # IPv4 literal AND the wire is plaintext; under mutual TLS the
+            # Python sender owns the connection (transport._dial wraps it,
+            # so fast-plane frames ride the same encrypted channel as the
+            # scalar path — never a silent plaintext downgrade).  Inbound
+            # under TLS likewise stays encrypted: tcp.py decrypts on its
+            # recv thread and feeds plaintext to the native reassembler
+            # via the stream hooks (no fd takeover of TLS sockets).
             host, _, port = addr.rpartition(":")
             native_ok = False
+            tls = bool(getattr(self.nh.nhconfig, "mutual_tls", False))
             try:
                 socket_ok = all(
                     p.isdigit() and 0 <= int(p) <= 255
                     for p in host.split(".")
                 ) and len(host.split(".")) == 4
-                if socket_ok:
+                if socket_ok and not tls:
                     native_ok = self.nat.remote_connect(slot, host, int(port))
             except (ValueError, OSError):
                 native_ok = False
