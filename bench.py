@@ -548,6 +548,24 @@ def main() -> None:
             False, "tpu", timeout_key="BENCH_E2E_SCALAR_TIMEOUT"
         )
         _note(f"e2e_tpu: {json.dumps(detail['e2e_tpu'])[:300]}")
+        # scale rung (VERDICT r4 next #1): 4,096 groups × 3 replicas,
+        # engine A/B at IDENTICAL placement.  This is where the device
+        # tick kernel carries the 12k-replica mass (elections + ticks for
+        # everything not yet enrolled) and the tpu engine's convergence/
+        # throughput edge over scalar shows e2e, not just in kernels.
+        if os.environ.get("BENCH_SKIP_SCALE") != "1":
+            scale_env = {
+                "E2E_SM": "native", "E2E_GROUPS": "4096",
+                "E2E_DURATION": "20", "E2E_LEADER_TIMEOUT": "240",
+            }
+            for eng_name in ("tpu", "scalar"):
+                key = f"e2e_scale_{eng_name}"
+                _note(f"running e2e scale rung (4,096 groups, {eng_name})...")
+                detail[key] = _run_e2e(
+                    False, eng_name, dict(scale_env),
+                    timeout_key="BENCH_E2E_SCALE_TIMEOUT",
+                )
+                _note(f"{key}: {json.dumps(_slim_e2e(detail[key]))[:300]}")
     if "e2e" in detail:
         e2e_ok = bool(
             detail["e2e"].get("writes_per_sec")
@@ -648,6 +666,19 @@ def main() -> None:
     for k in ("e2e", "e2e_python_sm", "e2e_tpu"):
         if k in slim:
             slim[k] = _slim_e2e(slim[k])
+    for k in ("e2e_scale_tpu", "e2e_scale_scalar"):
+        # ultra-slim: the A/B verdict fields only (full data in
+        # BENCH_DETAIL.json); the driver's tail capture budget is 2000B
+        if k in slim and isinstance(slim[k], dict):
+            s = _slim_e2e(slim[k])
+            slim[k] = {
+                f: s[f]
+                for f in ("writes_per_sec", "commit_latency_ms",
+                          "mixed_ops_per_sec", "setup_s", "error", "tail")
+                if f in s
+            }
+            if detail[k].get("led_groups") is not None:
+                slim[k]["led"] = detail[k]["led_groups"]
     slim.pop("tpu_probe", None)
     if not on_tpu and PROBE_LOG:
         last = dict(PROBE_LOG[-1])

@@ -31,6 +31,10 @@ RTT_MS = 20
 
 @pytest.fixture(scope="module")
 def certs(tmp_path_factory):
+    import shutil
+
+    if shutil.which("openssl") is None:
+        pytest.skip("openssl CLI not available")
     d = tmp_path_factory.mktemp("tls")
     ca_key, ca_crt = d / "ca.key", d / "ca.crt"
     key, csr, crt = d / "node.key", d / "node.csr", d / "node.crt"
@@ -195,7 +199,9 @@ def test_wrong_ca_client_rejected(tmp_path, tmp_path_factory, certs):
         ctx.verify_mode = ssl.CERT_NONE
         ctx.load_cert_chain(str(d / "other.crt"), str(d / "other.key"))
         raw = socket.create_connection(("127.0.0.1", ports[0]), timeout=5)
-        with pytest.raises(ssl.SSLError):
+        # the server's bad_certificate rejection can surface as an SSL
+        # alert or (timing-dependent, esp. TLS 1.3) a plain reset
+        with pytest.raises((ssl.SSLError, ConnectionError)):
             tls = ctx.wrap_socket(raw, server_hostname="127.0.0.1")
             # some stacks surface the server's reject on first IO
             tls.sendall(b"\xae\x7d")
