@@ -460,7 +460,7 @@ class FastLaneManager:
         from .statemachine import Result
 
         cids, indexes, terms, keys, results, client_ids, series_ids, \
-            leaders, statuses = got
+            payload_ids, leaders, statuses = got
         per: Dict[int, list] = {}
         for i in range(len(cids)):
             per.setdefault(int(cids[i]), []).append(i)
@@ -482,9 +482,17 @@ class FastLaneManager:
                 # future is deliberately NOT completed — Node.apply_update
                 # semantics for has_responded duplicates
                 if leaders[i] and keys[i] and statuses[i] != 2:
+                    # cached session responses with data bytes ride the
+                    # payload side-channel (the u64 record can't carry
+                    # them; round 4 ejected instead)
+                    data = (
+                        self.nat.take_payload(int(payload_ids[i]))
+                        if payload_ids[i] else b""
+                    )
                     node.pending_proposals.applied(
                         int(keys[i]), int(client_ids[i]), int(series_ids[i]),
-                        Result(value=int(results[i])), statuses[i] == 1,
+                        Result(value=int(results[i]), data=data),
+                        statuses[i] == 1,
                     )
             node.pending_reads.applied(node.sm.get_last_applied())
             # periodic snapshot trigger (reference saveSnapshotRequired):

@@ -488,7 +488,8 @@ struct Group {
   // (_handle_session_entry); without it they eject (EV_SM)
   void* sess = nullptr;
   int (*sess_apply)(void*, void*, uint64_t, uint64_t, uint64_t,
-                    const uint8_t*, size_t, uint64_t*) = nullptr;
+                    const uint8_t*, size_t, uint64_t*, uint8_t**,
+                    size_t*) = nullptr;
   // order barrier vs the scalar plane: entries <= apply_barrier were
   // handed to the PYTHON apply queue before enrollment; native applies
   // hold off until Python reports them applied (py_applied)
@@ -573,6 +574,10 @@ struct Engine {
     // session identity for pending-proposal matching (requests.py
     // applied() validates client_id/series_id); 0/0 for noop entries
     uint64_t client_id, series_id;
+    // payload side-channel id (0 = none): cached session responses that
+    // carry data bytes park them in paymap; the Python pump fetches by
+    // id (natr_take_payload) and completes the future with Result.data
+    uint64_t payload_id = 0;
     uint8_t leader;
     // 0 completed, 1 rejected (no session / unregister miss), 2 ignored
     // (client already responded — the future is NOT completed)
@@ -581,6 +586,8 @@ struct Engine {
   std::mutex cmu;
   std::condition_variable ccv;
   std::deque<Completion> complq;
+  std::unordered_map<uint64_t, std::string> paymap;  // under cmu
+  uint64_t next_payload_id = 1;
 
   // confirmed ReadIndex contexts: (cid, low, high, commit_index)
   std::mutex rmu;
@@ -883,6 +890,7 @@ struct Engine {
         break;
       }
       uint64_t result = 0;
+      uint64_t payload_id = 0;
       uint8_t status = 0;
       if (cid_ != 0) {
         // session-managed: exactly-once dedup through the shared native
@@ -892,11 +900,24 @@ struct Engine {
           begin_eject(g, EV_SM);
           break;
         }
+        uint8_t* pay = nullptr;
+        size_t pay_len = 0;
         int stc = g->sess_apply(g->sess, g->sm, cid_, sid, resp, payload,
-                                plen, &result);
-        if (stc == 3) {  // cached response carries a payload: Python-only
-          begin_eject(g, EV_SM);
-          break;
+                                plen, &result, &pay, &pay_len);
+        if (pay != nullptr) {
+          // cached response with data bytes: park it in the completion
+          // side-channel (the u64 record can't carry it); the Python
+          // pump fetches by id and completes with Result.data.  ONLY a
+          // leader completion with a future to notify consumes it —
+          // parking on followers (or keyless entries) would leak the
+          // copy for the engine's lifetime.
+          if (g->leader && key != 0 && stc == 0) {
+            std::lock_guard<std::mutex> lk(cmu);
+            payload_id = next_payload_id++;
+            paymap.emplace(payload_id,
+                           std::string((const char*)pay, pay_len));
+          }
+          free(pay);
         }
         status = (uint8_t)stc;
       } else {
@@ -904,7 +925,8 @@ struct Engine {
       }
       g->applied_handed = i;
       if (g->leader) {
-        batch.push_back({g->cid, i, term, key, result, cid_, sid, 1, status});
+        batch.push_back(
+            {g->cid, i, term, key, result, cid_, sid, payload_id, 1, status});
         lat_emit_us += now - e2.born_us;
         lat_count++;
       } else {
@@ -917,7 +939,7 @@ struct Engine {
       // (ReadIndex completion, snapshot triggers) but no futures complete
       uint64_t hi = g->applied_handed;
       batch.push_back(
-          {g->cid, hi, g->term_of(hi), 0, 0, 0, 0, 0, 0});
+          {g->cid, hi, g->term_of(hi), 0, 0, 0, 0, 0, 0, 0});
     }
     if (!batch.empty()) {
       std::lock_guard<std::mutex> lk(cmu);
@@ -1771,8 +1793,9 @@ int natr_attach_sm(void* h, uint64_t cid, void* sm, void* update_fn,
   g->sm_update = (uint64_t (*)(void*, const uint8_t*, size_t))update_fn;
   if (sess != nullptr && sess_apply_fn != nullptr) {
     g->sess = sess;
-    g->sess_apply = (int (*)(void*, void*, uint64_t, uint64_t, uint64_t,
-                             const uint8_t*, size_t, uint64_t*))sess_apply_fn;
+    g->sess_apply =
+        (int (*)(void*, void*, uint64_t, uint64_t, uint64_t, const uint8_t*,
+                 size_t, uint64_t*, uint8_t**, size_t*))sess_apply_fn;
   }
   g->apply_barrier = g->applied_handed;
   // max: a racing natr_note_applied may already have reported fresher
@@ -1800,8 +1823,8 @@ long long natr_next_completions(void* h, int timeout_ms, uint64_t* cids,
                                 uint64_t* indexes, uint64_t* terms,
                                 uint64_t* keys, uint64_t* results,
                                 uint64_t* client_ids, uint64_t* series_ids,
-                                uint8_t* leaders, uint8_t* statuses,
-                                long long cap) {
+                                uint64_t* payload_ids, uint8_t* leaders,
+                                uint8_t* statuses, long long cap) {
   Engine* e = (Engine*)h;
   std::unique_lock<std::mutex> lk(e->cmu);
   if (e->complq.empty() && !e->stopped.load())
@@ -1817,12 +1840,29 @@ long long natr_next_completions(void* h, int timeout_ms, uint64_t* cids,
     results[n] = c.result;
     client_ids[n] = c.client_id;
     series_ids[n] = c.series_id;
+    payload_ids[n] = c.payload_id;
     leaders[n] = c.leader;
     statuses[n] = c.status;
     e->complq.pop_front();
     n++;
   }
   return n;
+}
+
+// Fetch (and consume) a completion payload parked by the apply loop.
+// Copies min(len, cap) bytes and returns the payload's full length; the
+// entry is erased only when the caller's buffer held all of it, so an
+// undersized read can retry with a bigger buffer.  Unknown id: -1.
+long long natr_take_payload(void* h, uint64_t pid, uint8_t* buf,
+                            long long cap) {
+  Engine* e = (Engine*)h;
+  std::lock_guard<std::mutex> lk(e->cmu);
+  auto it = e->paymap.find(pid);
+  if (it == e->paymap.end()) return -1;
+  long long len = (long long)it->second.size();
+  memcpy(buf, it->second.data(), (size_t)std::min(len, cap));
+  if (cap >= len) e->paymap.erase(it);
+  return len;
 }
 
 // Propose on an enrolled leader group.  Returns the assigned index (>0) or

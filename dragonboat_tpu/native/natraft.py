@@ -169,8 +169,12 @@ def _load():
             c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),
             c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),
             c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),
-            c.POINTER(c.c_uint64), c.POINTER(c.c_uint8),
-            c.POINTER(c.c_uint8), c.c_longlong,
+            c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),
+            c.POINTER(c.c_uint8), c.POINTER(c.c_uint8), c.c_longlong,
+        ]
+        lib.natr_take_payload.restype = c.c_longlong
+        lib.natr_take_payload.argtypes = [
+            c.c_void_p, c.c_uint64, c.POINTER(c.c_uint8), c.c_longlong,
         ]
         _lib = lib
         return lib
@@ -538,26 +542,42 @@ class NatRaft:
     def next_completions(self, timeout_ms: int = 200):
         """Batch of native-SM apply completions as parallel lists
         (cids, indexes, terms, keys, results, client_ids, series_ids,
-        leader_flags, statuses); None on timeout; raises on stop.
-        Status: 0 completed, 1 rejected, 2 ignored (already responded —
-        no future completion, mirroring Node.apply_update)."""
+        payload_ids, leader_flags, statuses); None on timeout; raises on
+        stop.  Status: 0 completed, 1 rejected, 2 ignored (already
+        responded — no future completion, mirroring Node.apply_update).
+        A nonzero payload_id points at data bytes in the side-channel
+        (``take_payload``)."""
         cap = self._COMPL_CAP
         if not hasattr(self, "_cbufs"):
             u64 = ctypes.c_uint64 * cap
             u8 = ctypes.c_uint8 * cap
             self._cbufs = (
-                u64(), u64(), u64(), u64(), u64(), u64(), u64(), u8(), u8()
+                u64(), u64(), u64(), u64(), u64(), u64(), u64(), u64(),
+                u8(), u8(),
             )
         b = self._cbufs
         n = self._lib.natr_next_completions(
             self._h, timeout_ms, b[0], b[1], b[2], b[3], b[4], b[5], b[6],
-            b[7], b[8], cap
+            b[7], b[8], b[9], cap
         )
         if n < 0:
             raise ConnectionError("natraft stopped")
         if n == 0:
             return None
         return tuple(buf[:n] for buf in b)
+
+    def take_payload(self, payload_id: int) -> bytes:
+        """Fetch (and consume) a completion payload from the side-channel
+        (cached session responses whose Result carried data bytes)."""
+        cap = 1 << 16
+        while True:
+            buf = (ctypes.c_uint8 * cap)()
+            n = self._lib.natr_take_payload(self._h, payload_id, buf, cap)
+            if n < 0:
+                return b""  # unknown id (already consumed)
+            if n <= cap:
+                return bytes(buf[:n])
+            cap = int(n)  # undersized: retry with the exact size
 
     def close_conn(self, conn_id: int) -> None:
         self._lib.natr_close_conn(self._h, conn_id)

@@ -423,15 +423,21 @@ uint64_t natsm_sess_hash(void* h) {
 // StateMachineManager._handle_session_entry exactly.  Returns the
 // completion status: 0 completed (*result set), 1 rejected, 2 ignored
 // (client already responded — the future is NOT completed, matching
-// Node.apply_update's `ignored` arm), 3 punt (cached response carries a
-// data payload the u64 completion record cannot deliver — caller ejects
-// to the Python plane; unreachable for natsm-applied groups, whose
-// results are all value-only).
+// Node.apply_update's `ignored` arm).  A cached response that carries a
+// data payload (a history entry imported from a Python-era apply whose
+// Result had data bytes) is returned through *pay_out/*pay_len — a
+// malloc'd copy the caller owns — and rides the completion side-channel
+// instead of forcing an eject (round-4: status 3 punt → eject per
+// retry, which cost any data-bearing SM its exactly-once fast path).
 int natsm_sess_apply(void* sess_h, void* kv_h, uint64_t cid, uint64_t sid,
                      uint64_t responded_to, const uint8_t* cmd, size_t len,
-                     uint64_t* result) {
+                     uint64_t* result, uint8_t** pay_out, size_t* pay_len) {
   SessStore* s = (SessStore*)sess_h;
   *result = 0;
+  if (pay_out != nullptr) {
+    *pay_out = nullptr;
+    *pay_len = 0;
+  }
   std::unique_lock<std::mutex> lk(s->mu);
   if (sid == kSeriesRegister) {
     *result = sess_register_locked(s, cid);
@@ -446,8 +452,14 @@ int natsm_sess_apply(void* sess_h, void* kv_h, uint64_t cid, uint64_t sid,
   if (sid <= sess->responded_up_to) return 2;  // already responded
   auto it = sess->history.find(sid);
   if (it != sess->history.end()) {  // duplicate: cached response
-    if (!it->second.second.empty()) return 3;
     *result = it->second.first;
+    const std::string& p = it->second.second;
+    if (!p.empty() && pay_out != nullptr) {
+      *pay_out = (uint8_t*)malloc(p.size());
+      if (*pay_out == nullptr) return 1;  // OOM: reject, never corrupt
+      memcpy(*pay_out, p.data(), p.size());
+      *pay_len = p.size();
+    }
     return 0;
   }
   // first sight: apply through the shared KV, then record the response.

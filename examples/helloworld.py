@@ -8,10 +8,11 @@ the multi-process TCP + native-fast-lane deployment shape.
 
 Run:  python examples/helloworld.py
 """
+import os
 import sys
 import time
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from dragonboat_tpu import Config, IStateMachine, NodeHost, NodeHostConfig, Result
 from dragonboat_tpu.transport import ChanRouter, ChanTransport

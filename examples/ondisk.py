@@ -13,7 +13,7 @@ import sys
 import tempfile
 import time
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from dragonboat_tpu import Config, NodeHost, NodeHostConfig, Result
 from dragonboat_tpu.transport import ChanRouter, ChanTransport
@@ -24,9 +24,11 @@ class DiskKV:
     sync().  A real implementation would use the native KV engine or any
     embedded store."""
 
+    STATE_DIR = tempfile.mkdtemp(prefix="dbtpu-ondisk-")  # fresh per run
+
     def __init__(self, cluster_id, node_id):
         self.path = os.path.join(
-            tempfile.gettempdir(), f"dbtpu-ondisk-{cluster_id}-{node_id}.json"
+            self.STATE_DIR, f"sm-{cluster_id}-{node_id}.json"
         )
         self.kv = {}
         self.applied_index = 0

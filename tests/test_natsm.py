@@ -461,3 +461,60 @@ def test_periodic_snapshot_triggers_while_enrolled(tmp_path):
     finally:
         for nh in nhs.values():
             nh.stop()
+
+
+def test_cached_response_payload_completes_natively(tmp_path):
+    """A cached session response that carries DATA bytes (a history entry
+    from a Python-era apply whose Result had a payload — e.g. imported
+    with the session image at attach) completes through the native path
+    via the completion payload side-channel instead of ejecting the
+    group (round-4: one sm-punt eject per such retry)."""
+    from dragonboat_tpu.client import Session
+
+    sms = {}
+    nhs, addrs = _cluster(tmp_path, sms)
+    try:
+        nhs[1].get_node(CID).request_campaign()
+        lid, leader = _leader(nhs)
+        s0 = leader.get_noop_session(CID)
+        for j in range(20):
+            assert leader.propose(
+                s0, f"w{j}=v{j}".encode(), timeout=60.0
+            ).wait(120.0).completed
+        assert _wait_native_applies(nhs)
+
+        sess = leader.sync_get_session(CID, timeout=60.0)
+        assert leader.propose(sess, b"k=1", timeout=60.0).wait(120.0).completed
+        sess.proposal_completed()
+        # inject a payload-bearing cached response at a FUTURE series id
+        # on every replica's shared native store (the deterministic twin
+        # of a session image whose history carries Result.data bytes)
+        future_sid = sess.series_id + 3
+        payload = b"cached-data-bytes" * 3
+        from dragonboat_tpu.native import natsm as natsm_mod
+
+        lib = natsm_mod._load()
+        for i, nh in nhs.items():
+            sm = sms[i]
+            lib.natsm_sess_add_response(
+                sm.natsm_sess_handle, sess.client_id, future_sid,
+                7777, payload, len(payload),
+            )
+        # the client "retries" that series: the native dedup finds the
+        # cached payload and the future completes WITH the data
+        retry = Session(
+            cluster_id=CID, client_id=sess.client_id, series_id=future_sid,
+        )
+        r = leader.propose(retry, b"ignored=1", timeout=60.0).wait(120.0)
+        assert r.completed
+        assert r.result.value == 7777
+        assert r.result.data == payload
+        # no re-apply, no punt, still enrolled
+        assert leader.sync_read(CID, "ignored", timeout=20.0) is None
+        assert leader.get_node(CID).fast_lane
+        for nh in nhs.values():
+            st = nh.fastlane.stats()
+            assert st["eject_reasons"].get("sm-punt", 0) == 0, st
+    finally:
+        for nh in nhs.values():
+            nh.stop()
