@@ -472,6 +472,12 @@ class FastLaneManager:
             # making that deterministic at shutdown)
             node = self.nh._clusters.get(cid)
             if node is None:
+                # consume (and discard) any parked payloads: nothing else
+                # will ever fetch them for a removed cluster, and the C++
+                # side keeps a parked copy until it is taken
+                for i in idxs:
+                    if payload_ids[i]:
+                        self.nat.take_payload(int(payload_ids[i]))
                 continue
             last = idxs[-1]
             node.sm.advance_applied_native(

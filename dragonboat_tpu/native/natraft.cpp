@@ -403,7 +403,7 @@ struct Remote {
   std::mutex flush_mu;
   std::condition_variable cv;
   std::string buf;          // complete frames
-  std::string msgs;         // current pass's message spans (round thread only)
+  std::string msgs;         // current pass's message spans (under r->mu)
   uint64_t msg_count = 0;   // messages in `msgs`
   bool closed = false;
   uint64_t dropped = 0;
@@ -618,6 +618,7 @@ struct Engine {
 
   std::atomic<bool> stopped{false};
   std::thread round_thread;
+  std::thread clock_thread;
   int64_t round_interval_ms = 1;
   std::atomic<int64_t> commit_window_us{0};
 
@@ -634,6 +635,8 @@ struct Engine {
   std::atomic<uint64_t> lat_resp_us{0}, lat_respn{0};  // follower: born->resp flushed
   std::atomic<uint64_t> rtt_us{0}, rttn{0}, rtt_max_us{0};  // hb echo round trip
   std::atomic<uint64_t> stale_dropped{0};  // stale-term fast frames consumed
+  // scheduling-stall compensation diagnostics (clock_pass)
+  std::atomic<uint64_t> clock_stalls{0}, clock_stall_ms{0};
   // partition injection (natr_set_partition): blocked inbound source
   // addresses + outbound remote-slot bitmask, with drop counters
   std::mutex block_mu;
@@ -686,6 +689,7 @@ struct Engine {
       if (sh->thread.joinable()) sh->thread.join();
     }
     if (round_thread.joinable()) round_thread.join();
+    if (clock_thread.joinable()) clock_thread.join();
     // wake the readers (shutdown their sockets), then join them outside
     // the mutex (their exit path takes readers_mu briefly)
     std::vector<std::shared_ptr<Reader>> rds;
@@ -732,8 +736,9 @@ struct Engine {
     push_event(g->cid, code);
   }
 
-  // Append a message span to a remote's current-pass buffer (round thread
-  // only, or ingest thread for direct responses under the remote's mutex).
+  // Append a message span to a remote's current-pass buffer.  Callers:
+  // round thread (replication), clock thread (heartbeats/timeouts) and
+  // ingest threads (direct responses) — safe because r->mu guards msgs.
   void queue_msg(int slot, const std::string& span) {
     if (slot < 0 || slot >= nremotes.load()) return;
     Remote* r = remotes[slot].get();
@@ -1116,7 +1121,6 @@ struct Engine {
       run_effects(g);
     }
     flush_remotes();
-    clock_pass();
     struct timespec t3;
     clock_gettime(CLOCK_MONOTONIC, &t3);
     round_ns += (uint64_t)(t3.tv_sec - t0.tv_sec) * 1000000000ull +
@@ -1198,6 +1202,27 @@ struct Engine {
   void clock_pass() {
     int64_t now = mono_ms();
     if (now - last_clock_ms < 10) return;
+    // Scheduling-stall compensation: when this thread was off-CPU for a
+    // long gap (box contention, SIGSTOP, VM pause), the liveness stamps
+    // aged without the process observing its peers — remote heartbeats
+    // sat unread in socket buffers while the local clocks "expired".
+    // Firing contact-loss/quorum-loss ejects on resume would punish the
+    // remotes for a LOCAL stall, and every spurious eject exiles the
+    // group to the scalar path for 2+ election windows (the duty-0.706
+    // collapse under a contended box, BENCH_r04).  Shift the eject
+    // stamps forward by the unobserved time so each timeout is measured
+    // in OBSERVED time; a genuinely dead peer still ejects, one fresh
+    // window after the stall.  Send-side stamps (last_hb_ms) stay put —
+    // after a stall, heartbeats should fire immediately, not later.
+    int64_t stall = 0;
+    if (last_clock_ms != 0) {
+      int64_t gap = now - last_clock_ms;
+      if (gap > 100) {
+        stall = gap;
+        clock_stalls++;
+        clock_stall_ms += (uint64_t)gap;
+      }
+    }
     last_clock_ms = now;
     // snapshot the registry first: holding gmu while locking a group
     // would invert the g->mu -> (no gmu) order the hot paths rely on
@@ -1211,6 +1236,20 @@ struct Engine {
       Group* g = sp.get();
       std::lock_guard<std::mutex> lk(g->mu);
       if (g->state != G_ACTIVE) continue;
+      if (stall > 0) {
+        // clamp to now: ingest/reader threads kept running during the
+        // clock thread's gap and may have stamped fresh contact — an
+        // unclamped shift would push those stamps into the future and
+        // delay GENUINE failure detection by up to the stall
+        auto bump = [&](int64_t& t) { t = std::min(t + stall, now); };
+        bump(g->leader_contact_ms);
+        bump(g->quorum_ok_ms);
+        bump(g->last_commit_adv_ms);
+        for (auto& p : g->peers) {
+          bump(p.contact_ms);
+          if (p.progress_ms != 0) bump(p.progress_ms);
+        }
+      }
       if (g->leader) {
         if (now - g->last_hb_ms >= g->hb_period_ms) {
           g->last_hb_ms = now;
@@ -1253,7 +1292,13 @@ struct Engine {
           }
         }
       } else {
-        if (now - g->leader_contact_ms > g->elect_timeout_ms)
+        // 2x window (matching the check-quorum and commit-stall margins):
+        // the eject is a FALLBACK, not an election — scalar raft runs its
+        // own election clock after the handoff, so the extra margin costs
+        // little failover latency but absorbs heartbeat jitter from a
+        // starved LEADER box (the remote-side half of the duty collapse;
+        // the local half is the stall compensation above)
+        if (now - g->leader_contact_ms > 2 * g->elect_timeout_ms)
           begin_eject(g, EV_CONTACT_LOST);
       }
       // liveness watchdog: entries are pending yet commit has not moved
@@ -1275,6 +1320,22 @@ struct Engine {
   void round_main() {
     prctl(PR_SET_NAME, "natr-round", 0, 0, 0);
     while (!stopped.load()) round_pass();
+  }
+
+  // Heartbeats and liveness timeouts run on their OWN lean thread: under
+  // box contention the round thread can spend an entire election window
+  // inside one heavy pass (batch staging for thousands of groups), and
+  // heartbeats riding behind that work are exactly what made remote
+  // followers fire contact-loss ejects (BENCH_r04 duty 0.706).  A thread
+  // whose whole loop is O(groups) stamp checks gets scheduled far more
+  // reliably than one carrying the data plane.
+  void clock_main() {
+    prctl(PR_SET_NAME, "natr-clock", 0, 0, 0);
+    while (!stopped.load()) {
+      clock_pass();
+      struct timespec d = {0, 10 * 1000000};
+      nanosleep(&d, nullptr);
+    }
   }
 
   // ------------------------------------------------------------ ingest
@@ -1638,6 +1699,7 @@ void* natr_create(const char* source_address, uint64_t deployment_id,
 void natr_start(void* h) {
   Engine* e = (Engine*)h;
   e->round_thread = std::thread([e] { e->round_main(); });
+  e->clock_thread = std::thread([e] { e->clock_main(); });
 }
 
 void natr_destroy(void* h) {
@@ -2607,7 +2669,7 @@ void natr_stats(void* h, uint64_t* out12) {  // array of 24 u64
   out12[20] = e->stale_dropped.load();
   out12[21] = e->part_in_dropped.load();   // partition-dropped inbound msgs
   out12[22] = e->part_out_dropped.load();  // partition-dropped outbound msgs
-  out12[23] = 0;  // reserved
+  out12[23] = (e->clock_stalls.load() << 32) | (e->clock_stall_ms.load() & 0xffffffffu);
 }
 
 void natr_set_debug_cid(void* h, uint64_t cid) {

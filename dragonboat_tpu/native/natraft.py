@@ -569,15 +569,23 @@ class NatRaft:
     def take_payload(self, payload_id: int) -> bytes:
         """Fetch (and consume) a completion payload from the side-channel
         (cached session responses whose Result carried data bytes)."""
+        # reuse one 64KB buffer across calls (the _cbufs pattern): the
+        # common payload is tiny and the discard path for removed
+        # clusters shouldn't pay a fresh zeroed allocation per record
+        buf = getattr(self, "_paybuf", None)
         cap = 1 << 16
+        if buf is None:
+            buf = self._paybuf = (ctypes.c_uint8 * cap)()
+        else:
+            cap = len(buf)
         while True:
-            buf = (ctypes.c_uint8 * cap)()
             n = self._lib.natr_take_payload(self._h, payload_id, buf, cap)
             if n < 0:
                 return b""  # unknown id (already consumed)
             if n <= cap:
                 return bytes(buf[:n])
             cap = int(n)  # undersized: retry with the exact size
+            buf = (ctypes.c_uint8 * cap)()  # oversize stays per-call
 
     def close_conn(self, conn_id: int) -> None:
         self._lib.natr_close_conn(self._h, conn_id)
@@ -622,6 +630,10 @@ class NatRaft:
             "stale_dropped": int(out[20]),
             "part_in_dropped": int(out[21]),
             "part_out_dropped": int(out[22]),
+            # scheduling-stall compensation (clock_pass): passes whose gap
+            # exceeded the stall threshold, and the summed unobserved time
+            "clock_stalls": int(out[23]) >> 32,
+            "clock_stall_ms": int(out[23]) & 0xFFFFFFFF,
         }
 
     def stop(self) -> None:
