@@ -531,6 +531,13 @@ class FastLaneManager:
                     # could discard its freshly queued node forever
                     due, self._snapshot_due = self._snapshot_due, []
                 for node in due:  # ejects OUTSIDE the lock (order: raftMu
+                    if node._natsm_attached:
+                        # native-SM groups snapshot in place via the
+                        # consistent capture path (natr_capture_sm) — no
+                        # eject.  _snapshotting's non-blocking acquire
+                        # dedups re-triggers while a save is in flight.
+                        node._save_snapshot_required()
+                        continue
                     if node.fast_lane:  # -> _compl_mu, never the reverse)
                         self.count_eject("snapshot-due")
                         node.fast_eject()

@@ -142,6 +142,18 @@ class LogReader:
                     f"first {first}"
                 )
 
+    def extend_to(self, last: int) -> None:
+        """Monotonically grow the stable window to cover ``last``.
+
+        Unlike a ``get_range``+``set_range`` pair this is atomic, and it
+        can only GROW the window — the no-eject snapshot path extends the
+        window from outside raftMu, so it must never shrink a range a
+        concurrent ``fast_eject`` (which holds raftMu) just set."""
+        with self._mu:
+            cur_last = self._last_index()
+            if last > cur_last:
+                self.length += last - cur_last
+
     def compact(self, index: int) -> None:
         """Move the marker forward (reference ``logreader.go`` ``Compact``)."""
         with self._mu:

@@ -490,6 +490,11 @@ struct Group {
   int (*sess_apply)(void*, void*, uint64_t, uint64_t, uint64_t,
                     const uint8_t*, size_t, uint64_t*, uint8_t**,
                     size_t*) = nullptr;
+  // consistent-image serializers (natsm_save / natsm_sess_save): let
+  // natr_capture_sm snapshot the attached SM at an exact applied index
+  // under g->mu, so periodic snapshots no longer eject the group
+  long long (*sm_save)(void*, uint8_t**) = nullptr;
+  long long (*sess_save)(void*, uint8_t**) = nullptr;
   // order barrier vs the scalar plane: entries <= apply_barrier were
   // handed to the PYTHON apply queue before enrollment; native applies
   // hold off until Python reports them applied (py_applied)
@@ -1844,7 +1849,8 @@ int natr_enroll(void* h, uint64_t cid, uint64_t nid, uint64_t term,
 // (natr_note_applied).  py_applied0 = the Python RSM manager's current
 // last_applied.  Returns 1 on success, 0 when the group is not enrolled.
 int natr_attach_sm(void* h, uint64_t cid, void* sm, void* update_fn,
-                   uint64_t py_applied0, void* sess, void* sess_apply_fn) {
+                   uint64_t py_applied0, void* sess, void* sess_apply_fn,
+                   void* sm_save_fn, void* sess_save_fn) {
   Engine* e = (Engine*)h;
   std::shared_ptr<Group> sp = e->find(cid);
   Group* g = sp.get();
@@ -1853,11 +1859,13 @@ int natr_attach_sm(void* h, uint64_t cid, void* sm, void* update_fn,
   if (g->state != G_ACTIVE) return 0;
   g->sm = sm;
   g->sm_update = (uint64_t (*)(void*, const uint8_t*, size_t))update_fn;
+  g->sm_save = (long long (*)(void*, uint8_t**))sm_save_fn;
   if (sess != nullptr && sess_apply_fn != nullptr) {
     g->sess = sess;
     g->sess_apply =
         (int (*)(void*, void*, uint64_t, uint64_t, uint64_t, const uint8_t*,
                  size_t, uint64_t*, uint8_t**, size_t*))sess_apply_fn;
+    g->sess_save = (long long (*)(void*, uint8_t**))sess_save_fn;
   }
   g->apply_barrier = g->applied_handed;
   // max: a racing natr_note_applied may already have reported fresher
@@ -1865,6 +1873,63 @@ int natr_attach_sm(void* h, uint64_t cid, void* sm, void* update_fn,
   if (py_applied0 > g->py_applied) g->py_applied = py_applied0;
   e->mark_dirty(g);  // an applicable backlog applies on the next pass
   return 1;
+}
+
+// Consistent native-SM snapshot capture: returns a malloc'd blob
+// [uvarint index][uvarint term][uvarint kv_len][kv bytes]
+// [uvarint sess_len][sess bytes] serialized under g->mu at exactly
+// applied_handed — the apply path holds g->mu, so no apply can land
+// mid-image.  Holding the group mutex for the serialization matches
+// regular-SM save semantics (the reference holds the update lock for
+// non-concurrent SMs, internal/rsm/statemachine.go:552-814).  Returns
+// the blob length, or -1 when the group is not enrolled / attached /
+// capturable — the caller then falls back to the eject path.
+long long natr_capture_sm(void* h, uint64_t cid, uint8_t** out) {
+  Engine* e = (Engine*)h;
+  std::shared_ptr<Group> sp = e->find(cid);
+  Group* g = sp.get();
+  if (!g) return -1;
+  std::lock_guard<std::mutex> lk(g->mu);
+  if (g->state != G_ACTIVE || g->sm == nullptr || g->sm_save == nullptr)
+    return -1;
+  // a sessions-bearing group without a session serializer must fall
+  // back (eject path): capturing with an empty session image would
+  // persist a snapshot that silently drops all exactly-once dedup state
+  if (g->sess != nullptr && g->sess_save == nullptr) return -1;
+  // pre-enrollment entries may still be in flight on the PYTHON apply
+  // plane (the attach barrier); an image taken now could miss them
+  if (g->py_applied < g->apply_barrier) return -1;
+  uint64_t index = g->applied_handed;
+  uint64_t term = g->term_of(index);  // 0 below the enrollment window
+  if (index == 0 || term == 0) return -1;
+  uint8_t* kv = nullptr;
+  long long kvn = g->sm_save(g->sm, &kv);
+  if (kvn < 0) {
+    free(kv);
+    return -1;
+  }
+  uint8_t* ss = nullptr;
+  long long ssn = 0;
+  if (g->sess != nullptr && g->sess_save != nullptr) {
+    ssn = g->sess_save(g->sess, &ss);
+    if (ssn < 0) {
+      free(kv);
+      free(ss);
+      return -1;
+    }
+  }
+  std::string b;
+  put_uvarint(b, index);
+  put_uvarint(b, term);
+  put_uvarint(b, (uint64_t)kvn);
+  b.append((const char*)kv, (size_t)kvn);
+  put_uvarint(b, (uint64_t)ssn);
+  if (ssn > 0) b.append((const char*)ss, (size_t)ssn);
+  free(kv);
+  free(ss);
+  *out = (uint8_t*)malloc(b.size() ? b.size() : 1);
+  memcpy(*out, b.data(), b.size());
+  return (long long)b.size();
 }
 
 // Python reports its apply progress (lifts the attach-time barrier).

@@ -160,7 +160,11 @@ def _load():
         lib.natr_attach_sm.restype = c.c_int
         lib.natr_attach_sm.argtypes = [
             c.c_void_p, c.c_uint64, c.c_void_p, c.c_void_p, c.c_uint64,
-            c.c_void_p, c.c_void_p,
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
+        ]
+        lib.natr_capture_sm.restype = c.c_longlong
+        lib.natr_capture_sm.argtypes = [
+            c.c_void_p, c.c_uint64, c.POINTER(c.POINTER(c.c_uint8)),
         ]
         lib.natr_note_applied.argtypes = [c.c_void_p, c.c_uint64, c.c_uint64]
         lib.natr_next_completions.restype = c.c_longlong
@@ -519,19 +523,49 @@ class NatRaft:
     def attach_sm(
         self, cid: int, sm_handle: int, update_fn: int, py_applied: int,
         sess_handle: int = 0, sess_apply_fn: int = 0,
+        sm_save_fn: int = 0, sess_save_fn: int = 0,
     ) -> bool:
         """Attach a native SM to an enrolled group; committed application
         entries then apply in C++ with only batched completion records
         crossing the GIL.  With a session store handle (natsm.cpp
         SessStore + its ``natsm_sess_apply`` pointer), session-managed
-        entries apply natively too — exactly-once dedup included."""
+        entries apply natively too — exactly-once dedup included.  The
+        save pointers (``natsm_save`` / ``natsm_sess_save``) enable
+        :meth:`capture_sm` — snapshots without ejecting the group."""
         return (
             self._lib.natr_attach_sm(
                 self._h, cid, sm_handle, update_fn, py_applied,
-                sess_handle, sess_apply_fn,
+                sess_handle, sess_apply_fn, sm_save_fn, sess_save_fn,
             )
             == 1
         )
+
+    def capture_sm(self, cid: int):
+        """Consistent snapshot of an enrolled group's attached native SM,
+        taken under the group mutex at exactly the native applied index.
+        Returns ``(index, term, kv_image, session_image)`` or ``None``
+        when the group cannot be captured (not enrolled / not attached /
+        apply barrier still in flight) — callers fall back to the
+        eject-based snapshot path."""
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.natr_capture_sm(self._h, cid, ctypes.byref(out))
+        if n < 0:
+            return None
+        try:
+            blob = bytes(ctypes.string_at(out, n))
+        finally:
+            self._lib.natr_free(ctypes.cast(out, ctypes.c_void_p))
+        from ..wire.codec import _read_uvarint
+
+        pos = 0
+        index, pos = _read_uvarint(blob, pos)
+        term, pos = _read_uvarint(blob, pos)
+        kvn, pos = _read_uvarint(blob, pos)
+        kv = blob[pos:pos + kvn]
+        pos += kvn
+        ssn, pos = _read_uvarint(blob, pos)
+        sess = blob[pos:pos + ssn]
+        return int(index), int(term), kv, sess
 
     def note_applied(self, cid: int, applied: int) -> None:
         """Report Python-plane apply progress (lifts the attach barrier)."""

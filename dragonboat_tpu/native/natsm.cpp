@@ -273,6 +273,10 @@ void natsm_buf_free(uint8_t* p) { free(p); }
 // libraries linking against each other.
 void* natsm_update_ptr() { return (void*)&natsm_update; }
 
+// Image serializers as raw pointers, for natraft's consistent snapshot
+// capture (natr_capture_sm) — same no-link handoff as natsm_update_ptr.
+void* natsm_save_ptr() { return (void*)&natsm_save; }
+
 // ---------------------------------------------------------------- sessions
 
 void* natsm_sess_create(uint64_t maxn) { return new SessStore(maxn); }
@@ -473,5 +477,6 @@ int natsm_sess_apply(void* sess_h, void* kv_h, uint64_t cid, uint64_t sid,
 }
 
 void* natsm_sess_apply_ptr() { return (void*)&natsm_sess_apply; }
+void* natsm_sess_save_ptr() { return (void*)&natsm_sess_save; }
 
 }  // extern "C"

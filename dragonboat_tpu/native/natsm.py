@@ -111,6 +111,8 @@ def _load():
         lib.natsm_sess_hash.restype = ctypes.c_uint64
         lib.natsm_sess_hash.argtypes = [ctypes.c_void_p]
         lib.natsm_sess_apply_ptr.restype = ctypes.c_void_p
+        lib.natsm_save_ptr.restype = ctypes.c_void_p
+        lib.natsm_sess_save_ptr.restype = ctypes.c_void_p
         _lib = lib
         return lib
 
@@ -142,6 +144,11 @@ class NativeKVStateMachine:
             Hard.lru_max_session_count
         )
         self.natsm_sess_apply_fn: int = self._lib.natsm_sess_apply_ptr()
+        # image serializers for natr_capture_sm: snapshots of enrolled
+        # groups are taken natively at a consistent applied index instead
+        # of ejecting the group once per snapshot_entries window
+        self.natsm_save_fn: int = self._lib.natsm_save_ptr()
+        self.natsm_sess_save_fn: int = self._lib.natsm_sess_save_ptr()
 
     # ---- user SM protocol (scalar plane) ----
 

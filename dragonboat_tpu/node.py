@@ -699,7 +699,17 @@ class Node:
         ):
             return True
         if self.snapshot_due():
-            return True
+            if self._natsm_attached:
+                # enrolled native-SM groups snapshot IN PLACE: the native
+                # core captures a consistent kv+session image at its
+                # applied index (natr_capture_sm) and the save runs on
+                # the snapshot pool — no eject, no scalar exile.  The
+                # reference's concurrent SMs never stall apply for a save
+                # either (statemachine.go:552-814); this is the regular-
+                # SM analog for the fast lane.
+                self._save_snapshot_required()
+            else:
+                return True
         return False
 
     def snapshot_due(self) -> bool:
@@ -881,6 +891,10 @@ class Node:
                 # already fronts the same store for the scalar plane
                 getattr(user, "natsm_sess_handle", 0),
                 getattr(user, "natsm_sess_apply_fn", 0),
+                # image serializers: periodic snapshots capture natively
+                # (natr_capture_sm) instead of ejecting the group
+                getattr(user, "natsm_save_fn", 0),
+                getattr(user, "natsm_sess_save_fn", 0),
             ):
                 self._natsm_attached = False
 
@@ -1291,6 +1305,45 @@ class Node:
                     self.fastlane.nat.note_applied(self.cluster_id, applied)
                 self.nh.engine.set_step_ready(self.cluster_id)
 
+    def _try_capture_save(self, req: SSRequest):
+        """Snapshot an ENROLLED native-SM group from a consistent image
+        captured by the native core (``natr_capture_sm``) — the no-eject
+        periodic-snapshot path.  Returns ``(ss, env)`` or ``None`` to fall
+        back to the scalar ``sm.save`` flow (which requires the group to
+        be off the fast lane).  Exported requests stay scalar: the export
+        flow's env/finalize handling expects the standard savable."""
+        fl = self.fastlane
+        if (
+            fl is None
+            or not self.fast_lane
+            or not self._natsm_attached
+            or req.exported
+            or self.sm.on_disk
+        ):
+            return None
+        cap = fl.nat.capture_sm(self.cluster_id)
+        if cap is None:
+            # cannot capture (no save fn on the attached SM / attach
+            # barrier still in flight / mid-eject): restore the
+            # pre-capture behavior — leave the lane FIRST, because a
+            # scalar sm.save() while native applies keep mutating the
+            # shared state would label the image with a stale index
+            # (double-apply after recovery)
+            if self.fast_lane:
+                self._count_eject("snapshot-due")
+                self.fast_eject()
+            return None
+        index, term, kv_image, sess_image = cap
+        # entries through the captured index are durable (native applies
+        # only run past the local fsync watermark) but the Python-side
+        # LogReader window froze at enrollment; extend it (monotonic,
+        # atomic vs a racing fast_eject) so create_snapshot/compaction
+        # accept the new snapshot index
+        self.logreader.extend_to(index)
+        return self.sm.save_from_capture(
+            req, index, term, kv_image, sess_image
+        )
+
     def _save_snapshot(self, t: Task) -> None:
         req = t.ss_request
         # only user-initiated requests may resolve the pending-snapshot slot;
@@ -1298,7 +1351,8 @@ class Node:
         user_req = req.type in (SSReqType.USER_REQUESTED, SSReqType.EXPORTED)
         try:
             try:
-                ss, env = self.sm.save(req)
+                cap = self._try_capture_save(req)
+                ss, env = cap if cap is not None else self.sm.save(req)
             except SnapshotIgnored:
                 if user_req:
                     self.pending_snapshot.notify(

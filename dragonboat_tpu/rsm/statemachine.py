@@ -148,6 +148,20 @@ class ISnapshotter(Protocol):
     def is_no_snapshot_error(self, e: Exception) -> bool: ...
 
 
+class _CaptureSavable:
+    """Savable facade over a native consistent capture
+    (``natr_capture_sm``): writes the pre-serialized KV image in exactly
+    the framing ``NativeKVStateMachine.save_snapshot`` uses, so the
+    recovery side is the shared adapter path."""
+
+    def __init__(self, kv_image: bytes) -> None:
+        self._kv = kv_image
+
+    def save_snapshot_payload(self, meta: "SSMeta", writer) -> None:
+        writer.write_session(meta.session)
+        writer.write(len(self._kv).to_bytes(8, "little") + self._kv)
+
+
 class StateMachine:
     """Reference ``statemachine.go:162`` ``StateMachine``."""
 
@@ -441,6 +455,44 @@ class StateMachine:
                 with self._update_mu:
                     meta = self._checked_meta(req)
                     ss, env = self.snapshotter.save(self, meta)
+        with self._mu:
+            if not req.exported and ss.index > self.snapshot_index:
+                self.snapshot_index = ss.index
+        return ss, env
+
+    def save_from_capture(
+        self,
+        req: SSRequest,
+        index: int,
+        term: int,
+        kv_image: bytes,
+        session_image: bytes,
+    ) -> Tuple[Snapshot, object]:
+        """Snapshot from a pre-captured consistent native image
+        (``natr_capture_sm``): the native core serialized kv+sessions at
+        exactly ``index`` under its group mutex, so — unlike :meth:`save`
+        — no update lock is needed here and the fast lane keeps applying
+        while the file is written.  The image framing matches
+        ``NativeKVStateMachine.save_snapshot``, so recovery is the shared
+        path."""
+        if self.snapshotter is None:
+            raise RuntimeError("no snapshotter configured")
+        with self._save_mu:
+            with self._mu:
+                if index == 0 or index <= self.snapshot_index:
+                    raise SnapshotIgnored("nothing new to snapshot")
+                meta = SSMeta(
+                    from_index=self.snapshot_index,
+                    index=index,
+                    term=term,
+                    on_disk_index=0,
+                    request=req,
+                    membership=self.members.get(),
+                    session=session_image,
+                    type=self.sm_type,
+                    compression=self.snapshot_compression,
+                )
+            ss, env = self.snapshotter.save(_CaptureSavable(kv_image), meta)
         with self._mu:
             if not req.exported and ss.index > self.snapshot_index:
                 self.snapshot_index = ss.index
