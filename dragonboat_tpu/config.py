@@ -83,6 +83,16 @@ class ExpertConfig:
         6.3k vs 8.8k w/s at rung 3), else ``tpu`` iff a probe dispatch
         fits the commit-latency budget (a tunneled backend's ~70ms round
         trip does not; a local device's ~0.2ms does).
+
+        Placement note (measured r5): ``auto``'s fast-lane preference is
+        a SPREAD-placement policy.  When leadership concentrates on the
+        engine's host (the ``rank0`` topology — all commit tallying on
+        one rank), the device engine beats scalar+fastlane end-to-end
+        (+21% writes / +62% mixed ops at 2,048 groups, +37% writes at
+        512 on a 1-vCPU box): the per-group Python tally that grows
+        linearly is one fused ~1ms device dispatch.  Auto cannot see
+        future leader placement, so concentrated deployments should set
+        ``"tpu"`` explicitly.
     """
 
     quorum_engine: str = "scalar"

@@ -491,10 +491,18 @@ struct Group {
                     const uint8_t*, size_t, uint64_t*, uint8_t**,
                     size_t*) = nullptr;
   // consistent-image serializers (natsm_save / natsm_sess_save): let
-  // natr_capture_sm snapshot the attached SM at an exact applied index
-  // under g->mu, so periodic snapshots no longer eject the group
+  // natr_capture_sm snapshot the attached SM at an exact applied index,
+  // so periodic snapshots no longer eject the group
   long long (*sm_save)(void*, uint8_t**) = nullptr;
   long long (*sess_save)(void*, uint8_t**) = nullptr;
+  // capture in progress: applies DEFER (emit_apply no-ops) while the
+  // image serializes OFF g->mu — replication/heartbeats/acks continue,
+  // mirroring the reference's regular-SM semantics where a save blocks
+  // only the update lock, never the raft plane.  natr_eject waits on
+  // capture_cv so a racing eject cannot hand pending applies to the
+  // Python plane mid-serialization (which would tear the image).
+  bool capturing = false;
+  std::condition_variable capture_cv;
   // order barrier vs the scalar plane: entries <= apply_barrier were
   // handed to the PYTHON apply queue before enrollment; native applies
   // hold off until Python reports them applied (py_applied)
@@ -828,6 +836,7 @@ struct Engine {
   }
 
   void emit_apply(Group* g) {  // g->mu held
+    if (g->capturing) return;  // applies defer until the capture clears
     uint64_t upto = std::min(g->commit, g->fsynced);
     if (upto <= g->applied_handed) return;
     if (g->sm != nullptr && g->state == G_ACTIVE) {
@@ -1889,34 +1898,53 @@ long long natr_capture_sm(void* h, uint64_t cid, uint8_t** out) {
   std::shared_ptr<Group> sp = e->find(cid);
   Group* g = sp.get();
   if (!g) return -1;
-  std::lock_guard<std::mutex> lk(g->mu);
-  if (g->state != G_ACTIVE || g->sm == nullptr || g->sm_save == nullptr)
-    return -1;
-  // a sessions-bearing group without a session serializer must fall
-  // back (eject path): capturing with an empty session image would
-  // persist a snapshot that silently drops all exactly-once dedup state
-  if (g->sess != nullptr && g->sess_save == nullptr) return -1;
-  // pre-enrollment entries may still be in flight on the PYTHON apply
-  // plane (the attach barrier); an image taken now could miss them
-  if (g->py_applied < g->apply_barrier) return -1;
-  uint64_t index = g->applied_handed;
-  uint64_t term = g->term_of(index);  // 0 below the enrollment window
-  if (index == 0 || term == 0) return -1;
-  uint8_t* kv = nullptr;
-  long long kvn = g->sm_save(g->sm, &kv);
-  if (kvn < 0) {
-    free(kv);
-    return -1;
+  uint64_t index, term;
+  void *sm, *sess;
+  long long (*sm_save)(void*, uint8_t**);
+  long long (*sess_save)(void*, uint8_t**);
+  {
+    std::lock_guard<std::mutex> lk(g->mu);
+    if (g->state != G_ACTIVE || g->sm == nullptr || g->sm_save == nullptr ||
+        g->capturing)
+      return -1;
+    // a sessions-bearing group without a session serializer must fall
+    // back (eject path): capturing with an empty session image would
+    // persist a snapshot that silently drops all exactly-once dedup state
+    if (g->sess != nullptr && g->sess_save == nullptr) return -1;
+    // pre-enrollment entries may still be in flight on the PYTHON apply
+    // plane (the attach barrier); an image taken now could miss them
+    if (g->py_applied < g->apply_barrier) return -1;
+    index = g->applied_handed;
+    term = g->term_of(index);  // 0 below the enrollment window
+    if (index == 0 || term == 0) return -1;
+    // freeze APPLIES only (emit_apply defers while capturing), then
+    // serialize off g->mu: replication, heartbeats, acks and commit
+    // tallying keep running — an O(state) image must never stall the
+    // raft plane for this group (that would drop leadership on every
+    // periodic snapshot of a large SM)
+    g->capturing = true;
+    sm = g->sm;
+    sess = g->sess;
+    sm_save = g->sm_save;
+    sess_save = g->sess_save;
   }
+  uint8_t* kv = nullptr;
+  long long kvn = sm_save(sm, &kv);
   uint8_t* ss = nullptr;
   long long ssn = 0;
-  if (g->sess != nullptr && g->sess_save != nullptr) {
-    ssn = g->sess_save(g->sess, &ss);
-    if (ssn < 0) {
-      free(kv);
-      free(ss);
-      return -1;
-    }
+  if (kvn >= 0 && sess != nullptr) {
+    ssn = sess_save(sess, &ss);
+  }
+  {
+    std::lock_guard<std::mutex> lk(g->mu);
+    g->capturing = false;
+    g->capture_cv.notify_all();
+    e->mark_dirty(g);  // resume any deferred applies promptly
+  }
+  if (kvn < 0 || ssn < 0) {
+    free(kv);
+    free(ss);
+    return -1;
   }
   std::string b;
   put_uvarint(b, index);
@@ -2549,7 +2577,11 @@ int natr_eject(void* h, uint64_t cid, uint64_t* term, uint64_t* vote,
   std::string pending_blob;
   uint64_t pending_first = 0, pending_count = 0;
   {
-    std::lock_guard<std::mutex> lk(g->mu);
+    std::unique_lock<std::mutex> lk(g->mu);
+    // an in-flight consistent capture serializes the SM off g->mu with
+    // applies frozen; handing pending applies to the Python plane now
+    // would let them mutate the SM mid-serialization and tear the image
+    while (g->capturing) g->capture_cv.wait(lk);
     if (g->state == G_GONE) return -1;
     g->state = G_EJECTING;
     // flush un-persisted tail synchronously
