@@ -1886,13 +1886,17 @@ int natr_attach_sm(void* h, uint64_t cid, void* sm, void* update_fn,
 
 // Consistent native-SM snapshot capture: returns a malloc'd blob
 // [uvarint index][uvarint term][uvarint kv_len][kv bytes]
-// [uvarint sess_len][sess bytes] serialized under g->mu at exactly
-// applied_handed — the apply path holds g->mu, so no apply can land
-// mid-image.  Holding the group mutex for the serialization matches
-// regular-SM save semantics (the reference holds the update lock for
-// non-concurrent SMs, internal/rsm/statemachine.go:552-814).  Returns
-// the blob length, or -1 when the group is not enrolled / attached /
-// capturable — the caller then falls back to the eject path.
+// [uvarint sess_len][sess bytes] at exactly applied_handed.
+// Consistency protocol: the capturing flag is set under g->mu, then the
+// image serializes OFF the lock while emit_apply defers (applies are the
+// only SM/session writers) and natr_eject waits on capture_cv — so no
+// write can land mid-image, yet replication/heartbeats/commit tallying
+// keep running (the reference's regular-SM saves block only the update
+// lock, never the raft plane; internal/rsm/statemachine.go:552-814).
+// Any new SM writer MUST either run through emit_apply or check
+// g->capturing.  Returns the blob length, or -1 when the group is not
+// enrolled / attached / capturable — the caller falls back to the eject
+// path.
 long long natr_capture_sm(void* h, uint64_t cid, uint8_t** out) {
   Engine* e = (Engine*)h;
   std::shared_ptr<Group> sp = e->find(cid);
