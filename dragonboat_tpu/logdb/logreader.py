@@ -155,7 +155,10 @@ class LogReader:
                 self.length += last - cur_last
 
     def compact(self, index: int) -> None:
-        """Move the marker forward (reference ``logreader.go`` ``Compact``)."""
+        """Move the marker forward (reference ``logreader.go:273``
+        ``Compact``; strict ``<`` — compacting AT the marker is a no-op
+        success, matching the real LogReader rather than the etcd test
+        double, whose table treats it as already-compacted)."""
         with self._mu:
             if index < self.marker:
                 raise CompactedError()

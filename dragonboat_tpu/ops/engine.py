@@ -743,13 +743,16 @@ class BatchedQuorumEngine:
         gi = self.groups[cluster_id]
         return int(gi.base) + int(self._read("committed", gi.row))
 
-    def committed_snapshot(self) -> Dict[int, int]:
-        """Every registered group's absolute committed index from AT MOST
-        one device→host transfer.  ``committed_index`` costs a readback
-        per call — prohibitive over a tunneled backend (~67ms RTT each);
-        scale probes (bench rungs 4/5) read the whole vector once per
-        round and index it host-side.  Right after ``step()`` the egress
-        cache is fresh and the probe is zero-transfer."""
+    def committed_snapshot(self, cids=None) -> Dict[int, int]:
+        """Absolute committed indexes for ``cids`` (default: every
+        registered group) from AT MOST one device→host transfer.
+        ``committed_index`` costs a readback per call — prohibitive over
+        a tunneled backend (~67ms RTT each); scale probes (bench rungs
+        4/5) sample through this instead.  Right after ``step()`` the
+        egress cache is fresh and the call is zero-transfer — it indexes
+        the vector the device produced for that round's egress.  Pass
+        ``cids`` when sampling: building the full dict for 100k groups
+        costs ~100k boxed ints per call."""
         if self._cache_stale:
             self._committed_cache = np.array(
                 np.asarray(self.dev.committed), dtype=np.int32
@@ -758,10 +761,15 @@ class BatchedQuorumEngine:
         committed = self._committed_cache
         mirror = self.mirror.arrays["committed"]
         dirty = self._dirty
+        items = (
+            self.groups.items()
+            if cids is None
+            else ((cid, self.groups[cid]) for cid in cids)
+        )
         return {
             cid: int(gi.base)
             + int(mirror[gi.row] if gi.row in dirty else committed[gi.row])
-            for cid, gi in self.groups.items()
+            for cid, gi in items
         }
 
     def peer_match(self, cluster_id: int, node_id: int) -> int:
