@@ -1191,6 +1191,12 @@ def run_quick() -> dict:
             durable=durable,
             threads=threads,
             procs=procs,
+            # honor an explicit placement request (E2E_LEADER_MODE=rank0
+            # for the concentrated topology); "" keeps run_mp's policy
+            # default — without this passthrough the orchestrator
+            # silently overwrote the caller's env with "spread"
+            leader_mode=os.environ.get("E2E_LEADER_MODE", ""),
+            leader_timeout=float(os.environ.get("E2E_LEADER_TIMEOUT", "180")),
             deadline_s=deadline,
         )
     return run(
@@ -1201,6 +1207,7 @@ def run_quick() -> dict:
         engine=engine,
         durable=durable,
         threads=threads,
+        leader_timeout=float(os.environ.get("E2E_LEADER_TIMEOUT", "180")),
     )
 
 

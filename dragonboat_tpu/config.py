@@ -84,15 +84,18 @@ class ExpertConfig:
         fits the commit-latency budget (a tunneled backend's ~70ms round
         trip does not; a local device's ~0.2ms does).
 
-        Placement note (measured r5): ``auto``'s fast-lane preference is
-        a SPREAD-placement policy.  When leadership concentrates on the
-        engine's host (the ``rank0`` topology — all commit tallying on
-        one rank), the device engine beats scalar+fastlane end-to-end
-        (+21% writes / +62% mixed ops at 2,048 groups, +37% writes at
-        512 on a 1-vCPU box): the per-group Python tally that grows
-        linearly is one fused ~1ms device dispatch.  Auto cannot see
-        future leader placement, so concentrated deployments should set
-        ``"tpu"`` explicitly.
+        Scale note (measured r5, spread placement, native SM): the
+        device engine overtakes scalar+fastlane as group count grows —
+        at 2,048 groups ``tpu`` wins repeatedly (+8-21% writes, +7-62%
+        mixed ops, enrollment duty 1.0 on a 1-vCPU box; +37% writes at
+        512 groups), while at 1,024 scalar holds a ~10% edge.  The
+        crossover is where per-group scalar tick/tally work (linear in
+        groups) outgrows the engine's fused ~1ms dispatch.  Deployments
+        with thousands of groups per host should set ``"tpu"``
+        explicitly; concentrated single-leader-host (rank0) topologies
+        measured the other way (scalar 13.3k vs tpu 8.1k at 2,048) —
+        there every proposal already funnels through one process and
+        the dispatches compete with its GIL.
     """
 
     quorum_engine: str = "scalar"
