@@ -561,15 +561,14 @@ def main() -> None:
         _note(f"e2e_tpu: {json.dumps(detail['e2e_tpu'])[:300]}")
         # scale rung (VERDICT r4 next #1): engine A/B at IDENTICAL
         # placement, 2,048 groups, leaders SPREAD (the production
-        # shape).  This is where the device engine wins end-to-end on a
-        # 1-vCPU box: tpu 10.1-10.7k w/s / mixed 7.8-9.2k ops/s vs
-        # scalar 8.4-9.9k / 4.8-8.6k across repeated pairs (+8-21%
-        # writes), duty 1.0, all 2,048 elected; +37% writes at 512
-        # groups.  (The concentrated rank0 variant measures the OTHER
-        # way — scalar 13.3k vs tpu 8.1k — there every proposal already
-        # funnels through one process and the engine's dispatches
-        # compete with its GIL.)  2,048 keeps setup inside the section
-        # budget on small boxes; override with BENCH_SCALE_GROUPS.
+        # shape).  Round-5 full dataset on a 1-vCPU box: tpu ~8.8k
+        # ± 1.9k w/s over six runs vs scalar ~9.9k ± 1.0k over four —
+        # parity within noise (r4 measured a 4x deficit), with the tpu
+        # spread wide because every dispatch competes with the box's
+        # single host core (PERF.md round-5 §3).  The rung keeps the
+        # comparison honest run over run; single pairs on a small box
+        # are weather.  2,048 keeps setup inside the section budget;
+        # override with BENCH_SCALE_GROUPS.
         if os.environ.get("BENCH_SKIP_SCALE") != "1":
             scale_groups = os.environ.get("BENCH_SCALE_GROUPS", "2048")
             scale_env = {

@@ -84,18 +84,18 @@ class ExpertConfig:
         fits the commit-latency budget (a tunneled backend's ~70ms round
         trip does not; a local device's ~0.2ms does).
 
-        Scale note (measured r5, spread placement, native SM): the
-        device engine overtakes scalar+fastlane as group count grows —
-        at 2,048 groups ``tpu`` wins repeatedly (+8-21% writes, +7-62%
-        mixed ops, enrollment duty 1.0 on a 1-vCPU box; +37% writes at
-        512 groups), while at 1,024 scalar holds a ~10% edge.  The
-        crossover is where per-group scalar tick/tally work (linear in
-        groups) outgrows the engine's fused ~1ms dispatch.  Deployments
-        with thousands of groups per host should set ``"tpu"``
-        explicitly; concentrated single-leader-host (rank0) topologies
-        measured the other way (scalar 13.3k vs tpu 8.1k at 2,048) —
-        there every proposal already funnels through one process and
-        the dispatches compete with its GIL.
+        Scale note (measured r5, spread placement, native SM, 1-vCPU
+        box): the round-4 4x deficit at identical placement closed to
+        parity-within-noise at 2,048 groups (tpu ~8.8k ± 1.9k w/s over
+        six runs vs scalar ~9.9k ± 1.0k over four; scalar wins ~10% at
+        1,024 consistently).  The tpu configuration's wide variance is
+        host-core contention: each dispatch (and the jax runtime's
+        threads) competes with the NodeHost processes when the box has
+        few cores.  ``tpu`` earns its keep with spare host cores for
+        the dispatch thread, a co-located (non-tunneled) device, or
+        group counts far past the per-group-Python crossover — measure
+        with bench.py's scale rung on the target topology before
+        switching (PERF.md round-5 §3).
     """
 
     quorum_engine: str = "scalar"
