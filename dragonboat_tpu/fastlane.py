@@ -356,8 +356,15 @@ class FastLaneManager:
             with self.apply_gate:
                 # drain spans the pump has not yet taken (ours and others' —
                 # delivering other groups' spans here is harmless and keeps
-                # the gate hold short)
-                self._drain_applies_locked()
+                # the gate hold short).  ConnectionError = the engine
+                # stopped (NodeHost shutdown): proceed best-effort — the
+                # process is exiting and restart replays from disk; an
+                # escaped exception here would instead kill the event
+                # pump and strand the node half-ejected
+                try:
+                    self._drain_applies_locked()
+                except ConnectionError:
+                    pass
                 # claim whatever the drain touched: the pump only swaps
                 # _touched after wait_apply reports a NEW span, so without
                 # this, a quiescent system would leave those groups'
@@ -370,7 +377,10 @@ class FastLaneManager:
                 # — and only AFTER nat.eject, which finalizes the group:
                 # draining a still-ACTIVE group would race further native
                 # applies queued behind the drain
-                self._drain_completions()
+                try:
+                    self._drain_completions()
+                except ConnectionError:
+                    pass  # engine stopped mid-eject (see drain above)
                 with self._nodes_mu:
                     self._nodes.pop(node.cluster_id, None)
                 if st is not None:
@@ -437,7 +447,10 @@ class FastLaneManager:
             except ConnectionError:
                 return
             with self.apply_gate:
-                self._drain_applies_locked()
+                try:
+                    self._drain_applies_locked()
+                except ConnectionError:
+                    return  # engine stopped between wait_apply and drain
                 touched, self._touched = self._touched, []
             # applies run OUTSIDE the gate: handle_apply_tasks takes
             # raftMu, and fast_eject holds raftMu while taking the gate —
