@@ -12,6 +12,8 @@ from .nodehost import ClusterInfo, NodeHost, NodeHostInfo  # noqa: F401
 from .requests import (  # noqa: F401
     ClusterAlreadyExistError,
     ClusterNotFoundError,
+    InvalidOperationError,
+    PayloadTooBigError,
     RejectedError,
     RequestError,
     RequestResult,

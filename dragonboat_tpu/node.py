@@ -20,6 +20,8 @@ from .queue import EntryQueue
 from .quiesce import QuiesceManager
 from .requests import (
     ClusterClosedError,
+    InvalidOperationError,
+    PayloadTooBigError,
     PendingConfigChange,
     PendingLeaderTransfer,
     PendingProposal,
@@ -317,9 +319,25 @@ class Node:
         ticks = int(timeout_s * 1000 / self.tick_millisecond)
         return max(ticks, 1)
 
+    # non-cmd entry fields bound (reference settings.EntryNonCmdFieldsSize:
+    # 16 u64 fields) used by the payload-size guard
+    _ENTRY_NON_CMD_FIELDS_SIZE = 16 * 8
+
+    def _check_user_op(self, payload_len: int = 0) -> None:
+        """Witness replicas serve NO user operations (reference
+        ``ErrInvalidOperation``, node.go:352-442), and a payload that
+        cannot fit ``max_in_mem_log_size`` can never be appended
+        (``ErrPayloadTooBig``, node.go:363-367)."""
+        if self.config.is_witness:
+            raise InvalidOperationError()
+        limit = self.config.max_in_mem_log_size
+        if limit and payload_len + self._ENTRY_NON_CMD_FIELDS_SIZE > limit:
+            raise PayloadTooBigError()
+
     def propose(
         self, session: Session, cmd: bytes, timeout_s: float
     ) -> RequestState:
+        self._check_user_op(len(cmd))
         # non-empty commands are stored as ENCODED entries: 1-byte
         # version/compression header (+ snappy when configured) — reference
         # requests.go:1038-1042 + rsm/encoded.go
@@ -358,7 +376,11 @@ class Node:
         fast lane, appending the whole burst under one lock.  Pipelined
         clients (and the e2e benchmark) refill their windows through this;
         the per-request propose path is a first-order cost once replication
-        itself is native."""
+        itself is native.  One deviation from the N-calls equivalence:
+        the witness/payload precheck is atomic over the whole batch — one
+        oversized command rejects the batch up front (nothing partial is
+        enqueued), where N calls would submit the small ones first."""
+        self._check_user_op(max((len(c) for c in cmds), default=0))
         if not cmds:
             return []
         entry_type = EntryType.APPLICATION
@@ -400,6 +422,7 @@ class Node:
         return states
 
     def propose_session(self, session: Session, timeout_s: float) -> RequestState:
+        self._check_user_op()
         rs, entry = self.pending_proposals.propose(
             session.client_id, session.series_id, b"",
             self._timeout_ticks(timeout_s),
@@ -425,6 +448,7 @@ class Node:
         return rs
 
     def read(self, timeout_s: float) -> RequestState:
+        self._check_user_op()
         rs = self.pending_reads.read(self._timeout_ticks(timeout_s))
         fl = self.fastlane
         if self.fast_lane and fl is not None:
@@ -456,6 +480,7 @@ class Node:
     def request_config_change(
         self, cc: ConfigChange, timeout_s: float
     ) -> RequestState:
+        self._check_user_op()
         if self.fast_lane:
             self.fast_eject()
         rs = self.pending_config_change.request(
@@ -465,6 +490,7 @@ class Node:
         return rs
 
     def request_snapshot(self, req: SSRequest, timeout_s: float) -> RequestState:
+        self._check_user_op()
         if self.fast_lane:
             self.fast_eject()
         rs = self.pending_snapshot.request(req, self._timeout_ticks(timeout_s))
@@ -472,6 +498,7 @@ class Node:
         return rs
 
     def request_leader_transfer(self, target: int, timeout_s: float) -> RequestState:
+        self._check_user_op()
         if self.fast_lane:
             self.fast_eject()
         rs = self.pending_leader_transfer.request(
@@ -481,6 +508,11 @@ class Node:
         return rs
 
     def stale_read(self, query):
+        # a witness SM never applies payloads — a lookup would return a
+        # silently empty answer for keys committed cluster-wide
+        # (reference StaleRead: ErrInvalidOperation on a witness)
+        if self.config.is_witness:
+            raise InvalidOperationError()
         return self.sm.lookup(query)
 
     # ---- inbound messages ----

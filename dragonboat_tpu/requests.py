@@ -61,6 +61,19 @@ class RejectedError(RequestError):
     pass
 
 
+class InvalidOperationError(RequestError):
+    """The request is not valid on this replica type — e.g. any
+    proposal/read/config-change/snapshot/transfer on a WITNESS replica
+    (reference ``ErrInvalidOperation``, node.go:352-442: witnesses vote
+    and persist metadata but never serve user operations)."""
+
+
+class PayloadTooBigError(RequestError):
+    """Entry payload exceeds ``Config.max_in_mem_log_size`` (reference
+    ``ErrPayloadTooBig``, node.go:363-367: an entry that cannot fit the
+    in-memory log bound can never be appended)."""
+
+
 class PendingConfigChangeExistError(RequestError):
     pass
 

@@ -281,5 +281,87 @@ def test_sigstop_resume_without_contact_loss_ejects(tmp_path):
                 h.proc.kill()
 
 
+def test_dead_leader_still_detected_despite_compensation(tmp_path):
+    """The complement guard: stall compensation must never mask a
+    GENUINE failure.  Here the host holding every leader freezes for far
+    longer than the eject window while its followers keep running — the
+    followers' clocks are healthy (no local stall to compensate), so
+    contact-loss MUST fire, the groups must eject to scalar raft, and a
+    new leader on a live host must accept writes while the old one is
+    still frozen."""
+    addrs = ",".join(f"127.0.0.1:{p}" for p in _ports(3))
+    hosts = []
+    try:
+        for i in range(3):
+            env = dict(os.environ)
+            env.update(
+                STALL_RANK=str(i), STALL_ADDRS=addrs,
+                STALL_DIR=str(tmp_path),
+                PYTHONPATH=os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                JAX_PLATFORMS="cpu",
+            )
+            hosts.append(_Host(i, env))
+        for h in hosts:
+            h.expect("READY", 120)
+        # host 0 campaigns every group: it leads all of them
+        hosts[0].send("CAMPAIGN")
+        hosts[0].expect("CAMPAIGNED")
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            n = 0
+            for h in hosts:
+                h.send("ENROLLED")
+                n += h.expect("ENROLLED")["n"]
+            if n == 3 * CID_COUNT:
+                break
+            time.sleep(0.3)
+        else:
+            raise AssertionError("groups never fully enrolled")
+        hosts[0].send("WRITE 1")
+        assert hosts[0].expect("WROTE")["done"] >= 1
+
+        # ---- freeze the LEADER host; followers stay healthy ----
+        hosts[0].proc.send_signal(signal.SIGSTOP)
+        try:
+            # new leaders must emerge on the live hosts and accept writes
+            deadline = time.time() + 90
+            j = 1
+            done = 0
+            while time.time() < deadline and not done:
+                j += 1
+                for h in hosts[1:]:
+                    h.send(f"WRITE {j}")
+                    done += h.expect("WROTE", 30)["done"]
+                time.sleep(0.2)
+            assert done >= 1, "no live-host leader emerged while the " \
+                "leader host was frozen"
+            # ...and the genuine-failure detector is what fired
+            fired = 0
+            for h in hosts[1:]:
+                h.send("STATS")
+                st = h.expect("STATS")
+                fired += st["eject_reasons"].get("contact-lost", 0)
+            assert fired >= 1, "failover happened without a contact-loss " \
+                "eject — compensation may be masking real failures"
+        finally:
+            hosts[0].proc.send_signal(signal.SIGCONT)
+    finally:
+        for h in hosts:
+            try:
+                h.proc.send_signal(signal.SIGCONT)
+            except Exception:
+                pass
+            try:
+                h.send("EXIT")
+            except Exception:
+                pass
+        for h in hosts:
+            try:
+                h.proc.wait(timeout=20)
+            except Exception:
+                h.proc.kill()
+
+
 if __name__ == "__main__" and "--rank" in sys.argv:
     sys.exit(_rank_main())

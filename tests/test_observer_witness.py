@@ -209,3 +209,117 @@ def test_witness_votes_but_stores_no_payloads():
     finally:
         for nh in nhs.values():
             nh.stop()
+
+
+def test_user_operations_on_witness_are_rejected():
+    """Reference node.go:352-442 (ErrInvalidOperation) — a witness
+    replica serves NO user operations: proposals (plain, batch and
+    session ops), reads, config changes, snapshot requests and leader
+    transfers are all rejected locally, before anything is enqueued.
+    Ports TestConfigChangeOnWitnessWillBeRejected / ReadOnWitness /
+    MakingProposalOnWitnessNode / ProposingSessionOnWitnessNode /
+    RequestingSnapshotOnWitness (node_test.go)."""
+    from dragonboat_tpu import InvalidOperationError
+    from dragonboat_tpu.rsm import SSReqType, SSRequest
+
+    router = ChanRouter()
+    addrs = {i: f"wr{i}:1" for i in (1, 2, 3)}
+    sms = {}
+    nhs = {
+        1: _mk(1, router, sms, addrs, (1, 2)),
+        2: _mk(2, router, sms, addrs, (1, 2)),
+    }
+    try:
+        nhs[1].get_node(CID).request_campaign()
+        lid, leader = _leader({1: nhs[1], 2: nhs[2]})
+        assert _propose_ok(leader, b"pre=w")
+        leader.sync_request_add_witness(CID, 3, addrs[3], timeout=10.0)
+        nhs[3] = _mk(3, router, sms, addrs, (1, 2), is_witness=True)
+        wnode = nhs[3].get_node(CID)
+        deadline = time.time() + 20
+        while time.time() < deadline and not wnode.peer.raft.is_witness():
+            time.sleep(0.1)
+        assert wnode.peer.raft.is_witness()
+
+        s = nhs[3].get_noop_session(CID)
+        with pytest.raises(InvalidOperationError):
+            nhs[3].propose(s, b"k=v", timeout=5.0)
+        with pytest.raises(InvalidOperationError):
+            wnode.propose_batch(s, [b"k=v"], 5.0)
+        with pytest.raises(InvalidOperationError):
+            wnode.propose_session(s, 5.0)
+        with pytest.raises(InvalidOperationError):
+            nhs[3].sync_read(CID, "pre", timeout=5.0)
+        with pytest.raises(InvalidOperationError):
+            nhs[3].request_add_node(CID, 9, "wr9:1", timeout=5.0)
+        with pytest.raises(InvalidOperationError):
+            wnode.request_snapshot(
+                SSRequest(type=SSReqType.USER_REQUESTED), 5.0
+            )
+        with pytest.raises(InvalidOperationError):
+            wnode.request_leader_transfer(1, 5.0)
+        # the full replicas still serve everything
+        assert _propose_ok(leader, b"post=w")
+    finally:
+        for nh in nhs.values():
+            nh.stop()
+
+
+def test_payload_too_big_rejected():
+    """Reference node.go:363-381 (ErrPayloadTooBig): with
+    max_in_mem_log_size configured, an oversized payload is rejected
+    before it is enqueued; a small one passes."""
+    from dragonboat_tpu import PayloadTooBigError
+
+    router = ChanRouter()
+    addrs = {1: "pb1:1"}
+    sms = {}
+    nh = _mk(1, router, sms, addrs, (1,), max_in_mem_log_size=64 * 1024)
+    try:
+        nh.get_node(CID).request_campaign()
+        _leader({1: nh})
+        s = nh.get_noop_session(CID)
+        assert _propose_ok(nh, b"small=ok")
+        with pytest.raises(PayloadTooBigError):
+            nh.propose(s, b"x" * (64 * 1024), timeout=5.0)
+        node = nh.get_node(CID)
+        with pytest.raises(PayloadTooBigError):
+            node.propose_batch(s, [b"ok", b"y" * (64 * 1024)], 5.0)
+    finally:
+        nh.stop()
+
+
+def test_stale_read_on_witness_rejected():
+    """A witness's SM never applies payloads, so even the relaxed
+    stale-read path must refuse (reference StaleRead:
+    ErrInvalidOperation) rather than answer from permanently empty
+    state."""
+    from dragonboat_tpu import InvalidOperationError
+
+    router = ChanRouter()
+    addrs = {i: f"sr{i}:1" for i in (1, 2, 3)}
+    sms = {}
+    nhs = {
+        1: _mk(1, router, sms, addrs, (1, 2)),
+        2: _mk(2, router, sms, addrs, (1, 2)),
+    }
+    try:
+        nhs[1].get_node(CID).request_campaign()
+        lid, leader = _leader({1: nhs[1], 2: nhs[2]})
+        assert _propose_ok(leader, b"sk=sv")
+        leader.sync_request_add_witness(CID, 3, addrs[3], timeout=10.0)
+        nhs[3] = _mk(3, router, sms, addrs, (1, 2), is_witness=True)
+        wnode = nhs[3].get_node(CID)
+        deadline = time.time() + 20
+        while time.time() < deadline and not wnode.peer.raft.is_witness():
+            time.sleep(0.1)
+        with pytest.raises(InvalidOperationError):
+            nhs[3].stale_read(CID, "sk")
+        # the full replicas still serve stale reads
+        deadline = time.time() + 10
+        while time.time() < deadline and leader.stale_read(CID, "sk") != "sv":
+            time.sleep(0.05)
+        assert leader.stale_read(CID, "sk") == "sv"
+    finally:
+        for nh in nhs.values():
+            nh.stop()
