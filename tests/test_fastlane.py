@@ -115,7 +115,7 @@ def _leader(nhs, timeout=30.0):
     raise TimeoutError("no leader")
 
 
-def _wait_enrolled(nh, timeout=15.0, want=True):
+def _wait_enrolled(nh, timeout=45.0, want=True):
     node = nh.get_node(CID)
     deadline = time.time() + timeout
     while time.time() < deadline:
@@ -378,7 +378,7 @@ def test_observer_group_enrolls_and_replicates(tmp_path):
     try:
         lid, leader = _leader(nhs)
         _propose_all(leader, [b"a", b"b"])
-        leader.sync_request_add_observer(CID, 4, addrs[4], timeout=10.0)
+        leader.sync_request_add_observer(CID, 4, addrs[4], timeout=30.0)
         nhs[4] = _mk(4, addrs, tmp_path, sms, join=True, is_observer=True)
         # the config change ejected; the group must RE-enroll with the
         # observer present (the old eligibility refused observer-bearing
@@ -426,7 +426,7 @@ def test_witness_group_enrolls_and_witness_ack_commits(tmp_path):
     try:
         lid, leader = _leader(nhs)
         _propose_all(leader, [b"pre"])
-        leader.sync_request_add_witness(CID, 3, addrs[3], timeout=10.0)
+        leader.sync_request_add_witness(CID, 3, addrs[3], timeout=30.0)
         nhs[3] = _mk(3, addrs, tmp_path, sms, join=True, is_witness=True)
         deadline = time.time() + 20
         while time.time() < deadline:

@@ -95,10 +95,10 @@ def test_tpu_engine_propose_and_read():
         assert nhs[0].quorum_coordinator is not None
         s = nhs[0].get_noop_session(CID)
         for i in range(20):
-            r = nhs[0].sync_propose(s, f"k{i}=v{i}".encode(), timeout=5.0)
+            r = nhs[0].sync_propose(s, f"k{i}=v{i}".encode(), timeout=30.0)
             assert r.value == i + 1
         for i in range(20):
-            assert nhs[0].sync_read(CID, f"k{i}", timeout=5.0) == f"v{i}"
+            assert nhs[0].sync_read(CID, f"k{i}", timeout=30.0) == f"v{i}"
         # the engine actually owns the group rows
         eng = nhs[0].quorum_coordinator.eng
         assert CID in eng.groups
@@ -118,8 +118,8 @@ def test_tpu_engine_single_replica():
         _wait_leader([nh], CID)
         s = nh.get_noop_session(CID)
         for i in range(5):
-            nh.sync_propose(s, f"a{i}=1".encode(), timeout=5.0)
-        assert nh.sync_read(CID, "a4", timeout=5.0) == "1"
+            nh.sync_propose(s, f"a{i}=1".encode(), timeout=30.0)
+        assert nh.sync_read(CID, "a4", timeout=30.0) == "1"
     finally:
         nh.stop()
 
@@ -156,7 +156,7 @@ def test_tpu_engine_leader_failover():
             except Exception:
                 time.sleep(0.2)
         assert committed
-        assert survivors[0].sync_read(CID, "after", timeout=5.0) == "failover"
+        assert survivors[0].sync_read(CID, "after", timeout=30.0) == "failover"
     finally:
         for nh in nhs:
             nh.stop()
@@ -170,25 +170,25 @@ def test_tpu_engine_membership_change():
     nh4 = _mk_nh("mc4:1", router, "tpu")
     try:
         _wait_leader(nhs, CID)
-        nhs[0].sync_request_add_node(CID, 4, "mc4:1", timeout=10.0)
+        nhs[0].sync_request_add_node(CID, 4, "mc4:1", timeout=60.0)
         nh4.start_cluster(
             {}, True, KVSM,
             Config(cluster_id=CID, node_id=4, election_rtt=10, heartbeat_rtt=1),
         )
         s = nhs[0].get_noop_session(CID)
         for i in range(5):
-            nhs[0].sync_propose(s, f"m{i}=1".encode(), timeout=5.0)
+            nhs[0].sync_propose(s, f"m{i}=1".encode(), timeout=30.0)
         deadline = time.time() + 10
         while time.time() < deadline:
-            m = nhs[0].sync_get_cluster_membership(CID, timeout=5.0)
+            m = nhs[0].sync_get_cluster_membership(CID, timeout=30.0)
             if 4 in m.addresses:
                 break
             time.sleep(0.1)
         assert 4 in m.addresses
-        nhs[0].sync_request_delete_node(CID, 4, timeout=10.0)
+        nhs[0].sync_request_delete_node(CID, 4, timeout=60.0)
         for i in range(5):
-            nhs[0].sync_propose(s, f"n{i}=1".encode(), timeout=5.0)
-        m = nhs[0].sync_get_cluster_membership(CID, timeout=5.0)
+            nhs[0].sync_propose(s, f"n{i}=1".encode(), timeout=30.0)
+        m = nhs[0].sync_get_cluster_membership(CID, timeout=30.0)
         assert 4 not in m.addresses
     finally:
         for nh in nhs + [nh4]:
@@ -207,10 +207,10 @@ def test_scalar_vs_tpu_differential():
             s = nhs[0].get_noop_session(CID)
             vals = []
             for i in range(30):
-                r = nhs[0].sync_propose(s, f"k{i % 7}=v{i}".encode(), 5.0)
+                r = nhs[0].sync_propose(s, f"k{i % 7}=v{i}".encode(), 30.0)
                 vals.append(r.value)
             reads = [
-                nhs[0].sync_read(CID, f"k{j}", timeout=5.0) for j in range(7)
+                nhs[0].sync_read(CID, f"k{j}", timeout=30.0) for j in range(7)
             ]
             results[engine] = (vals, reads)
         finally:
@@ -242,8 +242,8 @@ def test_tpu_engine_snapshot_and_restart(tmp_path):
         _wait_leader([nh], CID)
         s = nh.get_noop_session(CID)
         for i in range(8):
-            nh.sync_propose(s, f"k{i}=v{i}".encode(), timeout=5.0)
-        assert nh.sync_request_snapshot(CID, timeout=5.0) > 0
+            nh.sync_propose(s, f"k{i}=v{i}".encode(), timeout=30.0)
+        assert nh.sync_request_snapshot(CID, timeout=30.0) > 0
     finally:
         nh.stop()
     nh2 = NodeHost(
@@ -264,9 +264,9 @@ def test_tpu_engine_snapshot_and_restart(tmp_path):
         )
         _wait_leader([nh2], CID)
         for i in range(8):
-            assert nh2.sync_read(CID, f"k{i}", timeout=5.0) == f"v{i}"
+            assert nh2.sync_read(CID, f"k{i}", timeout=30.0) == f"v{i}"
         s = nh2.get_noop_session(CID)
-        nh2.sync_propose(s, b"post=restart", timeout=5.0)
-        assert nh2.sync_read(CID, "post", timeout=5.0) == "restart"
+        nh2.sync_propose(s, b"post=restart", timeout=30.0)
+        assert nh2.sync_read(CID, "post", timeout=30.0) == "restart"
     finally:
         nh2.stop()
