@@ -65,5 +65,10 @@ soak-native-smoke: native
 bench: native
 	$(PY) bench.py
 
+# per-subsystem micro-benchmarks (reference `make benchmark`,
+# benchmark_test.go families)
+bench-micro: native
+	$(PY) bench_micro.py
+
 dryrun:
 	$(PY) __graft_entry__.py

@@ -1,0 +1,326 @@
+"""Micro-benchmark suite — the reference ``benchmark_test.go`` analog.
+
+Per-subsystem throughput probes for the hot host-path pieces, mirroring
+the reference's families (``/root/reference/benchmark_test.go:54-641``):
+payload encoding (plain + snappy), entry queue, pending-proposal key
+allocation, entry marshal/unmarshal (Python and the C accelerator),
+LogDB SaveRaftState at 16/128/1024B, fsync latency, transport framing,
+SM step through the RSM manager, and the native-KV update path.
+
+Run:  python bench_micro.py            (all sections, one JSON line each)
+      python bench_micro.py entry_q    (substring-filter sections)
+
+Numbers are ops/s on the current box; they exist for regression
+comparison run-over-run, not cross-machine comparison (the e2e story
+lives in bench.py / PERF.md).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def _rate(fn, n, *, min_s=0.4):
+    """ops/s for fn(i) called n times (repeats until min_s elapsed)."""
+    reps = 0
+    t0 = time.perf_counter()
+    while True:
+        for i in range(n):
+            fn(i)
+        reps += n
+        dt = time.perf_counter() - t0
+        if dt >= min_s:
+            return round(reps / dt, 1)
+
+
+def bench_encoded_payload():
+    """BenchmarkNoCompression/SnappyEncodedPayload{16,512,4096}Bytes."""
+    from dragonboat_tpu.rsm.encoded import get_encoded_payload
+
+    out = {}
+    for size in (16, 512, 4096):
+        data = os.urandom(size)
+        out[f"plain_{size}B"] = _rate(
+            lambda i, d=data: get_encoded_payload(0, d), 20_000
+        )
+        out[f"snappy_{size}B"] = _rate(
+            lambda i, d=data: get_encoded_payload(1, d), 2_000
+        )
+    return out
+
+
+def bench_entry_queue():
+    """BenchmarkAddToEntryQueue: the propose-side staging queue."""
+    from dragonboat_tpu.queue import EntryQueue
+    from dragonboat_tpu.wire import Entry
+
+    q = EntryQueue(1 << 16)
+    e = Entry(term=1, index=1, cmd=b"x" * 16)
+
+    def add(i):
+        if not q.add(e):
+            q.get()  # drain when full (amortized)
+
+    return {"add": _rate(add, 50_000)}
+
+
+def bench_pending_proposal_key():
+    """BenchmarkPendingProposalNextKey + Propose{16,128,1024} through the
+    sharded pending-proposal store (no raft underneath — the tracking
+    cost itself)."""
+    from dragonboat_tpu.requests import PendingProposal
+
+    pp = PendingProposal()
+    out = {"next_key": _rate(lambda i: pp._next_key(), 100_000)}
+    for size in (16, 128, 1024):
+        cmd = b"x" * size
+
+        def prop(i, c=cmd):
+            rs, e = pp.propose(0, 0, c, 100)
+            pp.dropped(e.key)
+
+        out[f"propose_{size}B"] = _rate(prop, 20_000)
+    return out
+
+
+def bench_marshal_entry():
+    """BenchmarkMarshalEntry{16,128,1024}: wire codec, Python and the C
+    accelerator (dbtpu_wirecodec)."""
+    from dragonboat_tpu.wire import Entry
+    from dragonboat_tpu.wire import codec
+
+    out = {}
+    for size in (16, 128, 1024):
+        e = Entry(term=5, index=42, key=7, client_id=1, series_id=2,
+                  cmd=b"x" * size)
+        buf = bytearray()
+        codec.encode_entry_into(buf, e)
+        blob = bytes(buf)
+
+        def enc(i, ent=e):
+            ent._enc = None  # defeat the wire cache: measure marshaling
+            b = bytearray()
+            codec.encode_entry_into(b, ent)
+
+        out[f"encode_{size}B"] = _rate(enc, 20_000)
+        out[f"decode_{size}B"] = _rate(
+            lambda i, bl=blob: codec.decode_entry(bl), 20_000
+        )
+    return out
+
+
+def bench_logdb_save(durable: bool):
+    """BenchmarkSaveRaftState{16,128,1024}: one Update with 128 entries
+    per call through the real LogDB (in-mem KV, or the durable WAL with
+    fsync when durable=True — the fsync variant is the
+    BenchmarkFSyncLatency analog)."""
+    from dragonboat_tpu.logdb import open_logdb
+    from dragonboat_tpu.wire import Entry, State, Update
+
+    tmp = None
+    if durable:
+        tmp = tempfile.mkdtemp(prefix="dbtpu-microbench-")
+        db = open_logdb(tmp, shards=1, fsync=True)
+    else:
+        db = open_logdb(shards=1)
+    out = {}
+    try:
+        for size in (16, 128, 1024):
+            seq = [0]
+
+            def save(i, s=size, q=seq):
+                lo = q[0] * 128 + 1
+                q[0] += 1
+                ents = [
+                    Entry(term=1, index=lo + j, cmd=b"x" * s)
+                    for j in range(128)
+                ]
+                db.save_raft_state([
+                    Update(cluster_id=1, node_id=1, entries_to_save=ents,
+                           state=State(term=1, vote=1, commit=lo))
+                ])
+
+            key = f"save128x{size}B"
+            # entries/s, not calls/s: each call persists 128 entries
+            out[key] = round(_rate(save, 8 if durable else 64) * 128, 1)
+    finally:
+        db.close()
+        if tmp:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def bench_fsync():
+    """BenchmarkFSyncLatency: the raw device floor under this repo's WAL
+    (one small durable append per call)."""
+    from dragonboat_tpu.logdb import open_logdb
+    from dragonboat_tpu.wire import Entry, Update
+
+    tmp = tempfile.mkdtemp(prefix="dbtpu-fsync-")
+    db = open_logdb(tmp, shards=1, fsync=True)
+    try:
+        lat = []
+
+        def one(i):
+            t0 = time.perf_counter()
+            db.save_raft_state([
+                Update(cluster_id=1, node_id=1,
+                       entries_to_save=[Entry(term=1, index=i + 1, cmd=b"x")])
+            ])
+            lat.append(time.perf_counter() - t0)
+
+        _rate(one, 8, min_s=1.0)
+        lat.sort()
+        return {
+            "p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+            "p99_ms": round(lat[int(len(lat) * 0.99)] * 1e3, 3),
+            "ops_s": round(len(lat) / sum(lat), 1),
+        }
+    finally:
+        db.close()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_transport_framing():
+    """BenchmarkTransport{16,128,1024} stand-in at the framing layer: the
+    message-batch encode/decode that every wire byte passes through (the
+    socket itself is measured by the e2e bench)."""
+    from dragonboat_tpu.wire import Entry, Message, MessageBatch, MessageType
+    from dragonboat_tpu.wire.codec import (
+        decode_message_batch as decode_batch,
+        encode_message_batch as encode_batch,
+    )
+
+    out = {}
+    for size in (16, 128, 1024):
+        batch = MessageBatch(
+            source_address="127.0.0.1:1", deployment_id=1,
+            requests=[
+                Message(
+                    type=MessageType.REPLICATE, cluster_id=1, from_=1, to=2,
+                    term=3, log_index=7, log_term=3,
+                    entries=[Entry(term=3, index=8 + j, cmd=b"x" * size)
+                             for j in range(8)],
+                )
+            ],
+        )
+        blob = encode_batch(batch)
+
+        def enc(i, b=batch):
+            for m in b.requests:  # defeat the per-entry wire cache
+                for e in m.entries:
+                    e._enc = None
+            encode_batch(b)
+
+        out[f"encode_8x{size}B"] = _rate(enc, 5_000)
+        out[f"decode_8x{size}B"] = _rate(
+            lambda i, bl=blob: decode_batch(bl), 5_000
+        )
+    return out
+
+
+def bench_sm_step():
+    """BenchmarkStateMachineStepNoOPSession16 analog: committed entries
+    through the RSM manager's batch apply (noop session, 16B cmds) —
+    the per-entry apply rim PERF.md itemizes."""
+    from dragonboat_tpu.rsm.statemachine import StateMachine, Task
+    from dragonboat_tpu.rsm.adapters import RegularSM
+    from dragonboat_tpu.statemachine import Result
+    from dragonboat_tpu.wire import Entry
+
+    class _NoopSM:
+        def update(self, cmd):
+            return Result(value=len(cmd))
+
+        def lookup(self, q):
+            return None
+
+        def save_snapshot(self, *a):
+            pass
+
+        def recover_from_snapshot(self, *a):
+            pass
+
+        def close(self):
+            pass
+
+    applied = []
+
+    class _Node:
+        def apply_update(self, e, result, rejected, ignored, notify_read):
+            applied.append(e.index)
+
+        def apply_config_change(self, *a):
+            pass
+
+        def restore_remotes(self, *a):
+            pass
+
+    sm = StateMachine(RegularSM(_NoopSM()), None, _Node(), 1, 1)
+    seq = [0]
+
+    def step(i, q=seq):
+        lo = q[0] * 64 + 1
+        q[0] += 1
+        sm.handle([Task(cluster_id=1, node_id=1, entries=[
+            Entry(term=1, index=lo + j, cmd=b"y" * 16) for j in range(64)
+        ])])
+
+    return {"apply64x16B": round(_rate(step, 64) * 64, 1)}
+
+
+def bench_natsm_update():
+    """The C-ABI KV update path (scalar-plane ctypes hop included) — the
+    per-op floor the native fast lane's zero-GIL apply avoids."""
+    from dragonboat_tpu.native import natsm
+
+    if not natsm.available():
+        return {"skipped": "libnatsm unavailable"}
+    sm = natsm.NativeKVStateMachine(1, 1)
+    try:
+        return {
+            "update_16B": _rate(
+                lambda i: sm.update(b"k%d=v" % (i % 512)), 20_000
+            ),
+            "lookup": _rate(lambda i: sm.lookup("k1"), 20_000),
+        }
+    finally:
+        sm.close()
+
+
+SECTIONS = [
+    ("encoded_payload", bench_encoded_payload),
+    ("entry_queue", bench_entry_queue),
+    ("pending_proposal", bench_pending_proposal_key),
+    ("marshal_entry", bench_marshal_entry),
+    ("logdb_save_inmem", lambda: bench_logdb_save(False)),
+    ("logdb_save_fsync", lambda: bench_logdb_save(True)),
+    ("fsync_latency", bench_fsync),
+    ("transport_framing", bench_transport_framing),
+    ("sm_step", bench_sm_step),
+    ("natsm_update", bench_natsm_update),
+]
+
+
+def main() -> int:
+    pat = sys.argv[1] if len(sys.argv) > 1 else ""
+    for name, fn in SECTIONS:
+        if pat and pat not in name:
+            continue
+        try:
+            res = fn()
+        except Exception as e:  # a broken section must not hide the rest
+            res = {"error": repr(e)[:200]}
+        print(json.dumps({"section": name, **res}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

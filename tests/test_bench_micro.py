@@ -1,0 +1,35 @@
+"""Keep bench_micro.py honest: every section must produce numbers, not
+an error dict (the runner swallows per-section exceptions so one broken
+probe can't hide the rest — which also means API drift would rot
+silently without this gate).  Every section runs for real, including
+both durable LogDB variants."""
+from __future__ import annotations
+
+import bench_micro
+
+
+def test_cheap_sections_produce_numbers():
+    for name in ("entry_queue", "pending_proposal", "marshal_entry",
+                 "transport_framing", "sm_step"):
+        fn = dict(bench_micro.SECTIONS)[name]
+        out = fn()
+        assert "error" not in out, (name, out)
+        assert any(
+            isinstance(v, (int, float)) for v in out.values()
+        ), (name, out)
+
+
+def test_logdb_and_fsync_sections():
+    out = bench_micro.bench_logdb_save(False)
+    assert "error" not in out and out, out
+    out = bench_micro.bench_logdb_save(True)
+    assert "error" not in out and out, out
+    out = bench_micro.bench_fsync()
+    assert out.get("ops_s", 0) > 0, out
+
+
+def test_encoded_and_natsm_sections():
+    out = bench_micro.bench_encoded_payload()
+    assert "error" not in out, out
+    out = bench_micro.bench_natsm_update()
+    assert "error" not in out, out
