@@ -316,6 +316,17 @@ class _ErrorFile:
     def __getattr__(self, name):
         return getattr(self._f, name)
 
+    # dunder lookups bypass __getattr__ (type-level resolution), so the
+    # context-manager protocol must be explicit — without it every
+    # `with fs.open(...)` in the snapshot path fails under ErrorFS,
+    # which silently exempted that whole path from fault injection
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()  # through the wrapper: keeps close injectable
+        return False
+
 
 class ErrorFS(IFS):
     """FS wrapper injecting errors per an :class:`Injector`."""
