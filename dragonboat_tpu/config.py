@@ -86,16 +86,18 @@ class ExpertConfig:
 
         Scale note (measured r5, spread placement, native SM, 1-vCPU
         box): the round-4 4x deficit at identical placement closed to
-        parity-within-noise at 2,048 groups (tpu ~8.8k ± 1.9k w/s over
-        six runs vs scalar ~9.9k ± 1.0k over four; scalar wins ~10% at
-        1,024 consistently).  The tpu configuration's wide variance is
-        host-core contention: each dispatch (and the jax runtime's
-        threads) competes with the NodeHost processes when the box has
-        few cores.  ``tpu`` earns its keep with spare host cores for
-        the dispatch thread, a co-located (non-tunneled) device, or
-        group counts far past the per-group-Python crossover — measure
-        with bench.py's scale rung on the target topology before
-        switching (PERF.md round-5 §3).
+        parity with a slight tpu edge at 2,048 groups (tpu ~10.7k ±
+        1.5k w/s vs scalar ~10.2k ± 1.1k; scalar still wins ~10% at
+        1,024).  Getting there required running the coordinator's round
+        thread at niceness +5 (default; ``DBTPU_ENGINE_NICE``
+        overrides): un-niced, the scheduler sometimes favored the
+        dispatch thread over raft/transport on the shared core and a
+        run lost a third of its throughput for its lifetime.  A
+        decisive ``tpu`` e2e win still wants spare host cores for the
+        dispatch thread, a co-located (non-tunneled) device, or group
+        counts far past the per-group-Python crossover — measure with
+        bench.py's scale rung on the target topology before switching
+        (PERF.md round-5 §3).
     """
 
     quorum_engine: str = "scalar"

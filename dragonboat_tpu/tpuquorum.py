@@ -335,6 +335,33 @@ class TpuQuorumCoordinator:
     # ------------------------------------------------------------------
 
     def _round_main(self) -> None:
+        # Deprioritize this thread (Linux per-thread niceness, default +5,
+        # DBTPU_ENGINE_NICE overrides, 0 disables).  The round thread is
+        # a batch amortizer — a delayed round just batches more events —
+        # but its dispatches (and the jax runtime work they trigger)
+        # compete with the raft/transport threads for cycles on a
+        # core-starved box: the e2e A/B's bimodal throughput (a ~6.6k
+        # w/s mode whenever the scheduler favored this thread; PERF.md
+        # round-5 §3) hit 3 of 8 un-niced runs and 0 of 6 niced ones
+        # (validated at both +10 and this +5 default; mean up ~22%).
+        # On an idle machine niceness changes nothing — a niced thread
+        # with a free core still runs immediately.
+        import os as _os
+
+        try:
+            nice = int(_os.environ.get("DBTPU_ENGINE_NICE", "5"))
+        except ValueError:
+            plog.warning("malformed DBTPU_ENGINE_NICE; using default 5")
+            nice = 5
+        if nice:
+            try:
+                _os.setpriority(
+                    _os.PRIO_PROCESS, threading.get_native_id(), nice
+                )
+            except (OSError, AttributeError) as e:
+                # the perf fix silently not applying must be attributable
+                # (the bimodal slow mode would return with no clue)
+                plog.warning("engine round-thread nice failed: %r", e)
         while not self._stopped.is_set():
             fired = self._pending.wait(timeout=self._interval)
             if self._stopped.is_set():
