@@ -132,6 +132,12 @@ def _rank_main() -> int:
                 if rs.wait(5.0).completed:
                     done += 1
             emit("WROTE", {"done": done})
+        elif cmd == "LEADERS":
+            n = sum(
+                1 for cid in range(1, CID_COUNT + 1)
+                if (nd := nh.get_node(cid)) is not None and nd.is_leader()
+            )
+            emit("LEADERS", {"n": n})
         elif cmd == "STATS":
             st = nh.fastlane.stats() if nh.fastlane else {}
             emit("STATS", {
@@ -304,9 +310,20 @@ def test_dead_leader_still_detected_despite_compensation(tmp_path):
             hosts.append(_Host(i, env))
         for h in hosts:
             h.expect("READY", 120)
-        # host 0 campaigns every group: it leads all of them
-        hosts[0].send("CAMPAIGN")
-        hosts[0].expect("CAMPAIGNED")
+        # host 0 campaigns every group: it must lead ALL of them before
+        # the freeze — otherwise a leader naturally elected elsewhere
+        # during setup lets the post-freeze write succeed WITHOUT any
+        # failover and the eject assertion below is vacuous (the flake)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            hosts[0].send("CAMPAIGN")
+            hosts[0].expect("CAMPAIGNED")
+            time.sleep(0.5)
+            hosts[0].send("LEADERS")
+            if hosts[0].expect("LEADERS")["n"] == CID_COUNT:
+                break
+        else:
+            raise AssertionError("host 0 never led every group")
         deadline = time.time() + 120
         while time.time() < deadline:
             n = 0
@@ -320,6 +337,11 @@ def test_dead_leader_still_detected_despite_compensation(tmp_path):
             raise AssertionError("groups never fully enrolled")
         hosts[0].send("WRITE 1")
         assert hosts[0].expect("WROTE")["done"] >= 1
+        # leadership may have moved while enrolling; re-verify the premise
+        hosts[0].send("LEADERS")
+        assert hosts[0].expect("LEADERS")["n"] == CID_COUNT, (
+            "premise lost: host 0 no longer leads every group"
+        )
 
         # ---- freeze the LEADER host; followers stay healthy ----
         hosts[0].proc.send_signal(signal.SIGSTOP)
