@@ -3,7 +3,8 @@
 
 PY ?= python
 
-.PHONY: test test-all native soak soak-smoke bench dryrun
+.PHONY: test test-all native soak soak-smoke bench dryrun \
+	perf-ledger perf-ledger-check
 
 test: native
 	$(PY) -m pytest tests/ -x -q -m "not slow"
@@ -69,6 +70,15 @@ bench: native
 # benchmark_test.go families)
 bench-micro: native
 	$(PY) bench_micro.py
+
+# regenerate the PERF.md A/B ledger tables from the committed bench
+# artifact (VERDICT r5 item 4: every headline claim traceable to
+# BENCH_DETAIL.json — run after each bench capture)
+perf-ledger:
+	$(PY) tools/perf_ledger.py
+
+perf-ledger-check:
+	$(PY) tools/perf_ledger.py --check
 
 dryrun:
 	$(PY) __graft_entry__.py

@@ -261,46 +261,68 @@ def _run_e2e(on_tpu: bool, engine: str, extra_env=None, timeout_key: str = "BENC
         return {"error": repr(e)}
 
 
-def _run_host_loop(n_groups: int, rounds: int) -> dict:
-    """Engine throughput with real host-side event staging (the live
-    coordinator's path): per round, every group's leader self-ack and one
-    follower ack are staged via ``eng.ack`` and one ``eng.step`` dispatch
-    ingests them and advances commits.  Includes the Python staging cost
-    the pipelined kernel mode deliberately excludes."""
-    if rounds < 1 or n_groups < 1:
-        return {"error": f"invalid parameters: groups={n_groups} rounds={rounds}"}
+def _check_cancel(cancel) -> None:
+    """Cooperative watchdog flag for device-rung workers: a wedged
+    tunneled backend degrades to an error entry, and the daemon worker
+    stops DISPATCHING the moment the watchdog gives up — it must not keep
+    feeding the device while the cpu fallback measures (ISSUE 1
+    satellite; previously the abandoned thread ran to completion)."""
+    if cancel is not None and cancel.is_set():
+        raise RuntimeError("rung cancelled by watchdog")
+
+
+def _run_host_loop(n_groups: int, rounds: int, k: int = 16,
+                   cancel=None) -> dict:
+    """Engine throughput through the real host staging path — now the
+    K-round FUSED shape every ladder section runs (ISSUE 1 tentpole):
+    per scanned round every group's leader self-ack and one follower ack
+    are staged via the vectorized bulk-ingest API, ``begin_round`` closes
+    the round, and ONE ``step_rounds`` dispatch scans all ``k`` rounds on
+    device.  Host staging of block i+1 overlaps the in-flight dispatch of
+    block i (``pipelined=True`` double-buffering), and egress is the
+    vectorized watermark view — no per-row Python anywhere.  ``rounds``
+    counts DISPATCHES; total engine rounds = rounds × k."""
+    if rounds < 1 or n_groups < 1 or k < 1:
+        return {"error": f"invalid parameters: groups={n_groups} rounds={rounds} k={k}"}
     # host-driven clocks: this mode never ticks on device, so the
     # contact-reset scatter compiles out (see kernels.quorum_step_impl)
     eng = build_state(n_groups, 2 * n_groups, device_ticks=False)
-    base = 1
-    # warmup (jit compile) via the per-event path
-    for cid in range(1, n_groups + 1):
-        eng.ack(cid, 1, base + 1)
-        eng.ack(cid, 2, base + 1)
-    eng.step(do_tick=False)
-    base += 1
-    # steady state uses the vectorized bulk-ingest API (ack_block): the
-    # rows are 0..G-1 in registration order and every group shares the
-    # same base, so the row/slot translation is a flat arange — this is
-    # the staging shape a native control plane produces
     rows = np.tile(np.arange(n_groups, dtype=np.int32), 2)
     slots = np.concatenate(
         [np.zeros(n_groups, np.int32), np.ones(n_groups, np.int32)]
     )
+
+    def stage_block(base):
+        # K rounds in one validated staging call: same (row, slot)
+        # geometry every round, advancing rel indexes (ack_block_rounds)
+        rels = (
+            base + 1 + np.arange(k, dtype=np.int32)[:, None]
+            + np.zeros((1, rows.size), np.int32)
+        )
+        eng.ack_block_rounds(rows, slots, rels)
+
+    # warmup (jit compile of the fused K-round program)
+    base = 1
+    stage_block(base)
+    eng.step_rounds(do_tick=False)
+    base += k
     t0 = time.perf_counter()
     for _ in range(rounds):
-        nxt = base + 1
-        gi = eng.groups[1]
-        rel = nxt - gi.base
-        eng.ack_block(rows, slots, np.full(2 * n_groups, rel, np.int32))
-        res = eng.step(do_tick=False)
-        base = nxt
+        _check_cancel(cancel)
+        stage_block(base)
+        # returns the PREVIOUS block's egress; this block stays in flight
+        # while the next one stages (ingress double-buffering)
+        eng.step_rounds(do_tick=False, pipelined=True)
+        base += k
+    eng.harvest()
+    view = eng.committed_view()
     elapsed = time.perf_counter() - t0
-    assert res.commit.get(1) == base, (res.commit.get(1), base)
+    assert view[0] == base, (view[:4], base)
     return {
         "groups": n_groups,
         "rounds": rounds,
-        "writes_per_sec": round(n_groups * rounds / elapsed, 1),
+        "rounds_per_dispatch": k,
+        "writes_per_sec": round(n_groups * rounds * k / elapsed, 1),
     }
 
 
@@ -355,13 +377,17 @@ def _slim_e2e(e2e: dict) -> dict:
     return out
 
 
-def _run_rung4(n_groups: int = 65_536, rounds: int = 8) -> dict:
+def _run_rung4(n_groups: int = 65_536, rounds: int = 8, k: int = 16,
+               cancel=None) -> dict:
     """Rung-4 batched-engine numbers (BASELINE.md ladder): 64k groups ×
-    5 peer slots — every group commits once per round via the vectorized
-    ack_block ingest (quorum of 5 = self + 2 acks), with sampled
-    commit-watermark queries interleaved as the read-side probe.  The
+    5 peer slots — every group commits once per scanned round via the
+    vectorized ack_block ingest (quorum of 5 = self + 2 acks), K rounds
+    fused per dispatch with double-buffered staging (ISSUE 1 tentpole),
+    and sampled commit-watermark queries as the read-side probe.  The
     correctness twin (differential vs scalar oracles + membership/leader
-    churn, and the genuinely mixed-load variant) is tests/test_rung4.py."""
+    churn, and the genuinely mixed-load variant) is tests/test_rung4.py
+    plus the fused-block differential in tests/test_multiround.py.
+    ``rounds`` counts DISPATCHES; total engine rounds = rounds × k."""
     from dragonboat_tpu.ops.engine import BatchedQuorumEngine
 
     eng = BatchedQuorumEngine(
@@ -378,32 +404,55 @@ def _run_rung4(n_groups: int = 65_536, rounds: int = 8) -> dict:
         np.zeros(n_groups, np.int32), np.ones(n_groups, np.int32),
         np.full(n_groups, 2, np.int32),
     ])
-    # warmup (compile)
-    eng.ack_block(rows3, slots, np.full(3 * n_groups, 2, np.int32))
-    eng.step(do_tick=False)
+
+    def stage_block(start_rel):
+        # one validated staging call for the whole K-round block
+        rels = (
+            start_rel + np.arange(k, dtype=np.int32)[:, None]
+            + np.zeros((1, rows3.size), np.int32)
+        )
+        eng.ack_block_rounds(rows3, slots, rels)
+
+    # warmup (compile the fused K-round program)
+    stage_block(2)
+    eng.step_rounds(do_tick=False)
     reads = writes = 0
-    read_cids = list(range(1, n_groups + 1, max(1, n_groups // 576)))
+    # read probe rows (~576 sampled watermarks per dispatch): validated
+    # against the vectorized egress view the dispatch already paid for —
+    # per-cid committed_index readbacks are ~67ms each on a tunneled
+    # backend (the reason this rung used to be CPU-only).  reads_per_sec
+    # measures the host-side watermark-query rate over fresh egress data.
+    probe = np.arange(0, n_groups, max(1, n_groups // 576), dtype=np.int64)
+    rel = k + 1  # committed after warmup
+    expect_prev = None  # watermark the in-flight block will land on
     t0 = time.perf_counter()
-    for rnd in range(3, rounds + 3):
-        eng.ack_block(rows3, slots, np.full(3 * n_groups, rnd, np.int32))
-        eng.step(do_tick=False)
-        writes += n_groups
-        # read probe: validates the committed vector the device produced
-        # for this round's egress (step() already paid the device->host
-        # transfer; per-cid committed_index readbacks are ~67ms each on a
-        # tunneled backend — the reason this rung used to be CPU-only).
-        # reads_per_sec therefore measures the HOST-SIDE watermark-query
-        # rate over fresh egress data, not extra device round trips.
-        snap = eng.committed_snapshot(read_cids)
-        for cid in read_cids:
-            assert snap[cid] == rnd
-            reads += 1
+    for _ in range(rounds):
+        _check_cancel(cancel)
+        stage_block(rel + 1)
+        res = eng.step_rounds(do_tick=False, pipelined=True)
+        if res is not None:
+            # probe the PREVIOUS block's egress vector directly — it is
+            # already host-side; touching committed_view here would
+            # harvest (and so serialize) the in-flight dispatch
+            assert (res.committed_rel[probe] == expect_prev).all(), (
+                res.committed_rel[probe][:4], expect_prev
+            )
+            reads += probe.size
+        expect_prev = rel + k
+        rel += k
+        writes += n_groups * k
+    final = eng.harvest()
     elapsed = time.perf_counter() - t0
-    assert eng.committed_index(1) == rounds + 2
+    assert (final.committed_rel[probe] == rel).all(), (
+        final.committed_rel[probe][:4], rel
+    )
+    reads += probe.size
+    assert eng.committed_index(1) == rel
     return {
         "groups": n_groups,
         "peer_slots": 5,
         "rounds": rounds,
+        "rounds_per_dispatch": k,
         "writes_per_sec": round(writes / elapsed, 1),
         "reads_per_sec": round(reads / elapsed, 1),
     }
@@ -454,15 +503,19 @@ def _run_cpu_section(fn_name: str, spec: list, timeout: float = 420.0) -> dict:
         return {"error": repr(e)[:300]}
 
 
-def _run_rung5(n_groups: int = 100_000, rounds: int = 6,
-               churn_block: int = 2_048) -> dict:
+def _run_rung5(n_groups: int = 100_000, rounds: int = 6, k: int = 8,
+               churn_block: int = 2_048, cancel=None) -> dict:
     """Rung-5 batched-engine numbers (BASELINE.md ladder, final rung):
     100k groups × 5 peer slots with membership churn ROLLING THROUGH the
-    load — each round recycles ``churn_block`` rows (remove + re-add, the
-    engine's membership-change geometry) while every surviving group
-    commits once via the vectorized ack_block ingest.  The correctness
-    twin (differential vs scalar oracles, leader transfers, bit-identity
-    every round) is tests/test_rung5.py."""
+    load — every scanned round recycles ``churn_block`` rows while every
+    surviving group commits once.  The churn now travels INSIDE the
+    dispatched program (``stage_recycle`` → masked row resets in
+    ``kernels.quorum_multiround``, the VERDICT §7 design pivot) instead
+    of as per-recycle host re-uploads, so K churn+commit rounds fuse into
+    ONE dispatch with double-buffered staging.  The correctness twin
+    (differential vs scalar oracles, leader transfers, bit-identity every
+    round) is tests/test_rung5.py plus the recycle-mid-block differential
+    in tests/test_multiround.py.  ``rounds`` counts DISPATCHES."""
     from dragonboat_tpu.ops.engine import BatchedQuorumEngine
 
     eng = BatchedQuorumEngine(
@@ -479,47 +532,64 @@ def _run_rung5(n_groups: int = 100_000, rounds: int = 6,
         np.zeros(n_groups, np.int32), np.ones(n_groups, np.int32),
         np.full(n_groups, 2, np.int32),
     ])
-    # warmup (compile)
-    eng.ack_block(rows3, slots, np.full(3 * n_groups, 2, np.int32))
-    eng.step(do_tick=False)
-    rel = np.full(n_groups, 2, np.int32)  # per-group committed rel index
+    rel = np.full(n_groups, 1, np.int64)  # per-row committed rel watermark
+    live = np.arange(1, n_groups + 1, dtype=np.int64)  # cid per row
     next_cid = n_groups + 1
-    live = np.arange(1, n_groups + 1, dtype=np.int64)  # cid per row slot
-    reads = writes = recycled = 0
+    state = {"rel": rel, "next_cid": next_cid, "churn_at": 0}
+
+    def stage_block():
+        """K scanned rounds: recycle a rotating row block IN-PROGRAM,
+        then every row commits one more entry."""
+        rel = state["rel"]
+        for _ in range(k):
+            lo = state["churn_at"] % n_groups
+            block = range(lo, min(lo + churn_block, n_groups))
+            for i in block:
+                cid = state["next_cid"]
+                state["next_cid"] += 1
+                eng.stage_recycle(
+                    int(live[i]), cid, term=1, term_start=1, last_index=1
+                )
+                live[i] = cid
+                rel[i] = 1
+            state["churn_at"] += churn_block
+            rel += 1
+            rels3 = np.concatenate([rel, rel, rel]).astype(np.int32)
+            eng.ack_block(rows3, slots, rels3)
+            eng.begin_round()
+            state["recycled"] = state.get("recycled", 0) + len(block)
+
+    # warmup (compile the fused churn+commit program)
+    stage_block()
+    eng.step_rounds(do_tick=False)
+    state["recycled"] = 0  # report only the measured window's churn
+    probe = np.arange(0, n_groups, max(1, n_groups // 576), dtype=np.int64)
+    reads = writes = 0
+    prev_rel = None  # expected watermarks of the in-flight block
     t0 = time.perf_counter()
-    for rnd in range(rounds):
-        # membership churn mid-load: recycle a rotating block of rows
-        lo = (rnd * churn_block) % n_groups
-        block = range(lo, min(lo + churn_block, n_groups))
-        for i in block:
-            eng.remove_group(int(live[i]))
-            eng.add_group(next_cid, node_ids=peers, self_id=1)
-            eng.set_leader(next_cid, term=1, term_start=1, last_index=1)
-            # the engine's free-list may hand the new group ANY freed row
-            r2 = eng.groups[next_cid].row
-            live[r2] = next_cid
-            rel[r2] = 1
-            next_cid += 1
-        eng._upload_dirty()
-        recycled += len(block)
-        rel += 1
-        rels3 = np.concatenate([rel, rel, rel])
-        eng.ack_block(rows3, slots, rels3)
-        eng.step(do_tick=False)
-        writes += n_groups
-        # host-side watermark probe over the round's fresh egress data
-        # (see the rung-4 comment)
-        idxs = range(0, n_groups, max(1, n_groups // 576))
-        snap = eng.committed_snapshot([int(live[i]) for i in idxs])
-        for i in idxs:
-            assert snap[int(live[i])] == rel[i]
-            reads += 1
+    for _ in range(rounds):
+        _check_cancel(cancel)
+        stage_block()
+        res = eng.step_rounds(do_tick=False, pipelined=True)
+        if res is not None:
+            # vectorized probe of the PREVIOUS block's egress (rung-4
+            # comment: committed_view here would serialize the pipeline)
+            assert (res.committed_rel[probe] == prev_rel[probe]).all()
+            reads += probe.size
+        prev_rel = rel.copy()
+        writes += n_groups * k
+    final = eng.harvest()
     elapsed = time.perf_counter() - t0
+    assert (final.committed_rel[probe] == rel[probe]).all(), (
+        final.committed_rel[probe][:4], rel[probe][:4]
+    )
+    reads += probe.size
     return {
         "groups": n_groups,
         "peer_slots": 5,
         "rounds": rounds,
-        "recycled_groups": recycled,
+        "rounds_per_dispatch": k,
+        "recycled_groups": state.get("recycled", 0),
         "writes_per_sec": round(writes / elapsed, 1),
         "reads_per_sec": round(reads / elapsed, 1),
     }
@@ -619,6 +689,9 @@ def main() -> None:
         groups=n_groups,
         rounds_per_dispatch=rounds,
         dispatches=dispatches,
+        # duplicated into the detail artifact so the PERF.md ledger
+        # generator (tools/perf_ledger.py) has every figure in one file
+        headline_writes_per_sec=round(writes_per_sec, 1),
         dispatch_p99_ms=round(
             float(np.percentile(np.array(times) * 1e3, 99)), 3
         ),
@@ -649,7 +722,9 @@ def main() -> None:
         detail["host_loop"] = _run_host_loop(
             int(os.environ.get("BENCH_HOST_GROUPS", "65536" if on_tpu else "16384")),
             int(os.environ.get("BENCH_HOST_ROUNDS", "8")),
+            int(os.environ.get("BENCH_HOST_K", "16")),
         )
+        detail["host_loop"].setdefault("platform", platform)
     except Exception as e:
         detail["host_loop"] = {"error": repr(e)}
 
@@ -660,19 +735,27 @@ def main() -> None:
     # DEVICE when the parent holds one (VERDICT r4 #10) and fall back to
     # the cpu-subprocess shape otherwise.
     def _rung_on_device(fn, env_groups, dflt_groups, env_rounds, dflt_rounds,
-                        timeout=420.0):
+                        env_k, dflt_k, timeout=420.0):
         """Run a rung inline on the parent's device, bounded by a watchdog
         thread: a wedged tunneled backend must degrade to an error entry
-        (like the cpu-subprocess path's timeout), not hang the bench."""
+        (like the cpu-subprocess path's timeout), not hang the bench.
+        The worker gets a CANCELLATION flag checked before every dispatch
+        (_check_cancel): when the watchdog gives up, the abandoned daemon
+        thread stops feeding the device instead of dispatching on in the
+        background while the cpu fallback measures (ISSUE 1 satellite)."""
         import threading as _th
 
         box = {}
+        cancel = _th.Event()
 
         def _work():
             try:
                 g = int(os.environ.get(env_groups, str(dflt_groups)))
                 rds = int(os.environ.get(env_rounds, str(dflt_rounds)))
-                out = fn(g, rds)
+                # same K override the cpu-subprocess spec honors — the
+                # device and cpu capture must stay A/B-comparable
+                kv = int(os.environ.get(env_k, str(dflt_k)))
+                out = fn(g, rds, kv, cancel=cancel)
                 out["platform"] = platform
                 box["out"] = out
             except Exception as e:
@@ -682,16 +765,19 @@ def main() -> None:
         t.start()
         t.join(timeout)
         if t.is_alive():
+            cancel.set()  # the worker aborts at its next dispatch boundary
             return {"error": f"device rung timed out after {timeout}s"}
         # BaseException (SystemExit etc.) ends the thread without a result
         return box.get("out", {"error": "device rung worker died"})
 
     if on_tpu:
         detail["rung4"] = _rung_on_device(
-            _run_rung4, "BENCH_RUNG4_GROUPS", 65536, "BENCH_RUNG4_ROUNDS", 8
+            _run_rung4, "BENCH_RUNG4_GROUPS", 65536, "BENCH_RUNG4_ROUNDS", 8,
+            "BENCH_RUNG4_K", 16,
         )
         detail["rung5"] = _rung_on_device(
-            _run_rung5, "BENCH_RUNG5_GROUPS", 100000, "BENCH_RUNG5_ROUNDS", 6
+            _run_rung5, "BENCH_RUNG5_GROUPS", 100000, "BENCH_RUNG5_ROUNDS", 6,
+            "BENCH_RUNG5_K", 8,
         )
     for rung in ("rung4", "rung5"):
         err = detail.get(rung, {}).get("error")
@@ -701,9 +787,11 @@ def main() -> None:
                 # must stay visible even after the cpu fallback succeeds
                 detail[f"{rung}_device_error"] = err
             spec = (
-                ["BENCH_RUNG4_GROUPS", 65536, "BENCH_RUNG4_ROUNDS", 8]
+                ["BENCH_RUNG4_GROUPS", 65536, "BENCH_RUNG4_ROUNDS", 8,
+                 "BENCH_RUNG4_K", 16]
                 if rung == "rung4"
-                else ["BENCH_RUNG5_GROUPS", 100000, "BENCH_RUNG5_ROUNDS", 6]
+                else ["BENCH_RUNG5_GROUPS", 100000, "BENCH_RUNG5_ROUNDS", 6,
+                      "BENCH_RUNG5_K", 8]
             )
             detail[rung] = _run_cpu_section(f"_run_{rung}", spec)
 

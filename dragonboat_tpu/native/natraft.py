@@ -227,6 +227,12 @@ class NatRaft:
         self._lib = lib
         self._peer_order: dict = {}  # cid -> peer id order used at enroll
         self._stopped = False
+        # guards the reused take_payload scratch buffer: the completion
+        # pump is the designed single caller, but the discard path for
+        # removed clusters (fastlane._completion_main) and any future
+        # caller outside _compl_mu must not interleave reads of one
+        # shared buffer (ISSUE 1 satellite)
+        self._pay_mu = threading.Lock()
 
     def start(self) -> None:
         self._lib.natr_start(self._h)
@@ -602,24 +608,30 @@ class NatRaft:
 
     def take_payload(self, payload_id: int) -> bytes:
         """Fetch (and consume) a completion payload from the side-channel
-        (cached session responses whose Result carried data bytes)."""
-        # reuse one 64KB buffer across calls (the _cbufs pattern): the
-        # common payload is tiny and the discard path for removed
-        # clusters shouldn't pay a fresh zeroed allocation per record
-        buf = getattr(self, "_paybuf", None)
-        cap = 1 << 16
-        if buf is None:
-            buf = self._paybuf = (ctypes.c_uint8 * cap)()
-        else:
-            cap = len(buf)
-        while True:
-            n = self._lib.natr_take_payload(self._h, payload_id, buf, cap)
-            if n < 0:
-                return b""  # unknown id (already consumed)
-            if n <= cap:
-                return bytes(buf[:n])
-            cap = int(n)  # undersized: retry with the exact size
-            buf = (ctypes.c_uint8 * cap)()  # oversize stays per-call
+        (cached session responses whose Result carried data bytes).
+
+        Thread-safe: ``_pay_mu`` serializes use of the shared scratch
+        buffer, so callers outside the completion pump's ``_compl_mu``
+        (e.g. the removed-cluster discard path) can't interleave with an
+        in-flight read and hand one caller another payload's bytes."""
+        with self._pay_mu:
+            # reuse one 64KB buffer across calls (the _cbufs pattern):
+            # the common payload is tiny and the discard path for removed
+            # clusters shouldn't pay a fresh zeroed allocation per record
+            buf = getattr(self, "_paybuf", None)
+            cap = 1 << 16
+            if buf is None:
+                buf = self._paybuf = (ctypes.c_uint8 * cap)()
+            else:
+                cap = len(buf)
+            while True:
+                n = self._lib.natr_take_payload(self._h, payload_id, buf, cap)
+                if n < 0:
+                    return b""  # unknown id (already consumed)
+                if n <= cap:
+                    return bytes(buf[:n])
+                cap = int(n)  # undersized: retry with the exact size
+                buf = (ctypes.c_uint8 * cap)()  # oversize stays per-call
 
     def close_conn(self, conn_id: int) -> None:
         self._lib.natr_close_conn(self._h, conn_id)

@@ -1353,14 +1353,26 @@ class Node:
             or self.sm.on_disk
         ):
             return None
+        # membership must be captured atomically with the capture index:
+        # snapshot it BEFORE the native capture, then verify the
+        # config-change id did not move while the capture ran (a racing
+        # fast_eject + config-change apply in that window would otherwise
+        # label the image with membership newer than its index).  The
+        # pre-capture view is consistent with the captured index exactly
+        # when the ccid is unchanged — config changes only apply on the
+        # Python plane, which the enrolled lane holds off.
+        pre_members = self.sm.get_membership()
         cap = fl.nat.capture_sm(self.cluster_id)
-        if cap is None:
+        if cap is None or (
+            self.sm.get_membership().config_change_id
+            != pre_members.config_change_id
+        ):
             # cannot capture (no save fn on the attached SM / attach
-            # barrier still in flight / mid-eject): restore the
-            # pre-capture behavior — leave the lane FIRST, because a
-            # scalar sm.save() while native applies keep mutating the
-            # shared state would label the image with a stale index
-            # (double-apply after recovery)
+            # barrier still in flight / mid-eject), or membership moved
+            # under the capture: restore the pre-capture behavior —
+            # leave the lane FIRST, because a scalar sm.save() while
+            # native applies keep mutating the shared state would label
+            # the image with a stale index (double-apply after recovery)
             if self.fast_lane:
                 self._count_eject("snapshot-due")
                 self.fast_eject()
@@ -1373,7 +1385,7 @@ class Node:
         # accept the new snapshot index
         self.logreader.extend_to(index)
         return self.sm.save_from_capture(
-            req, index, term, kv_image, sess_image
+            req, index, term, kv_image, sess_image, membership=pre_members
         )
 
     def _save_snapshot(self, t: Task) -> None:

@@ -55,17 +55,76 @@ class GroupInfo:
 
 
 class StepResult:
-    """Egress of one dispatch, in absolute-index / cluster-id terms."""
+    """Egress of one dispatch, in absolute-index / cluster-id terms.
 
-    __slots__ = ("commit", "won", "lost", "elect", "heartbeat", "demote")
+    ``commit`` materializes lazily from the vectorized egress arrays: hot
+    callers (the bench rungs, watermark probes) read the arrays or the
+    engine's ``committed_view`` and never pay the per-row dict build."""
+
+    __slots__ = (
+        "won", "lost", "elect", "heartbeat", "demote",
+        "_commit_cids", "_commit_abs", "_commit_dict",
+    )
 
     def __init__(self):
-        self.commit: Dict[int, int] = {}   # cluster_id -> new committed (abs)
+        self._commit_cids = None   # np (n,) int64 cluster ids, or None
+        self._commit_abs = None    # np (n,) int64 absolute committed
+        self._commit_dict: Optional[Dict[int, int]] = None
         self.won: List[int] = []
         self.lost: List[int] = []
         self.elect: List[int] = []
         self.heartbeat: List[int] = []
         self.demote: List[int] = []
+
+    @property
+    def commit(self) -> Dict[int, int]:
+        """cluster_id -> new committed (abs); built on first access."""
+        if self._commit_dict is None:
+            if self._commit_cids is None or not len(self._commit_cids):
+                self._commit_dict = {}
+            else:
+                self._commit_dict = dict(
+                    zip(self._commit_cids.tolist(), self._commit_abs.tolist())
+                )
+        return self._commit_dict
+
+
+class MultiRoundResult(StepResult):
+    """Egress of one K-round fused dispatch (``step_rounds``).
+
+    Adds the raw vectorized views on top of the StepResult interface:
+    ``committed_rel`` is the device's final (G,) relative watermark vector
+    and ``commit_rows`` the rows that advanced vs the pre-block host twin —
+    both numpy, zero per-row Python.  Flags are OR-accumulated across the
+    block's rounds (see ``kernels.quorum_multiround_impl`` on recycled-row
+    attribution)."""
+
+    __slots__ = ("rounds", "committed_rel", "commit_rows")
+
+    def __init__(self, rounds: int):
+        super().__init__()
+        self.rounds = rounds
+        self.committed_rel: Optional[np.ndarray] = None  # (G,) i32
+        self.commit_rows: Optional[np.ndarray] = None    # (n,) changed rows
+
+
+class _RoundBuf:
+    """One closed ingest round awaiting the fused multi-round dispatch:
+    epoch-filtered ack arrays, first-wins-deduped votes, and the round's
+    leader-recycle records (applied at round start, device-side).
+    ``cells`` optionally carries the precomputed flat (row·P + slot)
+    index vector when the staging path shares one geometry across rounds
+    (``ack_block_rounds``), sparing a per-round int64 conversion."""
+
+    __slots__ = ("rows", "slots", "rels", "votes", "churn", "cells")
+
+    def __init__(self, rows, slots, rels, votes, churn, cells=None):
+        self.rows = rows
+        self.slots = slots
+        self.rels = rels
+        self.votes = votes   # list[(row, slot, grant)]
+        self.churn = churn   # list[(row, term, term_start_rel, last_rel)]
+        self.cells = cells   # np (n,) int64 row*P+slot, or None
 
 
 class BatchedQuorumEngine:
@@ -152,6 +211,25 @@ class BatchedQuorumEngine:
         self._voted_cells: dict = {}  # (row, slot) -> staging epoch
         # vectorized bulk-ingest blocks (ack_block): (rows, slots, rels, eps)
         self._ack_blocks: List[Tuple[np.ndarray, ...]] = []
+        # --- multi-round fused staging (ISSUE 1 tentpole) ---------------
+        # closed ingest rounds awaiting ONE fused dispatch (begin_round /
+        # step_rounds); each round's epoch filter resolves at close time,
+        # so a later transition only purges rounds still open
+        self._round_blocks: List[_RoundBuf] = []
+        # leader-recycle records of the CURRENT open round (stage_recycle)
+        self._churn: List[Tuple[int, int, int, int]] = []
+        self._churn_rows: set = set()  # one recycle per row per round
+        # rows with an UNDISPATCHED recycle anywhere in the backlog (open
+        # round or closed blocks): their mirror rows are authoritative
+        # (recycle_row already applied) and host reads must not consult
+        # the pre-recycle device row; a rare-path mutation on such a row
+        # collapses the recycle to pre-block ordering (_sync_row)
+        self._churn_pending: set = set()
+        # in-flight pipelined dispatch: (StepOutputs, prev_committed,
+        # row_cid snapshot, row_base snapshot, n_rounds) — the ingest of
+        # block i+1 overlaps the device execution of block i, and every
+        # host read of device state harvests first (_harvest_inflight)
+        self._inflight = None
 
     @property
     def dev(self) -> QuorumState:
@@ -163,6 +241,7 @@ class BatchedQuorumEngine:
         the bench's staged multistep) — the host committed twin can no
         longer be trusted, so the next step() re-reads it from the device
         once instead of mis-reporting commit deltas."""
+        self._harvest_inflight()
         self._dev = st
         self._cache_stale = True
         self._synced.clear()
@@ -240,8 +319,50 @@ class BatchedQuorumEngine:
         vectorized pass at dispatch."""
         self._row_epoch[row] += 1
 
+    def _drop_churn_records(self, row: int, drop_events: bool = False) -> None:
+        """Strip every undispatched recycle record for ``row`` — from the
+        open round AND from closed blocks awaiting dispatch.  A stale
+        record surviving into the program would revive a freed row (or
+        clobber its next tenant) with the dead recycle's reset.
+
+        ``drop_events=True`` additionally strips the row's ack/vote
+        events from CLOSED blocks.  Required when the recycle collapses
+        to pre-block ordering (a rare-path mutation, ``_sync_row``): the
+        row's fresh state uploads before the block, so old-tenant events
+        sealed into earlier rounds — whose epoch filters resolved at
+        close time, immune to the recycle's epoch bump — would otherwise
+        scatter into the NEW tenant.  This restores the single-round
+        path's semantics, where a transition purges every staged event
+        for its row."""
+        if row in self._churn_rows:
+            self._churn = [c for c in self._churn if c[0] != row]
+            self._churn_rows.discard(row)
+        if row in self._churn_pending:
+            for b in self._round_blocks:
+                if b.churn:
+                    b.churn = [c for c in b.churn if c[0] != row]
+            self._churn_pending.discard(row)
+        if drop_events:
+            for b in self._round_blocks:
+                if b.rows.size:
+                    keep = b.rows != row
+                    if not keep.all():
+                        b.rows = b.rows[keep]
+                        b.slots = b.slots[keep]
+                        b.rels = b.rels[keep]
+                        if b.cells is not None:
+                            b.cells = b.cells[keep]
+                if b.votes:
+                    b.votes = [v for v in b.votes if v[0] != row]
+
     def remove_group(self, cluster_id: int) -> None:
         gi = self.groups.pop(cluster_id)
+        # any undispatched recycle of this row is now moot — it must not
+        # revive the freed row when the block dispatches — and events
+        # already sealed into closed blocks must die with the tenant (a
+        # future add_group may hand this row to a new group before the
+        # block dispatches)
+        self._drop_churn_records(gi.row, drop_events=True)
         del self.rows[gi.row]
         self.mirror.arrays["live"][gi.row] = False
         self._dirty.add(gi.row)
@@ -439,12 +560,406 @@ class BatchedQuorumEngine:
         )
 
     # ------------------------------------------------------------------
+    # multi-round fused staging (ISSUE 1 tentpole)
+    # ------------------------------------------------------------------
+
+    def begin_round(self) -> None:
+        """Close the current ingest round: everything staged so far forms
+        one scanned round of the next fused dispatch; events staged after
+        this call land in the NEXT round.  The round's stale-epoch filter
+        resolves NOW — a transition staged later (including a
+        ``stage_recycle`` in a later round) must not retroactively purge
+        events that a per-round host dispatch would already have consumed.
+        """
+        if self._votes:
+            votes = [
+                (r, s, v)
+                for r, s, v, ep in self._votes
+                if ep == self._row_epoch[r]
+            ]
+            self._votes = []
+            self._voted_cells.clear()
+        else:
+            votes = []
+        rows, slots, rels = self._gather_acks()
+        self._round_blocks.append(
+            _RoundBuf(rows, slots, rels, votes, self._churn)
+        )
+        self._churn = []
+        self._churn_rows = set()
+
+    def pending_rounds(self) -> int:
+        """Closed rounds awaiting the fused dispatch."""
+        return len(self._round_blocks)
+
+    def ack_block_rounds(self, rows, slots, rels_rounds) -> None:
+        """K CLOSED rounds of bulk acks over ONE (row, slot) geometry —
+        the steady-state shape of every ladder section (same cells every
+        round, advancing rel indexes).  Validates the geometry once and
+        snapshots the epoch filter once for the whole block instead of
+        per round: at 64k groups × 3 acks × K=16 the per-round
+        ``ack_block`` + ``begin_round`` path spent ~60ms/dispatch on
+        validation min/max scans and defensive copies this API skips
+        (the round buffers alias the caller's arrays — the caller must
+        not mutate them until the block is dispatched).
+
+        ``rels_rounds`` is (K, n): row ``r`` forms scanned round ``r``.
+        Events/churn already staged are closed into one preceding round
+        first (exactly ``begin_round`` semantics).
+        """
+        rows = np.asarray(rows)
+        slots = np.asarray(slots)
+        rels_rounds = np.asarray(rels_rounds)
+        if rels_rounds.ndim != 2 or rows.shape != slots.shape or (
+            rels_rounds.shape[1:] != rows.shape
+        ):
+            raise ValueError("ack_block_rounds: shape mismatch")
+        if rels_rounds.size and rels_rounds.max() >= REBASE_THRESHOLD:
+            raise ValueError("ack_block_rounds rel out of range")
+        if rows.size and (rows.min() < 0 or rows.max() >= self.n_groups):
+            raise ValueError("ack_block_rounds row out of range")
+        if slots.size and (slots.min() < 0 or slots.max() >= self.n_peers):
+            raise ValueError("ack_block_rounds slot out of range")
+        if self._acks or self._ack_blocks or self._votes or self._churn:
+            self.begin_round()
+        rows32 = rows.astype(np.int32, copy=False)
+        slots32 = slots.astype(np.int32, copy=False)
+        cells = rows32.astype(np.int64) * self.n_peers + slots32
+        # no epoch filter needed: every event is staged NOW under the
+        # rows' current epochs — begin_round closing each round here
+        # would resolve to the identity filter
+        if rels_rounds.size and rels_rounds.min() < 0:
+            # below-base retransmits clamp to rel 0 (ack() semantics)
+            rels_rounds = np.maximum(rels_rounds, 0)
+        for r in range(rels_rounds.shape[0]):
+            self._round_blocks.append(
+                _RoundBuf(
+                    rows32, slots32,
+                    rels_rounds[r].astype(np.int32, copy=False),
+                    [], [], cells=cells,
+                )
+            )
+
+    def stage_recycle(
+        self,
+        old_cluster_id: int,
+        new_cluster_id: int,
+        term: int,
+        term_start: int,
+        last_index: int,
+        rand_timeout: Optional[int] = None,
+    ) -> GroupInfo:
+        """Replace a group with a fresh SAME-GEOMETRY leader tenant as a
+        masked row update INSIDE the next dispatched program — the
+        device-side twin of ``remove_group`` + ``add_group`` +
+        ``set_leader`` (kernels._apply_recycle), with none of the
+        host-side re-upload those pay (the dominant cost of churn-under-
+        load at 100k groups: one dirty-row scatter per recycle).
+
+        The reset applies at the START of the recycle's ingest round —
+        before that round's events — exactly where the host path's
+        ``_upload_dirty`` lands relative to its dispatch, so acks staged
+        for the new tenant in the same round ingest correctly and events
+        already staged for the old tenant this round are purged (epoch
+        bump), while earlier CLOSED rounds still reach the old tenant.
+
+        Geometry (peer slots, voting/present masks, quorum, self slot,
+        timeouts) carries over unchanged; anything else — different
+        membership, witnesses, a different randomized timeout — must take
+        the host path.  ``rand_timeout`` may be passed to ASSERT the
+        carried-over value.  Raises ValueError when the swap isn't a pure
+        recycle.
+        """
+        gi = self.groups.get(old_cluster_id)
+        if gi is None:
+            raise ValueError(f"group {old_cluster_id} not registered")
+        if new_cluster_id in self.groups:
+            raise ValueError(f"group {new_cluster_id} already registered")
+        row = gi.row
+        if row in self._churn_rows:
+            raise ValueError(
+                f"row {row} already recycled this round (begin_round first)"
+            )
+        a = self.mirror.arrays
+        if rand_timeout is not None and rand_timeout != int(a["rand_timeout"][row]):
+            raise ValueError("rand_timeout differs: recycle must keep geometry")
+        if term_start < 0 or last_index < 0 or term_start > last_index:
+            raise ValueError("term_start/last_index out of range")
+        if last_index >= REBASE_THRESHOLD:
+            raise ValueError("index needs rebase before recycle")
+        # host bookkeeping: the new tenant takes the SAME row at base 0
+        del self.groups[old_cluster_id]
+        ngi = GroupInfo(
+            new_cluster_id, row, gi.slots, base=0, node_ids=gi.node_ids
+        )
+        self.groups[new_cluster_id] = ngi
+        self.rows[row] = ngi
+        self._row_cid[row] = new_cluster_id
+        self._row_base[row] = 0
+        # old-tenant events staged this round must not reach the new
+        # tenant (closed rounds resolved their filter at close time)
+        self._purge_row_events(row)
+        # mirror coherence WITHOUT dirtying the row: the device applies
+        # the identical reset in-program (state.HostMirror.recycle_row);
+        # until the block dispatches, host reads of this row resolve to
+        # the mirror (_read / committed caches), never the stale device
+        self.mirror.recycle_row(row, term, term_start, last_index)
+        self._committed_cache[row] = 0
+        self._synced.discard(row)
+        self._churn.append((row, term, term_start, last_index))
+        self._churn_rows.add(row)
+        self._churn_pending.add(row)
+        return ngi
+
+    def step_rounds(
+        self,
+        do_tick: bool = False,
+        pipelined: bool = False,
+        pad_rounds_to: int = 0,
+    ) -> Optional[MultiRoundResult]:
+        """ONE fused dispatch over every staged round (``begin_round``
+        boundaries; a non-empty open round is closed implicitly).
+
+        ``pipelined=True`` double-buffers host staging against device
+        execution: the call returns the PREVIOUS dispatch's egress (None
+        on the first) and leaves this dispatch in flight, so the caller
+        ingests/encodes block i+1 while block i executes.  Any host read
+        of device state (``committed_view``, ``_read``, a rare-path
+        transition, the next dispatch) harvests the in-flight block
+        first, so the pipelining is invisible to correctness.  Host
+        rare-path mutations (``set_leader`` …) staged between rounds
+        apply BEFORE the whole block — mid-block transitions must use
+        ``stage_recycle`` or split the block.
+
+        ``pad_rounds_to`` pads the block with event-free, tick-masked-off
+        rounds (provable no-ops) up to a fixed K, so a caller with a
+        VARYING round count — the coordinator's 2..4 missed-tick catch-up
+        — reuses one compiled program instead of paying a multi-second
+        XLA compile per distinct K (kernels.quorum_multiround tick_mask
+        note).
+        """
+        if self._acks or self._ack_blocks or self._votes or self._churn:
+            self.begin_round()
+        if not self._round_blocks:
+            # nothing staged: drain whatever is still in flight
+            return self._harvest_inflight()
+        blocks, self._round_blocks = self._round_blocks, []
+        n_real = len(blocks)
+        z = np.zeros((0,), np.int32)
+        while len(blocks) < pad_rounds_to:
+            blocks.append(_RoundBuf(z, z, z, [], []))
+        tick_mask = np.zeros((len(blocks),), bool)
+        tick_mask[:n_real] = True
+        prev = self._harvest_inflight()
+        self._upload_dirty()
+        self._refresh_committed_cache()
+        out = self._dispatch_multiround(blocks, do_tick, tick_mask)
+        self._synced.clear()
+        # every staged recycle is now inside the dispatched program
+        self._churn_pending.clear()
+        self._inflight = (
+            out,
+            # snapshot, not alias: stage_recycle zeroes cache rows in
+            # place while this dispatch is in flight, which must not
+            # corrupt ITS commit-delta baseline
+            self._committed_cache.copy(),
+            self._row_cid.copy(),
+            self._row_base.copy(),
+            len(blocks),
+        )
+        if pipelined:
+            return prev
+        return self.harvest()
+
+    def harvest(self) -> Optional[MultiRoundResult]:
+        """Egress of the in-flight pipelined dispatch (None when idle)."""
+        return self._harvest_inflight()
+
+    def _harvest_inflight(self) -> Optional[MultiRoundResult]:
+        if self._inflight is None:
+            return None
+        out, prev_committed, row_cid, row_base, n_rounds = self._inflight
+        self._inflight = None
+        committed, won, lost, elect, hb, demote = jax.device_get(
+            (
+                out.committed,
+                out.won,
+                out.lost,
+                out.flags.elect_due,
+                out.flags.hb_due,
+                out.flags.checkq_demote,
+            )
+        )
+        res = MultiRoundResult(n_rounds)
+        committed = np.asarray(committed)
+        res.committed_rel = committed
+        self._committed_cache = np.array(committed, dtype=np.int32)
+        if self._churn_pending:
+            # recycles staged while this block was in flight: their rows'
+            # host watermark is the mirror's (new tenant) until THEIR
+            # block lands — the harvested vector still shows the old one
+            rows = np.fromiter(self._churn_pending, dtype=np.int64)
+            self._committed_cache[rows] = (
+                self.mirror.arrays["committed"][rows]
+            )
+        res.commit_rows = self._translate_egress(
+            res, committed, prev_committed, row_cid, row_base,
+            (("won", won), ("lost", lost), ("elect", elect),
+             ("heartbeat", hb), ("demote", demote)),
+        )
+        return res
+
+    @staticmethod
+    def _translate_egress(
+        res, committed, prev_committed, row_cid, row_base, flags
+    ) -> np.ndarray:
+        """Vectorized row→cluster egress translation, shared by step()'s
+        single-round path and the fused harvest: watermark deltas become
+        (cid, abs) arrays (dead rows — cid -1 — dropped; the commit dict
+        materializes lazily), flag vectors become cid lists.  Returns the
+        changed-row index vector."""
+        changed = np.nonzero(committed != prev_committed)[0]
+        if changed.size:
+            cids = row_cid[changed]
+            live = cids >= 0
+            res._commit_cids = cids[live]
+            res._commit_abs = (row_base[changed] + committed[changed])[live]
+        for name, arr in flags:
+            idx = np.nonzero(np.asarray(arr))[0]
+            if idx.size:
+                cids = row_cid[idx]
+                getattr(res, name).extend(cids[cids >= 0].tolist())
+        return changed
+
+    def _dispatch_multiround(
+        self, blocks: List[_RoundBuf], do_tick: bool, tick_mask: np.ndarray
+    ):
+        """Stack K closed rounds into (K,G,P) tensors + (K,C) churn blocks
+        and run ``kernels.quorum_multiround`` — one scan, one upload, one
+        egress for the whole block."""
+        from .kernels import quorum_multiround
+
+        k = len(blocks)
+        g, p = self.n_groups, self.n_peers
+        # -1 = untouched sentinel: one tensor instead of (max, touched) —
+        # halves both the host staging stores and the upload bytes
+        ack_max = np.full((k, g, p), -1, np.int32)
+        flat = ack_max.reshape(-1)
+        stride = g * p
+        for r, b in enumerate(blocks):
+            if b.rows.size:
+                if b.cells is not None:  # shared-geometry fast path
+                    cell = r * stride + b.cells
+                else:
+                    cell = (r * g + b.rows.astype(np.int64)) * p + b.slots
+                np.maximum.at(flat, cell, b.rels)
+        has_votes = any(b.votes for b in blocks)
+        if has_votes:
+            vote_new = np.full((k, g, p), VOTE_NONE, np.int8)
+            for r, b in enumerate(blocks):
+                if b.votes:
+                    cols = np.array(b.votes, dtype=np.int64).T
+                    vote_new[r, cols[0], cols[1]] = cols[2].astype(np.int8)
+        else:
+            vote_new = np.zeros((1, 1, 1), np.int8)  # unused dummy
+        has_churn = any(b.churn for b in blocks)
+        if has_churn:
+            # pad the per-round churn width to a power of two so the jit
+            # cache stays bounded at ~log2(G) entries per K (the same
+            # shape-bucketing rationale as _pad_pow2_rows)
+            cmax = max(len(b.churn) for b in blocks)
+            cap = 1 << max(0, cmax - 1).bit_length()
+            cap = max(cap, 1)
+            churn_row = np.full((k, cap), g, np.int32)  # g = padding (drops)
+            churn_term = np.zeros((k, cap), np.int32)
+            churn_start = np.zeros((k, cap), np.int32)
+            churn_last = np.zeros((k, cap), np.int32)
+            for r, b in enumerate(blocks):
+                if b.churn:
+                    cols = np.array(b.churn, dtype=np.int64).T
+                    n = cols.shape[1]
+                    churn_row[r, :n] = cols[0]
+                    churn_term[r, :n] = cols[1]
+                    churn_start[r, :n] = cols[2]
+                    churn_last[r, :n] = cols[3]
+        else:
+            z = np.zeros((1, 1), np.int32)
+            churn_row = churn_term = churn_start = churn_last = z
+        out = quorum_multiround(
+            self._dev,
+            jnp.asarray(ack_max),
+            jnp.asarray(vote_new),
+            jnp.asarray(churn_row),
+            jnp.asarray(churn_term),
+            jnp.asarray(churn_start),
+            jnp.asarray(churn_last),
+            jnp.asarray(tick_mask),
+            do_tick=do_tick,
+            track_contact=self.device_ticks or do_tick,
+            has_votes=has_votes,
+            has_churn=has_churn,
+        )
+        self._dev = out.state
+        return out
+
+    def _refresh_committed_cache(self) -> None:
+        """Re-read the host committed twin from the device when it was
+        invalidated (external ``dev`` assignment).  Rows with a staged
+        in-program recycle keep their MIRROR watermark (the device still
+        holds the old tenant until the block dispatches)."""
+        if not self._cache_stale:
+            return
+        self._committed_cache = np.array(
+            np.asarray(self._dev.committed), dtype=np.int32
+        )
+        if self._churn_pending:
+            rows = np.fromiter(self._churn_pending, dtype=np.int64)
+            self._committed_cache[rows] = (
+                self.mirror.arrays["committed"][rows]
+            )
+        self._cache_stale = False
+
+    def committed_view(self) -> np.ndarray:
+        """Absolute committed watermark per ROW as one (G,) int64 vector —
+        the fully vectorized egress view (dead rows included; mask with
+        ``row_cids() >= 0``).  Fresh after any step/harvest; reads the
+        host twin, never the device."""
+        self._harvest_inflight()
+        self._refresh_committed_cache()
+        view = self._row_base + self._committed_cache.astype(np.int64)
+        if self._dirty:
+            rows = np.fromiter(self._dirty, dtype=np.int64)
+            view[rows] = (
+                self._row_base[rows]
+                + self.mirror.arrays["committed"][rows].astype(np.int64)
+            )
+        return view
+
+    def row_cids(self) -> np.ndarray:
+        """(G,) int64 cluster id per row (-1 = dead); pairs with
+        ``committed_view`` for vectorized watermark asserts."""
+        return self._row_cid.copy()
+
+    # ------------------------------------------------------------------
     # dispatch
     # ------------------------------------------------------------------
 
     def _sync_row(self, row: int) -> None:
         """Pull one device row into the mirror before mutating it (the
-        dense path may have advanced it since the last upload)."""
+        dense path may have advanced it since the last upload).
+
+        A row with an undispatched in-program recycle is special: its
+        MIRROR already holds the post-recycle state (recycle_row) and the
+        device row is stale pre-recycle data — pulling it would resurrect
+        the old tenant under the new cid.  The caller is about to mutate
+        the row host-side, which supersedes the staged device reset, so
+        the recycle collapses to pre-block ordering: drop the in-program
+        record and dirty the (post-recycle) mirror for upload instead."""
+        self._harvest_inflight()
+        if row in self._churn_pending:
+            self._drop_churn_records(row, drop_events=True)
+            self._dirty.add(row)
+            return
         if row in self._dirty or row in self._synced:
             return
         for k in self.mirror.arrays:
@@ -475,6 +990,14 @@ class BatchedQuorumEngine:
         per transition (the per-row form measured ~0.5ms each on the CPU
         backend — an election burst syncing 1,024 rows one at a time was
         the bulk of a 680ms round)."""
+        self._harvest_inflight()
+        if self._churn_pending:
+            # recycled-but-undispatched rows keep their mirror state and
+            # collapse the recycle to pre-block ordering (see _sync_row)
+            for r in rows:
+                if r in self._churn_pending:
+                    self._drop_churn_records(r, drop_events=True)
+                    self._dirty.add(r)
         todo = [
             r for r in rows if r not in self._dirty and r not in self._synced
         ]
@@ -491,6 +1014,7 @@ class BatchedQuorumEngine:
     def _upload_dirty(self) -> None:
         if not self._dirty:
             return
+        self._harvest_inflight()
         rows = self._pad_pow2_rows(np.fromiter(self._dirty, dtype=np.int32))
         st = self.dev
         updates = {}
@@ -522,7 +1046,15 @@ class BatchedQuorumEngine:
 
         Oversized event backlogs run extra (tickless) dispatches first so
         the jit program never recompiles for a new batch size.
+
+        When rounds were staged (``begin_round`` / ``stage_recycle``),
+        the whole backlog — closed rounds plus the open buffers as the
+        final round — runs as ONE fused multi-round dispatch instead
+        (``step_rounds``; the result satisfies the StepResult interface).
         """
+        if self._round_blocks or self._churn:
+            return self.step_rounds(do_tick=do_tick)
+        self._harvest_inflight()
         # stale-epoch votes (staged before a row transition) drop here;
         # surviving entries shed the epoch column for the dispatch path
         if self._votes:
@@ -536,11 +1068,7 @@ class BatchedQuorumEngine:
         # step on a network-attached chip); _upload_dirty and the egress
         # below keep it coherent.  An external `eng.dev = ...` assignment
         # marks it stale and forces a one-time device re-read here.
-        if self._cache_stale:
-            self._committed_cache = np.array(
-                np.asarray(self._dev.committed), dtype=np.int32
-            )
-            self._cache_stale = False
+        self._refresh_committed_cache()
         prev_committed = self._committed_cache
 
         ack_g, ack_p, ack_v = self._gather_acks()
@@ -590,31 +1118,14 @@ class BatchedQuorumEngine:
                 out.flags.checkq_demote,
             )
         )
-        changed = np.nonzero(committed != prev_committed)[0]
         # device_get arrays are read-only; the cache must stay writable
         # for _upload_dirty's row sync
         self._committed_cache = np.array(committed, dtype=np.int32)
-        if changed.size:
-            # vectorized row→(cid, abs index) translation: dead rows carry
-            # cid -1 and are dropped (their committed can flip when a row
-            # is reused mid-buffer)
-            cids = self._row_cid[changed]
-            live_mask = cids >= 0
-            abs_commit = self._row_base[changed] + committed[changed]
-            res.commit = dict(
-                zip(cids[live_mask].tolist(), abs_commit[live_mask].tolist())
-            )
-        for name, arr in (
-            ("won", won),
-            ("lost", lost),
-            ("elect", elect),
-            ("heartbeat", hb),
-            ("demote", demote),
-        ):
-            idx = np.nonzero(np.asarray(arr))[0]
-            if idx.size:
-                cids = self._row_cid[idx]
-                getattr(res, name).extend(cids[cids >= 0].tolist())
+        self._translate_egress(
+            res, committed, prev_committed, self._row_cid, self._row_base,
+            (("won", won), ("lost", lost), ("elect", elect),
+             ("heartbeat", hb), ("demote", demote)),
+        )
         return res
 
     def _gather_acks(self):
@@ -734,8 +1245,11 @@ class BatchedQuorumEngine:
     # ------------------------------------------------------------------
 
     def _read(self, field_name: str, row: int):
-        """Field value at a row: pending mirror edits win over device."""
-        if row in self._dirty:
+        """Field value at a row: pending mirror edits win over device —
+        including a staged in-program recycle, whose mirror row is the
+        post-recycle truth while the device still holds the old tenant."""
+        self._harvest_inflight()
+        if row in self._dirty or row in self._churn_pending:
             return self.mirror.arrays[field_name][row]
         return np.asarray(getattr(self.dev, field_name)[row])
 
@@ -752,15 +1266,14 @@ class BatchedQuorumEngine:
         egress cache is fresh and the call is zero-transfer — it indexes
         the vector the device produced for that round's egress.  Pass
         ``cids`` when sampling: building the full dict for 100k groups
-        costs ~100k boxed ints per call."""
-        if self._cache_stale:
-            self._committed_cache = np.array(
-                np.asarray(self.dev.committed), dtype=np.int32
-            )
-            self._cache_stale = False
+        costs ~100k boxed ints per call (vectorized twin:
+        ``committed_view``)."""
+        self._harvest_inflight()
+        self._refresh_committed_cache()
         committed = self._committed_cache
         mirror = self.mirror.arrays["committed"]
         dirty = self._dirty
+        pend = self._churn_pending
         items = (
             self.groups.items()
             if cids is None
@@ -768,7 +1281,11 @@ class BatchedQuorumEngine:
         )
         return {
             cid: int(gi.base)
-            + int(mirror[gi.row] if gi.row in dirty else committed[gi.row])
+            + int(
+                mirror[gi.row]
+                if gi.row in dirty or gi.row in pend
+                else committed[gi.row]
+            )
             for cid, gi in items
         }
 

@@ -544,3 +544,151 @@ quorum_multistep_dense = jax.jit(
     static_argnames=("do_tick", "track_contact", "has_votes"),
     donate_argnums=(0,),
 )
+
+
+def _apply_recycle(
+    st: QuorumState,
+    row: jax.Array,    # (C,) i32 — target rows; G (out of range) = padding
+    term: jax.Array,   # (C,) i32
+    start: jax.Array,  # (C,) i32 rel — term_start of the fresh leader
+    last: jax.Array,   # (C,) i32 rel — last_index of the fresh leader
+) -> QuorumState:
+    """Masked leader-recycle row reset (twin: the host's ``remove_group``
+    + ``add_group`` + ``set_leader`` sequence for a SAME-GEOMETRY tenant
+    swap, ``engine.py``).  Membership geometry (quorum, self_slot, voting,
+    present, electable, timeouts) is untouched — the engine's
+    ``stage_recycle`` validates that invariant host-side — so the reset is
+    a handful of row scatters instead of a full host re-upload: the
+    VERDICT §7 design pivot (churn as masked updates inside the dispatched
+    program).  Padding rows carry ``row == G`` and drop out of bounds.
+    """
+    g, p = st.match.shape
+    sel = st.self_slot[row.clip(0, g - 1)]  # (C,) — self slot per target row
+    cols = jnp.arange(p, dtype=I32)[None, :]
+    # reset_remotes: match 0 everywhere except self = last; next = last + 1
+    match_rows = jnp.where(cols == sel[:, None], last[:, None], 0)
+    next_rows = jnp.broadcast_to(last[:, None] + 1, match_rows.shape)
+    zc = jnp.zeros_like(term)
+    return st._replace(
+        node_state=st.node_state.at[row].set(LEADER, mode="drop"),
+        live=st.live.at[row].set(True, mode="drop"),
+        term=st.term.at[row].set(term, mode="drop"),
+        term_start=st.term_start.at[row].set(start, mode="drop"),
+        last_index=st.last_index.at[row].set(last, mode="drop"),
+        committed=st.committed.at[row].set(zc, mode="drop"),
+        election_tick=st.election_tick.at[row].set(zc, mode="drop"),
+        heartbeat_tick=st.heartbeat_tick.at[row].set(zc, mode="drop"),
+        match=st.match.at[row].set(match_rows, mode="drop"),
+        next=st.next.at[row].set(next_rows, mode="drop"),
+        active=st.active.at[row].set(False, mode="drop"),
+        votes=st.votes.at[row].set(
+            jnp.full(match_rows.shape, VOTE_NONE, jnp.int8), mode="drop"
+        ),
+    )
+
+
+def quorum_multiround_impl(
+    st: QuorumState,
+    ack_max: jax.Array,     # (K,G,P) i32 — per-round ack maxima; -1 = untouched
+    vote_new: jax.Array,    # (K,G,P) i8, or (1,1,1) dummy when not has_votes
+    churn_row: jax.Array,   # (K,C) i32 — rows recycled at round start; G = pad
+    churn_term: jax.Array,  # (K,C) i32
+    churn_start: jax.Array,  # (K,C) i32 rel
+    churn_last: jax.Array,  # (K,C) i32 rel
+    tick_mask: jax.Array,   # (K,) bool — which rounds tick; dummy when !do_tick
+    do_tick: bool = False,
+    track_contact: bool = True,
+    has_votes: bool = False,
+    has_churn: bool = False,
+) -> StepOutputs:
+    """K engine rounds — INCLUDING membership churn — in ONE dispatch.
+
+    This is the ladder's workhorse (ISSUE 1 tentpole): the host stages K
+    rounds of dense event blocks plus per-round leader-recycle records and
+    the device scans them, paying one dispatch + one egress transfer for
+    the whole block instead of per round.  Round structure mirrors the
+    host sequence exactly: (1) apply that round's row recycles (the twin
+    of ``_upload_dirty`` scattering a re-registered row before the
+    dispatch), (2) ingest the round's dense ack/vote block, (3) tally /
+    commit / tick.  The single ``-1``-sentinel ack tensor replaces the
+    separate ``(ack_max, ack_touched)`` pair — ``touched == ack_max >= 0``
+    is computed on device, halving host staging stores and upload bytes.
+
+    ``tick_mask`` makes the per-round tick decision DYNAMIC under a
+    static ``do_tick=True``: the live coordinator catches up a varying
+    tick deficit (2..4) by padding every block to a FIXED K with
+    event-free masked-off rounds, so one compiled program serves every
+    deficit — per-K recompiles measured 0.5-4s each on a loaded 2-vCPU
+    host, long enough to stall proposals behind the compile.  A padding
+    round (no events, tick masked off) is a provable no-op: ingestion of
+    an all-sentinel block changes nothing and the standing-state
+    tally/commit flags are idempotent across rounds.
+
+    Ingestion delegates to :func:`quorum_step_dense_impl`, so each scanned
+    round is bit-identical to a standalone dense dispatch of the same
+    block (differential: ``tests/test_multiround.py``).  Egress carries
+    the final state, final commit watermarks (monotone ⇒ sufficient), and
+    OR-accumulated flags.  Flag OR-accumulation is per ROW: a row recycled
+    mid-block attributes surviving flags to its final tenant — recycling
+    callers (bench rungs, tickless coordinators) run flag-free rounds.
+    """
+
+    def body(carry, ev):
+        stc = carry
+        i = 0
+        am = ev[i]; i += 1
+        if has_votes:
+            vn = ev[i]; i += 1
+        else:
+            vn = jnp.zeros((1, 1), jnp.int8)
+        if has_churn:
+            crow, cterm, cstart, clast = (
+                ev[i], ev[i + 1], ev[i + 2], ev[i + 3]
+            )
+            i += 4
+            stc = _apply_recycle(stc, crow, cterm, cstart, clast)
+        out = quorum_step_dense_impl(
+            stc,
+            jnp.maximum(am, 0),  # -1 sentinel → 0 (a scatter-max no-op)
+            am >= 0,
+            vn,
+            do_tick=False,  # ticking handled below, per-round masked
+            track_contact=track_contact,
+            has_votes=has_votes,
+        )
+        stc = out.state
+        if do_tick:
+            tm = ev[i]  # () bool — this round's tick decision
+            ticked, tflags = tick_step(stc)
+            stc = QuorumState(
+                *(jnp.where(tm, t, o) for t, o in zip(ticked, stc))
+            )
+            flags = TickFlags(*(f & tm for f in tflags))
+        else:
+            zeros = jnp.zeros_like(out.won)
+            flags = TickFlags(zeros, zeros, zeros)
+        return stc, (out.won, out.lost, flags)
+
+    xs = (ack_max,)
+    if has_votes:
+        xs = xs + (vote_new,)
+    if has_churn:
+        xs = xs + (churn_row, churn_term, churn_start, churn_last)
+    if do_tick:
+        xs = xs + (tick_mask,)
+    st, (won, lost, flags) = jax.lax.scan(body, st, xs)
+    any_ = lambda x: jnp.any(x, axis=0)  # noqa: E731
+    return StepOutputs(
+        st,
+        st.committed,
+        any_(won),
+        any_(lost),
+        TickFlags(*(any_(f) for f in flags)),
+    )
+
+
+quorum_multiround = jax.jit(
+    quorum_multiround_impl,
+    static_argnames=("do_tick", "track_contact", "has_votes", "has_churn"),
+    donate_argnums=(0,),
+)

@@ -136,3 +136,28 @@ class HostMirror:
     def pull(self, st: QuorumState) -> None:
         for k, v in st._asdict().items():
             np.copyto(self.arrays[k], np.asarray(v))
+
+    def recycle_row(
+        self, row: int, term: int, term_start: int, last_index: int
+    ) -> None:
+        """Numpy twin of ``kernels._apply_recycle``: reset a row to a
+        fresh same-geometry leader tenant WITHOUT touching membership
+        columns.  The engine applies this when it stages a device-side
+        recycle (``BatchedQuorumEngine.stage_recycle``) so the mirror's
+        host-authoritative columns (term, watermarks) match what the
+        dispatched program will compute — the row is deliberately NOT
+        marked dirty; the device applies the same reset in-program."""
+        a = self.arrays
+        a["live"][row] = True
+        a["node_state"][row] = LEADER
+        a["term"][row] = term
+        a["term_start"][row] = term_start
+        a["last_index"][row] = last_index
+        a["committed"][row] = 0
+        a["election_tick"][row] = 0
+        a["heartbeat_tick"][row] = 0
+        a["match"][row, :] = 0
+        a["match"][row, a["self_slot"][row]] = last_index
+        a["next"][row, :] = last_index + 1
+        a["active"][row, :] = False
+        a["votes"][row, :] = VOTE_NONE

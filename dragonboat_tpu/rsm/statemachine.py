@@ -467,6 +467,7 @@ class StateMachine:
         term: int,
         kv_image: bytes,
         session_image: bytes,
+        membership=None,
     ) -> Tuple[Snapshot, object]:
         """Snapshot from a pre-captured consistent native image
         (``natr_capture_sm``): the native core serialized kv+sessions at
@@ -474,7 +475,17 @@ class StateMachine:
         — no update lock is needed here and the fast lane keeps applying
         while the file is written.  The image framing matches
         ``NativeKVStateMachine.save_snapshot``, so recovery is the shared
-        path."""
+        path.
+
+        ``membership`` must be the view captured ATOMICALLY with
+        ``index`` (the caller snapshots it before ``natr_capture_sm`` and
+        falls back to the eject path if the config-change id moved —
+        ``Node._try_capture_save``): reading live membership here would
+        race a config-change apply landing between the native capture and
+        this call, labeling the image with membership newer than its
+        index (the reference captures both under one mutex,
+        ``prepare_snapshot``).  ``None`` preserves the legacy live read
+        for callers that hold applies off by construction."""
         if self.snapshotter is None:
             raise RuntimeError("no snapshotter configured")
         with self._save_mu:
@@ -487,7 +498,10 @@ class StateMachine:
                     term=term,
                     on_disk_index=0,
                     request=req,
-                    membership=self.members.get(),
+                    membership=(
+                        membership if membership is not None
+                        else self.members.get()
+                    ),
                     session=session_image,
                     type=self.sm_type,
                     compression=self.snapshot_compression,

@@ -410,6 +410,17 @@ class TpuQuorumCoordinator:
                 or (self.eng._dirty and not self.drive_ticks)
             ):
                 return
+            # Tick catch-up stays PER-STEP on the live path, deliberately:
+            # the fused K-round program (step_rounds, the ladder's
+            # workhorse) was measured here and reverted — on a loaded
+            # host the deficit fires constantly (~300×/min at test scale),
+            # each first-use XLA compile of a fused variant costs 0.5-4s
+            # (stalling proposals behind it; pre-warming the cache just
+            # moved the contention to startup), while the per-step replay
+            # reuses the single-round programs every round already
+            # compiled.  Bulk-staged drivers with no latency bound (bench
+            # ladder, native control planes) use begin_round/step_rounds
+            # directly — see docs/overview.md "multi-round coordinator".
             res = self.eng.step(do_tick=do_tick)
             for _ in range(deficit - 1):  # replay remaining missed ticks
                 extra = self.eng.step(do_tick=True)

@@ -1,0 +1,599 @@
+"""Differential tests for the K-round fused dispatch (ISSUE 1 tentpole).
+
+The multi-round program (``kernels.quorum_multiround`` /
+``BatchedQuorumEngine.begin_round``/``step_rounds``/``stage_recycle``)
+must be observationally identical to K single-round dispatches — and,
+through them, to the scalar Raft path the single-round differential
+suites pin (``tests/test_ops_quorum.py``).  Every test here compares
+full device state field-by-field, not just watermarks, including the
+membership-recycle-mid-block case where churn travels inside the
+dispatched program as masked row updates.
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from dragonboat_tpu.ops.engine import BatchedQuorumEngine, MultiRoundResult
+from dragonboat_tpu.wire import Entry, Message, MessageType
+from raft_harness import new_test_raft
+
+MT = MessageType
+
+
+def _state_equal(a, b, tag=""):
+    for name, va in a._asdict().items():
+        vb = getattr(b, name)
+        assert np.array_equal(np.asarray(va), np.asarray(vb)), (tag, name)
+
+
+def _build(n_groups=12, n_peers=3, cap=256):
+    eng = BatchedQuorumEngine(n_groups, n_peers, event_cap=cap)
+    for cid in range(1, n_groups + 1):
+        eng.add_group(cid, node_ids=list(range(1, n_peers + 1)), self_id=1)
+        eng.set_leader(cid, term=1, term_start=1, last_index=1)
+    eng._upload_dirty()
+    return eng
+
+
+# ----------------------------------------------------------------------
+# kernel level: fused scan ≡ K sequential dense dispatches
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("do_tick", [False, True])
+def test_multiround_kernel_matches_dense_rounds(do_tick):
+    from dragonboat_tpu.ops.kernels import quorum_multiround, quorum_step_dense
+    from dragonboat_tpu.ops.state import VOTE_NONE
+
+    rng = random.Random(501 + do_tick)
+    g, p, k = 16, 3, 5
+    eng_a = _build(g, p)
+    eng_b = _build(g, p)
+    _state_equal(eng_a.dev, eng_b.dev)
+
+    # random per-round dense blocks with the -1 sentinel
+    ack = np.full((k, g, p), -1, np.int32)
+    votes = np.full((k, g, p), VOTE_NONE, np.int8)
+    for r in range(k):
+        for _ in range(rng.randrange(0, 24)):
+            ack[r, rng.randrange(g), rng.randrange(p)] = rng.choice(
+                [0, 1, 2, 5, 9]
+            )
+        for _ in range(rng.randrange(0, 4)):
+            votes[r, rng.randrange(g), rng.randrange(p)] = rng.choice([0, 1])
+
+    out_f = quorum_multiround(
+        eng_a.dev,
+        jnp.asarray(ack),
+        jnp.asarray(votes),
+        jnp.zeros((1, 1), jnp.int32),
+        jnp.zeros((1, 1), jnp.int32),
+        jnp.zeros((1, 1), jnp.int32),
+        jnp.zeros((1, 1), jnp.int32),
+        jnp.asarray(np.ones((k,), bool)),
+        do_tick=do_tick,
+        track_contact=True,
+        has_votes=True,
+        has_churn=False,
+    )
+
+    st = eng_b.dev
+    won = lost = None
+    flags_acc = None
+    for r in range(k):
+        am = ack[r]
+        out = quorum_step_dense(
+            st,
+            jnp.asarray(np.maximum(am, 0)),
+            jnp.asarray(am >= 0),
+            jnp.asarray(votes[r]),
+            do_tick=do_tick,
+            track_contact=True,
+            has_votes=True,
+        )
+        st = out.state
+        w, l_ = np.asarray(out.won), np.asarray(out.lost)
+        fl = [np.asarray(f) for f in out.flags]
+        if won is None:
+            won, lost, flags_acc = w, l_, fl
+        else:
+            won, lost = won | w, lost | l_
+            flags_acc = [a | b for a, b in zip(flags_acc, fl)]
+
+    _state_equal(out_f.state, st, "kernel")
+    assert np.array_equal(np.asarray(out_f.won), won)
+    assert np.array_equal(np.asarray(out_f.lost), lost)
+    for i in range(3):
+        assert np.array_equal(np.asarray(out_f.flags[i]), flags_acc[i]), i
+
+
+# ----------------------------------------------------------------------
+# engine level: begin_round/step_rounds ≡ per-round step()
+# ----------------------------------------------------------------------
+
+
+def test_multiround_engine_matches_per_round_steps():
+    """Random multi-round workloads (acks, votes, heartbeat zero-acks)
+    through the fused path vs one step() per round: final device state
+    bit-identical; the fused commit egress equals the final watermarks of
+    the per-round sequence."""
+    seed = 902
+
+    def drive(eng, fused):
+        rng = random.Random(seed)
+        per_round_commit = {}
+        for _ in range(6):
+            for _ in range(rng.randrange(4, 30)):
+                cid = rng.randrange(1, 13)
+                idx = rng.randrange(1, 8)
+                eng.ack(cid, rng.choice([1, 2, 3]), idx)
+            if rng.random() < 0.4:
+                eng.heartbeat_resp(rng.randrange(1, 13), 2)
+            if fused:
+                eng.begin_round()
+            else:
+                res = eng.step(do_tick=False)
+                per_round_commit.update(res.commit)
+        if fused:
+            res = eng.step_rounds(do_tick=False)
+            return eng, res.commit
+        return eng, per_round_commit
+
+    eng_f, commit_f = drive(_build(), True)
+    eng_s, commit_s = drive(_build(), False)
+    _state_equal(eng_f.dev, eng_s.dev, "engine")
+    # fused egress reports final watermarks; the per-round merge's last
+    # value per cid is exactly that
+    assert commit_f == {
+        cid: q
+        for cid, q in commit_s.items()
+        if eng_s.committed_index(cid) == q
+    }
+    for cid in range(1, 13):
+        assert eng_f.committed_index(cid) == eng_s.committed_index(cid)
+
+
+def test_multiround_vote_quorum_mid_block():
+    """A candidate reaching quorum in round r of a fused block must set
+    the OR-accumulated won flag exactly as the per-round path does."""
+    def build():
+        eng = BatchedQuorumEngine(4, 3, event_cap=64)
+        eng.add_group(1, node_ids=[1, 2, 3], self_id=1)
+        eng.set_candidate(1, term=2)
+        return eng
+
+    a, b = build(), build()
+    # round 0: self vote only; round 1: peer 2 grants -> quorum of 2
+    a.vote(1, 1, True)
+    a.begin_round()
+    a.vote(1, 2, True)
+    a.begin_round()
+    ra = a.step_rounds(do_tick=False)
+    b.vote(1, 1, True)
+    r0 = b.step(do_tick=False)
+    b.vote(1, 2, True)
+    r1 = b.step(do_tick=False)
+    assert r0.won == [] and r1.won == [1]
+    assert ra.won == [1]
+    _state_equal(a.dev, b.dev, "votes")
+
+
+def test_multiround_padded_tick_mask_matches_sequential():
+    """The coordinator's fixed-K catch-up shape: a block of 2 real tick
+    rounds padded to K=4 with masked-off empty rounds must equal 2
+    sequential step(do_tick=True) calls exactly — the padding rounds are
+    provable no-ops (one compiled program serves every deficit)."""
+    def build():
+        eng = BatchedQuorumEngine(3, 3, event_cap=32)
+        eng.add_group(
+            1, node_ids=[1, 2, 3], self_id=1,
+            election_timeout=4, rand_timeout=6,
+        )
+        eng.add_group(2, node_ids=[1, 2, 3], self_id=1)
+        eng.set_leader(2, term=1, term_start=1, last_index=1)
+        return eng
+
+    for blocks in range(1, 4):  # 2, 4, 6 total ticks
+        a, b = build(), build()
+        for _ in range(blocks):
+            a.ack(2, 1, 2)
+            a.ack(2, 2, 2)
+            a.begin_round()
+            a.begin_round()
+            ra = a.step_rounds(do_tick=True, pad_rounds_to=4)
+            b.ack(2, 1, 2)
+            b.ack(2, 2, 2)
+            rb0 = b.step(do_tick=True)
+            rb1 = b.step(do_tick=True)
+        _state_equal(a.dev, b.dev, f"padded-ticks-{blocks}")
+        assert sorted(ra.elect) == sorted(set(rb0.elect) | set(rb1.elect))
+        assert ra.commit.get(2) == (rb0.commit | rb1.commit).get(2)
+
+
+def test_multiround_tick_rounds_match_sequential_ticks():
+    """K fused tick rounds (the coordinator's catch-up shape) fire
+    election flags on exactly the same tick as K sequential step()s."""
+    def build():
+        eng = BatchedQuorumEngine(2, 3, event_cap=32)
+        eng.add_group(
+            1, node_ids=[1, 2, 3], self_id=1,
+            election_timeout=4, rand_timeout=5,
+        )
+        return eng
+
+    # sequential: find the firing tick
+    eng = build()
+    fired_seq = None
+    for tick in range(1, 9):
+        out = eng.step(do_tick=True)
+        if out.elect:
+            fired_seq = tick
+            break
+    assert fired_seq == 5
+
+    # fused blocks of 2: the flag must surface in the block containing
+    # tick 5 (OR-accumulated), and not before
+    eng = build()
+    fired_block = None
+    for block in range(4):
+        eng.begin_round()
+        eng.begin_round()
+        out = eng.step_rounds(do_tick=True)
+        if out.elect and fired_block is None:
+            fired_block = block
+    assert fired_block == 2  # ticks 5-6 live in the third block of 2
+
+
+# ----------------------------------------------------------------------
+# membership recycle mid-block (churn inside the dispatched program)
+# ----------------------------------------------------------------------
+
+
+def test_multiround_recycle_mid_block_matches_host_churn():
+    """stage_recycle (device-side masked row reset at round start) must
+    be bit-identical to the host remove/add/set_leader path run between
+    per-round dispatches — including purging same-round old-tenant
+    events and ingesting same-round new-tenant acks."""
+    a, b = _build(8, 3), _build(8, 3)
+
+    # round 0: everyone commits index 2; group 3 also has a STALE ack
+    # staged after the round that must die with the old tenant
+    for cid in range(1, 9):
+        a.ack(cid, 1, 2)
+        a.ack(cid, 2, 2)
+        b.ack(cid, 1, 2)
+        b.ack(cid, 2, 2)
+    a.begin_round()
+    b.step(do_tick=False)
+
+    # round 1: old-tenant ack staged BEFORE the recycle (must be purged),
+    # then recycle 3 -> 103, then the new tenant commits 2
+    a.ack(3, 2, 9)  # old tenant, same round: purged by the recycle
+    a.stage_recycle(3, 103, term=1, term_start=1, last_index=1)
+    a.ack(103, 1, 2)
+    a.ack(103, 2, 2)
+    for cid in (1, 5):
+        a.ack(cid, 1, 3)
+        a.ack(cid, 2, 3)
+    a.begin_round()
+    ra = a.step_rounds(do_tick=False)
+
+    b.ack(3, 2, 9)
+    b.remove_group(3)  # purges the staged old-tenant ack (epoch bump)
+    b.add_group(103, node_ids=[1, 2, 3], self_id=1)
+    b.set_leader(103, term=1, term_start=1, last_index=1)
+    b.ack(103, 1, 2)
+    b.ack(103, 2, 2)
+    for cid in (1, 5):
+        b.ack(cid, 1, 3)
+        b.ack(cid, 2, 3)
+    rb = b.step(do_tick=False)
+
+    _state_equal(a.dev, b.dev, "recycle")
+    assert a.committed_index(103) == b.committed_index(103) == 2
+    assert a.committed_index(1) == b.committed_index(1) == 3
+    assert ra.commit[103] == rb.commit[103] == 2
+    # row bookkeeping: the new tenant owns the old tenant's row, base 0
+    assert a.groups[103].row == b.groups[103].row
+    assert 3 not in a.groups and 3 not in b.groups
+
+
+def test_multiround_recycle_against_scalar_oracle():
+    """Commit vectors of a fused churn block stay bit-identical to scalar
+    Raft oracles driven through the same K rounds, with a recycle in the
+    middle of the block (the ISSUE 1 acceptance case)."""
+    peers = [1, 2, 3]
+
+    def mk_leader(cid):
+        r = new_test_raft(1, peers)
+        r.cluster_id = cid
+        r.handle(Message(from_=1, to=1, type=MT.ELECTION))
+        for p in (2, 3):
+            if not r.is_leader():
+                r.handle(Message(
+                    from_=p, to=1, term=r.term, type=MT.REQUEST_VOTE_RESP
+                ))
+        assert r.is_leader()
+        return r
+
+    eng = BatchedQuorumEngine(4, 3, event_cap=128)
+    oracles = {}
+    for cid in (1, 2, 3):
+        r = mk_leader(cid)
+        oracles[cid] = r
+        eng.add_group(cid, node_ids=peers, self_id=1)
+        eng.set_leader(
+            cid, term=r.term, term_start=r.log.last_index(),
+            last_index=r.log.last_index(),
+        )
+
+    def propose_and_ack(r, cid):
+        r.handle(Message(
+            from_=1, to=1, type=MT.PROPOSE, entries=[Entry(cmd=b"x")]
+        ))
+        idx = r.log.last_index()
+        eng.ack(cid, 1, idx)
+        for p in (2, 3):
+            r.handle(Message(
+                from_=p, to=1, term=r.term, type=MT.REPLICATE_RESP,
+                log_index=idx,
+            ))
+            eng.ack(cid, p, idx)
+
+    # rounds 0-1: all three commit; round 2: group 2 is recycled into a
+    # brand-new group 42 (fresh oracle) which commits in the same round;
+    # round 3: everyone commits again
+    for _ in range(2):
+        for cid, r in oracles.items():
+            propose_and_ack(r, cid)
+        eng.begin_round()
+    fresh = mk_leader(42)
+    eng.stage_recycle(
+        2, 42, term=fresh.term,
+        term_start=fresh.log.last_index(),
+        last_index=fresh.log.last_index(),
+    )
+    del oracles[2]
+    oracles[42] = fresh
+    for cid, r in oracles.items():
+        propose_and_ack(r, cid)
+    eng.begin_round()
+    for cid, r in oracles.items():
+        propose_and_ack(r, cid)
+    res = eng.step_rounds(do_tick=False)
+    assert isinstance(res, MultiRoundResult) and res.rounds == 4
+    for cid, r in oracles.items():
+        assert eng.committed_index(cid) == r.log.committed, cid
+        assert res.commit[cid] == r.log.committed, cid
+
+
+def test_stage_recycle_validation():
+    eng = _build(4, 3)
+    with pytest.raises(ValueError):
+        eng.stage_recycle(99, 100, term=1, term_start=1, last_index=1)
+    with pytest.raises(ValueError):
+        eng.stage_recycle(1, 2, term=1, term_start=1, last_index=1)  # taken
+    with pytest.raises(ValueError):  # geometry change (rand_timeout)
+        eng.stage_recycle(
+            1, 100, term=1, term_start=1, last_index=1, rand_timeout=99
+        )
+    with pytest.raises(ValueError):  # term_start > last_index
+        eng.stage_recycle(1, 100, term=1, term_start=5, last_index=1)
+    eng.stage_recycle(1, 100, term=1, term_start=1, last_index=1)
+    with pytest.raises(ValueError):  # same row twice in one round
+        eng.stage_recycle(100, 101, term=1, term_start=1, last_index=1)
+    eng.begin_round()
+    eng.stage_recycle(100, 101, term=1, term_start=1, last_index=1)  # ok now
+    eng.step_rounds(do_tick=False)
+    assert 101 in eng.groups and 100 not in eng.groups
+
+
+def test_remove_group_drops_open_round_recycle():
+    """remove_group after a same-round stage_recycle must not let the
+    staged in-program reset revive the freed row."""
+    eng = _build(4, 3)
+    eng.stage_recycle(1, 100, term=1, term_start=1, last_index=1)
+    eng.remove_group(100)
+    eng.ack(2, 1, 2)
+    eng.ack(2, 2, 2)
+    eng.begin_round()
+    eng.step_rounds(do_tick=False)
+    row = 0  # group 1 was registered first -> row 0
+    assert not bool(np.asarray(eng.dev.live)[row])
+    assert eng.committed_index(2) == 2
+
+
+def test_remove_group_drops_closed_round_recycle():
+    """A recycle already CLOSED into a pending block must also die with
+    remove_group — the stale record would otherwise revive the freed row
+    (or clobber its next tenant) when the block dispatches."""
+    eng = _build(4, 3)
+    eng.stage_recycle(1, 100, term=7, term_start=1, last_index=1)
+    eng.begin_round()  # churn record now lives in a closed block
+    eng.remove_group(100)
+    # the freed row goes to a NEW tenant via the normal host path
+    eng.add_group(200, node_ids=[1, 2, 3], self_id=1)
+    assert eng.groups[200].row == 0
+    eng.set_leader(200, term=3, term_start=1, last_index=1)
+    eng.ack(200, 1, 2)
+    eng.ack(200, 2, 2)
+    eng.begin_round()
+    eng.step_rounds(do_tick=False)
+    # the dead recycle's term=7 reset must NOT have clobbered tenant 200
+    assert int(np.asarray(eng.dev.term)[0]) == 3
+    assert eng.committed_index(200) == 2
+
+
+def test_rare_path_transition_cancels_pending_recycle():
+    """A host rare-path mutation on a recycled-but-undispatched row must
+    keep the recycle's state as its baseline (the mirror, not the stale
+    pre-recycle device row) and supersede the in-program reset — the
+    transition must survive the dispatch."""
+    eng = _build(4, 3)
+    # advance group 1 so the old tenant's device row is distinguishable
+    eng.ack(1, 1, 5)
+    eng.ack(1, 2, 5)
+    eng.step(do_tick=False)
+    assert eng.committed_index(1) == 5
+    eng.stage_recycle(1, 100, term=2, term_start=1, last_index=1)
+    # host reads of the pending row resolve to the NEW tenant already
+    assert eng.committed_index(100) == 0
+    assert int(eng._read("term", 0)) == 2
+    # rare-path transition on the new tenant before the block dispatches
+    eng.set_leader(100, term=9, term_start=3, last_index=3)
+    eng.ack(100, 1, 3)
+    eng.ack(100, 2, 3)
+    eng.begin_round()
+    eng.step_rounds(do_tick=False)
+    # the transition won (term 9), the dead recycle (term 2) did not,
+    # and the old tenant's match state did not resurrect
+    assert int(np.asarray(eng.dev.term)[0]) == 9
+    assert eng.committed_index(100) == 3
+
+
+def test_collapsed_recycle_purges_closed_round_events():
+    """When a rare-path mutation collapses a staged recycle to pre-block
+    ordering, the OLD tenant's events already sealed into closed blocks
+    must die with it — they would otherwise scatter-max into the new
+    tenant's freshly uploaded row."""
+    eng = _build(4, 3)
+    # closed round 0 carries old-tenant (group 1) acks at rel 5
+    eng.ack(1, 1, 5)
+    eng.ack(1, 2, 5)
+    eng.ack(2, 1, 2)
+    eng.ack(2, 2, 2)
+    eng.begin_round()
+    eng.stage_recycle(1, 100, term=2, term_start=1, last_index=1)
+    # rare-path mutation on the new tenant -> recycle collapses pre-block
+    eng.set_randomized_timeout(100, 20)
+    eng.begin_round()
+    eng.step_rounds(do_tick=False)
+    # group 100 never replicated rel 5; the dead tenant's acks must not
+    # have advanced it (fresh leader at last_index 1, no acks -> 0)
+    assert eng.committed_index(100) == 0
+    assert int(np.asarray(eng.dev.match)[0].max()) <= 1
+    # unrelated group's closed events were untouched
+    assert eng.committed_index(2) == 2
+
+
+def test_pipelined_recycle_does_not_pollute_inflight_egress():
+    """stage_recycle zeroes the host watermark cache in place; a dispatch
+    already in flight must keep its own (snapshotted) commit baseline —
+    no phantom commit deltas for the recycled row."""
+    eng = _build(4, 3)
+    for cid in range(1, 5):
+        eng.ack(cid, 1, 2)
+        eng.ack(cid, 2, 2)
+    eng.step(do_tick=False)
+    # block A: ONLY group 2 advances; group 1 stays at watermark 2
+    eng.ack(2, 1, 3)
+    eng.ack(2, 2, 3)
+    eng.step_rounds(do_tick=False, pipelined=True)
+    # while A is in flight: recycle group 1 (zeroes its cache row)
+    eng.stage_recycle(1, 100, term=1, term_start=1, last_index=1)
+    res = eng.harvest()  # block A's egress
+    # group 1 did not advance in block A: its (old or new) cid must not
+    # appear as a commit delta
+    assert set(res.commit) == {2}, res.commit
+    assert res.commit[2] == 3
+    # and the pending new tenant still reads watermark 0
+    assert eng.committed_index(100) == 0
+    eng.ack(100, 1, 2)
+    eng.ack(100, 2, 2)
+    eng.begin_round()
+    out = eng.step_rounds(do_tick=False)
+    assert out.commit[100] == 2
+
+
+# ----------------------------------------------------------------------
+# pipelined double-buffered staging
+# ----------------------------------------------------------------------
+
+
+def test_pipelined_step_rounds_equivalent():
+    """pipelined=True (ingress double-buffering) must produce the same
+    final state and the same per-block egress as synchronous dispatch,
+    one block late."""
+    a, b = _build(6, 3), _build(6, 3)
+    sync_results = []
+    piped_results = []
+    for blk in range(4):
+        for cid in range(1, 7):
+            a.ack(cid, 1, 2 + blk)
+            a.ack(cid, 2, 2 + blk)
+            b.ack(cid, 1, 2 + blk)
+            b.ack(cid, 2, 2 + blk)
+        sync_results.append(a.step_rounds(do_tick=False))
+        r = b.step_rounds(do_tick=False, pipelined=True)
+        if r is not None:
+            piped_results.append(r)
+    final = b.harvest()
+    assert final is not None
+    piped_results.append(final)
+    _state_equal(a.dev, b.dev, "pipelined")
+    assert len(sync_results) == len(piped_results)
+    for rs, rp in zip(sync_results, piped_results):
+        assert rs.commit == rp.commit
+        assert np.array_equal(rs.committed_rel, rp.committed_rel)
+    # a host read mid-pipeline harvests the in-flight block first
+    for cid in range(1, 7):
+        b.ack(cid, 1, 9)
+        b.ack(cid, 2, 9)
+    b.step_rounds(do_tick=False, pipelined=True)
+    assert b.committed_index(1) == 9  # forced harvest, correct value
+    assert b.harvest() is None       # already drained
+
+
+def test_ack_block_rounds_matches_per_round_staging():
+    """The bulk K-round staging API (one validation, aliased buffers,
+    precomputed cells) must be bit-identical to K× ack_block+begin_round,
+    including duplicate cells within a round (max-aggregation) and
+    below-base clamping."""
+    a, b = _build(8, 3), _build(8, 3)
+    rows = np.array([0, 1, 2, 3, 4, 5, 6, 7, 0], np.int32)  # dup cell row 0
+    slots = np.array([0, 0, 0, 0, 1, 1, 1, 1, 0], np.int32)
+    k = 4
+    rels = np.arange(2, 2 + k, dtype=np.int32)[:, None] + np.zeros(
+        (1, rows.size), np.int32
+    )
+    rels[1, -1] = -3  # below-base retransmit: clamps to 0
+    rels[2, 0] = 1    # stale (lower) ack: max-aggregation keeps 4
+
+    a.ack_block_rounds(rows, slots, rels)
+    ra = a.step_rounds(do_tick=False)
+    for r in range(k):
+        b.ack_block(rows, slots, np.maximum(rels[r], 0))
+        b.begin_round()
+    rb = b.step_rounds(do_tick=False)
+    _state_equal(a.dev, b.dev, "ack_block_rounds")
+    assert ra.commit == rb.commit
+    # validation still fires on the bulk path
+    with pytest.raises(ValueError):
+        a.ack_block_rounds(rows, slots, rels[:, :3])  # shape mismatch
+    with pytest.raises(ValueError):
+        a.ack_block_rounds(
+            np.array([99], np.int32), np.array([0], np.int32),
+            np.array([[1]], np.int32),
+        )
+
+
+def test_committed_view_matches_committed_index():
+    eng = _build(6, 3)
+    for cid in range(1, 7):
+        eng.ack(cid, 1, 1 + cid)
+        eng.ack(cid, 2, 1 + cid)
+    eng.step(do_tick=False)
+    view = eng.committed_view()
+    cids = eng.row_cids()
+    for row in range(6):
+        assert cids[row] == row + 1
+        assert view[row] == eng.committed_index(int(cids[row]))
+    # dead rows are excluded via the cid mask
+    eng.remove_group(3)
+    assert (eng.row_cids() >= 0).sum() == 5
