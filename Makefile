@@ -3,11 +3,19 @@
 
 PY ?= python
 
-.PHONY: test test-all native soak soak-smoke bench dryrun \
+.PHONY: test test-all test-kernels native soak soak-smoke bench dryrun \
 	perf-ledger perf-ledger-check
 
 test: native
 	$(PY) -m pytest tests/ -x -q -m "not slow"
+
+# fast local gate for kernel changes: the device-engine differential
+# suites (fused ≡ single-round ≡ scalar oracle, incl. the read plane)
+# standalone on the cpu backend — run this before the full tier-1 sweep
+# whenever ops/kernels.py, ops/state.py, or ops/engine.py change
+test-kernels:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_ops_quorum.py \
+	    tests/test_multiround.py tests/test_read_confirm.py -q
 
 # parallel run: heavy multi-NodeHost modules carry
 # xdist_group("heavy-multiprocess") and serialize on one worker while

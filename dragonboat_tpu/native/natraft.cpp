@@ -648,8 +648,26 @@ struct Engine {
   std::atomic<uint64_t> lat_resp_us{0}, lat_respn{0};  // follower: born->resp flushed
   std::atomic<uint64_t> rtt_us{0}, rttn{0}, rtt_max_us{0};  // hb echo round trip
   std::atomic<uint64_t> stale_dropped{0};  // stale-term fast frames consumed
-  // scheduling-stall compensation diagnostics (clock_pass)
+  // scheduling-stall compensation diagnostics (clock_pass).
+  // RESIDUAL LIMITATION of the stall compensation: the pass-gap check
+  // only detects the CLOCK thread's own starvation.  The complementary
+  // failure — reader threads starved while the clock thread ran on
+  // schedule — is covered by last_ingest_ms below, but only engine-wide:
+  // ingest progress on ANY connection re-arms contact-loss ejects, so
+  // one starved reader among otherwise-busy connections can still
+  // mis-eject its groups; and in a fully idle deployment (no inbound
+  // bytes at all) the stamp stays old and a genuine dead-leader eject is
+  // deferred to the 2x cap in clock_pass.  Per-connection stamps would
+  // close both gaps at the cost of a remote->groups reverse map on the
+  // hot ingest path; not paid until observed in practice.
   std::atomic<uint64_t> clock_stalls{0}, clock_stall_ms{0};
+  // last wall time any ingest path (native connection readers,
+  // stream/batch ingest from the transport recv thread) finished
+  // processing inbound bytes; 0 until the first ingest
+  std::atomic<int64_t> last_ingest_ms{0};
+  // contact-loss ejects deferred because the ingest plane itself showed
+  // no progress over the silence window (see clock_pass)
+  std::atomic<uint64_t> contact_ejects_deferred{0};
   // partition injection (natr_set_partition): blocked inbound source
   // addresses + outbound remote-slot bitmask, with drop counters
   std::mutex block_mu;
@@ -1312,8 +1330,27 @@ struct Engine {
         // little failover latency but absorbs heartbeat jitter from a
         // starved LEADER box (the remote-side half of the duty collapse;
         // the local half is the stall compensation above)
-        if (now - g->leader_contact_ms > 2 * g->elect_timeout_ms)
-          begin_eject(g, EV_CONTACT_LOST);
+        if (now - g->leader_contact_ms > 2 * g->elect_timeout_ms) {
+          // Reader-plane cross-check (last_ingest_ms): the stall
+          // compensation above keys only off THIS thread's pass gap, so
+          // a starvation that hit the reader threads alone leaves the
+          // leader's heartbeats unread in kernel socket buffers while
+          // the local stamps age normally — ejecting then punishes the
+          // remote for a local stall.  Only eject when the ingest plane
+          // demonstrably ran inside the silence window; otherwise defer
+          // so resumed readers get a pass to drain the backlog (which
+          // refreshes leader_contact_ms before the stamp is written).
+          // Cap at 2x the window: a genuinely dead link feeds no bytes
+          // anywhere, and the eject must still fire, one window late.
+          int64_t ingest = last_ingest_ms.load(std::memory_order_relaxed);
+          bool readers_live =
+              ingest != 0 && now - ingest < 2 * g->elect_timeout_ms;
+          bool capped = now - g->leader_contact_ms > 4 * g->elect_timeout_ms;
+          if (readers_live || capped)
+            begin_eject(g, EV_CONTACT_LOST);
+          else
+            contact_ejects_deferred++;
+        }
       }
       // liveness watchdog: entries are pending yet commit has not moved
       // for two election windows — some corner case has wedged the fast
@@ -2208,6 +2245,7 @@ long long natr_ingest(void* h, const uint8_t* d, size_t len, uint8_t** leftover,
   std::string out;
   bool has = false;
   long long consumed = ingest_batch(e, d, len, &out, &has);
+  e->last_ingest_ms.store(mono_ms(), std::memory_order_relaxed);
   if (consumed < 0) return -1;
   if (has) {
     *leftover = (uint8_t*)malloc(out.size());
@@ -2301,6 +2339,10 @@ static bool process_stream(Engine* e, ConnState* cs, const uint8_t* d,
   // keep the unconsumed remainder for the next read
   std::string rest((const char*)buf + pos, blen - pos);
   cs->pending.swap(rest);
+  // ingest-progress stamp for clock_pass's contact-loss cross-check —
+  // written AFTER the frames were consumed, so a "live" reading implies
+  // any heartbeat in this chunk already refreshed its group's contact
+  e->last_ingest_ms.store(mono_ms(), std::memory_order_relaxed);
   return !fatal;
 }
 
@@ -2733,7 +2775,7 @@ int natr_wait_apply(void* h, int timeout_ms) {
   return e->applyq.empty() ? 0 : 1;
 }
 
-void natr_stats(void* h, uint64_t* out12) {  // array of 24 u64
+void natr_stats(void* h, uint64_t* out12) {  // array of 25 u64
   Engine* e = (Engine*)h;
   out12[0] = e->proposed.load();
   out12[1] = e->ingested_fast.load();
@@ -2771,6 +2813,7 @@ void natr_stats(void* h, uint64_t* out12) {  // array of 24 u64
   out12[21] = e->part_in_dropped.load();   // partition-dropped inbound msgs
   out12[22] = e->part_out_dropped.load();  // partition-dropped outbound msgs
   out12[23] = (e->clock_stalls.load() << 32) | (e->clock_stall_ms.load() & 0xffffffffu);
+  out12[24] = e->contact_ejects_deferred.load();
 }
 
 void natr_set_debug_cid(void* h, uint64_t cid) {

@@ -650,7 +650,7 @@ class NatRaft:
         )
 
     def stats(self) -> dict:
-        out = (ctypes.c_uint64 * 24)()
+        out = (ctypes.c_uint64 * 25)()
         self._lib.natr_stats(self._h, out)
         return {
             "proposed": int(out[0]),
@@ -680,6 +680,10 @@ class NatRaft:
             # exceeded the stall threshold, and the summed unobserved time
             "clock_stalls": int(out[23]) >> 32,
             "clock_stall_ms": int(out[23]) & 0xFFFFFFFF,
+            # contact-loss ejects held back because the READER plane made
+            # no ingest progress over the silence window (clock_pass
+            # cross-check; see the residual-limitation note in natraft.cpp)
+            "contact_ejects_deferred": int(out[24]),
         }
 
     def stop(self) -> None:

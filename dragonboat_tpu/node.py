@@ -57,6 +57,7 @@ from .wire import (
     MessageType,
     Snapshot,
     State,
+    SystemCtx,
     Update,
     is_empty_snapshot,
 )
@@ -142,6 +143,12 @@ class Node:
         self._off_hb = False
         self._off_elect = False
         self._off_demote = False
+        # device read plane: quorum-confirmed ReadIndex ctxs awaiting the
+        # scalar prefix release, and fallback echoes for ctxs the device
+        # is not tracking (slot overflow / stale) — both applied under
+        # raftMu with the leader/term guards intact
+        self._off_reads: list = []
+        self._off_read_echoes: list = []
         self._natsm_attached = False  # native C-ABI SM wired to the lane
         self._next_enroll_try = 0.0
         self._tick_count_pending = 0
@@ -231,6 +238,26 @@ class Node:
             self._off_election = (won, term)
         self.nh.engine.set_step_ready(self.cluster_id)
 
+    def offload_read_confirm(self, low: int, high: int, term: int) -> None:
+        """Flag a device-confirmed ReadIndex ctx (kernels.read_confirm
+        reached quorum for its slot).  Applied in
+        ``_apply_offload_effects`` through ``read_index.release`` — the
+        scalar prefix pop — under raftMu with leader/term guards, so a
+        stale confirmation (leadership moved since the echo quorum) is
+        rejected, never applied."""
+        with self._off_mu:
+            self._off_reads.append((low, high, term))
+        self.nh.engine.set_step_ready(self.cluster_id)
+
+    def offload_read_echo(self, from_: int, low: int, high: int) -> None:
+        """Fallback: a heartbeat echo for a ctx the device read plane is
+        NOT tracking (pending-read slot overflow, or the echo raced a
+        confirmation).  Re-routed through the scalar tally, which is a
+        no-op for unknown ctxs."""
+        with self._off_mu:
+            self._off_read_echoes.append((from_, low, high))
+        self.nh.engine.set_step_ready(self.cluster_id)
+
     def offload_tick_elect(self) -> None:
         with self._off_mu:
             self._off_elect = True
@@ -257,10 +284,27 @@ class Node:
             hb, self._off_hb = self._off_hb, False
             elect, self._off_elect = self._off_elect, False
             demote, self._off_demote = self._off_demote, False
+            reads, self._off_reads = self._off_reads, []
+            echoes, self._off_read_echoes = self._off_read_echoes, []
         if self.fast_lane:
             return  # native core owns the group; flags are stale
         if commit_q and r.is_leader() and r.log.try_commit(commit_q, r.term):
             r.broadcast_replicate_message()
+        if reads and r.is_leader():
+            for low, high, term in reads:
+                # term-pinned like offload_election: a confirmation tallied
+                # before leadership moved must not release reads under the
+                # new term (become_* rebuilt read_index, so the release is
+                # a no-op then anyway — the guard keeps intent explicit)
+                if r.term != term:
+                    continue
+                ctx = SystemCtx(low=low, high=high)
+                r.apply_read_releases(r.read_index.release(ctx), ctx)
+        if echoes and r.is_leader():
+            for from_, low, high in echoes:
+                r.handle_read_index_leader_confirmation(
+                    Message(from_=from_, hint=low, hint_high=high)
+                )
         if election is not None:
             won, term = election
             if r.is_candidate() and r.term == term:

@@ -16,6 +16,8 @@ chips instead of goroutines.
 """
 from __future__ import annotations
 
+import threading
+from contextlib import nullcontext
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -30,6 +32,7 @@ from .state import (
     FOLLOWER,
     LEADER,
     OBSERVER,
+    READ_SLOTS,
     VOTE_GRANT,
     VOTE_NONE,
     VOTE_REJECT,
@@ -64,6 +67,8 @@ class StepResult:
     __slots__ = (
         "won", "lost", "elect", "heartbeat", "demote",
         "_commit_cids", "_commit_abs", "_commit_dict",
+        "read_cids", "read_slots", "read_index_abs", "read_counts",
+        "_reads_list",
     )
 
     def __init__(self):
@@ -75,6 +80,16 @@ class StepResult:
         self.elect: List[int] = []
         self.heartbeat: List[int] = []
         self.demote: List[int] = []
+        # confirmed-read egress, vectorized (None when the dispatch ran
+        # read-free): per confirmed pending-read slot, the cluster, the
+        # slot, the ABSOLUTE release index, and how many client reads
+        # the batch carried.  Like the commit egress, hot callers read
+        # the arrays; the list-of-tuples view materializes lazily.
+        self.read_cids: Optional[np.ndarray] = None       # (n,) int64
+        self.read_slots: Optional[np.ndarray] = None      # (n,) int64
+        self.read_index_abs: Optional[np.ndarray] = None  # (n,) int64
+        self.read_counts: Optional[np.ndarray] = None     # (n,) int64
+        self._reads_list = None
 
     @property
     def commit(self) -> Dict[int, int]:
@@ -87,6 +102,25 @@ class StepResult:
                     zip(self._commit_cids.tolist(), self._commit_abs.tolist())
                 )
         return self._commit_dict
+
+    @property
+    def reads(self) -> List[Tuple[int, int, int, int]]:
+        """Confirmed reads as ``(cluster_id, slot, abs_index, count)``
+        tuples; built on first access (vectorized twin: the
+        ``read_*`` arrays)."""
+        if self._reads_list is None:
+            if self.read_cids is None or not len(self.read_cids):
+                self._reads_list = []
+            else:
+                self._reads_list = list(
+                    zip(
+                        self.read_cids.tolist(),
+                        self.read_slots.tolist(),
+                        self.read_index_abs.tolist(),
+                        self.read_counts.tolist(),
+                    )
+                )
+        return self._reads_list
 
 
 class MultiRoundResult(StepResult):
@@ -114,17 +148,27 @@ class _RoundBuf:
     leader-recycle records (applied at round start, device-side).
     ``cells`` optionally carries the precomputed flat (row·P + slot)
     index vector when the staging path shares one geometry across rounds
-    (``ack_block_rounds``), sparing a per-round int64 conversion."""
+    (``ack_block_rounds``), sparing a per-round int64 conversion.
+    ``reads`` / ``racks`` carry the round's staged ReadIndex batches
+    ``(rows, slots, rels, counts)`` and heartbeat echoes
+    ``(rows, rslots, peers)`` as flat arrays (None = none)."""
 
-    __slots__ = ("rows", "slots", "rels", "votes", "churn", "cells")
+    __slots__ = (
+        "rows", "slots", "rels", "votes", "churn", "cells", "reads", "racks",
+    )
 
-    def __init__(self, rows, slots, rels, votes, churn, cells=None):
+    def __init__(
+        self, rows, slots, rels, votes, churn, cells=None,
+        reads=None, racks=None,
+    ):
         self.rows = rows
         self.slots = slots
         self.rels = rels
         self.votes = votes   # list[(row, slot, grant)]
         self.churn = churn   # list[(row, term, term_start_rel, last_rel)]
         self.cells = cells   # np (n,) int64 row*P+slot, or None
+        self.reads = reads   # (rows, slots, rels, counts) int32 arrays
+        self.racks = racks   # (rows, rslots, peers) int32 arrays
 
 
 class BatchedQuorumEngine:
@@ -140,6 +184,21 @@ class BatchedQuorumEngine:
         out.commit[cid]                        # -> advanced commit index
     """
 
+    #: PROCESS-WIDE serialization of multi-device dispatches.  XLA's CPU
+    #: client runs each collective as an all-participant rendezvous on a
+    #: shared per-device thread pool; two INDEPENDENT sharded programs
+    #: (different engines — e.g. three in-process NodeHost coordinators
+    #: in the sharding tests) launched from different threads can
+    #: interleave their per-device work in different orders and deadlock
+    #: the rendezvous (observed: CollectivePermute participants of two
+    #: run_ids waiting on each other forever once the CI box shrank to
+    #: 2 vCPUs; programs of ONE engine are ordered by their donated-state
+    #: data dependency and cannot interleave).  Engines whose state spans
+    #: more than one device therefore hold this lock from launch through
+    #: the blocking egress; single-device engines (every production
+    #: deployment runs one engine per process anyway) take a no-op path.
+    _MULTIDEV_MU = threading.RLock()
+
     def __init__(
         self,
         n_groups: int,
@@ -148,9 +207,11 @@ class BatchedQuorumEngine:
         sharding=None,
         device_ticks: bool = True,
         dense_ingest: str | bool = "auto",
+        n_read_slots: int = READ_SLOTS,
     ):
         self.n_groups = n_groups
         self.n_peers = n_peers
+        self.n_read_slots = n_read_slots
         self.event_cap = event_cap
         #: dense-ingestion policy: collapse a round's acks into a (G,P)
         #: max matrix and dispatch the scatter-free dense kernel (see
@@ -176,8 +237,15 @@ class BatchedQuorumEngine:
         #: host ticks.  Engines that never tick (host-driven clocks) skip
         #: the reset scatter entirely (it is dead work there).
         self.device_ticks = device_ticks
-        self.mirror = HostMirror(n_groups, n_peers)
+        self.mirror = HostMirror(n_groups, n_peers, n_read_slots)
         self.sharding = sharding
+        n_dev = (
+            len(getattr(sharding, "device_set", ())) if sharding is not None
+            else 1
+        )
+        # reentrant on purpose: step -> step_rounds -> _harvest_inflight
+        # all guard themselves (see _MULTIDEV_MU)
+        self._dispatch_mu = self._MULTIDEV_MU if n_dev > 1 else nullcontext()
         self._dev: QuorumState = self.mirror.to_device(sharding)
         self._cache_stale = False
         self.groups: Dict[int, GroupInfo] = {}
@@ -230,6 +298,47 @@ class BatchedQuorumEngine:
         # block i+1 overlaps the device execution of block i, and every
         # host read of device state harvests first (_harvest_inflight)
         self._inflight = None
+        # --- device read plane staging (ISSUE 3 tentpole) ---------------
+        # ReadIndex batches and heartbeat echoes of the CURRENT open
+        # round; epoch columns filter events staged before a transition,
+        # exactly like the ack/vote buffers
+        self._read_stages: List[Tuple[int, int, int, int, int]] = []
+        self._read_stage_blocks: List[Tuple[np.ndarray, ...]] = []
+        self._read_echoes: List[Tuple[int, int, int, int]] = []
+        self._read_echo_blocks: List[Tuple[np.ndarray, ...]] = []
+        # host slot bookkeeping.  A slot is BUSY from stage until its
+        # batch deterministically confirms: the device only ever sees
+        # echoes this host staged, so once the staged echoes of a batch
+        # reach quorum (counting self), the batch WILL confirm in its
+        # round — the host predicts that without a readback and frees
+        # the slot for rounds AFTER the current open one (a same-round
+        # restage would overwrite the batch before its echoes land).
+        # A batch whose echoes never reach quorum holds its slot until a
+        # row transition purges it (the scalar path bounds the same case
+        # with request timeouts, requests.py).
+        self._read_busy = np.zeros((n_groups, n_read_slots), bool)
+        self._read_echo_host = np.zeros(
+            (n_groups, n_read_slots, n_peers), bool
+        )
+        self._read_next_slot = np.zeros((n_groups,), np.int32)
+        # round seq of the moment a slot was predicted-confirmed: the
+        # slot is reusable only in a LATER round
+        self._read_freed_round = np.full((n_groups, n_read_slots), -1, np.int64)
+        self._round_seq = 0
+        # LATCH: set on the first read-plane ingress (stage/echo/cancel),
+        # never reset.  Until it flips, the device read arrays are
+        # provably all-zero — they mutate only inside has_reads dispatches,
+        # which only staging triggers — and the mirror's are too (row
+        # transitions merely re-zero them), so the rare-path row syncs
+        # skip them (_sync_keys).  That is not dead-work avoidance: the
+        # extra eager gather/scatter programs the read arrays add (incl. a
+        # 3-D (rows,S,P) bool scatter) deadlocked XLA's CPU client when
+        # several coordinator round threads first-compiled them while
+        # other multi-device dispatches were in flight on the 8-virtual-
+        # device mesh (test_full_stack_sharded_engine hung in
+        # _upload_dirty).  A read-free engine keeps the exact eager
+        # program set it had before the read plane existed.
+        self._read_plane_used = False
 
     @property
     def dev(self) -> QuorumState:
@@ -306,6 +415,9 @@ class BatchedQuorumEngine:
         for nid, slot in slots.items():
             a["present"][row, slot] = True
             a["voting"][row, slot] = nid not in observers
+        if self._read_plane_used:  # else provably already clear
+            self.mirror.clear_reads(row)
+            self._reset_read_rows([row])
         self._dirty.add(row)
         return gi
 
@@ -316,8 +428,16 @@ class BatchedQuorumEngine:
         (the scalar twin drops mismatched-term responses in
         ``handle_vote_resp`` / ``handle_replicate_resp``).  O(1): the row's
         staging epoch is bumped and stale-epoch events are filtered in one
-        vectorized pass at dispatch."""
+        vectorized pass at dispatch.
+
+        Pending READS die with the transition too (scalar twin: every
+        ``become_*`` builds a fresh ``ReadIndex``) — slot bookkeeping and
+        the mirror's read fields reset here; staged read/echo events fall
+        to the same epoch filter as acks/votes."""
         self._row_epoch[row] += 1
+        self._reset_read_rows([row])
+        if self._read_plane_used:  # else provably already clear
+            self.mirror.clear_reads(row)
 
     def _drop_churn_records(self, row: int, drop_events: bool = False) -> None:
         """Strip every undispatched recycle record for ``row`` — from the
@@ -354,6 +474,21 @@ class BatchedQuorumEngine:
                             b.cells = b.cells[keep]
                 if b.votes:
                     b.votes = [v for v in b.votes if v[0] != row]
+                self._purge_block_reads(b, row)
+
+    @staticmethod
+    def _purge_block_reads(b, row: int) -> None:
+        """Drop ``row``'s staged read-stage/read-ack batches from one
+        sealed round block (reads are droppable by contract; see
+        ``recycle_leader``)."""
+        if b.reads is not None and b.reads[0].size:
+            keep = b.reads[0] != row
+            if not keep.all():
+                b.reads = tuple(a[keep] for a in b.reads)
+        if b.racks is not None and b.racks[0].size:
+            keep = b.racks[0] != row
+            if not keep.all():
+                b.racks = tuple(a[keep] for a in b.racks)
 
     def remove_group(self, cluster_id: int) -> None:
         gi = self.groups.pop(cluster_id)
@@ -470,6 +605,10 @@ class BatchedQuorumEngine:
             a[f][row] = max(0, int(a[f][row]) - shift)
         a["match"][row, :] = np.maximum(a["match"][row, :] - shift, 0)
         a["next"][row, :] = np.maximum(a["next"][row, :] - shift, 1)
+        # pending-read watermarks shift with the base; clamping to the new
+        # floor only ever REWRITES a release index up (rel 0 = the old
+        # committed), which ReadIndex semantics permit
+        a["read_index"][row, :] = np.maximum(a["read_index"][row, :] - shift, 0)
         self._dirty.add(row)
 
     # ------------------------------------------------------------------
@@ -560,6 +699,278 @@ class BatchedQuorumEngine:
         )
 
     # ------------------------------------------------------------------
+    # device read plane: ReadIndex staging (ISSUE 3 tentpole)
+    # ------------------------------------------------------------------
+
+    def _free_read_slot(self, rows: np.ndarray) -> np.ndarray:
+        """Vectorized per-row free-slot pick (cursor + S-step scan);
+        returns -1 where a row has no reusable slot.  A slot freed by
+        predicted confirmation only becomes reusable in a LATER round
+        (``_read_freed_round``): the device applies a round's stage
+        BEFORE its echoes, so a same-round restage would overwrite the
+        confirming batch ahead of its own release."""
+        s = self.n_read_slots
+        slot = np.full(rows.shape, -1, np.int32)
+        cur = self._read_next_slot[rows]
+        for k in range(s):
+            cand = (cur + k) % s
+            ok = (
+                (slot < 0)
+                & ~self._read_busy[rows, cand]
+                & (self._read_freed_round[rows, cand] < self._round_seq)
+            )
+            slot = np.where(ok, cand, slot)
+        return slot
+
+    def _predict_read_confirm(self, rows: np.ndarray, rslots: np.ndarray) -> None:
+        """Host-side confirmation prediction: the device only ever sees
+        echoes THIS host staged, so once a batch's staged echoes reach
+        quorum (self counted via the one-hot column, observers masked
+        out — the exact ``kernels.read_confirm`` arithmetic on the
+        mirror's host-authoritative membership), the batch provably
+        confirms in its round and the slot can be freed for restaging
+        without a device readback."""
+        a = self.mirror.arrays
+        echo = self._read_echo_host[rows, rslots]            # (n,P)
+        selfc = (
+            np.arange(self.n_peers, dtype=np.int32)[None, :]
+            == a["self_slot"][rows][:, None]
+        )
+        cnt = ((echo | selfc) & a["voting"][rows]).sum(axis=1)
+        conf = self._read_busy[rows, rslots] & (cnt >= a["quorum"][rows])
+        if conf.any():
+            self._read_busy[rows[conf], rslots[conf]] = False
+            self._read_freed_round[rows[conf], rslots[conf]] = self._round_seq
+
+    def _reset_read_rows(self, rows) -> None:
+        """Drop the rows' pending-read bookkeeping (transition purge).
+        Skipped outright until the read plane has been used: the arrays
+        still hold their reset values then, and this runs on EVERY row
+        transition — 265k numpy row-writes per rung-5 window, ~20% of
+        its whole host budget (profiled), for a plane the ladder's write
+        rungs never touch."""
+        if not self._read_plane_used:
+            return
+        self._read_busy[rows] = False
+        self._read_freed_round[rows] = -1
+        self._read_echo_host[rows] = False
+
+    def stage_read(
+        self, cluster_id: int, count: int = 1, index: Optional[int] = None
+    ) -> int:
+        """Stage a batch of ``count`` ReadIndex requests for the group;
+        returns the pending-read SLOT the batch rides (the caller keys
+        its ctx bookkeeping on it — the confirmed-read egress names the
+        slot back).  Scalar twin: ``ReadIndex.add_request``.
+
+        ``index`` (absolute) pins the captured watermark explicitly (the
+        live coordinator passes scalar raft's ``log.committed``); default
+        is the engine's host view of the row's committed watermark.  The
+        host view may trail an unharvested in-flight block, which is
+        still linearizable: commits become client-observable only
+        through harvest egress, so the host view is exactly the upper
+        bound of what any client can have seen.
+
+        Raises ``RuntimeError`` when all S slots hold unconfirmed
+        batches — backpressure; the caller batches further reads into
+        the next free slot (the scalar path bounds the same situation
+        with request timeouts, ``requests.py``).
+        """
+        if count < 1:
+            raise ValueError("stage_read count must be >= 1")
+        gi = self.groups[cluster_id]
+        row = gi.row
+        rows1 = np.array([row], np.int64)
+        slot = int(self._free_read_slot(rows1)[0])
+        if slot < 0:
+            raise RuntimeError(
+                f"no free pending-read slot for group {cluster_id}"
+            )
+        if index is not None:
+            rel = self._rel(gi, index)
+        else:
+            self._refresh_committed_cache()
+            if row in self._dirty or row in self._churn_pending:
+                rel = int(self.mirror.arrays["committed"][row])
+            else:
+                rel = int(self._committed_cache[row])
+        self._read_plane_used = True
+        self._read_busy[row, slot] = True
+        self._read_next_slot[row] = (slot + 1) % self.n_read_slots
+        self._read_echo_host[row, slot, :] = False
+        self._read_stages.append(
+            (row, slot, rel, count, int(self._row_epoch[row]))
+        )
+        return slot
+
+    def stage_read_block(self, rows, rels, counts) -> np.ndarray:
+        """Vectorized bulk read staging: one batch per row (rows must be
+        unique), ``rels`` already rebased.  Returns the assigned slot per
+        row.  Caller contract mirrors ``ack_block``: live rows, bounds
+        validated vectorized here, membership the caller's business."""
+        rows = np.asarray(rows)
+        rels = np.asarray(rels)
+        counts = np.asarray(counts)
+        if not (rows.shape == rels.shape == counts.shape) or rows.ndim != 1:
+            raise ValueError("stage_read_block arrays must share a 1-D shape")
+        if rows.size == 0:
+            return np.zeros((0,), np.int32)
+        if rows.min() < 0 or rows.max() >= self.n_groups:
+            raise ValueError("stage_read_block row out of range")
+        if rels.min() < 0 or rels.max() >= REBASE_THRESHOLD:
+            raise ValueError("stage_read_block rel out of range")
+        if counts.min() < 1:
+            raise ValueError("stage_read_block counts must be >= 1")
+        if np.unique(rows).size != rows.size:
+            raise ValueError("stage_read_block rows must be unique")
+        rows64 = rows.astype(np.int64)
+        slot = self._free_read_slot(rows64)
+        if (slot < 0).any():
+            raise RuntimeError(
+                f"no free pending-read slot for {int((slot < 0).sum())} rows"
+            )
+        self._read_plane_used = True
+        self._read_busy[rows64, slot] = True
+        self._read_next_slot[rows64] = (slot + 1) % self.n_read_slots
+        self._read_echo_host[rows64, slot, :] = False
+        self._read_stage_blocks.append(
+            (rows.astype(np.int32), slot.astype(np.int32),
+             rels.astype(np.int32), counts.astype(np.int32),
+             self._row_epoch[rows.astype(np.int32)].copy())
+        )
+        return slot
+
+    def read_ack(self, cluster_id: int, node_id: int, slot: int) -> None:
+        """Heartbeat-echo confirmation for the group's pending-read slot
+        (scalar twin: the ``m.hint != 0`` branch of
+        ``handle_leader_heartbeat_resp`` feeding ``ReadIndex.confirm``)."""
+        gi = self.groups[cluster_id]
+        row = gi.row
+        if not (0 <= slot < self.n_read_slots):
+            raise ValueError(f"read slot {slot} out of range")
+        peer = gi.slots[node_id]
+        self._read_plane_used = True
+        self._read_echoes.append(
+            (row, slot, peer, int(self._row_epoch[row]))
+        )
+        self._read_echo_host[row, slot, peer] = True
+        self._predict_read_confirm(
+            np.array([row], np.int64), np.array([slot], np.int64)
+        )
+
+    def read_ack_block(self, rows, rslots, peers) -> None:
+        """Vectorized bulk echo ingest (row / pending-read-slot / peer-slot
+        space); duplicates are harmless (echo sets are idempotent)."""
+        rows = np.asarray(rows)
+        rslots = np.asarray(rslots)
+        peers = np.asarray(peers)
+        if not (rows.shape == rslots.shape == peers.shape) or rows.ndim != 1:
+            raise ValueError("read_ack_block arrays must share a 1-D shape")
+        if rows.size == 0:
+            return
+        if rows.min() < 0 or rows.max() >= self.n_groups:
+            raise ValueError("read_ack_block row out of range")
+        if rslots.min() < 0 or rslots.max() >= self.n_read_slots:
+            raise ValueError("read_ack_block read slot out of range")
+        if peers.min() < 0 or peers.max() >= self.n_peers:
+            raise ValueError("read_ack_block peer slot out of range")
+        rows32 = rows.astype(np.int32)
+        self._read_plane_used = True
+        self._read_echo_blocks.append(
+            (rows32, rslots.astype(np.int32), peers.astype(np.int32),
+             self._row_epoch[rows32].copy())
+        )
+        rows64 = rows.astype(np.int64)
+        rslots64 = rslots.astype(np.int64)
+        self._read_echo_host[rows64, rslots64, peers.astype(np.int64)] = True
+        self._predict_read_confirm(rows64, rslots64)
+
+    def cancel_read(self, cluster_id: int, slot: int) -> None:
+        """Withdraw a pending-read slot whose reads were released by
+        another path (the scalar prefix release frees every ctx queued
+        before a confirmed one — their device slots would otherwise leak
+        until a transition purge).  The slot frees host-side now and
+        device-side at its round: a zero-count stage overwrites the batch
+        (``read_count == 0`` means free; ``read_confirm`` gates on it)."""
+        gi = self.groups[cluster_id]
+        row = gi.row
+        if not (0 <= slot < self.n_read_slots):
+            raise ValueError(f"read slot {slot} out of range")
+        self._read_plane_used = True
+        self._read_stages.append((row, slot, 0, 0, int(self._row_epoch[row])))
+        self._read_busy[row, slot] = False
+        self._read_freed_round[row, slot] = self._round_seq
+        self._read_echo_host[row, slot, :] = False
+
+    def read_slots_free(self, cluster_id: int) -> int:
+        """Reusable pending-read slots for the group RIGHT NOW (counting
+        the next-round availability rule) — backpressure introspection."""
+        row = self.groups[cluster_id].row
+        free = ~self._read_busy[row] & (
+            self._read_freed_round[row] < self._round_seq
+        )
+        return int(free.sum())
+
+    def _gather_reads(self):
+        """Open-round read-plane buffers as flat arrays with stale-epoch
+        events filtered; clears the buffers and advances the slot-reuse
+        round seq (one call per round close).  Returns ``(reads, racks)``
+        — each a tuple of int32 arrays or None."""
+        self._round_seq += 1
+        reads = racks = None
+        parts = []
+        if self._read_stages:
+            cols = np.array(self._read_stages, dtype=np.int64)
+            rows = cols[:, 0].astype(np.int32)
+            keep = cols[:, 4].astype(np.int32) == self._row_epoch[rows]
+            if keep.any():
+                parts.append(tuple(
+                    cols[keep, i].astype(np.int32) for i in range(4)
+                ))
+            self._read_stages = []
+        if self._read_stage_blocks:
+            for r, sl, v, c, ep in self._read_stage_blocks:
+                keep = ep == self._row_epoch[r]
+                if keep.all():
+                    parts.append((r, sl, v, c))
+                elif keep.any():
+                    parts.append((r[keep], sl[keep], v[keep], c[keep]))
+            self._read_stage_blocks = []
+        if parts:
+            reads = tuple(
+                np.concatenate([p[i] for p in parts]) for i in range(4)
+            )
+        parts = []
+        if self._read_echoes:
+            cols = np.array(self._read_echoes, dtype=np.int64)
+            rows = cols[:, 0].astype(np.int32)
+            keep = cols[:, 3].astype(np.int32) == self._row_epoch[rows]
+            if keep.any():
+                parts.append(tuple(
+                    cols[keep, i].astype(np.int32) for i in range(3)
+                ))
+            self._read_echoes = []
+        if self._read_echo_blocks:
+            for r, sl, p, ep in self._read_echo_blocks:
+                keep = ep == self._row_epoch[r]
+                if keep.all():
+                    parts.append((r, sl, p))
+                elif keep.any():
+                    parts.append((r[keep], sl[keep], p[keep]))
+            self._read_echo_blocks = []
+        if parts:
+            racks = tuple(
+                np.concatenate([p[i] for p in parts]) for i in range(3)
+            )
+        return reads, racks
+
+    def _reads_pending(self) -> bool:
+        return bool(
+            self._read_stages or self._read_stage_blocks
+            or self._read_echoes or self._read_echo_blocks
+        )
+
+    # ------------------------------------------------------------------
     # multi-round fused staging (ISSUE 1 tentpole)
     # ------------------------------------------------------------------
 
@@ -582,8 +993,12 @@ class BatchedQuorumEngine:
         else:
             votes = []
         rows, slots, rels = self._gather_acks()
+        reads, racks = self._gather_reads()
         self._round_blocks.append(
-            _RoundBuf(rows, slots, rels, votes, self._churn)
+            _RoundBuf(
+                rows, slots, rels, votes, self._churn,
+                reads=reads, racks=racks,
+            )
         )
         self._churn = []
         self._churn_rows = set()
@@ -620,7 +1035,10 @@ class BatchedQuorumEngine:
             raise ValueError("ack_block_rounds row out of range")
         if slots.size and (slots.min() < 0 or slots.max() >= self.n_peers):
             raise ValueError("ack_block_rounds slot out of range")
-        if self._acks or self._ack_blocks or self._votes or self._churn:
+        if (
+            self._acks or self._ack_blocks or self._votes or self._churn
+            or self._reads_pending()
+        ):
             self.begin_round()
         rows32 = rows.astype(np.int32, copy=False)
         slots32 = slots.astype(np.int32, copy=False)
@@ -699,11 +1117,23 @@ class BatchedQuorumEngine:
         # old-tenant events staged this round must not reach the new
         # tenant (closed rounds resolved their filter at close time)
         self._purge_row_events(row)
+        # old-tenant READS die entirely — including batches sealed into
+        # closed pre-recycle rounds.  Acks in those rounds still apply to
+        # the old tenant (they run before the in-program reset), but a
+        # read CONFIRMED there would egress after the recycle, when the
+        # (G,S) accumulators can only attribute it to the row's final
+        # tenant — a misdelivered read.  Reads are droppable by contract
+        # (the scalar path drops on leader change/timeout and clients
+        # retry), so dropping beats misattributing.
+        for b in self._round_blocks:
+            self._purge_block_reads(b, row)
         # mirror coherence WITHOUT dirtying the row: the device applies
         # the identical reset in-program (state.HostMirror.recycle_row);
         # until the block dispatches, host reads of this row resolve to
         # the mirror (_read / committed caches), never the stale device
-        self.mirror.recycle_row(row, term, term_start, last_index)
+        self.mirror.recycle_row(
+            row, term, term_start, last_index, clear_reads=self._read_plane_used
+        )
         self._committed_cache[row] = 0
         self._synced.discard(row)
         self._churn.append((row, term, term_start, last_index))
@@ -738,7 +1168,16 @@ class BatchedQuorumEngine:
         XLA compile per distinct K (kernels.quorum_multiround tick_mask
         note).
         """
-        if self._acks or self._ack_blocks or self._votes or self._churn:
+        with self._dispatch_mu:
+            return self._step_rounds_locked(do_tick, pipelined, pad_rounds_to)
+
+    def _step_rounds_locked(
+        self, do_tick: bool, pipelined: bool, pad_rounds_to: int
+    ) -> Optional[MultiRoundResult]:
+        if (
+            self._acks or self._ack_blocks or self._votes or self._churn
+            or self._reads_pending()
+        ):
             self.begin_round()
         if not self._round_blocks:
             # nothing staged: drain whatever is still in flight
@@ -778,9 +1217,15 @@ class BatchedQuorumEngine:
     def _harvest_inflight(self) -> Optional[MultiRoundResult]:
         if self._inflight is None:
             return None
+        with self._dispatch_mu:
+            return self._harvest_inflight_locked()
+
+    def _harvest_inflight_locked(self) -> Optional[MultiRoundResult]:
+        if self._inflight is None:
+            return None
         out, prev_committed, row_cid, row_base, n_rounds = self._inflight
         self._inflight = None
-        committed, won, lost, elect, hb, demote = jax.device_get(
+        committed, won, lost, elect, hb, demote, rdc, rdi = jax.device_get(
             (
                 out.committed,
                 out.won,
@@ -788,9 +1233,13 @@ class BatchedQuorumEngine:
                 out.flags.elect_due,
                 out.flags.hb_due,
                 out.flags.checkq_demote,
+                out.read_done_count,
+                out.read_done_index,
             )
         )
         res = MultiRoundResult(n_rounds)
+        if rdc is not None:
+            self._translate_reads(res, rdc, rdi, row_cid, row_base)
         committed = np.asarray(committed)
         res.committed_rel = committed
         self._committed_cache = np.array(committed, dtype=np.int32)
@@ -830,6 +1279,26 @@ class BatchedQuorumEngine:
                 cids = row_cid[idx]
                 getattr(res, name).extend(cids[cids >= 0].tolist())
         return changed
+
+    @staticmethod
+    def _translate_reads(res, done_cnt, done_idx, row_cid, row_base) -> None:
+        """Vectorized confirmed-read egress translation: the device's
+        (G,S) count/index accumulators become flat (cid, slot, abs index,
+        count) vectors (dead rows dropped; the tuple list materializes
+        lazily via ``StepResult.reads``)."""
+        done_cnt = np.asarray(done_cnt)
+        rows, slots = np.nonzero(done_cnt)
+        if not rows.size:
+            return
+        cids = row_cid[rows]
+        live = cids >= 0
+        rows, slots = rows[live], slots[live]
+        res.read_cids = cids[live]
+        res.read_slots = slots.astype(np.int64)
+        res.read_index_abs = (
+            row_base[rows] + np.asarray(done_idx)[rows, slots]
+        )
+        res.read_counts = done_cnt[rows, slots].astype(np.int64)
 
     def _dispatch_multiround(
         self, blocks: List[_RoundBuf], do_tick: bool, tick_mask: np.ndarray
@@ -885,6 +1354,28 @@ class BatchedQuorumEngine:
         else:
             z = np.zeros((1, 1), np.int32)
             churn_row = churn_term = churn_start = churn_last = z
+        has_reads = any(
+            b.reads is not None or b.racks is not None for b in blocks
+        )
+        if has_reads:
+            s = self.n_read_slots
+            stage_idx = np.full((k, g, s), -1, np.int32)
+            stage_cnt = np.zeros((k, g, s), np.int32)
+            echo = np.zeros((k, g, s, p), bool)
+            for r, b in enumerate(blocks):
+                if b.reads is not None and b.reads[0].size:
+                    rr, sl, v, c = b.reads
+                    stage_idx[r, rr, sl] = v
+                    stage_cnt[r, rr, sl] = c
+                if b.racks is not None and b.racks[0].size:
+                    rr, sl, pe = b.racks
+                    echo[r, rr, sl, pe] = True
+            read_args = (
+                jnp.asarray(stage_idx), jnp.asarray(stage_cnt),
+                jnp.asarray(echo),
+            )
+        else:
+            read_args = (None, None, None)
         out = quorum_multiround(
             self._dev,
             jnp.asarray(ack_max),
@@ -894,10 +1385,15 @@ class BatchedQuorumEngine:
             jnp.asarray(churn_start),
             jnp.asarray(churn_last),
             jnp.asarray(tick_mask),
+            *read_args,
             do_tick=do_tick,
             track_contact=self.device_ticks or do_tick,
             has_votes=has_votes,
             has_churn=has_churn,
+            has_reads=has_reads,
+            # a never-used read plane is all-zero: compile its recycle
+            # purges out (measured ~40% of rung-5 churn throughput)
+            purge_reads=self._read_plane_used,
         )
         self._dev = out.state
         return out
@@ -962,11 +1458,25 @@ class BatchedQuorumEngine:
             return
         if row in self._dirty or row in self._synced:
             return
-        for k in self.mirror.arrays:
-            self.mirror.arrays[k][row] = np.asarray(
-                getattr(self.dev, k)[row]
-            )
+        with self._dispatch_mu:  # the gathers are multi-device programs
+            for k in self._sync_keys():
+                self.mirror.arrays[k][row] = np.asarray(
+                    getattr(self.dev, k)[row]
+                )
         self._synced.add(row)
+
+    def _sync_keys(self):
+        """Mirror fields the rare-path row syncs move between host and
+        device.  The read-plane arrays join only once the plane has been
+        used (see the ``_read_plane_used`` latch in ``__init__``); before
+        that both sides are all-zero by construction and the extra eager
+        gather/scatter programs must not be dispatched at all."""
+        if self._read_plane_used:
+            return list(self.mirror.arrays)
+        return [
+            k for k in self.mirror.arrays
+            if k not in ("read_index", "read_count", "read_acks")
+        ]
 
     @staticmethod
     def _pad_pow2_rows(idx: np.ndarray) -> np.ndarray:
@@ -1005,10 +1515,11 @@ class BatchedQuorumEngine:
             return
         idx = np.asarray(todo, np.int32)
         pidx = self._pad_pow2_rows(idx)
-        for k in self.mirror.arrays:
-            self.mirror.arrays[k][pidx] = np.asarray(
-                getattr(self.dev, k)[pidx]
-            )
+        with self._dispatch_mu:  # the gathers are multi-device programs
+            for k in self._sync_keys():
+                self.mirror.arrays[k][pidx] = np.asarray(
+                    getattr(self.dev, k)[pidx]
+                )
         self._synced.update(todo)
 
     def _upload_dirty(self) -> None:
@@ -1017,8 +1528,9 @@ class BatchedQuorumEngine:
         self._harvest_inflight()
         rows = self._pad_pow2_rows(np.fromiter(self._dirty, dtype=np.int32))
         st = self.dev
-        updates = {}
-        for k, host in self.mirror.arrays.items():
+        updates = dict(st._asdict())
+        for k in self._sync_keys():
+            host = self.mirror.arrays[k]
             dev_arr = getattr(st, k)
             updates[k] = dev_arr.at[rows].set(jnp.asarray(host[rows]))
         self._dev = QuorumState(**updates)
@@ -1052,6 +1564,10 @@ class BatchedQuorumEngine:
         final round — runs as ONE fused multi-round dispatch instead
         (``step_rounds``; the result satisfies the StepResult interface).
         """
+        with self._dispatch_mu:
+            return self._step_locked(do_tick)
+
+    def _step_locked(self, do_tick: bool) -> StepResult:
         if self._round_blocks or self._churn:
             return self.step_rounds(do_tick=do_tick)
         self._harvest_inflight()
@@ -1072,10 +1588,14 @@ class BatchedQuorumEngine:
         prev_committed = self._committed_cache
 
         ack_g, ack_p, ack_v = self._gather_acks()
+        reads, racks = self._gather_reads()
+        has_reads = reads is not None or racks is not None
         # dense mode collapses ANY number of acks/votes into (G,P)
         # matrices — no cap, no chunk loop (votes are already first-wins
-        # deduped per cell, so a dense matrix holds a whole round)
-        if self.dense_ingest is True or (
+        # deduped per cell, so a dense matrix holds a whole round).
+        # The read plane exists only on the dense kernel, so pending
+        # reads force dense regardless of occupancy or policy.
+        if has_reads or self.dense_ingest is True or (
             self.dense_ingest == "auto"
             and (
                 ack_g.size >= self._dense_threshold
@@ -1083,7 +1603,9 @@ class BatchedQuorumEngine:
                 or len(self._votes) > self.event_cap
             )
         ):
-            out = self._dispatch_dense(ack_g, ack_p, ack_v, self._votes, do_tick)
+            out = self._dispatch_dense(
+                ack_g, ack_p, ack_v, self._votes, do_tick, reads, racks
+            )
         else:
             pos = 0
             while (ack_g.size - pos) > self.event_cap or len(self._votes) > self.event_cap:
@@ -1108,7 +1630,7 @@ class BatchedQuorumEngine:
         res = StepResult()
         # one batched device→host transfer for the whole egress set (a
         # network-attached chip pays the full round trip per readback)
-        committed, won, lost, elect, hb, demote = jax.device_get(
+        committed, won, lost, elect, hb, demote, rdc, rdi = jax.device_get(
             (
                 out.committed,
                 out.won,
@@ -1116,8 +1638,12 @@ class BatchedQuorumEngine:
                 out.flags.elect_due,
                 out.flags.hb_due,
                 out.flags.checkq_demote,
+                out.read_done_count,
+                out.read_done_index,
             )
         )
+        if rdc is not None:
+            self._translate_reads(res, rdc, rdi, self._row_cid, self._row_base)
         # device_get arrays are read-only; the cache must stay writable
         # for _upload_dirty's row sync
         self._committed_cache = np.array(committed, dtype=np.int32)
@@ -1206,9 +1732,14 @@ class BatchedQuorumEngine:
         self._dev = out.state
         return out
 
-    def _dispatch_dense(self, ag, ap, av, votes, do_tick: bool):
+    def _dispatch_dense(
+        self, ag, ap, av, votes, do_tick: bool, reads=None, racks=None
+    ):
         """Aggregate a round's events into (G,P) matrices and run the
-        scatter-free dense kernel (kernels.quorum_step_dense_impl)."""
+        scatter-free dense kernel (kernels.quorum_step_dense_impl).
+        ``reads``/``racks`` are the round's gathered read-plane buffers
+        (``_gather_reads`` shape); the read plane lives only on this
+        kernel — step() forces dense whenever they are present."""
         from .kernels import quorum_step_dense
 
         g, p = self.n_groups, self.n_peers
@@ -1228,14 +1759,35 @@ class BatchedQuorumEngine:
             vote_new[cols[0], cols[1]] = cols[2].astype(np.int8)
         else:
             vote_new = np.zeros((1, 1), np.int8)  # unused dummy
+        has_reads = reads is not None or racks is not None
+        if has_reads:
+            s = self.n_read_slots
+            stage_idx = np.full((g, s), -1, np.int32)
+            stage_cnt = np.zeros((g, s), np.int32)
+            echo = np.zeros((g, s, p), bool)
+            if reads is not None and reads[0].size:
+                rr, sl, v, c = reads
+                stage_idx[rr, sl] = v
+                stage_cnt[rr, sl] = c
+            if racks is not None and racks[0].size:
+                rr, sl, pe = racks
+                echo[rr, sl, pe] = True
+            read_args = (
+                jnp.asarray(stage_idx), jnp.asarray(stage_cnt),
+                jnp.asarray(echo),
+            )
+        else:
+            read_args = (None, None, None)
         out = quorum_step_dense(
             self.dev,
             jnp.asarray(ack_max),
             jnp.asarray(touched),
             jnp.asarray(vote_new),
+            *read_args,
             do_tick=do_tick,
             track_contact=self.device_ticks or do_tick,
             has_votes=bool(votes),
+            has_reads=has_reads,
         )
         self._dev = out.state
         return out
@@ -1251,7 +1803,8 @@ class BatchedQuorumEngine:
         self._harvest_inflight()
         if row in self._dirty or row in self._churn_pending:
             return self.mirror.arrays[field_name][row]
-        return np.asarray(getattr(self.dev, field_name)[row])
+        with self._dispatch_mu:  # the gather is a multi-device program
+            return np.asarray(getattr(self.dev, field_name)[row])
 
     def committed_index(self, cluster_id: int) -> int:
         gi = self.groups[cluster_id]

@@ -174,6 +174,86 @@ class StepOutputs(NamedTuple):
     won: jax.Array          # (G,) bool — candidate reached vote quorum
     lost: jax.Array         # (G,) bool — candidate rejected by quorum
     flags: TickFlags
+    # device read plane egress (None unless has_reads): per pending-read
+    # slot, the client reads confirmed this dispatch and the rel index
+    # each batch was released at.  Multi-round dispatches ACCUMULATE
+    # (count-sum / index-max) across their scanned rounds — safe because
+    # a ReadIndex release index may only be rewritten UP (serving at a
+    # higher watermark is strictly more conservative; the scalar twin's
+    # prefix release does the same rewrite, readindex.py:70-74).
+    read_done_count: jax.Array | None = None  # (G,S) i32
+    read_done_index: jax.Array | None = None  # (G,S) i32 rel, -1 = none
+
+
+def read_confirm(
+    read_acks: jax.Array,   # (G,S,P) bool — heartbeat-echo acks per slot
+    read_count: jax.Array,  # (G,S) i32 — reads batched per slot (0 = free)
+    voting: jax.Array,      # (G,P) bool
+    self_slot: jax.Array,   # (G,) i32
+    quorum: jax.Array,      # (G,) i32
+    node_state: jax.Array,  # (G,) i8
+    live: jax.Array,        # (G,) bool
+) -> jax.Array:
+    """(G,S) bool — pending-read slots whose echo quorum is reached.
+
+    Scalar twin: ``ReadIndex.confirm`` (``raft/readindex.py:51``,
+    reference ``readindex.go:77-90``): ``len(p.confirmed) + 1 >= quorum``
+    — the ``+1`` is the leader counting itself, expressed here as the
+    same elementwise one-hot self-column trick as :func:`_self_column`
+    (a gather-free OR into the ack matrix).  The row-sum is masked by
+    ``voting`` exactly like :func:`vote_tally`/:func:`check_quorum`, so
+    observer echoes never count toward the quorum.  Only live LEADER
+    rows confirm: a row that lost leadership keeps its (about-to-be-
+    purged) slots unconfirmed, matching the scalar path dropping pending
+    reads on every state transition (``raft.py become_*`` builds a fresh
+    ``ReadIndex``).
+    """
+    p = voting.shape[1]
+    self_onehot = jax.nn.one_hot(self_slot, p, dtype=jnp.bool_)  # (G,P)
+    acked = (read_acks | self_onehot[:, None, :]) & voting[:, None, :]
+    count = jnp.sum(acked, axis=2).astype(I32)  # (G,S)
+    is_leader = (node_state == LEADER) & live
+    return (count >= quorum[:, None]) & (read_count > 0) & is_leader[:, None]
+
+
+def _read_plane(
+    st: QuorumState,
+    stage_idx: jax.Array,  # (G,S) i32 — new batch index per slot; -1 = no stage
+    stage_cnt: jax.Array,  # (G,S) i32 — reads in the new batch
+    ack: jax.Array,        # (G,S,P) bool — this round's heartbeat echoes
+) -> tuple[QuorumState, jax.Array, jax.Array]:
+    """One round of the device read plane: stage → echo ingest → confirm
+    → release.  Returns ``(state, done_count, done_index)`` where the
+    done arrays describe the batches released THIS round ((G,S) i32;
+    index -1 where nothing confirmed).
+
+    Staging a slot overwrites it and RESETS its acks: an echo proves
+    leadership only at a time >= its own ctx's capture, so echoes of an
+    older tenant of the slot must never count toward a newer batch (the
+    engine's host-side slot bookkeeping avoids overwriting unconfirmed
+    batches; the reset makes a violation conservative, not unsafe).
+    Echoes staged in the same round as the batch DO count — the host
+    sequences them after the stage, mirroring a heartbeat response
+    arriving after ``add_request`` in the scalar path.
+    """
+    staged = stage_idx >= 0                                   # (G,S)
+    read_index = jnp.where(staged, stage_idx, st.read_index)
+    read_count = jnp.where(staged, stage_cnt, st.read_count)
+    read_acks = jnp.where(staged[:, :, None], ack, st.read_acks | ack)
+    confirmed = read_confirm(
+        read_acks, read_count, st.voting, st.self_slot, st.quorum,
+        st.node_state, st.live,
+    )
+    done_count = jnp.where(confirmed, read_count, 0)
+    done_index = jnp.where(confirmed, read_index, -1)
+    # release: confirmed slots free (count 0) with acks cleared; the
+    # captured index is left in place (harmless — count gates everything)
+    read_count = jnp.where(confirmed, 0, read_count)
+    read_acks = read_acks & ~confirmed[:, :, None]
+    st = st._replace(
+        read_index=read_index, read_count=read_count, read_acks=read_acks
+    )
+    return st, done_count, done_index
 
 
 def tick_step(st: QuorumState) -> tuple[QuorumState, TickFlags]:
@@ -363,9 +443,13 @@ def quorum_step_dense_impl(
     ack_max: jax.Array,      # (G,P) i32 — max acked rel index, 0 where untouched
     ack_touched: jax.Array,  # (G,P) bool — slot received ≥1 event this round
     vote_new: jax.Array,     # (G,P) i8 — VOTE_NONE where no vote event
+    read_stage_idx: jax.Array | None = None,  # (G,S) i32, -1 = no stage
+    read_stage_cnt: jax.Array | None = None,  # (G,S) i32
+    read_ack: jax.Array | None = None,        # (G,S,P) bool echo events
     do_tick: bool = True,
     track_contact: bool = True,
     has_votes: bool = True,
+    has_reads: bool = False,
 ) -> StepOutputs:
     """Dense-ingestion twin of :func:`quorum_step_impl` — zero scatters.
 
@@ -409,14 +493,27 @@ def quorum_step_dense_impl(
     else:
         votes = st.votes
 
-    return _finish_step(
+    out = _finish_step(
         st, match, next_, active, votes, election_tick, last_index, do_tick
     )
+    if has_reads:
+        # read plane LAST: stage / echo ingest / confirm / release
+        # (ReadIndex confirmation is independent of this round's commit
+        # advancement — the release index is the CAPTURED watermark, not
+        # the current one — so ordering vs _finish_step is free; last
+        # keeps the write path byte-identical when reads are quiet)
+        rst, done_cnt, done_idx = _read_plane(
+            out.state, read_stage_idx, read_stage_cnt, read_ack
+        )
+        out = out._replace(
+            state=rst, read_done_count=done_cnt, read_done_index=done_idx
+        )
+    return out
 
 
 quorum_step_dense = jax.jit(
     quorum_step_dense_impl,
-    static_argnames=("do_tick", "track_contact", "has_votes"),
+    static_argnames=("do_tick", "track_contact", "has_votes", "has_reads"),
     donate_argnums=(0,),
 )
 
@@ -552,6 +649,7 @@ def _apply_recycle(
     term: jax.Array,   # (C,) i32
     start: jax.Array,  # (C,) i32 rel — term_start of the fresh leader
     last: jax.Array,   # (C,) i32 rel — last_index of the fresh leader
+    reset_reads: bool = True,
 ) -> QuorumState:
     """Masked leader-recycle row reset (twin: the host's ``remove_group``
     + ``add_group`` + ``set_leader`` sequence for a SAME-GEOMETRY tenant
@@ -563,12 +661,29 @@ def _apply_recycle(
     program).  Padding rows carry ``row == G`` and drop out of bounds.
     """
     g, p = st.match.shape
+    s = st.read_index.shape[1]
+    c = row.shape[0]
     sel = st.self_slot[row.clip(0, g - 1)]  # (C,) — self slot per target row
     cols = jnp.arange(p, dtype=I32)[None, :]
     # reset_remotes: match 0 everywhere except self = last; next = last + 1
     match_rows = jnp.where(cols == sel[:, None], last[:, None], 0)
     next_rows = jnp.broadcast_to(last[:, None] + 1, match_rows.shape)
     zc = jnp.zeros_like(term)
+    if reset_reads:
+        # pending reads die with the tenant (HostMirror.clear_reads twin).
+        # Compiled OUT (reset_reads=False, a static flag) when the engine's
+        # read plane has never been used: the read arrays are provably
+        # all-zero then, the resets are no-ops, and the three extra row
+        # scatters per scanned round cost ~40% of rung-5 throughput at
+        # 100k groups under churn (measured 2.83M -> 1.60M w/s).
+        zread = jnp.zeros((c, s), I32)
+        st = st._replace(
+            read_index=st.read_index.at[row].set(zread, mode="drop"),
+            read_count=st.read_count.at[row].set(zread, mode="drop"),
+            read_acks=st.read_acks.at[row].set(
+                jnp.zeros((c, s, p), jnp.bool_), mode="drop"
+            ),
+        )
     return st._replace(
         node_state=st.node_state.at[row].set(LEADER, mode="drop"),
         live=st.live.at[row].set(True, mode="drop"),
@@ -596,10 +711,15 @@ def quorum_multiround_impl(
     churn_start: jax.Array,  # (K,C) i32 rel
     churn_last: jax.Array,  # (K,C) i32 rel
     tick_mask: jax.Array,   # (K,) bool — which rounds tick; dummy when !do_tick
+    read_stage_idx: jax.Array | None = None,  # (K,G,S) i32, -1 = no stage
+    read_stage_cnt: jax.Array | None = None,  # (K,G,S) i32
+    read_ack: jax.Array | None = None,        # (K,G,S,P) bool echoes
     do_tick: bool = False,
     track_contact: bool = True,
     has_votes: bool = False,
     has_churn: bool = False,
+    has_reads: bool = False,
+    purge_reads: bool = True,
 ) -> StepOutputs:
     """K engine rounds — INCLUDING membership churn — in ONE dispatch.
 
@@ -631,10 +751,26 @@ def quorum_multiround_impl(
     OR-accumulated flags.  Flag OR-accumulation is per ROW: a row recycled
     mid-block attributes surviving flags to its final tenant — recycling
     callers (bench rungs, tickless coordinators) run flag-free rounds.
+
+    ``has_reads`` rides the device read plane on the same scan: per round,
+    staged ReadIndex ctx batches land in their slots, heartbeat echoes OR
+    in, and :func:`read_confirm` releases quorum-confirmed slots — read
+    contexts confirm in the SAME dispatch that advances commits.  The
+    confirmed-read egress accumulates in the scan carry (count-sum /
+    index-max per slot; see :class:`StepOutputs`), so one transfer serves
+    the whole block.  A slot confirming twice in one block (the engine
+    restages only deterministically-confirmed slots) reports the summed
+    count at the max index — an UP-only index rewrite, which ReadIndex
+    semantics permit (``tests/test_read_confirm.py`` pins all of this
+    against the scalar oracle, including a recycle and a leader change
+    with pending ctxs mid-block).
     """
 
     def body(carry, ev):
-        stc = carry
+        if has_reads:
+            stc, rcnt_acc, ridx_acc = carry
+        else:
+            stc = carry
         i = 0
         am = ev[i]; i += 1
         if has_votes:
@@ -646,15 +782,32 @@ def quorum_multiround_impl(
                 ev[i], ev[i + 1], ev[i + 2], ev[i + 3]
             )
             i += 4
-            stc = _apply_recycle(stc, crow, cterm, cstart, clast)
+            # reset_reads compiles the read-slot purges out of the recycle
+            # when the engine's read plane has never been used (all-zero
+            # arrays; see _apply_recycle) — the engine passes purge_reads=
+            # _read_plane_used; has_reads keeps the purge for blocks that
+            # stage reads themselves
+            stc = _apply_recycle(
+                stc, crow, cterm, cstart, clast,
+                reset_reads=has_reads or purge_reads,
+            )
+        if has_reads:
+            rsi, rsc, rak = ev[i], ev[i + 1], ev[i + 2]
+            i += 3
+        else:
+            rsi = rsc = rak = None
         out = quorum_step_dense_impl(
             stc,
             jnp.maximum(am, 0),  # -1 sentinel → 0 (a scatter-max no-op)
             am >= 0,
             vn,
+            rsi,
+            rsc,
+            rak,
             do_tick=False,  # ticking handled below, per-round masked
             track_contact=track_contact,
             has_votes=has_votes,
+            has_reads=has_reads,
         )
         stc = out.state
         if do_tick:
@@ -667,16 +820,38 @@ def quorum_multiround_impl(
         else:
             zeros = jnp.zeros_like(out.won)
             flags = TickFlags(zeros, zeros, zeros)
-        return stc, (out.won, out.lost, flags)
+        if has_reads:
+            carry = (
+                stc,
+                rcnt_acc + out.read_done_count,
+                jnp.maximum(ridx_acc, out.read_done_index),
+            )
+        else:
+            carry = stc
+        return carry, (out.won, out.lost, flags)
 
     xs = (ack_max,)
     if has_votes:
         xs = xs + (vote_new,)
     if has_churn:
         xs = xs + (churn_row, churn_term, churn_start, churn_last)
+    if has_reads:
+        xs = xs + (read_stage_idx, read_stage_cnt, read_ack)
     if do_tick:
         xs = xs + (tick_mask,)
-    st, (won, lost, flags) = jax.lax.scan(body, st, xs)
+    if has_reads:
+        g, s = st.read_index.shape
+        carry0 = (
+            st, jnp.zeros((g, s), I32), jnp.full((g, s), -1, I32)
+        )
+    else:
+        carry0 = st
+    carry, (won, lost, flags) = jax.lax.scan(body, carry0, xs)
+    if has_reads:
+        st, read_done_count, read_done_index = carry
+    else:
+        st = carry
+        read_done_count = read_done_index = None
     any_ = lambda x: jnp.any(x, axis=0)  # noqa: E731
     return StepOutputs(
         st,
@@ -684,11 +859,16 @@ def quorum_multiround_impl(
         any_(won),
         any_(lost),
         TickFlags(*(any_(f) for f in flags)),
+        read_done_count,
+        read_done_index,
     )
 
 
 quorum_multiround = jax.jit(
     quorum_multiround_impl,
-    static_argnames=("do_tick", "track_contact", "has_votes", "has_churn"),
+    static_argnames=(
+        "do_tick", "track_contact", "has_votes", "has_churn", "has_reads",
+        "purge_reads",
+    ),
     donate_argnums=(0,),
 )

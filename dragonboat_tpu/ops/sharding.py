@@ -39,9 +39,13 @@ def state_sharding(mesh: Mesh, axis: str = GROUP_AXIS) -> QuorumState:
     """
     row = NamedSharding(mesh, P(axis))
     mat = NamedSharding(mesh, P(axis, None))
+    cube = NamedSharding(mesh, P(axis, None, None))  # (G,S,P) read acks
+    mats = (
+        "match", "next", "voting", "present", "active", "votes",
+        "read_index", "read_count",
+    )
     fields = {
-        k: (mat if k in ("match", "next", "voting", "present", "active", "votes")
-            else row)
+        k: (cube if k == "read_acks" else mat if k in mats else row)
         for k in QuorumState._fields
     }
     return QuorumState(**fields)

@@ -47,6 +47,15 @@ FOLLOWER, CANDIDATE, LEADER, OBSERVER, WITNESS = 0, 1, 2, 3, 4
 # Vote cell encoding: -1 = no response, 0 = rejected, 1 = granted.
 VOTE_NONE, VOTE_REJECT, VOTE_GRANT = -1, 0, 1
 
+# Pending ReadIndex ctx slots per group (the ``S`` axis).  Each slot holds
+# ONE staged read batch: its captured commit watermark, the number of
+# client reads riding it, and the per-peer heartbeat-echo acks.  Four
+# slots cover a full K-round pipeline depth: a batch staged in round r
+# confirms in round >= r, and the engine's host-side slot bookkeeping
+# only reuses a slot once its batch deterministically confirmed
+# (``BatchedQuorumEngine.stage_read``).
+READ_SLOTS = 4
+
 
 class QuorumState(NamedTuple):
     """Struct-of-arrays state for G groups × P peer slots.
@@ -80,10 +89,21 @@ class QuorumState(NamedTuple):
     active: jax.Array          # (G,P) bool: remote.active (CheckQuorum recency)
     votes: jax.Array           # (G,P) i8: VOTE_NONE / VOTE_REJECT / VOTE_GRANT
 
+    # --- pending ReadIndex ctx slots (device read plane) ---------------
+    # Scalar twin: ``raft/readindex.py`` ReadStatus (index + confirmed
+    # set), batched per group into S fixed slots.  ``read_count == 0``
+    # means the slot is free; confirmation is a masked row-sum of
+    # ``read_acks`` vs quorum (kernels.read_confirm).
+    read_index: jax.Array      # (G,S) i32 rel: commit watermark captured at stage
+    read_count: jax.Array      # (G,S) i32: client reads batched in the slot (0 = free)
+    read_acks: jax.Array       # (G,S,P) bool: heartbeat-echo acks per slot
 
-def make_state(n_groups: int, n_peers: int) -> QuorumState:
+
+def make_state(
+    n_groups: int, n_peers: int, n_read_slots: int = READ_SLOTS
+) -> QuorumState:
     """All-dead state: rows are claimed by the host as groups start."""
-    g, p = n_groups, n_peers
+    g, p, s = n_groups, n_peers, n_read_slots
     zi = jnp.zeros((g,), I32)
     return QuorumState(
         node_state=jnp.zeros((g,), I8),
@@ -107,6 +127,9 @@ def make_state(n_groups: int, n_peers: int) -> QuorumState:
         present=jnp.zeros((g, p), BOOL),
         active=jnp.zeros((g, p), BOOL),
         votes=jnp.full((g, p), VOTE_NONE, I8),
+        read_index=jnp.zeros((g, s), I32),
+        read_count=jnp.zeros((g, s), I32),
+        read_acks=jnp.zeros((g, s, p), BOOL),
     )
 
 
@@ -119,10 +142,13 @@ class HostMirror:
     (see ``kernels.quorum_step``).
     """
 
-    def __init__(self, n_groups: int, n_peers: int):
+    def __init__(
+        self, n_groups: int, n_peers: int, n_read_slots: int = READ_SLOTS
+    ):
         self.n_groups = n_groups
         self.n_peers = n_peers
-        dev = make_state(n_groups, n_peers)
+        self.n_read_slots = n_read_slots
+        dev = make_state(n_groups, n_peers, n_read_slots)
         self.arrays = {k: np.asarray(v).copy() for k, v in dev._asdict().items()}
 
     def to_device(self, sharding=None) -> QuorumState:
@@ -138,7 +164,12 @@ class HostMirror:
             np.copyto(self.arrays[k], np.asarray(v))
 
     def recycle_row(
-        self, row: int, term: int, term_start: int, last_index: int
+        self,
+        row: int,
+        term: int,
+        term_start: int,
+        last_index: int,
+        clear_reads: bool = True,
     ) -> None:
         """Numpy twin of ``kernels._apply_recycle``: reset a row to a
         fresh same-geometry leader tenant WITHOUT touching membership
@@ -161,3 +192,15 @@ class HostMirror:
         a["next"][row, :] = last_index + 1
         a["active"][row, :] = False
         a["votes"][row, :] = VOTE_NONE
+        if clear_reads:  # engine skips while its read plane is untouched
+            self.clear_reads(row)
+
+    def clear_reads(self, row: int) -> None:
+        """Drop a row's pending ReadIndex slots (twin of the scalar path's
+        fresh ``ReadIndex()`` on every state transition, ``raft.py``
+        ``become_*``): reads staged under the old leadership must never
+        confirm under the new one."""
+        a = self.arrays
+        a["read_index"][row, :] = 0
+        a["read_count"][row, :] = 0
+        a["read_acks"][row, :, :] = False

@@ -168,6 +168,14 @@ class Raft:
         # but the local fire sites below are suppressed — the coordinator
         # applies the device flags through the same handlers instead
         self.device_ticks = False
+        # True when the device engine's read plane batches ReadIndex
+        # confirmations (kernels.read_confirm): pending-read bookkeeping
+        # (queue, hint rebroadcast) stays HERE — the scalar path remains
+        # the fallback and the releaser — but heartbeat-echo quorum
+        # counting moves to the per-round fused dispatch; the coordinator
+        # routes confirmed ctxs back through ``read_index.release`` with
+        # leader/term guards intact (node._apply_offload_effects)
+        self.device_reads = False
         # first index of the current leadership term (set at promotion)
         self.term_start_index = 0
         # ring buffer of recent election-related events (campaigns, vote
@@ -1141,6 +1149,15 @@ class Raft:
                 self.report_dropped_read_index(m)
                 return
             self.read_index.add_request(self.log.committed, ctx, m.from_)
+            if self.offload is not None and self.device_reads:
+                # device read plane: the echo-quorum counting for this ctx
+                # runs in the engine's per-round fused dispatch; the local
+                # pending entry above still drives hint rebroadcast and
+                # the prefix release when the coordinator confirms
+                self.offload.read_stage(
+                    self.cluster_id, self.log.committed, ctx.low, ctx.high,
+                    self.term,
+                )
             self.broadcast_heartbeat_message_with_hint(ctx)
         else:
             self.add_ready_to_read(self.log.committed, ctx)
@@ -1201,7 +1218,17 @@ class Raft:
         if rp.match < self.log.last_index():
             self.send_replicate_message(m.from_)
         if m.hint != 0:
-            self.handle_read_index_leader_confirmation(m)
+            if self.offload is not None and self.device_reads:
+                # batched per coordinator round: the echo joins the
+                # group's pending-read slot and the device's masked
+                # row-sum decides the quorum (ctxs the coordinator is
+                # not tracking — slot overflow, stale echoes — fall
+                # back to the scalar tally below via the node)
+                self.offload.read_ack_hint(
+                    self.cluster_id, m.from_, m.hint, m.hint_high
+                )
+            else:
+                self.handle_read_index_leader_confirmation(m)
 
     def handle_leader_transfer(self, m: Message, rp: Remote) -> None:
         # reference raft.go:1716-1738
@@ -1223,6 +1250,16 @@ class Raft:
         # reference raft.go:1740-1760
         ctx = SystemCtx(low=m.hint, high=m.hint_high)
         ris = self.read_index.confirm(ctx, m.from_, self.quorum())
+        self.apply_read_releases(ris, ctx)
+
+    def apply_read_releases(self, ris, ctx: SystemCtx) -> None:
+        """Route released ReadStatuses: local requesters land in
+        ``ready_to_read``, remote ones get a READ_INDEX_RESP carrying the
+        CONFIRMED ctx (reference raft.go:1740-1760 echoes ``m.Hint``, not
+        the released request's own ctx).  Shared by the scalar confirm
+        above and the device read plane's confirmed egress
+        (``node._apply_offload_effects``) — both release through
+        ``read_index``, so routing and indices are identical."""
         for s in ris:
             if s.from_ == NO_NODE or s.from_ == self.node_id:
                 self.add_ready_to_read(s.index, s.ctx)
@@ -1232,8 +1269,8 @@ class Raft:
                         to=s.from_,
                         type=MT.READ_INDEX_RESP,
                         log_index=s.index,
-                        hint=m.hint,
-                        hint_high=m.hint_high,
+                        hint=ctx.low,
+                        hint_high=ctx.high,
                     )
                 )
 

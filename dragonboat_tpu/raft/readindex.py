@@ -59,6 +59,17 @@ class ReadIndex:
         p.confirmed.add(from_)
         if len(p.confirmed) + 1 < quorum:
             return []
+        return self.release(ctx)
+
+    def release(self, ctx: SystemCtx) -> List[ReadStatus]:
+        """The queue-pop half of :meth:`confirm`: release ``ctx`` and every
+        request queued before it, all rewritten to ``ctx``'s index.  The
+        quorum counting is the caller's — the scalar echo tally above, or
+        the device ``read_confirm`` kernel whose confirmed-slot egress the
+        coordinator routes back here (``tpuquorum.py``); either way the
+        released statuses and their indices are identical."""
+        if ctx not in self.pending:
+            return []
         done = 0
         cs: List[ReadStatus] = []
         for pctx in self.queue:

@@ -69,12 +69,18 @@ class Snapshotter:
         env.remove_tmp_dir()
         env.create_tmp_dir()
         path = env.get_tmp_filepath()
-        w = SnapshotWriter(path, self.fs, compression=meta.compression)
+        # writer construction is inside the cleanup scope: __init__ already
+        # writes the header placeholder, and a fault there (ErrorFS write
+        # injection, ENOSPC) must not leak the .generating temp dir
+        # (tests/test_rsm.py fault table caught exactly this)
+        w = None
         try:
+            w = SnapshotWriter(path, self.fs, compression=meta.compression)
             savable.save_snapshot_payload(meta, w)
             w.finalize()
         except Exception:
-            w.abort()
+            if w is not None:
+                w.abort()
             env.remove_tmp_dir()
             raise
         ss = Snapshot(

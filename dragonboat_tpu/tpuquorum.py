@@ -54,6 +54,7 @@ class TpuQuorumCoordinator:
         interval_s: float = 0.002,
         drive_ticks: bool = True,
         mesh_devices: int = 0,
+        drive_reads: bool = True,
     ):
         from .ops.engine import BatchedQuorumEngine
 
@@ -91,6 +92,21 @@ class TpuQuorumCoordinator:
         # heartbeat due, check-quorum window) come from the device tick
         # kernel; registered nodes set raft.device_ticks accordingly
         self.drive_ticks = drive_ticks
+        # device read plane (ISSUE 3): ReadIndex heartbeat-echo quorum
+        # counting batches into the same single-round dispatch; the
+        # scalar ReadIndex stays the pending bookkeeping and the releaser
+        self.drive_reads = drive_reads
+        # per-group FIFO of device-staged read ctxs: cid -> list of
+        # (slot, low, high, term) in staging order.  Confirmation of a
+        # slot releases its ctx through the scalar prefix release, which
+        # also frees every EARLIER ctx — their engine slots are cancelled
+        # here.  Guarded by _mu (round thread + drain).
+        self._read_pending: Dict[int, list] = {}
+        # observability: ctxs confirmed BY THE DEVICE plane vs echoes that
+        # fell back to the scalar tally (overflow/stale) — the read-plane
+        # tests assert the device actually served the load
+        self.read_confirms = 0
+        self.read_fallbacks = 0
         # monotonically increasing tick sequence written ONLY by the tick
         # thread; the round compares against the last value it consumed, so
         # a tick arriving mid-round is never lost (no lock needed: single
@@ -129,10 +145,13 @@ class TpuQuorumCoordinator:
         with self._mu:
             self._nodes[node.cluster_id] = node
             self._sync_row_locked(node)
+            if self.drive_reads:
+                node.peer.raft.device_reads = True
 
     def unregister(self, cluster_id: int) -> None:
         with self._mu:
             self._nodes.pop(cluster_id, None)
+            self._read_pending.pop(cluster_id, None)
             if cluster_id in self.eng.groups:
                 self.eng.remove_group(cluster_id)
 
@@ -141,6 +160,7 @@ class TpuQuorumCoordinator:
         resync used at registration and after membership changes."""
         r = node.peer.raft
         cid = r.cluster_id
+        self._read_pending.pop(cid, None)
         if cid in self.eng.groups:
             self.eng.remove_group(cid)
         voters = sorted(set(r.remotes))
@@ -235,6 +255,21 @@ class TpuQuorumCoordinator:
     def set_randomized_timeout(self, cluster_id: int, timeout: int) -> None:
         self._stage(("randto", cluster_id, timeout))
 
+    def read_stage(
+        self, cluster_id: int, committed: int, low: int, high: int, term: int
+    ) -> None:
+        """A leader accepted a ReadIndex ctx (``handle_leader_read_index``
+        under raftMu): stage it into the group's pending-read slot,
+        captured at scalar raft's own committed watermark."""
+        self._stage(("rstage", cluster_id, committed, low, high, term))
+
+    def read_ack_hint(
+        self, cluster_id: int, node_id: int, low: int, high: int
+    ) -> None:
+        """A heartbeat response echoed a ReadIndex hint: joins the ctx's
+        pending-read slot; the device row-sum decides the quorum."""
+        self._stage(("rack", cluster_id, node_id, low, high))
+
     def set_leader(
         self, cluster_id: int, term: int, term_start: int, last_index: int
     ) -> None:
@@ -296,15 +331,48 @@ class TpuQuorumCoordinator:
                     self.eng.leader_contact(cid)
                 elif kind == "randto":
                     self.eng.set_randomized_timeout(cid, op[2])
+                elif kind == "rstage":
+                    try:
+                        slot = self.eng.stage_read(cid, count=1, index=op[2])
+                    except RuntimeError:
+                        # every pending-read slot holds an unconfirmed
+                        # batch: leave this ctx to the scalar fallback
+                        # (its echoes arrive as unknown-ctx racks below)
+                        pass
+                    else:
+                        self._read_pending.setdefault(cid, []).append(
+                            (slot, op[3], op[4], op[5])
+                        )
+                elif kind == "rack":
+                    node_id, low, high = op[2], op[3], op[4]
+                    slot = None
+                    for sl, lo, hi, _t in self._read_pending.get(cid, ()):
+                        if lo == low and hi == high:
+                            slot = sl
+                            break
+                    if slot is not None:
+                        self.eng.read_ack(cid, node_id, slot)
+                    else:
+                        # ctx not device-tracked (slot overflow, stale or
+                        # already-confirmed echo): scalar tally under
+                        # raftMu — confirm() on an unknown ctx is a no-op
+                        self.read_fallbacks += 1
+                        node = self._nodes.get(cid)
+                        if node is not None:
+                            node.offload_read_echo(node_id, low, high)
                 elif kind == "leader":
+                    self._read_pending.pop(cid, None)
                     self.eng.set_leader(
                         cid, term=op[2], term_start=op[3], last_index=op[4]
                     )
                 elif kind == "candidate":
+                    self._read_pending.pop(cid, None)
                     self.eng.set_candidate(cid, term=op[2])
                 elif kind == "follower":
+                    self._read_pending.pop(cid, None)
                     self.eng.set_follower(cid, term=op[2])
                 else:  # resync
+                    self._read_pending.pop(cid, None)
                     recover.append(cid)
             except (ValueError, KeyError):
                 # unknown peer slot / index past the rebase window: rebuild
@@ -401,6 +469,11 @@ class TpuQuorumCoordinator:
                 or self.eng._acks
                 or self.eng._ack_blocks
                 or self.eng._votes
+                # staged read ctxs / heartbeat echoes must dispatch even
+                # on an otherwise-quiet round: with drive_ticks off (or
+                # a quiet group) nothing else would ever flush them and
+                # the pending ReadIndex would hang until client timeout
+                or self.eng._reads_pending()
                 # dirty-only rounds (row registrations, transition
                 # replays with no queued events) need no dispatch when
                 # ticks drive regular rounds anyway: the upload
@@ -422,13 +495,24 @@ class TpuQuorumCoordinator:
             # ladder, native control planes) use begin_round/step_rounds
             # directly — see docs/overview.md "multi-round coordinator".
             res = self.eng.step(do_tick=do_tick)
+            read_confirms: list = []
+            self._collect_read_confirms(res, read_confirms)
             for _ in range(deficit - 1):  # replay remaining missed ticks
                 extra = self.eng.step(do_tick=True)
                 res.commit.update(extra.commit)
+                self._collect_read_confirms(extra, read_confirms)
                 for field in ("won", "lost", "elect", "heartbeat", "demote"):
                     merged = set(getattr(res, field))
                     merged.update(getattr(extra, field))
                     setattr(res, field, list(merged))
+        # confirmed-read releases, OUTSIDE _mu like the commit callbacks:
+        # the node re-checks leader/term under raftMu and releases through
+        # the scalar ReadIndex prefix pop (indices identical to the pure
+        # scalar path — tests/test_read_confirm.py)
+        for cid, low, high, term in read_confirms:
+            node = self._nodes.get(cid)
+            if node is not None:
+                node.offload_read_confirm(low, high, term)
         for cid, q in res.commit.items():
             node = self._nodes.get(cid)
             if node is not None:
@@ -473,6 +557,36 @@ class TpuQuorumCoordinator:
             node = self._nodes.get(cid)
             if node is not None:
                 node.offload_election(False, term)
+
+    def _collect_read_confirms(self, res, out: list) -> None:
+        """Map confirmed-read egress slots back to their ctxs (under _mu).
+
+        A confirmed slot releases its ctx AND — through the scalar prefix
+        release — every ctx staged before it; the earlier ctxs' engine
+        slots are cancelled here so they don't leak until a transition
+        purge.  Ctxs no longer tracked (a transition purged the group's
+        FIFO after the dispatch was staged) drop silently: the node-side
+        term guard would reject them anyway."""
+        if res.read_cids is None or not len(res.read_cids):
+            return
+        for cid, slot, _index, _count in res.reads:
+            fifo = self._read_pending.get(cid)
+            if not fifo:
+                continue
+            pos = next(
+                (i for i, e in enumerate(fifo) if e[0] == slot), None
+            )
+            if pos is None:
+                continue
+            _slot, low, high, term = fifo[pos]
+            for e in fifo[:pos]:  # prefix-released scalar-side
+                try:
+                    self.eng.cancel_read(cid, e[0])
+                except (ValueError, KeyError):
+                    pass
+            del fifo[: pos + 1]
+            self.read_confirms += 1
+            out.append((cid, low, high, term))
 
     def flush(self) -> None:
         """Run one round synchronously (tests)."""

@@ -122,7 +122,14 @@ class _MemFile(io.BytesIO):
 
     def flush(self) -> None:
         super().flush()
-        self._fs._store(self._path, self.getvalue())
+        # store only while the entry still exists: a handle left open
+        # across remove()/rmtree() must not resurrect the file when it is
+        # eventually flushed or GC-closed (BytesIO.__del__ calls close →
+        # flush) — POSIX writes to an unlinked file vanish with the inode.
+        # Without this, an abandoned writer handle (e.g. a fault-injected
+        # SnapshotWriter kept alive by the exception traceback) re-created
+        # its file AFTER the snapshot temp-dir cleanup had removed it.
+        self._fs._store_if_tracked(self._path, self.getvalue())
 
     def close(self) -> None:
         if not self.closed:
@@ -148,6 +155,15 @@ class MemFS(IFS):
     def _store(self, path: str, data: bytes) -> None:
         with self._mu:
             self._files[self._norm(path)] = bytes(data)
+
+    def _store_if_tracked(self, path: str, data: bytes) -> None:
+        """Flush-path store: a no-op once the entry was removed (the
+        unlinked-inode semantics _MemFile.flush relies on).  ``open``
+        registers the entry up front, so live handles always store."""
+        path = self._norm(path)
+        with self._mu:
+            if path in self._files:
+                self._files[path] = bytes(data)
 
     def open(self, path: str, mode: str):
         path = self._norm(path)

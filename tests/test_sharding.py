@@ -119,9 +119,14 @@ def test_coordinator_shards_when_configured():
 
 
 def test_full_stack_sharded_engine():
-    """3 NodeHosts, each with an 8-way group-sharded engine, 24 groups:
-    device-tick elections + committed proposals through the full stack
-    (shared harness with ``__graft_entry__.dryrun_multichip`` phase D)."""
+    """3 NodeHosts, each with an 8-way group-sharded engine: device-tick
+    elections + committed proposals through the full stack (shared
+    harness with ``__graft_entry__.dryrun_multichip`` phase D).  Load is
+    sized for the 2-vCPU CI box: the engines' cross-engine dispatch
+    serialization (BatchedQuorumEngine._MULTIDEV_MU — the XLA CPU
+    collective-rendezvous deadlock note there) stops the three
+    coordinators' dispatches overlapping, so wall time scales with
+    groups × writes."""
     from dragonboat_tpu.testing import run_sharded_stack_check
 
-    assert run_sharded_stack_check(N_DEV, groups=24, writes_per_group=5) == 120
+    assert run_sharded_stack_check(N_DEV, groups=16, writes_per_group=3) == 48
