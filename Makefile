@@ -3,8 +3,8 @@
 
 PY ?= python
 
-.PHONY: test test-all test-kernels native soak soak-smoke bench dryrun \
-	perf-ledger perf-ledger-check
+.PHONY: test test-all test-kernels test-obs native soak soak-smoke bench \
+	dryrun perf-ledger perf-ledger-check
 
 test: native
 	$(PY) -m pytest tests/ -x -q -m "not slow"
@@ -16,6 +16,13 @@ test: native
 test-kernels:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_ops_quorum.py \
 	    tests/test_multiround.py tests/test_read_confirm.py -q
+
+# fast cpu gate for the observability plane (mirrors test-kernels): the
+# flight recorder, Prometheus exposition round-trip, obs on/off engine
+# parity and the stall-watchdog auto-dump — run before the full tier-1
+# sweep whenever obs/, events.py, or the engine/coordinator hooks change
+test-obs:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_obs.py tests/test_events.py -q
 
 # parallel run: heavy multi-NodeHost modules carry
 # xdist_group("heavy-multiprocess") and serialize on one worker while

@@ -14,6 +14,7 @@ registry provides the same counter/gauge + text-exposition surface.
 """
 from __future__ import annotations
 
+import bisect
 import enum
 import queue
 import threading
@@ -30,13 +31,42 @@ plog = get_logger("events")
 # ---------------------------------------------------------------------------
 
 
+def escape_label_value(v: str) -> str:
+    """Prometheus text-format label escaping: ``\\`` → ``\\\\``, ``"`` →
+    ``\\"``, newline → ``\\n`` (exposition spec).  Backslash first — the
+    replacements must not re-escape each other's output."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+#: default histogram bucket upper bounds (ms-scale latencies); callers
+#: pass their own geometry at first observe
+DEFAULT_BUCKETS = (
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+    100.0, 250.0, 500.0, 1000.0, 2500.0, 10000.0,
+)
+
+
 class MetricsRegistry:
-    """Counters and gauges keyed by name + label set."""
+    """Counters, gauges, and bucketed histograms keyed by name + label
+    set, with valid Prometheus text exposition.
+
+    Exposition invariants (ISSUE 5 satellite audit — the original
+    formatter re-emitted ``# TYPE`` per LABEL SET, invalid for repeated
+    metric names, and wrote label values unescaped, so a ``"``, ``\\``
+    or newline in a value corrupted the whole scrape): exactly one
+    ``# TYPE`` line per metric name, label values escaped, and stable
+    (name, labels)-sorted ordering so successive scrapes diff cleanly.
+    """
 
     def __init__(self) -> None:
         self._mu = threading.Lock()
         self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+        # histograms: per-NAME bucket geometry (first declare/observe
+        # wins — mergeable series require one geometry per family) and
+        # per-series [counts (len(buckets)+1, +Inf last), sum, count]
+        self._hist_buckets: Dict[str, Tuple[float, ...]] = {}
+        self._hists: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], list] = {}
 
     @staticmethod
     def _key(name: str, labels: Optional[Dict[str, str]]):
@@ -67,28 +97,119 @@ class MetricsRegistry:
         with self._mu:
             return self._gauges.get(self._key(name, labels), 0)
 
+    # -- histograms (device-plane latency families; obs/instruments.py) --
+
+    def _hist_series(self, name, labels, buckets) -> list:
+        """Get-or-create one histogram series; caller holds ``_mu``."""
+        bk = self._hist_buckets.get(name)
+        if bk is None:
+            bk = tuple(float(b) for b in (buckets or DEFAULT_BUCKETS))
+            if list(bk) != sorted(bk):
+                raise ValueError("histogram buckets must be sorted")
+            self._hist_buckets[name] = bk
+        k = self._key(name, labels)
+        series = self._hists.get(k)
+        if series is None:
+            series = [[0] * (len(bk) + 1), 0.0, 0]
+            self._hists[k] = series
+        return series
+
+    def histogram_declare(
+        self, name: str, buckets=None, labels: Optional[Dict[str, str]] = None
+    ) -> None:
+        """Materialize an empty histogram series so the family is visible
+        in the exposition before the first observation."""
+        with self._mu:
+            self._hist_series(name, labels, buckets)
+
+    def histogram_observe(
+        self,
+        name: str,
+        value: float,
+        labels: Optional[Dict[str, str]] = None,
+        buckets=None,
+    ) -> None:
+        with self._mu:
+            series = self._hist_series(name, labels, buckets)
+            bk = self._hist_buckets[name]
+            i = bisect.bisect_left(bk, value)
+            series[0][i] += 1
+            series[1] += value
+            series[2] += 1
+
+    def histogram_value(
+        self, name: str, labels: Optional[Dict[str, str]] = None
+    ):
+        """``(buckets, counts, sum, count)`` for one series (counts are
+        per-bucket, +Inf last), or None when the series doesn't exist."""
+        with self._mu:
+            series = self._hists.get(self._key(name, labels))
+            if series is None:
+                return None
+            return (
+                self._hist_buckets[name], tuple(series[0]),
+                series[1], series[2],
+            )
+
+    def families(self):
+        """Sorted metric family names across all instrument kinds."""
+        with self._mu:
+            names = {n for n, _ in self._counters}
+            names.update(n for n, _ in self._gauges)
+            names.update(n for n, _ in self._hists)
+        return sorted(names)
+
     @staticmethod
     def _fmt(name: str, label_items, value: float) -> str:
         if label_items:
-            body = ",".join(f'{k}="{v}"' for k, v in label_items)
+            body = ",".join(
+                f'{k}="{escape_label_value(str(v))}"' for k, v in label_items
+            )
             return f"{name}{{{body}}} {value:g}"
         return f"{name} {value:g}"
 
     def write_health_metrics(self, out) -> None:
         """Prometheus text format (reference ``WriteHealthMetrics``
-        ``event.go:31``)."""
+        ``event.go:31``): one ``# TYPE`` per metric name, escaped label
+        values, stable ordering (counters, then gauges, then
+        histograms; (name, labels)-sorted within each)."""
         with self._mu:
             counters = sorted(self._counters.items())
             gauges = sorted(self._gauges.items())
-        for (name, labels), v in counters:
-            out.write(f"# TYPE {name} counter\n{self._fmt(name, labels, v)}\n")
-        for (name, labels), v in gauges:
-            out.write(f"# TYPE {name} gauge\n{self._fmt(name, labels, v)}\n")
+            hists = sorted(
+                (k, self._hist_buckets[k[0]], list(v[0]), v[1], v[2])
+                for k, v in self._hists.items()
+            )
+        for kind, items in (("counter", counters), ("gauge", gauges)):
+            prev = None
+            for (name, labels), v in items:
+                if name != prev:
+                    out.write(f"# TYPE {name} {kind}\n")
+                    prev = name
+                out.write(f"{self._fmt(name, labels, v)}\n")
+        prev = None
+        for (name, labels), bk, counts, total, count in hists:
+            if name != prev:
+                out.write(f"# TYPE {name} histogram\n")
+                prev = name
+            cum = 0
+            for le, c in zip(bk, counts):
+                cum += c
+                out.write(
+                    f"{self._fmt(name + '_bucket', labels + (('le', f'{le:g}'),), cum)}\n"
+                )
+            out.write(
+                f"{self._fmt(name + '_bucket', labels + (('le', '+Inf'),), count)}\n"
+            )
+            out.write(f"{self._fmt(name + '_sum', labels, total)}\n")
+            out.write(f"{self._fmt(name + '_count', labels, count)}\n")
 
     def reset(self) -> None:
         with self._mu:
             self._counters.clear()
             self._gauges.clear()
+            self._hists.clear()
+            self._hist_buckets.clear()
 
 
 DEFAULT_REGISTRY = MetricsRegistry()

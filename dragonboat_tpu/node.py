@@ -149,6 +149,11 @@ class Node:
         # raftMu with the leader/term guards intact
         self._off_reads: list = []
         self._off_read_echoes: list = []
+        # device-plane observability (ISSUE 5): set by the coordinator
+        # when obs is enabled; _apply_offload_effects counts delivered
+        # effects under dragonboat_node_offload_applied_total{kind=...}.
+        # None (the default) keeps the apply path untouched.
+        self.obs_registry = None
         self._natsm_attached = False  # native C-ABI SM wired to the lane
         self._next_enroll_try = 0.0
         self._tick_count_pending = 0
@@ -286,6 +291,22 @@ class Node:
             demote, self._off_demote = self._off_demote, False
             reads, self._off_reads = self._off_reads, []
             echoes, self._off_read_echoes = self._off_read_echoes, []
+        m = self.obs_registry
+        if m is not None:
+            # effects DELIVERED to the apply path (the scalar guards
+            # below may still reject stale ones — delivered minus the
+            # engine's egress counters bounds the rejection rate)
+            name = "dragonboat_node_offload_applied_total"
+            if commit_q:
+                m.counter_add(name, labels={"kind": "commit"})
+            if election is not None:
+                m.counter_add(name, labels={"kind": "election"})
+            if reads:
+                m.counter_add(name, len(reads), labels={"kind": "read_confirm"})
+            if echoes:
+                m.counter_add(name, len(echoes), labels={"kind": "read_echo"})
+            if elect or hb or demote:
+                m.counter_add(name, labels={"kind": "tick"})
         if self.fast_lane:
             return  # native core owns the group; flags are stale
         if commit_q and r.is_leader() and r.log.try_commit(commit_q, r.term):

@@ -244,6 +244,15 @@ class NodeHost:
                 or Soft.quorum_engine_block_groups,
                 mesh_devices=expert.engine_mesh_devices,
             )
+            if nhconfig.enable_metrics:
+                # device-plane observability rides the same flag as the
+                # raft event metrics: the flight recorder plus the
+                # engine/coordinator instrument families land in this
+                # host's registry, so write_health_metrics exposes
+                # device-plane health next to the node/transport counters
+                self.quorum_coordinator.enable_obs(
+                    registry=self.raft_events.registry
+                )
         # engine
         workers = expert.step_worker_count or 4
         self.engine = Engine(
@@ -320,6 +329,28 @@ class NodeHost:
 
     def raft_address(self) -> str:
         return self.nhconfig.raft_address
+
+    # ---- health metrics / observability ----
+
+    @property
+    def metrics_registry(self):
+        """The registry this host's metrics publish into (raft events,
+        transport, system events, and — when ``enable_metrics`` wired the
+        device plane — the ``dragonboat_device_*``/``dragonboat_coord_*``
+        families)."""
+        return self.raft_events.registry
+
+    def write_health_metrics(self, out) -> None:
+        """Prometheus text exposition of this host's registry (reference
+        ``WriteHealthMetrics``, ``nodehost.go``)."""
+        self.raft_events.registry.write_health_metrics(out)
+
+    @property
+    def flight_recorder(self):
+        """The device-plane flight recorder (None unless a quorum
+        coordinator is running with observability enabled)."""
+        qc = self.quorum_coordinator
+        return qc.flight_recorder if qc is not None else None
 
     # ---- cluster registry ----
 

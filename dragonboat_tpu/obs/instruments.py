@@ -1,0 +1,257 @@
+"""Engine/coordinator instruments: device-plane metric families.
+
+``EngineObs`` and ``CoordObs`` hold a :class:`FlightRecorder` plus the
+:class:`dragonboat_tpu.events.MetricsRegistry` the metrics publish into
+(default: the process registry ``events.DEFAULT_REGISTRY``, the same one
+``write_health_metrics`` exposes).  Every family is zero-registered at
+construction, so the exposition shows the device plane the moment obs is
+enabled — a scrape distinguishes "obs off" (families absent) from "obs
+on, idle" (families at zero).
+
+Families (device plane, published by ``EngineObs``):
+
+- ``dragonboat_device_dispatch_total`` — device dispatches launched
+- ``dragonboat_device_rounds_total`` — scanned rounds across dispatches
+- ``dragonboat_device_dispatch_latency_ms`` — host stage+launch wall
+  time histogram
+- ``dragonboat_device_egress_latency_ms`` — blocking egress wall time
+  histogram
+- ``dragonboat_device_acks_staged_total`` / ``…votes_staged_total`` —
+  events ingested
+- ``dragonboat_device_recycles_total`` — in-program membership recycles
+- ``dragonboat_device_reads_staged_total`` / ``…read_echoes_total`` /
+  ``…reads_released_total`` — read-plane traffic
+- ``dragonboat_device_upload_bytes_total`` — host→device event tensors
+- ``dragonboat_device_egress_rows_total`` — rows whose commit advanced
+- ``dragonboat_device_multidev_wait_ms_total`` — ``_MULTIDEV_MU`` wait
+- ``dragonboat_device_stalls_total`` — watchdog-flagged spans
+- gauges: ``dragonboat_device_staged_rounds`` (egress/dispatch queue
+  depth), ``dragonboat_device_read_slots_in_use``
+
+Coordinator plane (``CoordObs``): ``dragonboat_coord_rounds_total``,
+``…round_latency_ms`` (histogram), ``…ops_drained_total``,
+``…tick_deficit_total``, ``…commits_offloaded_total``,
+``…reads_confirmed_total``; gauges ``…staged_depth``,
+``…read_fallbacks``.  Node offload application counts under
+``dragonboat_node_offload_applied_total{kind=…}`` (node.py).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..events import DEFAULT_BUCKETS, DEFAULT_REGISTRY, MetricsRegistry
+from .recorder import FlightRecorder
+
+#: log-spaced dispatch/egress/round latency buckets (ms): the live
+#: coordinator's single-round dispatches sit near the bottom decade, a
+#: first-use XLA compile or a wedged tunnel at the top.  ONE geometry,
+#: shared with the registry default — histogram bucket sets are
+#: first-declare-wins, so a second copy that drifted would be silently
+#: ignored for already-declared families.
+LATENCY_BUCKETS_MS = DEFAULT_BUCKETS
+
+_DEV = "dragonboat_device_"
+_COORD = "dragonboat_coord_"
+
+
+class EngineObs:
+    """Device-plane instruments for one ``BatchedQuorumEngine``.
+
+    The engine keeps ``self._obs = None`` until ``enable_obs``; every
+    hot-path call site is gated on that ``is not None`` check, so the
+    obs-off host path stays bit-identical (module docstring contract).
+    """
+
+    __slots__ = ("recorder", "registry")
+
+    _COUNTERS = (
+        _DEV + "dispatch_total",
+        _DEV + "rounds_total",
+        _DEV + "acks_staged_total",
+        _DEV + "votes_staged_total",
+        _DEV + "recycles_total",
+        _DEV + "reads_staged_total",
+        _DEV + "read_echoes_total",
+        _DEV + "reads_released_total",
+        _DEV + "upload_bytes_total",
+        _DEV + "egress_rows_total",
+        _DEV + "multidev_wait_ms_total",
+        _DEV + "stalls_total",
+    )
+
+    def __init__(
+        self, recorder: FlightRecorder, registry: Optional[MetricsRegistry] = None
+    ):
+        self.recorder = recorder
+        self.registry = registry or DEFAULT_REGISTRY
+        r = self.registry
+        for name in self._COUNTERS:
+            r.counter_add(name, 0)
+        r.gauge_set(_DEV + "staged_rounds", 0)
+        r.gauge_set(_DEV + "read_slots_in_use", 0)
+        r.histogram_declare(
+            _DEV + "dispatch_latency_ms", buckets=LATENCY_BUCKETS_MS
+        )
+        r.histogram_declare(
+            _DEV + "egress_latency_ms", buckets=LATENCY_BUCKETS_MS
+        )
+
+    def dispatch(
+        self,
+        kind: str,
+        *,
+        rounds: int,
+        acks: int,
+        votes: int,
+        recycles: int,
+        reads: int,
+        echoes: int,
+        upload_bytes: int,
+        dispatch_ms: float,
+        gate: str,
+        mu_wait_ms: float = 0.0,
+        pending_rounds: int = 0,
+        read_slots_in_use: Optional[int] = None,
+        n_dispatches: int = 1,
+    ) -> dict:
+        """One logical step's device work launched: publish counters +
+        latency, and open its span (egress fields land via
+        :meth:`egress`).  ``n_dispatches`` counts the actual device
+        programs — an oversized sparse backlog chunks into several per
+        step — so ``dispatch_total`` tracks programs, not steps."""
+        r = self.registry
+        r.counter_add(_DEV + "dispatch_total", n_dispatches)
+        r.counter_add(_DEV + "rounds_total", rounds)
+        if acks:
+            r.counter_add(_DEV + "acks_staged_total", acks)
+        if votes:
+            r.counter_add(_DEV + "votes_staged_total", votes)
+        if recycles:
+            r.counter_add(_DEV + "recycles_total", recycles)
+        if reads:
+            r.counter_add(_DEV + "reads_staged_total", reads)
+        if echoes:
+            r.counter_add(_DEV + "read_echoes_total", echoes)
+        if upload_bytes:
+            r.counter_add(_DEV + "upload_bytes_total", upload_bytes)
+        if mu_wait_ms:
+            r.counter_add(_DEV + "multidev_wait_ms_total", mu_wait_ms)
+        r.histogram_observe(
+            _DEV + "dispatch_latency_ms", dispatch_ms,
+            buckets=LATENCY_BUCKETS_MS,
+        )
+        r.gauge_set(_DEV + "staged_rounds", pending_rounds)
+        if read_slots_in_use is not None:
+            r.gauge_set(_DEV + "read_slots_in_use", read_slots_in_use)
+        stalls = self.recorder.stalls
+        extra = {"dispatches": n_dispatches} if n_dispatches > 1 else {}
+        span = self.recorder.record(
+            kind,
+            gate=gate,
+            rounds=rounds,
+            **extra,
+            acks=acks,
+            votes=votes,
+            recycles=recycles,
+            reads=reads,
+            echoes=echoes,
+            upload_bytes=upload_bytes,
+            dispatch_ms=round(dispatch_ms, 4),
+            mu_wait_ms=round(mu_wait_ms, 4),
+        )
+        if self.recorder.stalls != stalls:
+            r.counter_add(_DEV + "stalls_total")
+        return span
+
+    def egress(
+        self, span: dict, *, egress_ms: float, egress_rows: int,
+        reads_released: int,
+    ) -> None:
+        """Close a dispatch span at harvest: blocking egress wall time
+        plus what the block released."""
+        r = self.registry
+        r.histogram_observe(
+            _DEV + "egress_latency_ms", egress_ms, buckets=LATENCY_BUCKETS_MS
+        )
+        if egress_rows:
+            r.counter_add(_DEV + "egress_rows_total", egress_rows)
+        if reads_released:
+            r.counter_add(_DEV + "reads_released_total", reads_released)
+        stalls = self.recorder.stalls
+        self.recorder.update(
+            span,
+            egress_ms=round(egress_ms, 4),
+            egress_rows=egress_rows,
+            reads_released=reads_released,
+        )
+        if self.recorder.stalls != stalls:
+            r.counter_add(_DEV + "stalls_total")
+
+
+class CoordObs:
+    """Round-loop instruments for one ``TpuQuorumCoordinator``."""
+
+    __slots__ = ("recorder", "registry")
+
+    _COUNTERS = (
+        _COORD + "rounds_total",
+        _COORD + "ops_drained_total",
+        _COORD + "tick_deficit_total",
+        _COORD + "commits_offloaded_total",
+        _COORD + "reads_confirmed_total",
+    )
+
+    def __init__(
+        self, recorder: FlightRecorder, registry: Optional[MetricsRegistry] = None
+    ):
+        self.recorder = recorder
+        self.registry = registry or DEFAULT_REGISTRY
+        r = self.registry
+        for name in self._COUNTERS:
+            r.counter_add(name, 0)
+        r.gauge_set(_COORD + "staged_depth", 0)
+        r.gauge_set(_COORD + "read_fallbacks", 0)
+        r.histogram_declare(
+            _COORD + "round_latency_ms", buckets=LATENCY_BUCKETS_MS
+        )
+
+    def round(
+        self,
+        *,
+        wall_ms: float,
+        gate: str,
+        ops: int,
+        deficit: int,
+        commits: int,
+        reads_confirmed: int,
+        read_fallbacks: int,
+        staged_depth: int,
+    ) -> dict:
+        """One dispatched coordinator round (quiet early-return rounds are
+        not recorded).  The recorder's stall check on ``wall_ms`` IS the
+        round-gate watchdog: a round outlasting ``stall_ms`` auto-dumps
+        the ring with this span as the trigger."""
+        r = self.registry
+        r.counter_add(_COORD + "rounds_total")
+        if ops:
+            r.counter_add(_COORD + "ops_drained_total", ops)
+        if deficit:
+            r.counter_add(_COORD + "tick_deficit_total", deficit)
+        if commits:
+            r.counter_add(_COORD + "commits_offloaded_total", commits)
+        if reads_confirmed:
+            r.counter_add(_COORD + "reads_confirmed_total", reads_confirmed)
+        r.gauge_set(_COORD + "staged_depth", staged_depth)
+        r.gauge_set(_COORD + "read_fallbacks", read_fallbacks)
+        r.histogram_observe(
+            _COORD + "round_latency_ms", wall_ms, buckets=LATENCY_BUCKETS_MS
+        )
+        return self.recorder.record(
+            "coord_round",
+            gate=gate,
+            wall_ms=round(wall_ms, 4),
+            ops=ops,
+            deficit=deficit,
+            commits=commits,
+            reads_confirmed=reads_confirmed,
+        )

@@ -1,0 +1,187 @@
+"""The device-plane flight recorder: a fixed-size ring of span records.
+
+One span per device dispatch (and per coordinator round): what was
+staged, how long the host spent launching it, how long the blocking
+egress took, and WHY the dispatch fired (its gate reason).  The ring is
+bounded — memory is O(capacity) no matter the load — and recording is
+lock-light: one micro-lock bump for the ring slot; span dicts are
+mutated in place by their single producing thread afterwards (the
+egress fields land at harvest time), so a dump taken mid-flight shows
+the in-flight dispatch with its egress still pending — exactly the span
+a stall investigation needs.
+
+The stall watchdog rides the same records: any span whose wall fields
+(``wall_ms`` / ``dispatch_ms`` / ``egress_ms`` / ``mu_wait_ms``) reach
+``stall_ms`` is marked ``stalled`` and triggers an automatic dump —
+logged, kept on ``last_dump``, and written to ``dump_path`` when set
+(``DBTPU_OBS_DUMP``).  ``stall_ms <= 0`` disables the watchdog (the
+bench overhead axis measures with it off).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+from ..logger import get_logger
+
+plog = get_logger("obs")
+
+DEFAULT_CAPACITY = 512
+
+#: span fields the stall watchdog inspects, in attribution order
+_STALL_KEYS = ("wall_ms", "dispatch_ms", "egress_ms", "mu_wait_ms")
+
+
+def _default_stall_ms() -> float:
+    try:
+        return float(os.environ.get("DBTPU_OBS_STALL_MS", "1000"))
+    except ValueError:
+        plog.warning("malformed DBTPU_OBS_STALL_MS; using 1000")
+        return 1000.0
+
+
+class FlightRecorder:
+    """Bounded ring of span records with a stall watchdog.
+
+    Span schema (all producers; absent fields simply weren't measured):
+
+    ======================  ==================================================
+    field                   meaning
+    ======================  ==================================================
+    ``seq``                 monotonically increasing record number
+    ``kind``                ``"dispatch"`` (engine, single-round),
+                            ``"fused"`` (engine, K-round block),
+                            ``"coord_round"`` (tpuquorum round loop)
+    ``ts``                  wall-clock time the span was recorded
+    ``gate``                why the dispatch fired: ``+``-joined subset of
+                            ``tick``/``acks``/``reads``/``churn``/``dirty``,
+                            or ``drain``
+    ``rounds``              scanned rounds in the block
+    ``acks`` ``votes``      staged event counts ingested by the dispatch
+    ``recycles``            in-program membership recycles in the block
+    ``reads`` ``echoes``    staged ReadIndex batches / heartbeat echoes
+    ``upload_bytes``        host→device event-tensor bytes
+    ``dispatch_ms``         host wall time staging + launching the program
+    ``egress_ms``           blocking device→host egress wall time (set at
+                            harvest; an in-flight span lacks it)
+    ``egress_rows``         rows whose commit watermark advanced
+    ``reads_released``      client reads released by confirmed slots
+    ``mu_wait_ms``          time spent waiting on ``_MULTIDEV_MU``
+    ``wall_ms``             whole-round wall time (coordinator spans)
+    ``stalled``             set by the watchdog: which field tripped
+    ======================  ==================================================
+    """
+
+    def __init__(
+        self,
+        capacity: int = DEFAULT_CAPACITY,
+        stall_ms: Optional[float] = None,
+        dump_path: Optional[str] = None,
+    ):
+        if capacity < 1:
+            raise ValueError("recorder capacity must be >= 1")
+        self.capacity = capacity
+        self.stall_ms = (
+            _default_stall_ms() if stall_ms is None else float(stall_ms)
+        )
+        self.dump_path = dump_path or os.environ.get("DBTPU_OBS_DUMP")
+        self._buf: List[Optional[dict]] = [None] * capacity
+        self._n = 0
+        self._mu = threading.Lock()
+        self.stalls = 0
+        self.dumps = 0
+        self.last_dump: Optional[dict] = None
+
+    # ------------------------------------------------------------------
+    # recording
+    # ------------------------------------------------------------------
+
+    def record(self, kind: str, **fields) -> dict:
+        """Append a span; returns the (mutable) span dict so the producer
+        can finalize it later (``update``)."""
+        span = {"kind": kind, "ts": time.time()}
+        span.update(fields)
+        with self._mu:
+            span["seq"] = self._n
+            self._buf[self._n % self.capacity] = span
+            self._n += 1
+        self._stall_check(span)
+        return span
+
+    def update(self, span: dict, **fields) -> None:
+        """Finalize a span in place (egress fields land at harvest)."""
+        span.update(fields)
+        self._stall_check(span)
+
+    def _stall_check(self, span: dict) -> None:
+        th = self.stall_ms
+        if th <= 0 or span.get("stalled"):
+            return
+        over = [
+            k for k in _STALL_KEYS if float(span.get(k) or 0.0) >= th
+        ]
+        if over:
+            span["stalled"] = "+".join(over)
+            self.stalls += 1
+            self.dump(
+                reason=f"stall:{span['stalled']} >= {th:g}ms", trigger=span
+            )
+
+    # ------------------------------------------------------------------
+    # introspection / dumping
+    # ------------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return min(self._n, self.capacity)
+
+    def spans(self) -> List[dict]:
+        """Recorded spans, oldest → newest."""
+        with self._mu:
+            n = self._n
+            if n <= self.capacity:
+                return [s for s in self._buf[:n]]
+            return [
+                self._buf[i % self.capacity] for i in range(n - self.capacity, n)
+            ]
+
+    def to_json(self, limit: Optional[int] = None) -> dict:
+        """JSON-serializable snapshot (``limit`` keeps only the newest N
+        spans — artifact writers cap the payload)."""
+        spans = self.spans()
+        if limit is not None and len(spans) > limit:
+            spans = spans[-limit:]
+        return {
+            "capacity": self.capacity,
+            "count": self._n,
+            "stall_ms": self.stall_ms,
+            "stalls": self.stalls,
+            "spans": spans,
+        }
+
+    def dump(self, reason: str = "on-demand", trigger: Optional[dict] = None) -> dict:
+        """Snapshot the ring (plus the triggering span) — kept on
+        ``last_dump``, logged, and written to ``dump_path`` when set.
+        Called automatically by the stall watchdog; callers (bench rung
+        watchdog, operators) may invoke it on demand."""
+        d = {"reason": reason, "time": time.time(), "trigger": trigger}
+        d.update(self.to_json())
+        self.last_dump = d
+        self.dumps += 1
+        path = self.dump_path
+        if path:
+            try:
+                with open(path, "w") as f:
+                    json.dump(d, f, indent=1, default=str)
+            except OSError as e:
+                plog.warning("flight recorder dump to %s failed: %r", path, e)
+        plog.warning(
+            "flight recorder dump (%s): %d spans, trigger=%s%s",
+            reason,
+            len(d["spans"]),
+            (trigger or {}).get("kind"),
+            f" -> {path}" if path else "",
+        )
+        return d

@@ -17,6 +17,7 @@ chips instead of goroutines.
 from __future__ import annotations
 
 import threading
+import time
 from contextlib import nullcontext
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -26,6 +27,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs as _obs
 from .kernels import quorum_step
 from .state import (
     CANDIDATE,
@@ -339,6 +341,61 @@ class BatchedQuorumEngine:
         # _upload_dirty).  A read-free engine keeps the exact eager
         # program set it had before the read plane existed.
         self._read_plane_used = False
+        # --- device-plane observability (ISSUE 5 tentpole) --------------
+        # OFF by default: self._obs stays None and every hot-path site
+        # gates on a plain `is not None` check, so an obs-off engine keeps
+        # a bit-identical host path and eager-op set (the _read_plane_used
+        # precedent; parity asserted by bench._run_obs_axis).  The module
+        # latch (obs.enable) flips newly built engines on; live wiring
+        # goes through NodeHostConfig.enable_metrics -> the coordinator.
+        self._obs = None
+        self._obs_span = None      # span of the in-flight fused dispatch
+        self._obs_mu_wait = 0.0    # _MULTIDEV_MU wait of the next dispatch
+        self._obs_upload = 0       # upload bytes of the current dispatch
+        if _obs.enabled():
+            self.enable_obs()
+
+    def enable_obs(self, recorder=None, registry=None):
+        """Attach device-plane instruments (``obs.instruments.EngineObs``):
+        per-dispatch flight-recorder spans plus the ``dragonboat_device_*``
+        metric families in ``registry`` (default: the process registry
+        ``events.DEFAULT_REGISTRY`` that ``write_health_metrics`` exposes).
+        Returns the attached instruments.  A repeat call with no arguments
+        is a no-op; passing ``recorder``/``registry`` REBINDS the
+        instruments — an engine self-attached by the module latch must not
+        swallow a later explicit wiring (NodeHost routing the families
+        into ITS registry would otherwise silently publish to the default
+        one and expose nothing)."""
+        if self._obs is not None and recorder is None and registry is None:
+            return self._obs
+        from ..obs.instruments import EngineObs
+
+        # `is None`, not truthiness: an EMPTY recorder is falsy
+        # (__len__ == 0) and must still be honored
+        if recorder is None:
+            recorder = (
+                self._obs.recorder if self._obs is not None
+                else _obs.default_recorder()
+            )
+        self._obs = EngineObs(recorder, registry=registry)
+        return self._obs
+
+    def disable_obs(self) -> None:
+        self._obs = None
+
+    @staticmethod
+    def _obs_gate(do_tick, acks, votes, recycles, reads, echoes) -> str:
+        """Why the dispatch fired, for the span record."""
+        parts = []
+        if do_tick:
+            parts.append("tick")
+        if recycles:
+            parts.append("churn")
+        if acks or votes:
+            parts.append("acks")
+        if reads or echoes:
+            parts.append("reads")
+        return "+".join(parts) or "drain"
 
     @property
     def dev(self) -> QuorumState:
@@ -1168,7 +1225,19 @@ class BatchedQuorumEngine:
         XLA compile per distinct K (kernels.quorum_multiround tick_mask
         note).
         """
+        obs = self._obs
+        if obs is None:
+            with self._dispatch_mu:
+                return self._step_rounds_locked(do_tick, pipelined, pad_rounds_to)
+        t0 = time.perf_counter()
         with self._dispatch_mu:
+            # _MULTIDEV_MU wait (zero on single-device engines): attributed
+            # to the NEXT dispatch's span; a wait past the stall threshold
+            # auto-dumps via the span's stall check.  ACCUMULATED, not
+            # assigned — step()'s reroute into step_rounds() re-enters here
+            # with the reentrant lock already held, and its ~0 wait must
+            # not erase the contended outer acquire.
+            self._obs_mu_wait += (time.perf_counter() - t0) * 1e3
             return self._step_rounds_locked(do_tick, pipelined, pad_rounds_to)
 
     def _step_rounds_locked(
@@ -1225,6 +1294,9 @@ class BatchedQuorumEngine:
             return None
         out, prev_committed, row_cid, row_base, n_rounds = self._inflight
         self._inflight = None
+        obs = self._obs
+        span, self._obs_span = self._obs_span, None
+        t_eg = time.perf_counter() if obs is not None else 0.0
         committed, won, lost, elect, hb, demote, rdc, rdi = jax.device_get(
             (
                 out.committed,
@@ -1256,6 +1328,16 @@ class BatchedQuorumEngine:
             (("won", won), ("lost", lost), ("elect", elect),
              ("heartbeat", hb), ("demote", demote)),
         )
+        if obs is not None and span is not None:
+            obs.egress(
+                span,
+                egress_ms=(time.perf_counter() - t_eg) * 1e3,
+                egress_rows=int(res.commit_rows.size),
+                reads_released=(
+                    int(res.read_counts.sum())
+                    if res.read_counts is not None else 0
+                ),
+            )
         return res
 
     @staticmethod
@@ -1308,6 +1390,8 @@ class BatchedQuorumEngine:
         egress for the whole block."""
         from .kernels import quorum_multiround
 
+        obs = self._obs
+        t_disp = time.perf_counter() if obs is not None else 0.0
         k = len(blocks)
         g, p = self.n_groups, self.n_peers
         # -1 = untouched sentinel: one tensor instead of (max, touched) —
@@ -1396,6 +1480,47 @@ class BatchedQuorumEngine:
             purge_reads=self._read_plane_used,
         )
         self._dev = out.state
+        if obs is not None:
+            n_acks = int(sum(b.rows.size for b in blocks))
+            n_votes = sum(len(b.votes) for b in blocks)
+            n_rec = sum(len(b.churn) for b in blocks)
+            n_reads = int(sum(
+                b.reads[0].size for b in blocks if b.reads is not None
+            ))
+            n_echo = int(sum(
+                b.racks[0].size for b in blocks if b.racks is not None
+            ))
+            up = ack_max.nbytes
+            if has_votes:
+                up += vote_new.nbytes
+            if has_churn:
+                up += (
+                    churn_row.nbytes + churn_term.nbytes
+                    + churn_start.nbytes + churn_last.nbytes
+                )
+            if has_reads:
+                up += stage_idx.nbytes + stage_cnt.nbytes + echo.nbytes
+            mu_wait, self._obs_mu_wait = self._obs_mu_wait, 0.0
+            self._obs_span = obs.dispatch(
+                "fused",
+                rounds=k,
+                acks=n_acks,
+                votes=n_votes,
+                recycles=n_rec,
+                reads=n_reads,
+                echoes=n_echo,
+                upload_bytes=int(up),
+                dispatch_ms=(time.perf_counter() - t_disp) * 1e3,
+                gate=self._obs_gate(
+                    do_tick, n_acks, n_votes, n_rec, n_reads, n_echo
+                ),
+                mu_wait_ms=mu_wait,
+                pending_rounds=len(self._round_blocks),
+                read_slots_in_use=(
+                    int(self._read_busy.sum())
+                    if self._read_plane_used else None
+                ),
+            )
         return out
 
     def _refresh_committed_cache(self) -> None:
@@ -1564,7 +1689,13 @@ class BatchedQuorumEngine:
         final round — runs as ONE fused multi-round dispatch instead
         (``step_rounds``; the result satisfies the StepResult interface).
         """
+        obs = self._obs
+        if obs is None:
+            with self._dispatch_mu:
+                return self._step_locked(do_tick)
+        t0 = time.perf_counter()
         with self._dispatch_mu:
+            self._obs_mu_wait += (time.perf_counter() - t0) * 1e3
             return self._step_locked(do_tick)
 
     def _step_locked(self, do_tick: bool) -> StepResult:
@@ -1587,8 +1718,12 @@ class BatchedQuorumEngine:
         self._refresh_committed_cache()
         prev_committed = self._committed_cache
 
+        obs = self._obs
+        t_disp = time.perf_counter() if obs is not None else 0.0
+        n_dispatches = 1
         ack_g, ack_p, ack_v = self._gather_acks()
         reads, racks = self._gather_reads()
+        n_votes = len(self._votes) if obs is not None else 0
         has_reads = reads is not None or racks is not None
         # dense mode collapses ANY number of acks/votes into (G,P)
         # matrices — no cap, no chunk loop (votes are already first-wins
@@ -1608,6 +1743,7 @@ class BatchedQuorumEngine:
             )
         else:
             pos = 0
+            n_chunks = 0
             while (ack_g.size - pos) > self.event_cap or len(self._votes) > self.event_cap:
                 take = min(self.event_cap, ack_g.size - pos)
                 self._dispatch(
@@ -1617,15 +1753,45 @@ class BatchedQuorumEngine:
                     False,
                 )
                 pos += take
+                n_chunks += 1
                 del self._votes[: self.event_cap]
             out = self._dispatch(
                 (ack_g[pos:], ack_p[pos:], ack_v[pos:]), self._votes, do_tick
             )
+            n_dispatches += n_chunks
         self._votes.clear()
         self._voted_cells.clear()
         # the dispatch advanced every row on device; bulk-synced mirror
         # rows are stale now
         self._synced.clear()
+
+        if obs is not None:
+            n_reads = int(reads[0].size) if reads is not None else 0
+            n_echo = int(racks[0].size) if racks is not None else 0
+            mu_wait, self._obs_mu_wait = self._obs_mu_wait, 0.0
+            upload, self._obs_upload = self._obs_upload, 0
+            span = obs.dispatch(
+                "dispatch",
+                rounds=1,
+                acks=int(ack_g.size),
+                votes=n_votes,
+                recycles=0,
+                reads=n_reads,
+                echoes=n_echo,
+                upload_bytes=upload,
+                n_dispatches=n_dispatches,
+                dispatch_ms=(time.perf_counter() - t_disp) * 1e3,
+                gate=self._obs_gate(
+                    do_tick, ack_g.size, n_votes, 0, n_reads, n_echo
+                ),
+                mu_wait_ms=mu_wait,
+                pending_rounds=0,
+                read_slots_in_use=(
+                    int(self._read_busy.sum())
+                    if self._read_plane_used else None
+                ),
+            )
+            t_eg = time.perf_counter()
 
         res = StepResult()
         # one batched device→host transfer for the whole egress set (a
@@ -1647,11 +1813,21 @@ class BatchedQuorumEngine:
         # device_get arrays are read-only; the cache must stay writable
         # for _upload_dirty's row sync
         self._committed_cache = np.array(committed, dtype=np.int32)
-        self._translate_egress(
+        changed = self._translate_egress(
             res, committed, prev_committed, self._row_cid, self._row_base,
             (("won", won), ("lost", lost), ("elect", elect),
              ("heartbeat", hb), ("demote", demote)),
         )
+        if obs is not None:
+            obs.egress(
+                span,
+                egress_ms=(time.perf_counter() - t_eg) * 1e3,
+                egress_rows=int(changed.size),
+                reads_released=(
+                    int(res.read_counts.sum())
+                    if res.read_counts is not None else 0
+                ),
+            )
         return res
 
     def _gather_acks(self):
@@ -1712,6 +1888,13 @@ class BatchedQuorumEngine:
             vg = vp = np.zeros((1,), np.int32)
             vv = np.zeros((1,), np.int8)
             vvalid = np.zeros((1,), bool)
+        if self._obs is not None:
+            # accumulated: an oversized backlog runs several chunked
+            # dispatches per step and the span must account them all
+            self._obs_upload += (
+                ag.nbytes + ap.nbytes + av.nbytes + avalid.nbytes
+                + vg.nbytes + vp.nbytes + vv.nbytes + vvalid.nbytes
+            )
         out = quorum_step(
             self.dev,
             jnp.asarray(ag),
@@ -1778,6 +1961,11 @@ class BatchedQuorumEngine:
             )
         else:
             read_args = (None, None, None)
+        if self._obs is not None:
+            up = ack_max.nbytes + touched.nbytes + vote_new.nbytes
+            if has_reads:
+                up += stage_idx.nbytes + stage_cnt.nbytes + echo.nbytes
+            self._obs_upload += up
         out = quorum_step_dense(
             self.dev,
             jnp.asarray(ack_max),

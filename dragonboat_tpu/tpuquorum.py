@@ -28,8 +28,10 @@ tests in ``tests/test_tpuquorum.py`` + ``tests/test_ops_quorum.py``).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional, TYPE_CHECKING
 
+from . import obs as _obs
 from .logger import get_logger
 
 if TYPE_CHECKING:
@@ -130,10 +132,44 @@ class TpuQuorumCoordinator:
         self._pending = threading.Event()
         self._stopped = threading.Event()
         self._interval = interval_s
+        # device-plane observability (ISSUE 5): OFF by default, gated on
+        # `is not None` everywhere (the engine's overhead contract); the
+        # module latch covers tests/bench, NodeHostConfig.enable_metrics
+        # covers the live stack (nodehost.py wiring)
+        self._obs = None
+        if _obs.enabled():
+            self.enable_obs()
         self._thread = threading.Thread(
             target=self._round_main, name="tpuquorum", daemon=True
         )
         self._thread.start()
+
+    def enable_obs(self, recorder=None, registry=None, stall_ms=None):
+        """Attach round-loop + engine instruments: coordinator spans and
+        ``dragonboat_coord_*`` families here, ``dragonboat_device_*`` on
+        the engine, node offload counters on registered nodes — all into
+        one registry so ``write_health_metrics`` exposes the whole device
+        plane.  ``stall_ms`` overrides the recorder's stall threshold
+        (the round-gate watchdog's trip point).  A repeat call with no
+        recorder/registry is a no-op; explicit arguments REBIND (the
+        engine's ``enable_obs`` note: a latch-attached coordinator must
+        not swallow NodeHost's later registry wiring)."""
+        if self._obs is None or recorder is not None or registry is not None:
+            from .obs.instruments import CoordObs
+
+            eng_obs = self.eng.enable_obs(recorder, registry)
+            self._obs = CoordObs(eng_obs.recorder, registry=registry)
+            with self._mu:
+                for node in self._nodes.values():
+                    node.obs_registry = self._obs.registry
+        if stall_ms is not None:
+            self._obs.recorder.stall_ms = float(stall_ms)
+        return self._obs
+
+    @property
+    def flight_recorder(self):
+        """The attached flight recorder (None while obs is off)."""
+        return self._obs.recorder if self._obs is not None else None
 
     # ------------------------------------------------------------------
     # node lifecycle
@@ -147,6 +183,8 @@ class TpuQuorumCoordinator:
             self._sync_row_locked(node)
             if self.drive_reads:
                 node.peer.raft.device_reads = True
+            if self._obs is not None:
+                node.obs_registry = self._obs.registry
 
     def unregister(self, cluster_id: int) -> None:
         with self._mu:
@@ -454,6 +492,10 @@ class TpuQuorumCoordinator:
                 self._pending.set()
 
     def _round_inner(self, recover: list) -> None:
+        obs = self._obs
+        t0 = time.perf_counter() if obs is not None else 0.0
+        gate = None
+        n_ops = 0
         with self._mu:
             seq = self._tick_seq
             # catch up missed ticks (a slow round — first jit compile,
@@ -463,26 +505,35 @@ class TpuQuorumCoordinator:
             deficit = min(seq - self._tick_seen, 4) if self.drive_ticks else 0
             do_tick = deficit > 0
             self._tick_seen = seq
+            if obs is not None:
+                n_ops = len(self._staged)  # racy read, gauge-grade
             recover.extend(self._drain_locked())
-            if not (
-                do_tick
-                or self.eng._acks
-                or self.eng._ack_blocks
-                or self.eng._votes
-                # staged read ctxs / heartbeat echoes must dispatch even
-                # on an otherwise-quiet round: with drive_ticks off (or
-                # a quiet group) nothing else would ever flush them and
-                # the pending ReadIndex would hang until client timeout
-                or self.eng._reads_pending()
-                # dirty-only rounds (row registrations, transition
-                # replays with no queued events) need no dispatch when
-                # ticks drive regular rounds anyway: the upload
-                # piggybacks on the next event/tick round.  Bulk
-                # registration of thousands of groups otherwise
-                # interleaves a dispatch between every few registers.
-                or (self.eng._dirty and not self.drive_ticks)
-            ):
+            has_acks = bool(
+                self.eng._acks or self.eng._ack_blocks or self.eng._votes
+            )
+            # staged read ctxs / heartbeat echoes must dispatch even
+            # on an otherwise-quiet round: with drive_ticks off (or
+            # a quiet group) nothing else would ever flush them and
+            # the pending ReadIndex would hang until client timeout
+            has_reads = self.eng._reads_pending()
+            # dirty-only rounds (row registrations, transition
+            # replays with no queued events) need no dispatch when
+            # ticks drive regular rounds anyway: the upload
+            # piggybacks on the next event/tick round.  Bulk
+            # registration of thousands of groups otherwise
+            # interleaves a dispatch between every few registers.
+            dirty_gate = bool(self.eng._dirty and not self.drive_ticks)
+            if not (do_tick or has_acks or has_reads or dirty_gate):
                 return
+            if obs is not None:
+                gate = "+".join(
+                    name
+                    for name, hit in (
+                        ("tick", do_tick), ("acks", has_acks),
+                        ("reads", has_reads), ("dirty", dirty_gate),
+                    )
+                    if hit
+                )
             # Tick catch-up stays PER-STEP on the live path, deliberately:
             # the fused K-round program (step_rounds, the ladder's
             # workhorse) was measured here and reverted — on a loaded
@@ -557,6 +608,21 @@ class TpuQuorumCoordinator:
             node = self._nodes.get(cid)
             if node is not None:
                 node.offload_election(False, term)
+        if obs is not None:
+            # the recorder's stall check on wall_ms IS the round-gate
+            # watchdog: a round outlasting stall_ms (wedged dispatch,
+            # first-compile storm, tunnel stall) auto-dumps the ring
+            # with this span as the trigger
+            obs.round(
+                wall_ms=(time.perf_counter() - t0) * 1e3,
+                gate=gate,
+                ops=n_ops,
+                deficit=deficit,
+                commits=len(res.commit),
+                reads_confirmed=len(read_confirms),
+                read_fallbacks=self.read_fallbacks,
+                staged_depth=len(self._staged),
+            )
 
     def _collect_read_confirms(self, res, out: list) -> None:
         """Map confirmed-read egress slots back to their ctxs (under _mu).

@@ -38,6 +38,24 @@ def test_metrics_registry_counter_gauge_and_exposition():
     assert "# TYPE y gauge\ny 7.5" in text
 
 
+def test_exposition_one_type_per_name_and_escaped_labels():
+    """ISSUE 5 satellite audit: the exposition spec allows exactly one
+    ``# TYPE`` per metric name (the old formatter re-emitted it per label
+    set), and label values must escape ``\\``, ``"`` and newlines (an
+    unescaped value corrupted the whole scrape)."""
+    reg = MetricsRegistry()
+    reg.counter_add("m_total", labels={"cluster_id": "1"})
+    reg.counter_add("m_total", labels={"cluster_id": "2"})
+    reg.gauge_set("g", 1, labels={"v": 'a"b\\c\nd'})
+    out = io.StringIO()
+    reg.write_health_metrics(out)
+    text = out.getvalue()
+    assert text.count("# TYPE m_total counter") == 1
+    assert 'm_total{cluster_id="1"} 1' in text
+    assert 'm_total{cluster_id="2"} 1' in text
+    assert 'g{v="a\\"b\\\\c\\nd"} 1' in text  # escaped, single line
+
+
 def test_raft_event_listener_metrics_and_forwarding():
     reg = MetricsRegistry()
     seen = []

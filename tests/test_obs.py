@@ -1,0 +1,402 @@
+"""Device-plane observability tests (ISSUE 5).
+
+Covers the ``dragonboat_tpu.obs`` package itself (a real package — the
+seed shipped only a stale ``__pycache__`` with no sources, so import
+behavior depended on interpreter caching), the flight recorder ring +
+stall-watchdog auto-dump, the Prometheus exposition audit (escaping,
+one ``# TYPE`` per name, round-trip), engine obs-on/obs-off parity, and
+the health-metrics surface end to end through a tpu-engine NodeHost.
+"""
+import importlib
+import io
+import json
+import os
+import pkgutil
+import time
+
+import dragonboat_tpu
+from dragonboat_tpu.events import MetricsRegistry, escape_label_value
+from dragonboat_tpu.obs import FlightRecorder
+from dragonboat_tpu.obs.instruments import CoordObs, EngineObs
+from dragonboat_tpu.ops.engine import BatchedQuorumEngine
+
+RTT_MS = 5
+
+
+# ---------------------------------------------------------------------------
+# packaging (satellite: the stale-__pycache__ bug)
+# ---------------------------------------------------------------------------
+
+
+def test_every_subpackage_imports_as_real_package():
+    """Every ``dragonboat_tpu.*`` subpackage must import from real
+    sources: a directory holding only a ``__pycache__`` imports as an
+    EMPTY namespace package (Python 3 ignores ``__pycache__`` pycs whose
+    sources are gone), so ``import dragonboat_tpu.obs`` silently
+    succeeded while every attribute access failed."""
+    root = os.path.dirname(dragonboat_tpu.__file__)
+    found = []
+    for entry in sorted(os.listdir(root)):
+        d = os.path.join(root, entry)
+        if os.path.isdir(d) and entry != "__pycache__":
+            mod = importlib.import_module(f"dragonboat_tpu.{entry}")
+            # a namespace package has no __file__ — the bug's signature
+            assert getattr(mod, "__file__", None), (
+                f"dragonboat_tpu.{entry} imported as a namespace package "
+                "(missing __init__.py?)"
+            )
+            found.append(entry)
+    assert "obs" in found and "ops" in found
+    # and the walkable module tree stays importable (sources, not pycs)
+    for info in pkgutil.iter_modules(
+        dragonboat_tpu.obs.__path__, "dragonboat_tpu.obs."
+    ):
+        importlib.import_module(info.name)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_ring_wrap_order_and_json():
+    rec = FlightRecorder(capacity=4, stall_ms=0)
+    for i in range(7):
+        rec.record("dispatch", rounds=i)
+    spans = rec.spans()
+    assert len(spans) == 4 == len(rec)
+    assert [s["rounds"] for s in spans] == [3, 4, 5, 6]  # oldest -> newest
+    assert [s["seq"] for s in spans] == [3, 4, 5, 6]
+    d = rec.to_json(limit=2)
+    assert d["count"] == 7 and len(d["spans"]) == 2
+    json.dumps(d)  # must be serializable as-is
+
+
+def test_recorder_stall_watchdog_autodump(tmp_path):
+    path = str(tmp_path / "dump.json")
+    rec = FlightRecorder(capacity=8, stall_ms=10.0, dump_path=path)
+    rec.record("dispatch", gate="acks", dispatch_ms=1.0)  # healthy
+    assert rec.stalls == 0 and rec.last_dump is None
+    span = rec.record("dispatch", gate="tick+acks", dispatch_ms=1.0)
+    rec.update(span, egress_ms=25.0)  # trips at finalize (slow egress)
+    assert rec.stalls == 1
+    assert span["stalled"] == "egress_ms"
+    dump = rec.last_dump
+    assert dump["trigger"] is span and "stall" in dump["reason"]
+    with open(path) as f:
+        on_disk = json.load(f)
+    assert on_disk["trigger"]["gate"] == "tick+acks"
+    # a span stalls (and dumps) at most once
+    rec.update(span, egress_ms=50.0)
+    assert rec.stalls == 1
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition (satellite audit)
+# ---------------------------------------------------------------------------
+
+
+def _parse_exposition(text):
+    """Minimal text-format parser: returns ({name: type}, {(name, labels
+    frozenset): value}) with label values UNescaped."""
+    types, samples = {}, {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ")
+            assert name not in types, f"duplicate # TYPE for {name}"
+            types[name] = kind
+            continue
+        assert not line.startswith("#")
+        metric, value = line.rsplit(" ", 1)
+        if "{" in metric:
+            name, rest = metric.split("{", 1)
+            body = rest.rsplit("}", 1)[0]
+            labels = []
+            # split on '",' boundaries so escaped quotes stay intact
+            for part in body.split('",'):
+                k, v = part.split("=", 1)
+                v = v.strip('"')
+                v = (
+                    v.replace("\\n", "\n")
+                    .replace('\\"', '"')
+                    .replace("\\\\", "\\")
+                )
+                labels.append((k, v))
+            samples[(name, frozenset(labels))] = float(value)
+        else:
+            samples[(metric, frozenset())] = float(value)
+    return types, samples
+
+
+def test_exposition_escaping_and_single_type_roundtrip():
+    reg = MetricsRegistry()
+    nasty = 'quo"te\\slash\nnewline'
+    reg.counter_add("x_total", 3, labels={"a": nasty})
+    reg.counter_add("x_total", 2, labels={"a": "plain"})  # same family
+    reg.gauge_set("depth", 7.5, labels={"q": "r"})
+    reg.histogram_observe("lat_ms", 3.0, buckets=(1.0, 5.0, 10.0))
+    reg.histogram_observe("lat_ms", 100.0, buckets=(1.0, 5.0, 10.0))
+    out = io.StringIO()
+    reg.write_health_metrics(out)
+    text = out.getvalue()
+    # escaping: raw specials never appear inside a label value
+    assert '\\"' in text and "\\\\" in text and "\\n" in text
+    assert "\n" not in text.split('a="')[1].split('"')[0]
+    types, samples = _parse_exposition(text)  # asserts one TYPE per name
+    assert types == {
+        "x_total": "counter", "depth": "gauge", "lat_ms": "histogram",
+    }
+    # round-trip: parsed values match what was registered
+    assert samples[("x_total", frozenset({("a", nasty)}))] == 3
+    assert samples[("x_total", frozenset({("a", "plain")}))] == 2
+    assert samples[("depth", frozenset({("q", "r")}))] == 7.5
+    # histogram: cumulative buckets, +Inf == count, sum preserved
+    assert samples[("lat_ms_bucket", frozenset({("le", "5")}))] == 1
+    assert samples[("lat_ms_bucket", frozenset({("le", "+Inf")}))] == 2
+    assert samples[("lat_ms_sum", frozenset())] == 103.0
+    assert samples[("lat_ms_count", frozenset())] == 2
+    # stable ordering: a second write is byte-identical
+    out2 = io.StringIO()
+    reg.write_health_metrics(out2)
+    assert out2.getvalue() == text
+
+
+def test_escape_label_value_order():
+    # backslash escapes FIRST: escaping a pre-escaped quote must not
+    # double-mangle
+    assert escape_label_value('\\"') == '\\\\\\"'
+    assert escape_label_value("a\nb") == "a\\nb"
+
+
+# ---------------------------------------------------------------------------
+# engine hooks
+# ---------------------------------------------------------------------------
+
+
+def _drive(eng):
+    for cid in (1, 2):
+        eng.add_group(cid, node_ids=[1, 2, 3], self_id=1)
+        eng.set_leader(cid, term=1, term_start=1, last_index=1)
+    outs = []
+    for r in range(3):
+        for cid in (1, 2):
+            eng.ack(cid, 1, 2 + r)
+            eng.ack(cid, 2, 2 + r)
+        eng.begin_round()
+        outs.append(dict(eng.step_rounds(do_tick=False).commit))
+    eng.ack(1, 2, 10)
+    outs.append(dict(eng.step(do_tick=False).commit))  # single-round path
+    return outs
+
+
+def test_engine_obs_off_by_default_and_parity():
+    plain = BatchedQuorumEngine(8, 3, device_ticks=False)
+    assert plain._obs is None  # obs-off: no instruments, no recorder
+    rec = FlightRecorder(capacity=32, stall_ms=0)
+    reg = MetricsRegistry()
+    instrumented = BatchedQuorumEngine(8, 3, device_ticks=False)
+    instrumented.enable_obs(recorder=rec, registry=reg)
+    assert _drive(plain) == _drive(instrumented)  # identical egress
+    spans = rec.spans()
+    assert len(spans) == 4
+    fused = spans[0]
+    assert fused["kind"] == "fused" and fused["gate"] == "acks"
+    assert fused["rounds"] == 1 and fused["acks"] == 4
+    assert fused["upload_bytes"] > 0 and "egress_ms" in fused
+    assert fused["egress_rows"] == 2  # both groups advanced
+    single = spans[-1]
+    assert single["kind"] == "dispatch" and single["acks"] == 1
+    # counters followed the spans
+    assert reg.counter_value("dragonboat_device_dispatch_total") == 4
+    assert reg.counter_value("dragonboat_device_acks_staged_total") == 13
+    assert reg.histogram_value("dragonboat_device_dispatch_latency_ms")[3] == 4
+
+
+def test_enable_obs_rebinds_registry_after_latch():
+    """A latch-attached engine must not swallow a later explicit wiring:
+    NodeHost routes the families into ITS registry after the module latch
+    already self-attached the default one."""
+    import dragonboat_tpu.obs as obs_mod
+
+    obs_mod.enable(stall_ms=0)
+    try:
+        eng = BatchedQuorumEngine(4, 3, device_ticks=False)
+        assert eng._obs is not None  # latch self-attached
+        mine = MetricsRegistry()
+        eng.enable_obs(registry=mine)  # the NodeHost-style rebind
+        assert eng._obs.registry is mine
+        assert "dragonboat_device_dispatch_total" in mine.families()
+        same = eng.enable_obs()  # argument-free repeat: no-op
+        assert same is eng._obs and same.registry is mine
+    finally:
+        obs_mod.disable()
+
+
+def test_engine_obs_recycle_and_gate_reasons():
+    rec = FlightRecorder(capacity=32, stall_ms=0)
+    eng = BatchedQuorumEngine(8, 3, device_ticks=False)
+    eng.enable_obs(recorder=rec, registry=MetricsRegistry())
+    eng.add_group(1, node_ids=[1, 2, 3], self_id=1)
+    eng.set_leader(1, term=1, term_start=1, last_index=1)
+    eng.step(do_tick=False)
+    eng.stage_recycle(1, 2, term=1, term_start=1, last_index=1)
+    eng.ack(2, 2, 2)
+    eng.begin_round()
+    eng.step_rounds(do_tick=False)
+    last = rec.spans()[-1]
+    assert last["recycles"] == 1
+    assert "churn" in last["gate"] and "acks" in last["gate"]
+
+
+def test_engine_stall_autodump_names_blocked_dispatch(monkeypatch, tmp_path):
+    """Acceptance: a forced dispatch stall (slow egress) auto-dumps the
+    recorder with the stalled span — its kind, gate reason, and staged
+    counts name the blocked dispatch."""
+    import jax
+
+    path = str(tmp_path / "stall.json")
+    rec = FlightRecorder(capacity=16, stall_ms=20.0, dump_path=path)
+    reg = MetricsRegistry()
+    eng = BatchedQuorumEngine(8, 3, device_ticks=False)
+    eng.enable_obs(recorder=rec, registry=reg)
+    eng.add_group(1, node_ids=[1, 2, 3], self_id=1)
+    eng.set_leader(1, term=1, term_start=1, last_index=1)
+    # warmup: compile the fused program so the stall below is attributable
+    # to the forced-slow egress, not a first-use jit dispatch (which the
+    # watchdog would legitimately flag as a dispatch_ms stall)
+    eng.ack(1, 2, 2)
+    eng.begin_round()
+    eng.step_rounds(do_tick=False)
+    assert rec.stalls == 0 or rec.last_dump["trigger"]["stalled"] != "egress_ms"
+    rec.stalls = 0
+
+    real_get = jax.device_get
+
+    def slow_get(x):  # a wedged egress (tunnel stall, device hang)
+        time.sleep(0.05)
+        return real_get(x)
+
+    monkeypatch.setattr(jax, "device_get", slow_get)
+    eng.ack(1, 2, 3)
+    eng.begin_round()
+    eng.step_rounds(do_tick=False)
+    assert rec.stalls >= 1
+    assert reg.counter_value("dragonboat_device_stalls_total") >= 1
+    dump = rec.last_dump
+    trigger = dump["trigger"]
+    assert trigger["stalled"] == "egress_ms"
+    assert trigger["kind"] == "fused" and trigger["gate"] == "acks"
+    assert trigger["acks"] == 1 and trigger["egress_ms"] >= 20.0
+    with open(path) as f:  # the on-demand artifact names it too
+        assert json.load(f)["trigger"]["kind"] == "fused"
+
+
+# ---------------------------------------------------------------------------
+# metric families through write_health_metrics
+# ---------------------------------------------------------------------------
+
+
+def test_device_plane_metric_families_exposed():
+    """ISSUE acceptance: with obs enabled, the health exposition carries
+    >= 8 device-plane families (engine + coordinator planes)."""
+    rec = FlightRecorder(capacity=8, stall_ms=0)
+    reg = MetricsRegistry()
+    EngineObs(rec, reg)
+    CoordObs(rec, reg)
+    out = io.StringIO()
+    reg.write_health_metrics(out)
+    types, _ = _parse_exposition(out.getvalue())
+    dev = [n for n in types if n.startswith("dragonboat_device_")]
+    coord = [n for n in types if n.startswith("dragonboat_coord_")]
+    assert len(dev) >= 8, dev
+    assert len(dev) + len(coord) >= 14
+    # the latency families expose as proper histograms
+    assert types["dragonboat_device_dispatch_latency_ms"] == "histogram"
+    assert types["dragonboat_coord_round_latency_ms"] == "histogram"
+
+
+def test_nodehost_health_metrics_device_plane():
+    """Live wiring: NodeHostConfig.enable_metrics + quorum_engine="tpu"
+    puts the device plane into nh.write_health_metrics, the recorder on
+    nh.flight_recorder, and node offload application into the registry."""
+    from dragonboat_tpu import Config, NodeHostConfig, Result
+    from dragonboat_tpu.config import ExpertConfig
+    from dragonboat_tpu.nodehost import NodeHost
+    from dragonboat_tpu.transport import ChanRouter, ChanTransport
+
+    class CountSM:
+        def __init__(self, cluster_id, node_id):
+            self.count = 0
+
+        def update(self, cmd):
+            self.count += 1
+            return Result(value=self.count)
+
+        def lookup(self, query):
+            return self.count
+
+        def save_snapshot(self, w, files, done):
+            w.write(self.count.to_bytes(8, "little"))
+
+        def recover_from_snapshot(self, r, files, done):
+            self.count = int.from_bytes(r.read(8), "little")
+
+        def close(self):
+            pass
+
+    router = ChanRouter()
+    nh = NodeHost(
+        NodeHostConfig(
+            node_host_dir=":memory:",
+            rtt_millisecond=RTT_MS,
+            raft_address="obs:1",
+            raft_rpc_factory=lambda src, rh, ch: ChanTransport(
+                src, rh, ch, router=router
+            ),
+            enable_metrics=True,
+            expert=ExpertConfig(quorum_engine="tpu", engine_block_groups=64),
+        )
+    )
+    try:
+        assert nh.flight_recorder is not None
+        out = io.StringIO()
+        nh.write_health_metrics(out)
+        types, _ = _parse_exposition(out.getvalue())
+        assert len(
+            [n for n in types if n.startswith("dragonboat_device_")]
+        ) >= 8
+        nh.start_cluster(
+            {1: "obs:1"},
+            False,
+            CountSM,
+            Config(cluster_id=5, node_id=1, election_rtt=10, heartbeat_rtt=1),
+        )
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            _, ok = nh.get_leader_id(5)
+            if ok:
+                break
+            time.sleep(0.01)
+        s = nh.get_noop_session(5)
+        for _ in range(5):
+            nh.sync_propose(s, b"x", timeout=5.0)
+        reg = nh.metrics_registry
+        # the device plane actually served the writes: dispatches ran,
+        # commits offloaded back, and the node applied them
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if reg.counter_value(
+                "dragonboat_node_offload_applied_total", {"kind": "commit"}
+            ) > 0:
+                break
+            time.sleep(0.05)
+        assert reg.counter_value("dragonboat_device_dispatch_total") > 0
+        assert reg.counter_value("dragonboat_coord_rounds_total") > 0
+        assert reg.counter_value(
+            "dragonboat_node_offload_applied_total", {"kind": "commit"}
+        ) > 0
+        assert len(nh.flight_recorder.spans()) > 0
+    finally:
+        nh.stop()
