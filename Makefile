@@ -3,8 +3,8 @@
 
 PY ?= python
 
-.PHONY: test test-all test-kernels test-obs native soak soak-smoke bench \
-	dryrun perf-ledger perf-ledger-check
+.PHONY: test test-all test-kernels test-obs test-warmup native soak \
+	soak-smoke bench dryrun perf-ledger perf-ledger-check
 
 test: native
 	$(PY) -m pytest tests/ -x -q -m "not slow"
@@ -23,6 +23,15 @@ test-kernels:
 # sweep whenever obs/, events.py, or the engine/coordinator hooks change
 test-obs:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_obs.py tests/test_events.py -q
+
+# fast cpu gate for the AOT warm-compile + persistent compilation cache
+# (ISSUE 7): warmup against a temp cache dir asserts (a) a second enable
+# is cache-hot (zero recompiles after jax.clear_caches) and (b)
+# proposals issued during warmup never block on compilation — plus the
+# live K-batched ≡ single-round ≡ scalar differential
+test-warmup:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_warmup.py \
+	    tests/test_live_fused.py -q
 
 # parallel run: heavy multi-NodeHost modules carry
 # xdist_group("heavy-multiprocess") and serialize on one worker while

@@ -551,10 +551,16 @@ def _run_cpu_section(fn_name: str, spec: list, timeout: float = 420.0) -> dict:
     # JAX_PLATFORMS=cpu alone is NOT enough: jax still initializes every
     # registered plugin backend, and the tunneled axon client hangs (not
     # fails) when the tunnel is down — force_cpu() drops the factory
+    # os._exit after the JSON lands: the result is already on stdout, and
+    # interpreter teardown with large donated device buffers + a cleared
+    # jit cache (the live-coord axis's restart simulation) can segfault
+    # in the XLA CPU client's destructor order — a teardown-only crash
+    # that must not discard a completed measurement
     code = (
         "from dragonboat_tpu import hostplatform; hostplatform.force_cpu(); "
-        "import json, bench; "
-        f"print(json.dumps(bench.{fn_name}(*{args!r})))"
+        "import json, os, sys, bench; "
+        f"print(json.dumps(bench.{fn_name}(*{args!r}))); "
+        "sys.stdout.flush(); os._exit(0)"
     )
     try:
         r = subprocess.run(
@@ -857,6 +863,222 @@ def _run_obs_axis(active: int = 16_384, rounds: int = 6, k: int = 16,
     }
 
 
+class _LiveNode:
+    """Node shim for the live-coordinator axis: commit effects re-applied
+    under raftMu with the scalar guards intact — the offload path the
+    real NodeHost runs, minus transport."""
+
+    __slots__ = ("cluster_id", "raft_mu", "peer", "commits", "obs_registry")
+
+    def __init__(self, cid, raft):
+        import threading
+
+        self.cluster_id = cid
+        self.raft_mu = threading.RLock()
+
+        class _P:
+            pass
+
+        self.peer = _P()
+        self.peer.raft = raft
+        self.commits = 0
+        self.obs_registry = None
+
+    def offload_commit(self, q):
+        r = self.peer.raft
+        with self.raft_mu:
+            if r.is_leader() and r.log.try_commit(q, r.term):
+                self.commits += 1
+
+    def offload_election(self, won, term):
+        pass
+
+    def offload_tick_elect(self):
+        pass
+
+    def offload_tick_heartbeat(self):
+        pass
+
+    def offload_tick_demote(self):
+        pass
+
+
+def _run_live_coord_axis(groups: int = 512, iters: int = 20) -> dict:
+    """Live-coordinator adaptive-K axis (ISSUE 7 tentpole).
+
+    The SAME live round — one append + two follower acks per group, a
+    K-tick backlog, one coordinator round through the scalar-guarded
+    offload path — driven through (a) a WARMED coordinator, whose round
+    fuses the backlog into one multi-round dispatch, and (b) an UNWARMED
+    one, whose round replays the backlog per-step (the pre-ISSUE-7
+    behavior).  K sweeps the adaptive range; K=1 is the quiet-round
+    case, where both modes run the identical single-round program.
+
+    Also captured, because the perf ledger's live columns are
+    ledger-backed, not prose: warm-enable wall seconds (cold and
+    cache-hot after ``jax.clear_caches()`` — the in-process twin of a
+    restart), persistent-cache hit/miss counts, the fused dispatch
+    count, and the flight-recorder dump proving fused k_rounds>1
+    dispatches on the live path with zero stalled spans."""
+    import tempfile
+
+    from dragonboat_tpu.config import Config
+    from dragonboat_tpu.obs import FlightRecorder
+    from dragonboat_tpu.ops.engine import enable_persistent_compilation_cache
+    from dragonboat_tpu.raft import InMemLogDB, Raft
+    from dragonboat_tpu.raft.remote import Remote
+    from dragonboat_tpu.tpuquorum import TpuQuorumCoordinator
+    from dragonboat_tpu.wire import Entry
+
+    cache_base = tempfile.mkdtemp(prefix="dbtpu-bench-cc-")
+    enable_persistent_compilation_cache(cache_base)
+
+    def mk_coord(warm: bool):
+        coord = TpuQuorumCoordinator(
+            capacity=groups, n_peers=4, drive_ticks=True, interval_s=60.0,
+        )
+        # deterministic drive: rounds run through flush() only (the
+        # round thread would consume the staged tick backlog mid-stage)
+        coord._stopped.set()
+        coord._pending.set()
+        coord._thread.join(timeout=10)
+        if warm:
+            coord.eng.warmup_fused(background=False)
+        nodes = {}
+        for g in range(groups):
+            cid = 1 + g
+            r = Raft(
+                Config(node_id=1, cluster_id=cid, election_rtt=10,
+                       heartbeat_rtt=1),
+                InMemLogDB(), seed=g,
+            )
+            for p in (1, 2, 3):
+                if p not in r.remotes:
+                    r.remotes[p] = Remote(next=1)
+            r.reset_match_value_array()
+            r.has_not_applied_config_change = lambda: False
+            r.become_candidate()
+            r.become_leader()
+            n = _LiveNode(cid, r)
+            r.offload = coord
+            nodes[cid] = n
+            coord._nodes[cid] = n
+            with coord._mu:
+                coord._sync_row_locked(n)
+        coord.flush()
+        return coord, nodes
+
+    t0 = time.perf_counter()
+    warm_coord, warm_nodes = mk_coord(warm=True)
+    warm_enable_s = round(warm_coord.warmup_stats["seconds"], 3)
+    cold_stats = dict(warm_coord.warmup_stats)
+    rec = FlightRecorder(capacity=256, stall_ms=1000.0)
+    warm_coord.enable_obs(recorder=rec)
+    single_coord, single_nodes = mk_coord(warm=False)
+    setup_s = round(time.perf_counter() - t0, 2)
+
+    def window(coord, nodes, k) -> float:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            for cid, n in nodes.items():
+                r = n.peer.raft
+                with n.raft_mu:
+                    r.append_entries([Entry(cmd=b"w")])
+                    idx = r.log.last_index()
+                coord.ack(cid, 2, idx)
+                coord.ack(cid, 3, idx)
+            for _ in range(k):
+                coord.request_tick()
+            coord.flush()
+        return groups * iters / (time.perf_counter() - t0)
+
+    k_axis = {}
+    for k in (1, 4, 8, 16):
+        # first window per mode warms any residual first-use ops, then
+        # interleaved best-of (the obs axis's scheduler-weather rule)
+        window(warm_coord, warm_nodes, k)
+        window(single_coord, single_nodes, k)
+        wps_fused = wps_single = 0.0
+        for _ in range(3):
+            wps_fused = max(wps_fused, window(warm_coord, warm_nodes, k))
+            wps_single = max(
+                wps_single, window(single_coord, single_nodes, k)
+            )
+        k_axis[str(k)] = {
+            "writes_per_sec_fused": round(wps_fused, 1),
+            "writes_per_sec_single": round(wps_single, 1),
+            "speedup": round(wps_fused / wps_single, 3),
+        }
+
+    spans = rec.spans()
+    fused_spans = [s for s in spans if s["kind"] == "fused"]
+    stalled = [
+        s for s in spans
+        if s.get("stalled") and s["kind"] in ("fused", "dispatch")
+    ]
+    warm_coord.stop()
+    single_coord.stop()
+    # cache-hot second enable: a REAL restart — a fresh process pointed
+    # at the same cache directory warms the identical engine shape and
+    # must deserialize every program from disk.  (An in-process
+    # jax.clear_caches() twin segfaults jaxlib at this scale — double
+    # free inside clear_all_caches with live donated executables.)
+    import subprocess
+
+    hot = {"hits": None, "misses": None, "enable_seconds": None}
+    code = (
+        "from dragonboat_tpu import hostplatform; hostplatform.force_cpu()\n"
+        "import json, os, sys, time\n"
+        "from dragonboat_tpu.ops.engine import (\n"
+        "    BatchedQuorumEngine, enable_persistent_compilation_cache)\n"
+        f"enable_persistent_compilation_cache({cache_base!r})\n"
+        f"eng = BatchedQuorumEngine({groups}, 4, "
+        f"event_cap={max(4 * groups, 4096)}, device_ticks=True)\n"
+        "t0 = time.perf_counter()\n"
+        "st = eng.warmup_fused(background=False)\n"
+        "print(json.dumps({'enable_seconds': "
+        "round(time.perf_counter() - t0, 3), 'hits': st['cache_hits'], "
+        "'misses': st['cache_misses']}))\n"
+        "sys.stdout.flush(); os._exit(0)\n"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=300.0, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        if r.returncode == 0 and r.stdout.strip():
+            hot = json.loads(r.stdout.strip().splitlines()[-1])
+        else:
+            hot["error"] = f"rc={r.returncode}"
+    except Exception as e:
+        hot["error"] = repr(e)[:200]
+    return {
+        "groups": groups,
+        "iters": iters,
+        "setup_s": setup_s,
+        "k_axis": k_axis,
+        "live_writes_per_sec": max(
+            v["writes_per_sec_fused"] for v in k_axis.values()
+        ),
+        "live_writes_per_sec_single": max(
+            v["writes_per_sec_single"] for v in k_axis.values()
+        ),
+        "fused_dispatches": warm_coord.fused_dispatches,
+        "warm_enable_seconds": warm_enable_s,
+        "warm_programs": cold_stats["programs"],
+        "cache_cold": {
+            "hits": cold_stats["cache_hits"],
+            "misses": cold_stats["cache_misses"],
+        },
+        "cache_hot": hot,
+        "stalled_spans": len(stalled),
+        "fused_span_k_rounds": sorted(
+            {int(s.get("k_rounds", 0)) for s in fused_spans}
+        ),
+        "recorder": rec.to_json(limit=96),
+    }
+
+
 def main() -> None:
     # ---- e2e NodeHost numbers first (ladder rung 3; VERDICT r2 item 1).
     # The TPU chip is free at this point — the probe subprocess exits and
@@ -1080,6 +1302,19 @@ def main() -> None:
              "BENCH_OBS_K", 16],
         )
 
+    # live-coordinator adaptive-K axis (ISSUE 7): the warmed fused round
+    # vs the single-round replay through the scalar-guarded offload path,
+    # plus warm-enable seconds and compile-cache hit/miss counts — the
+    # perf ledger's live columns derive from this section.  Always on the
+    # local cpu backend (it measures host round cost, and the subprocess
+    # keeps the compile-cache churn off the parent's jax state).
+    if os.environ.get("BENCH_SKIP_LIVE_COORD_AXIS") != "1":
+        detail["live_coord"] = _run_cpu_section(
+            "_run_live_coord_axis",
+            ["BENCH_LIVE_GROUPS", 512, "BENCH_LIVE_ITERS", 20],
+            timeout=900.0,
+        )
+
     # full detail (per-rank stats and all) goes to a FILE; the stdout line
     # stays small enough that the driver's 2000-char tail capture can never
     # truncate the headline (VERDICT r3 missing #1)
@@ -1103,6 +1338,15 @@ def main() -> None:
         # would blow the driver's 2000-char stdout tail capture
         slim["obs_axis"] = {
             k: v for k, v in slim["obs_axis"].items() if k != "recorder"
+        }
+    if isinstance(slim.get("live_coord"), dict):
+        # scalars only on stdout; the k_axis table + recorder dump live
+        # in BENCH_DETAIL.json
+        slim["live_coord"] = {
+            k: v for k, v in slim["live_coord"].items()
+            if k in ("groups", "live_writes_per_sec",
+                     "live_writes_per_sec_single", "warm_enable_seconds",
+                     "fused_dispatches", "stalled_spans", "error", "tail")
         }
     for k in ("e2e_scale_tpu", "e2e_scale_scalar"):
         # ultra-slim: the A/B verdict fields only (full data in

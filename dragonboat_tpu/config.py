@@ -102,6 +102,14 @@ class ExpertConfig:
 
     quorum_engine: str = "scalar"
     engine_block_groups: int = 0  # 0 = use Soft.quorum_engine_block_groups
+    # AOT warm-compile the engine's fused (K,G,P) program set on a
+    # background thread at NodeHost construction (ISSUE 7): until the
+    # readiness latch flips, the coordinator's round thread stays on the
+    # already-compiled single-round programs, so proposals never block
+    # behind a first-use XLA compile; once ready, tick backlogs replay as
+    # ONE adaptive-K fused dispatch.  Off = the live path stays
+    # single-round forever (the pre-warmup behavior).
+    engine_warm_fused: bool = True
     # shard the quorum engine's group axis over a jax.sharding.Mesh of
     # this many devices (ops/sharding.py): state tensors split on the
     # group axis, event batches replicated, zero collectives in steady
@@ -181,6 +189,14 @@ class NodeHostConfig:
     max_snapshot_send_bytes_per_second: int = 0
     max_snapshot_recv_bytes_per_second: int = 0
     notify_commit: bool = False
+    # persistent XLA compilation cache directory for the batched quorum
+    # engine (ISSUE 7): restarts deserialize the warmed device programs
+    # instead of recompiling (the directory is versioned internally by a
+    # kernel-source hash, so kernel changes never mix stale executables;
+    # point several hosts at one shared directory to amortize the first
+    # compile across the fleet).  Empty = env DBTPU_COMPILATION_CACHE,
+    # else no persistent cache.
+    compilation_cache_dir: str = ""
     logdb_config: LogDBConfig = field(default_factory=LogDBConfig.default)
     expert: ExpertConfig = field(default_factory=ExpertConfig)
     # factories (reference config/config.go:298-305)

@@ -243,6 +243,9 @@ class NodeHost:
                 capacity=expert.engine_block_groups
                 or Soft.quorum_engine_block_groups,
                 mesh_devices=expert.engine_mesh_devices,
+                compilation_cache_dir=(
+                    nhconfig.compilation_cache_dir or None
+                ),
             )
             if nhconfig.enable_metrics:
                 # device-plane observability rides the same flag as the
@@ -253,6 +256,14 @@ class NodeHost:
                 self.quorum_coordinator.enable_obs(
                     registry=self.raft_events.registry
                 )
+            if expert.engine_warm_fused:
+                # AOT warm-compile of the fused program set, AFTER the
+                # obs wiring above so the warmup spans/metrics land in
+                # this host's registry.  Background + niced: the round
+                # thread keeps using the already-compiled single-round
+                # programs until the readiness latch flips, so proposals
+                # issued during warmup never block on compilation.
+                self.quorum_coordinator.start_warmup()
         # engine
         workers = expert.step_worker_count or 4
         self.engine = Engine(

@@ -25,15 +25,19 @@ Families (device plane, published by ``EngineObs``):
 - ``dragonboat_device_egress_rows_total`` — rows whose commit advanced
 - ``dragonboat_device_multidev_wait_ms_total`` — ``_MULTIDEV_MU`` wait
 - ``dragonboat_device_stalls_total`` — watchdog-flagged spans
+- ``dragonboat_device_warmup_seconds`` / ``…warmup_programs_total`` —
+  AOT warm-compile wall time and programs warmed (ISSUE 7)
 - gauges: ``dragonboat_device_staged_rounds`` (egress/dispatch queue
   depth), ``dragonboat_device_read_slots_in_use``
 
 Coordinator plane (``CoordObs``): ``dragonboat_coord_rounds_total``,
 ``…round_latency_ms`` (histogram), ``…ops_drained_total``,
 ``…tick_deficit_total``, ``…commits_offloaded_total``,
-``…reads_confirmed_total``; gauges ``…staged_depth``,
-``…read_fallbacks``.  Node offload application counts under
-``dragonboat_node_offload_applied_total{kind=…}`` (node.py).
+``…reads_confirmed_total``, ``…fused_dispatch_total`` /
+``…fused_rounds_total`` (adaptive-K live batching); gauges
+``…staged_depth``, ``…read_fallbacks``.  Node offload application
+counts under ``dragonboat_node_offload_applied_total{kind=…}``
+(node.py).
 """
 from __future__ import annotations
 
@@ -77,6 +81,11 @@ class EngineObs:
         _DEV + "egress_rows_total",
         _DEV + "multidev_wait_ms_total",
         _DEV + "stalls_total",
+        # AOT warm-compile (ISSUE 7): wall seconds spent pre-compiling
+        # device programs and how many were warmed — the "warm-enable
+        # cost" column of the perf ledger reads these
+        _DEV + "warmup_seconds",
+        _DEV + "warmup_programs_total",
     )
 
     def __init__(
@@ -96,6 +105,22 @@ class EngineObs:
             _DEV + "egress_latency_ms", buckets=LATENCY_BUCKETS_MS
         )
 
+    def warmup(self, *, variant: str, seconds: float) -> dict:
+        """One AOT-warmed device program (engine ``_warmup_main``):
+        accumulate ``dragonboat_device_warmup_seconds`` and record a
+        ``warmup`` span.  The compile wall time deliberately lands in a
+        field the stall watchdog does NOT inspect (``compile_ms``) — a
+        multi-second warm compile is the expected out-of-band cost, not
+        a stall, and must not trigger an auto-dump."""
+        r = self.registry
+        r.counter_add(_DEV + "warmup_seconds", seconds)
+        r.counter_add(_DEV + "warmup_programs_total")
+        return self.recorder.record(
+            "warmup",
+            variant=variant,
+            compile_ms=round(seconds * 1e3, 4),
+        )
+
     def dispatch(
         self,
         kind: str,
@@ -109,6 +134,7 @@ class EngineObs:
         upload_bytes: int,
         dispatch_ms: float,
         gate: str,
+        k_rounds: Optional[int] = None,
         mu_wait_ms: float = 0.0,
         pending_rounds: int = 0,
         read_slots_in_use: Optional[int] = None,
@@ -118,7 +144,10 @@ class EngineObs:
         latency, and open its span (egress fields land via
         :meth:`egress`).  ``n_dispatches`` counts the actual device
         programs — an oversized sparse backlog chunks into several per
-        step — so ``dispatch_total`` tracks programs, not steps."""
+        step — so ``dispatch_total`` tracks programs, not steps.
+        ``k_rounds`` is the LIVE round count of the block (real staged
+        rounds, or ticked rounds when a deficit replay ticks into the
+        padding) vs ``rounds``, the padded program K."""
         r = self.registry
         r.counter_add(_DEV + "dispatch_total", n_dispatches)
         r.counter_add(_DEV + "rounds_total", rounds)
@@ -145,6 +174,8 @@ class EngineObs:
             r.gauge_set(_DEV + "read_slots_in_use", read_slots_in_use)
         stalls = self.recorder.stalls
         extra = {"dispatches": n_dispatches} if n_dispatches > 1 else {}
+        if k_rounds is not None:
+            extra["k_rounds"] = k_rounds
         span = self.recorder.record(
             kind,
             gate=gate,
@@ -199,6 +230,11 @@ class CoordObs:
         _COORD + "tick_deficit_total",
         _COORD + "commits_offloaded_total",
         _COORD + "reads_confirmed_total",
+        # adaptive K-round batching (ISSUE 7): rounds served by ONE fused
+        # multi-round dispatch, and the fused rounds they carried — the
+        # ratio to rounds_total is the live fused duty cycle
+        _COORD + "fused_dispatch_total",
+        _COORD + "fused_rounds_total",
     )
 
     def __init__(
@@ -226,11 +262,22 @@ class CoordObs:
         reads_confirmed: int,
         read_fallbacks: int,
         staged_depth: int,
+        k_rounds: int = 1,
+        fused: bool = False,
+        fuse_skip: Optional[str] = None,
     ) -> dict:
         """One dispatched coordinator round (quiet early-return rounds are
         not recorded).  The recorder's stall check on ``wall_ms`` IS the
         round-gate watchdog: a round outlasting ``stall_ms`` auto-dumps
-        the ring with this span as the trigger."""
+        the ring with this span as the trigger.
+
+        ``k_rounds`` is the adaptive K the round chose (1 = the
+        single-round path); ``fused`` marks a fused multi-round dispatch;
+        ``fuse_skip`` names why a K>1 backlog did NOT fuse
+        (``"warmup"`` — programs still compiling, ``"votes"`` — an
+        election rode this round, ``"churn"`` — unwarmed in-program
+        recycles/pre-staged rounds in the backlog) so the warmup gate can
+        assert proposals never blocked on compilation."""
         r = self.registry
         r.counter_add(_COORD + "rounds_total")
         if ops:
@@ -241,17 +288,27 @@ class CoordObs:
             r.counter_add(_COORD + "commits_offloaded_total", commits)
         if reads_confirmed:
             r.counter_add(_COORD + "reads_confirmed_total", reads_confirmed)
+        if fused:
+            r.counter_add(_COORD + "fused_dispatch_total")
+            r.counter_add(_COORD + "fused_rounds_total", k_rounds)
         r.gauge_set(_COORD + "staged_depth", staged_depth)
         r.gauge_set(_COORD + "read_fallbacks", read_fallbacks)
         r.histogram_observe(
             _COORD + "round_latency_ms", wall_ms, buckets=LATENCY_BUCKETS_MS
         )
+        extra = {}
+        if fused:
+            extra["fused"] = True
+        if fuse_skip:
+            extra["fuse_skip"] = fuse_skip
         return self.recorder.record(
             "coord_round",
             gate=gate,
             wall_ms=round(wall_ms, 4),
             ops=ops,
             deficit=deficit,
+            k_rounds=k_rounds,
             commits=commits,
             reads_confirmed=reads_confirmed,
+            **extra,
         )

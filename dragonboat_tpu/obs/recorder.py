@@ -54,12 +54,24 @@ class FlightRecorder:
     ``seq``                 monotonically increasing record number
     ``kind``                ``"dispatch"`` (engine, single-round),
                             ``"fused"`` (engine, K-round block),
-                            ``"coord_round"`` (tpuquorum round loop)
+                            ``"coord_round"`` (tpuquorum round loop),
+                            ``"warmup"`` (one AOT-warmed program)
     ``ts``                  wall-clock time the span was recorded
     ``gate``                why the dispatch fired: ``+``-joined subset of
                             ``tick``/``acks``/``reads``/``churn``/``dirty``,
                             or ``drain``
-    ``rounds``              scanned rounds in the block
+    ``rounds``              scanned rounds in the block (padded program K)
+    ``k_rounds``            LIVE rounds: real staged rounds, or the
+                            ticked count when a deficit replay ticks
+                            into the padding (coord spans: the adaptive
+                            K the round chose; 1 = single-round path)
+    ``fused`` ``fuse_skip`` coord spans: this round used a fused
+                            multi-round dispatch / why a K>1 backlog
+                            did not (``warmup``/``votes``/``churn``)
+    ``variant``             warmup spans: which program was warmed
+    ``compile_ms``          warmup spans: compile wall time (NOT a
+                            stall-watchdog field — warm compiles are
+                            expected to be slow)
     ``acks`` ``votes``      staged event counts ingested by the dispatch
     ``recycles``            in-program membership recycles in the block
     ``reads`` ``echoes``    staged ReadIndex batches / heartbeat echoes

@@ -16,6 +16,7 @@ chips instead of goroutines.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from contextlib import nullcontext
@@ -28,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import obs as _obs
+from ..logger import get_logger
 from .kernels import quorum_step
 from .state import (
     CANDIDATE,
@@ -43,11 +45,142 @@ from .state import (
     QuorumState,
 )
 
+elog = get_logger("ops.engine")
+
 # Event batches are padded to fixed sizes so jit compiles once.
 DEFAULT_EVENT_CAP = 4096
 
 # Rebase a row when relative indexes pass this (well clear of int32 max).
 REBASE_THRESHOLD = 1 << 30
+
+#: padded fused-block sizes the live coordinator dispatches (and the
+#: warmup pass pre-compiles): a K-round backlog pads up to the nearest
+#: bucket, so the whole adaptive range is served by len(buckets) compiled
+#: programs (the per-round tick mask makes padding rounds provable no-ops)
+WARM_K_BUCKETS = (4, 16)
+
+
+def k_bucket(k: int, buckets=WARM_K_BUCKETS) -> int:
+    """Smallest warm bucket holding ``k`` rounds (the largest bucket for
+    anything beyond — callers cap K at ``max(buckets)``)."""
+    for b in buckets:
+        if k <= b:
+            return b
+    return buckets[-1]
+
+
+# ----------------------------------------------------------------------
+# persistent XLA compilation cache (ISSUE 7 tentpole)
+# ----------------------------------------------------------------------
+# jax's persistent compilation cache makes restarts skip XLA compilation
+# entirely: the warmup pass's first run populates it, every later process
+# deserializes the compiled executables in milliseconds.  The directory
+# is VERSIONED by a hash of the kernel sources — a kernel change gets a
+# fresh subdirectory instead of silently mixing stale executables (jax
+# keys on the HLO, which would catch most but not all drift, e.g. a
+# semantics change hidden behind an unchanged trace shape).
+
+_CC_MU = threading.Lock()
+_CC = {"dir": None, "hits": 0, "misses": 0, "listener": False,
+       "read_patched": False}
+#: serializes jax's compile-or-deserialize step process-wide once the
+#: persistent cache is enabled: concurrent cache-hit deserialization on
+#: the shared XLA CPU client corrupts the heap (reproduced 3/3 — three
+#: engines warming from a hot cache in one process segfault in the warm
+#: thread; a read-only lock around get_executable_and_time still wedged
+#: or crashed 2/3, so the unsafe window spans the whole
+#: compile_or_get_cached step).  Held only when a program is NOT in the
+#: in-memory jit cache, so the dispatch hot path pays nothing.  RLock:
+#: a compile may re-enter for subcomputations.
+_CC_COMPILE_MU = threading.RLock()
+
+
+def kernel_source_hash() -> str:
+    """SHA-256 over the kernel-defining sources (kernels.py + state.py):
+    the version key of the persistent compilation cache directory."""
+    import hashlib
+
+    h = hashlib.sha256()
+    base = os.path.dirname(os.path.abspath(__file__))
+    for fname in ("kernels.py", "state.py"):
+        with open(os.path.join(base, fname), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
+
+
+def _cc_listener(event: str, **kwargs) -> None:
+    # jax.monitoring fires for EVERY event; keep this O(1) cheap
+    if event == "/jax/compilation_cache/cache_hits":
+        _CC["hits"] += 1
+    elif event == "/jax/compilation_cache/cache_misses":
+        _CC["misses"] += 1
+
+
+def enable_persistent_compilation_cache(cache_dir: str) -> str:
+    """Point jax's persistent compilation cache at
+    ``cache_dir/xla-<kernel-source-hash>`` and install the hit/miss
+    counter.  Idempotent; returns the versioned directory.  Safe to call
+    before or after backend init (the cache is consulted per compile).
+    The min-compile-time/min-entry-size floors are zeroed so even the
+    fast single-round programs persist — on the 1-2 vCPU boxes this
+    targets, "fast" compiles are still hundreds of ms of stall."""
+    versioned = os.path.join(cache_dir, "xla-" + kernel_source_hash()[:16])
+    with _CC_MU:
+        os.makedirs(versioned, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", versioned)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except AttributeError:  # older jax: flag absent, floor already 0
+            pass
+        # jax latches "cache in use?" at the FIRST compile of the process
+        # (compilation_cache.is_cache_used's _cache_checked flag): enabling
+        # the directory after anything has compiled — a NodeHost that
+        # touched jax before the coordinator, a test suite with earlier
+        # device work — would silently never engage the cache.  reset_cache
+        # drops that latch (not the compiled executables) so the next
+        # compile re-evaluates the config.
+        try:
+            from jax._src import compilation_cache as _jcc
+
+            _jcc.reset_cache()
+            # serialize compile-or-deserialize process-wide (see
+            # _CC_COMPILE_MU): patching the single entry point covers
+            # every engine, warm thread and round thread without
+            # touching the execute fast path (already-jit-cached
+            # programs never reach compiler.compile_or_get_cached)
+            if not _CC["read_patched"]:
+                from jax._src import compiler as _jcompiler
+
+                _orig_cc = _jcompiler.compile_or_get_cached
+
+                def _locked_cc(*a, **k):
+                    with _CC_COMPILE_MU:
+                        return _orig_cc(*a, **k)
+
+                # pxla resolves this through the module attribute at
+                # call time, so rebinding here covers every caller
+                _jcompiler.compile_or_get_cached = _locked_cc
+                _CC["read_patched"] = True
+        except Exception:  # pragma: no cover - jax internals moved
+            elog.warning(
+                "compilation-cache latch reset/read-lock unavailable; a "
+                "process that compiled before enabling the cache may not "
+                "use it, and concurrent cache reads are unserialized"
+            )
+        if not _CC["listener"]:
+            from jax import monitoring as _mon
+
+            _mon.register_event_listener(_cc_listener)
+            _CC["listener"] = True
+        _CC["dir"] = versioned
+    return versioned
+
+
+def compilation_cache_stats() -> dict:
+    """Persistent-cache telemetry: the versioned directory plus process-
+    lifetime hit/miss counts (None dir = cache never enabled here)."""
+    return {"dir": _CC["dir"], "hits": _CC["hits"], "misses": _CC["misses"]}
 
 
 @dataclass
@@ -354,6 +487,22 @@ class BatchedQuorumEngine:
         self._obs_upload = 0       # upload bytes of the current dispatch
         if _obs.enabled():
             self.enable_obs()
+        # --- AOT warm-compile of the fused variants (ISSUE 7 tentpole) --
+        # The latch gates the LIVE coordinator's fused dispatches: until
+        # warmup has compiled the padded (K,G,P) program set, rounds fall
+        # back to the already-compiled single-round path, so a proposal
+        # never blocks behind a first-use XLA compile (0.5-4s measured on
+        # the loaded 2-vCPU box).  Bulk drivers (bench ladder, native
+        # control planes) may keep calling step_rounds without warmup —
+        # they pay first-use compiles by construction and don't care.
+        self._fused_ready = threading.Event()
+        self._warmup_thread: Optional[threading.Thread] = None
+        self._warmup_mu = threading.Lock()
+        self._warmup_cancel = threading.Event()
+        self.warmup_stats = {
+            "seconds": 0.0, "programs": 0,
+            "cache_hits": 0, "cache_misses": 0, "error": None,
+        }
 
     def enable_obs(self, recorder=None, registry=None):
         """Attach device-plane instruments (``obs.instruments.EngineObs``):
@@ -382,6 +531,205 @@ class BatchedQuorumEngine:
 
     def disable_obs(self) -> None:
         self._obs = None
+
+    # ------------------------------------------------------------------
+    # AOT warm-compile (ISSUE 7 tentpole)
+    # ------------------------------------------------------------------
+
+    @property
+    def fused_ready(self) -> bool:
+        """True once the warmup pass has compiled the fused live-path
+        program set (the coordinator's gate for K>1 dispatches)."""
+        return self._fused_ready.is_set()
+
+    def warmup_fused(
+        self,
+        k_buckets=WARM_K_BUCKETS,
+        include_reads: bool = True,
+        include_single: bool = True,
+        background: bool = True,
+    ):
+        """Pre-compile the live path's device programs against a THROWAWAY
+        state of identical shapes/shardings, so first use on the live
+        state hits the jit cache instead of stalling proposals 0.5-4s
+        behind XLA.
+
+        The set is small and closed: the fused ``quorum_multiround``
+        variant per K bucket (reads on/off; votes stay OFF — the live
+        coordinator routes vote-carrying rounds to the single-round path,
+        elections want the fastest round, not a batched one), plus — with
+        ``include_single`` — the sparse tick/no-tick single-round
+        programs and the dense read-carrying ones the per-round fallback
+        uses.  Warm dispatches run real (empty, all-rows-dead) programs,
+        so the jit cache is populated by construction, and with the
+        persistent compilation cache enabled
+        (:func:`enable_persistent_compilation_cache`) a restarted process
+        deserializes instead of compiling.
+
+        ``background=True`` (default) runs on a niced daemon thread and
+        returns it; the readiness latch (:attr:`fused_ready`) flips only
+        after every fused variant compiled.  Repeat calls are no-ops.
+        """
+        args = (tuple(k_buckets), include_reads, include_single)
+        with self._warmup_mu:
+            if self._warmup_thread is not None or self._fused_ready.is_set():
+                return self._warmup_thread
+            if background:
+                t = threading.Thread(
+                    target=self._warmup_main, args=args,
+                    name="engine-warmup", daemon=True,
+                )
+                self._warmup_thread = t
+                t.start()
+                return t
+        self._warmup_main(*args)
+        return self.warmup_stats
+
+    def cancel_warmup(self) -> None:
+        """Stop warming after the current variant (coordinator shutdown);
+        a cancelled warmup leaves the latch unset — the fallback
+        single-round path simply stays in effect."""
+        self._warmup_cancel.set()
+
+    def _warmup_main(self, k_buckets, include_reads, include_single) -> None:
+        t0 = time.perf_counter()
+        try:
+            # same deprioritization as the coordinator round thread: a
+            # multi-second XLA compile must not starve raft/transport
+            # threads on a core-starved box (that contention was the
+            # original reason the live path avoided fused variants).
+            # ONLY on the dedicated warm thread — a foreground
+            # (background=False) caller must not have its thread left
+            # permanently niced.
+            if threading.current_thread() is self._warmup_thread:
+                try:
+                    os.setpriority(
+                        os.PRIO_PROCESS, threading.get_native_id(), 10
+                    )
+                except (OSError, AttributeError):
+                    pass
+            hits0, miss0 = _CC["hits"], _CC["misses"]
+            scratch = HostMirror(
+                self.n_groups, self.n_peers, self.n_read_slots
+            ).to_device(self.sharding)
+            read_set = (False, True) if include_reads else (False,)
+            plan = [
+                ("fused", k, hr)
+                for k in sorted({int(k) for k in k_buckets})
+                for hr in read_set
+            ]
+            if include_single:
+                plan += [("sparse", dt, False) for dt in (True, False)]
+                # elections dispatch the vote-carrying sparse variant;
+                # warm it so the first campaign after enable doesn't
+                # compile either
+                plan += [("sparse_votes", dt, False) for dt in (True, False)]
+                if include_reads:
+                    plan += [("dense", dt, True) for dt in (True, False)]
+            for kind, a, hr in plan:
+                if self._warmup_cancel.is_set():
+                    self.warmup_stats["error"] = "cancelled"
+                    return
+                tv = time.perf_counter()
+                scratch = self._warm_one(scratch, kind, a, hr)
+                dt_s = time.perf_counter() - tv
+                self.warmup_stats["programs"] += 1
+                obs = self._obs  # re-read: may attach mid-warmup
+                if obs is not None:
+                    obs.warmup(
+                        variant=(
+                            f"{kind}:k{a}" if kind == "fused"
+                            else f"{kind}:{'tick' if a else 'notick'}"
+                        ) + (":reads" if hr else ""),
+                        seconds=dt_s,
+                    )
+            self.warmup_stats["seconds"] = time.perf_counter() - t0
+            self.warmup_stats["cache_hits"] = _CC["hits"] - hits0
+            self.warmup_stats["cache_misses"] = _CC["misses"] - miss0
+            self._fused_ready.set()
+            elog.info(
+                "engine warmup: %d programs in %.2fs (cache: %d hits, "
+                "%d misses)",
+                self.warmup_stats["programs"], self.warmup_stats["seconds"],
+                self.warmup_stats["cache_hits"],
+                self.warmup_stats["cache_misses"],
+            )
+        except Exception as e:  # latch stays unset; live path unaffected
+            self.warmup_stats["error"] = repr(e)
+            self.warmup_stats["seconds"] = time.perf_counter() - t0
+            elog.warning("engine warmup failed (fused path stays off): %r", e)
+
+    def _warm_one(self, scratch: QuorumState, kind: str, arg, has_reads: bool):
+        """Compile-and-run one variant against the scratch state (donated;
+        the successor state is returned).  Shapes/statics must mirror the
+        live call sites EXACTLY — a near-miss warms a program the live
+        path never uses."""
+        from .kernels import quorum_multiround, quorum_step_dense
+
+        g, p, s = self.n_groups, self.n_peers, self.n_read_slots
+        if has_reads:
+            read_dims = lambda *lead: (  # noqa: E731
+                jnp.full(lead + (g, s), -1, jnp.int32),
+                jnp.zeros(lead + (g, s), jnp.int32),
+                jnp.zeros(lead + (g, s, p), bool),
+            )
+        with self._dispatch_mu:  # multi-device programs take the lock
+            if kind == "fused":
+                k = arg
+                read_args = read_dims(k) if has_reads else (None, None, None)
+                z11 = jnp.zeros((1, 1), jnp.int32)
+                out = quorum_multiround(
+                    scratch,
+                    jnp.full((k, g, p), -1, jnp.int32),
+                    jnp.zeros((1, 1, 1), jnp.int8),
+                    z11, z11, z11, z11,
+                    jnp.zeros((k,), bool),
+                    *read_args,
+                    do_tick=True,
+                    track_contact=True,
+                    has_votes=False,
+                    has_churn=False,
+                    has_reads=has_reads,
+                    purge_reads=False,
+                )
+            elif kind == "dense":
+                do_tick = arg
+                read_args = read_dims() if has_reads else (None, None, None)
+                out = quorum_step_dense(
+                    scratch,
+                    jnp.zeros((g, p), jnp.int32),
+                    jnp.zeros((g, p), bool),
+                    jnp.zeros((1, 1), jnp.int8),
+                    *read_args,
+                    do_tick=do_tick,
+                    track_contact=self.device_ticks or do_tick,
+                    has_votes=False,
+                    has_reads=has_reads,
+                )
+            else:  # sparse single-round (the quiet-path workhorse)
+                do_tick = arg
+                cap = self.event_cap
+                z32 = jnp.zeros((cap,), jnp.int32)
+                has_votes = kind == "sparse_votes"
+                if has_votes:  # vote events pad to the full event cap
+                    vg = vp = z32
+                    vv = jnp.zeros((cap,), jnp.int8)
+                    vvalid = jnp.zeros((cap,), bool)
+                else:
+                    vg = vp = jnp.zeros((1,), jnp.int32)
+                    vv = jnp.zeros((1,), jnp.int8)
+                    vvalid = jnp.zeros((1,), bool)
+                out = quorum_step(
+                    scratch,
+                    z32, z32, z32,
+                    jnp.zeros((cap,), bool),
+                    vg, vp, vv, vvalid,
+                    do_tick=do_tick,
+                    track_contact=self.device_ticks or do_tick,
+                    has_votes=has_votes,
+                )
+            jax.block_until_ready(out.committed)
+        return out.state
 
     @staticmethod
     def _obs_gate(do_tick, acks, votes, recycles, reads, echoes) -> str:
@@ -1203,6 +1551,7 @@ class BatchedQuorumEngine:
         do_tick: bool = False,
         pipelined: bool = False,
         pad_rounds_to: int = 0,
+        tick_rounds: Optional[int] = None,
     ) -> Optional[MultiRoundResult]:
         """ONE fused dispatch over every staged round (``begin_round``
         boundaries; a non-empty open round is closed implicitly).
@@ -1220,15 +1569,24 @@ class BatchedQuorumEngine:
 
         ``pad_rounds_to`` pads the block with event-free, tick-masked-off
         rounds (provable no-ops) up to a fixed K, so a caller with a
-        VARYING round count — the coordinator's 2..4 missed-tick catch-up
-        — reuses one compiled program instead of paying a multi-second
+        VARYING round count — the coordinator's missed-tick catch-up —
+        reuses one compiled program instead of paying a multi-second
         XLA compile per distinct K (kernels.quorum_multiround tick_mask
         note).
+
+        ``tick_rounds`` (with ``do_tick=True``) sets how many of the
+        block's rounds tick — default: every REAL (unpadded) round, the
+        historical behavior.  It may exceed the real round count up into
+        the padding: the live coordinator replays a tick deficit of N
+        with ONE staged event round plus N-1 event-free ticking padding
+        rounds, fused into a single dispatch (the adaptive-K live path).
         """
         obs = self._obs
         if obs is None:
             with self._dispatch_mu:
-                return self._step_rounds_locked(do_tick, pipelined, pad_rounds_to)
+                return self._step_rounds_locked(
+                    do_tick, pipelined, pad_rounds_to, tick_rounds
+                )
         t0 = time.perf_counter()
         with self._dispatch_mu:
             # _MULTIDEV_MU wait (zero on single-device engines): attributed
@@ -1238,10 +1596,13 @@ class BatchedQuorumEngine:
             # with the reentrant lock already held, and its ~0 wait must
             # not erase the contended outer acquire.
             self._obs_mu_wait += (time.perf_counter() - t0) * 1e3
-            return self._step_rounds_locked(do_tick, pipelined, pad_rounds_to)
+            return self._step_rounds_locked(
+                do_tick, pipelined, pad_rounds_to, tick_rounds
+            )
 
     def _step_rounds_locked(
-        self, do_tick: bool, pipelined: bool, pad_rounds_to: int
+        self, do_tick: bool, pipelined: bool, pad_rounds_to: int,
+        tick_rounds: Optional[int] = None,
     ) -> Optional[MultiRoundResult]:
         if (
             self._acks or self._ack_blocks or self._votes or self._churn
@@ -1256,12 +1617,18 @@ class BatchedQuorumEngine:
         z = np.zeros((0,), np.int32)
         while len(blocks) < pad_rounds_to:
             blocks.append(_RoundBuf(z, z, z, [], []))
+        if tick_rounds is None:
+            tick_rounds = n_real
+        tick_rounds = min(tick_rounds, len(blocks))
         tick_mask = np.zeros((len(blocks),), bool)
-        tick_mask[:n_real] = True
+        tick_mask[:tick_rounds] = True
         prev = self._harvest_inflight()
         self._upload_dirty()
         self._refresh_committed_cache()
-        out = self._dispatch_multiround(blocks, do_tick, tick_mask)
+        out = self._dispatch_multiround(
+            blocks, do_tick, tick_mask,
+            k_rounds=max(n_real, tick_rounds if do_tick else 0),
+        )
         self._synced.clear()
         # every staged recycle is now inside the dispatched program
         self._churn_pending.clear()
@@ -1383,7 +1750,8 @@ class BatchedQuorumEngine:
         res.read_counts = done_cnt[rows, slots].astype(np.int64)
 
     def _dispatch_multiround(
-        self, blocks: List[_RoundBuf], do_tick: bool, tick_mask: np.ndarray
+        self, blocks: List[_RoundBuf], do_tick: bool, tick_mask: np.ndarray,
+        k_rounds: Optional[int] = None,
     ):
         """Stack K closed rounds into (K,G,P) tensors + (K,C) churn blocks
         and run ``kernels.quorum_multiround`` — one scan, one upload, one
@@ -1476,8 +1844,14 @@ class BatchedQuorumEngine:
             has_churn=has_churn,
             has_reads=has_reads,
             # a never-used read plane is all-zero: compile its recycle
-            # purges out (measured ~40% of rung-5 churn throughput)
-            purge_reads=self._read_plane_used,
+            # purges out (measured ~40% of rung-5 churn throughput).
+            # Normalized to False when the block carries no churn — the
+            # flag is only consumed inside _apply_recycle, but as a
+            # static it keys the jit cache, and letting it flip with
+            # _read_plane_used would recompile the live coordinator's
+            # fused program the moment the first read stages (exactly
+            # the first-use stall the warmup pass exists to kill)
+            purge_reads=self._read_plane_used and has_churn,
         )
         self._dev = out.state
         if obs is not None:
@@ -1504,6 +1878,7 @@ class BatchedQuorumEngine:
             self._obs_span = obs.dispatch(
                 "fused",
                 rounds=k,
+                k_rounds=k_rounds if k_rounds is not None else k,
                 acks=n_acks,
                 votes=n_votes,
                 recycles=n_rec,
@@ -1773,6 +2148,7 @@ class BatchedQuorumEngine:
             span = obs.dispatch(
                 "dispatch",
                 rounds=1,
+                k_rounds=1,
                 acks=int(ack_g.size),
                 votes=n_votes,
                 recycles=0,

@@ -27,6 +27,7 @@ tests in ``tests/test_tpuquorum.py`` + ``tests/test_ops_quorum.py``).
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, Optional, TYPE_CHECKING
@@ -57,8 +58,15 @@ class TpuQuorumCoordinator:
         drive_ticks: bool = True,
         mesh_devices: int = 0,
         drive_reads: bool = True,
+        warm_fused: bool = False,
+        compilation_cache_dir: Optional[str] = None,
     ):
-        from .ops.engine import BatchedQuorumEngine
+        from .ops.engine import (
+            WARM_K_BUCKETS,
+            BatchedQuorumEngine,
+            enable_persistent_compilation_cache,
+            k_bucket,
+        )
 
         # group-axis mesh sharding (ExpertConfig.engine_mesh_devices):
         # every kernel op is row-wise over groups, so GSPMD partitions the
@@ -85,11 +93,46 @@ class TpuQuorumCoordinator:
                     n, capacity,
                 )
         self.mesh_devices = mesh_n
+        # persistent XLA compilation cache (ISSUE 7): enabled BEFORE any
+        # program compiles so even the single-round warm misses persist;
+        # the directory is versioned by kernel-source hash inside
+        # enable_persistent_compilation_cache.  Env fallback lets ops
+        # point every process at a shared cache without config plumbing.
+        if compilation_cache_dir is None:
+            compilation_cache_dir = (
+                os.environ.get("DBTPU_COMPILATION_CACHE") or None
+            )
+        self.compilation_cache_dir = None
+        if compilation_cache_dir:
+            try:
+                self.compilation_cache_dir = (
+                    enable_persistent_compilation_cache(compilation_cache_dir)
+                )
+            except OSError as e:
+                plog.warning("compilation cache unavailable: %r", e)
         self.eng = BatchedQuorumEngine(
             capacity, n_peers, event_cap=max(4 * capacity, 4096),
             device_ticks=drive_ticks, sharding=sharding,
         )
         self.capacity = capacity
+        # adaptive K-round batching (ISSUE 7 tentpole): once the warmup
+        # pass has compiled the padded fused program set, the round
+        # thread replays tick backlogs as ONE fused dispatch of up to
+        # fused_k_max rounds; until then (and whenever a round carries
+        # votes) it stays on the single-round path
+        self._k_bucket = k_bucket
+        # the deficit cap IS the largest warmed program: a bigger cap
+        # would silently drop the ticks past the pad clamp
+        self.fused_k_max = max(WARM_K_BUCKETS)
+        self.fused_dispatches = 0
+        # auto-warm only unsharded ticking engines: the fused live path
+        # is tick-deficit replay (meaningless without drive_ticks), and
+        # on a MESH-sharded engine the warm dispatches are multi-device
+        # collectives in exactly the XLA-CPU-client rendezvous zone
+        # _MULTIDEV_MU exists for — multi-chip fused batching is ROADMAP
+        # item 3's work, not a warmup default (a sharded caller can
+        # still warm explicitly via start_warmup()).
+        self._warm_requested = warm_fused and drive_ticks and mesh_n <= 1
         # device-tick mode: the per-tick firing decisions (election due,
         # heartbeat due, check-quorum window) come from the device tick
         # kernel; registered nodes set raft.device_ticks accordingly
@@ -139,10 +182,35 @@ class TpuQuorumCoordinator:
         self._obs = None
         if _obs.enabled():
             self.enable_obs()
+        if self._warm_requested:
+            self.start_warmup()
         self._thread = threading.Thread(
             target=self._round_main, name="tpuquorum", daemon=True
         )
         self._thread.start()
+
+    def start_warmup(self, force: bool = False):
+        """Kick off the engine's background AOT warm-compile (idempotent;
+        see ``BatchedQuorumEngine.warmup_fused``).  NodeHost calls this
+        AFTER wiring observability so the warmup spans/metrics land in
+        the host's registry; until the readiness latch flips, every
+        round uses the already-compiled single-round programs — a
+        proposal never waits on XLA.
+
+        No-op (returns None) on a mesh-sharded or tickless coordinator
+        unless ``force``: the fused live path is tick-deficit replay,
+        and multi-device warm dispatches sit in exactly the XLA-CPU
+        rendezvous zone ``_MULTIDEV_MU`` exists for (multi-chip fused
+        batching is ROADMAP item 3's work)."""
+        if not force and (self.mesh_devices > 1 or not self.drive_ticks):
+            return None
+        return self.eng.warmup_fused()
+
+    @property
+    def warmup_stats(self) -> dict:
+        """The engine's warm-compile record (programs, wall seconds,
+        persistent-cache hits/misses, error)."""
+        return self.eng.warmup_stats
 
     def enable_obs(self, recorder=None, registry=None, stall_ms=None):
         """Attach round-loop + engine instruments: coordinator spans and
@@ -496,13 +564,20 @@ class TpuQuorumCoordinator:
         t0 = time.perf_counter() if obs is not None else 0.0
         gate = None
         n_ops = 0
+        k_rounds = 1
+        fused = False
+        fuse_skip = None
         with self._mu:
             seq = self._tick_seq
-            # catch up missed ticks (a slow round — first jit compile,
-            # tunneled dispatch — can span several host ticks; the scalar
-            # path replays every LOCAL_TICK the same way).  Capped so a
-            # pathological stall can't turn into a dispatch storm.
-            deficit = min(seq - self._tick_seen, 4) if self.drive_ticks else 0
+            # catch up missed ticks (a slow round — tunneled dispatch,
+            # contended host — can span several host ticks; the scalar
+            # path replays every LOCAL_TICK the same way).  Fused-ready
+            # rounds replay up to fused_k_max ticks in ONE dispatch;
+            # before warmup completes the cap stays at 4 so the per-step
+            # fallback can't turn a stall into a dispatch storm.
+            fused_ok = self.drive_ticks and self.eng.fused_ready
+            cap = self.fused_k_max if fused_ok else 4
+            deficit = min(seq - self._tick_seen, cap) if self.drive_ticks else 0
             do_tick = deficit > 0
             self._tick_seen = seq
             if obs is not None:
@@ -534,28 +609,83 @@ class TpuQuorumCoordinator:
                     )
                     if hit
                 )
-            # Tick catch-up stays PER-STEP on the live path, deliberately:
-            # the fused K-round program (step_rounds, the ladder's
-            # workhorse) was measured here and reverted — on a loaded
-            # host the deficit fires constantly (~300×/min at test scale),
-            # each first-use XLA compile of a fused variant costs 0.5-4s
-            # (stalling proposals behind it; pre-warming the cache just
-            # moved the contention to startup), while the per-step replay
-            # reuses the single-round programs every round already
-            # compiled.  Bulk-staged drivers with no latency bound (bench
-            # ladder, native control planes) use begin_round/step_rounds
-            # directly — see docs/overview.md "multi-round coordinator".
-            res = self.eng.step(do_tick=do_tick)
+            # Adaptive K-round batching (ISSUE 7 tentpole).  The fused
+            # K-round program (step_rounds, the ladder's workhorse) was
+            # once measured here and reverted because each first-use XLA
+            # compile of a fused variant cost 0.5-4s and stalled
+            # proposals behind it; the warmup pass killed the stall
+            # instead of the feature — AOT warm-compile of the padded
+            # (K,G,P) program set at enable time, persisted across
+            # restarts by the XLA compilation cache.  Policy:
+            #   - quiet rounds (deficit <= 1) keep the single-round
+            #     program — identical dispatch, identical latency;
+            #   - a tick backlog replays as ONE fused dispatch: the
+            #     staged events ride round 0 and the remaining deficit
+            #     ticks run as event-free padding rounds (tick_rounds),
+            #     padded to the nearest warm K bucket so the whole
+            #     adaptive range reuses len(buckets) compiled programs;
+            #   - rounds carrying VOTES fall back to the single-round
+            #     path (elections want the fastest round, not a batched
+            #     one — and the fused vote variant is deliberately not
+            #     warmed);
+            #   - until warmup completes, the per-step replay below
+            #     keeps using the already-compiled single-round
+            #     programs, so a proposal NEVER waits on XLA
+            #     (fuse_skip span field: "warmup"/"votes").
+            # Semantics are unchanged either way: epoch filters resolve
+            # at dispatch exactly like the single-round path (no round
+            # is sealed mid-drain), and a deficit-K block is precisely
+            # the old step + (K-1) tick replays in one program
+            # (differential: tests/test_live_fused.py).
+            has_votes = bool(self.eng._votes)
+            # the coordinator itself never stages in-program recycles
+            # (membership changes resync through the host rare path),
+            # but a hybrid caller driving stage_recycle/begin_round on
+            # this engine could leave churn in the backlog — and the
+            # warmed program set deliberately excludes the has_churn
+            # variant, so fusing it would reintroduce the first-use
+            # compile stall this PR exists to kill
+            has_churn = bool(self.eng._churn or self.eng._round_blocks)
             read_confirms: list = []
-            self._collect_read_confirms(res, read_confirms)
-            for _ in range(deficit - 1):  # replay remaining missed ticks
-                extra = self.eng.step(do_tick=True)
-                res.commit.update(extra.commit)
-                self._collect_read_confirms(extra, read_confirms)
-                for field in ("won", "lost", "elect", "heartbeat", "demote"):
-                    merged = set(getattr(res, field))
-                    merged.update(getattr(extra, field))
-                    setattr(res, field, list(merged))
+            if deficit > 1:
+                if not fused_ok:
+                    fuse_skip = "warmup"
+                elif has_votes:
+                    fuse_skip = "votes"
+                elif has_churn:
+                    fuse_skip = "churn"
+            if fused_ok and deficit > 1 and not has_votes and not has_churn:
+                fused = True
+                k_rounds = deficit
+                # guarantee >= 1 round even on a pure tick-catch-up
+                # round with nothing staged
+                self.eng.begin_round()
+                res = self.eng.step_rounds(
+                    do_tick=True,
+                    pad_rounds_to=self._k_bucket(deficit),
+                    tick_rounds=deficit,
+                )
+                self.fused_dispatches += 1
+                self._collect_read_confirms(res, read_confirms)
+            else:
+                # per-step replay keeps the historical 4-tick cap even
+                # when the fused gate (votes, warmup) bounced a deeper
+                # backlog here: one skipped fuse must not become a
+                # 16-dispatch storm (excess ticks are swallowed, exactly
+                # as the old cap swallowed them)
+                deficit = min(deficit, 4)
+                res = self.eng.step(do_tick=do_tick)
+                self._collect_read_confirms(res, read_confirms)
+                for _ in range(deficit - 1):  # replay remaining ticks
+                    extra = self.eng.step(do_tick=True)
+                    res.commit.update(extra.commit)
+                    self._collect_read_confirms(extra, read_confirms)
+                    for field in (
+                        "won", "lost", "elect", "heartbeat", "demote"
+                    ):
+                        merged = set(getattr(res, field))
+                        merged.update(getattr(extra, field))
+                        setattr(res, field, list(merged))
         # confirmed-read releases, OUTSIDE _mu like the commit callbacks:
         # the node re-checks leader/term under raftMu and releases through
         # the scalar ReadIndex prefix pop (indices identical to the pure
@@ -622,6 +752,9 @@ class TpuQuorumCoordinator:
                 reads_confirmed=len(read_confirms),
                 read_fallbacks=self.read_fallbacks,
                 staged_depth=len(self._staged),
+                k_rounds=k_rounds,
+                fused=fused,
+                fuse_skip=fuse_skip,
             )
 
     def _collect_read_confirms(self, res, out: list) -> None:
@@ -660,5 +793,6 @@ class TpuQuorumCoordinator:
 
     def stop(self) -> None:
         self._stopped.set()
+        self.eng.cancel_warmup()
         self._pending.set()
         self._thread.join(timeout=5)

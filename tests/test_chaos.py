@@ -154,7 +154,11 @@ def _mk_nh(addr, router):
 
 
 def _wait_leader(nhs, cid, timeout=15.0):
-    deadline = time.time() + timeout
+    # load-scaled deadline (tests/loadwait.py): the r07 contention-flake
+    # class — sound standalone, starved under the full sweep
+    from tests.loadwait import scaled
+
+    deadline = time.time() + scaled(timeout)
     while time.time() < deadline:
         for nh in nhs:
             _, ok = nh.get_leader_id(cid)

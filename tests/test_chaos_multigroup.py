@@ -71,7 +71,12 @@ def _mk(i, addrs, tmp_path):
 
 def _spread_leaders(nhs, timeout=90.0):
     """One leader per group, striped across hosts (the e2e bench's
-    placement); returns when every group has SOME leader."""
+    placement); returns when every group has SOME leader.  The deadline
+    is load-scaled: this module is one of the r07 contention flakes —
+    sound under an idle box, starved under the full tier-1 sweep."""
+    from tests.loadwait import scaled
+
+    timeout = scaled(timeout)
     for g in range(GROUPS):
         target = 1 + (g % 3)
         try:
@@ -94,13 +99,11 @@ def _spread_leaders(nhs, timeout=90.0):
 
 
 def _wait_total(counts, target, timeout=240.0, what="load"):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if sum(counts.values()) >= target:
-            return
-        time.sleep(0.1)
-    raise AssertionError(
-        f"{what}: stalled at {sum(counts.values())}/{target} completed writes"
+    from tests.loadwait import wait_until
+
+    wait_until(
+        lambda: sum(counts.values()) >= target, timeout, interval=0.1,
+        what=f"{what}: {target} completed writes",
     )
 
 
@@ -172,7 +175,9 @@ def test_multigroup_kill_restart_hash_equal(tmp_path):
             assert not t.is_alive(), "load worker failed to stop"
 
         # --- every group: replicas converge to identical state hashes ---
-        deadline = time.time() + 120
+        from tests.loadwait import scaled
+
+        deadline = time.time() + scaled(120)
         lagging = dict.fromkeys(range(GROUPS))
         while lagging and time.time() < deadline:
             for g in list(lagging):
@@ -198,7 +203,7 @@ def test_multigroup_kill_restart_hash_equal(tmp_path):
             if counts[g]:
                 continue
             cid = 100 + g
-            deadline = time.time() + 60
+            deadline = time.time() + scaled(60)
             ok = False
             while time.time() < deadline and not ok:
                 for nh in list(nhs.values()):

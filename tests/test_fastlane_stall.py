@@ -181,6 +181,11 @@ class _Host:
     def expect(self, tag, timeout=60.0):
         import queue as _q
 
+        from tests.loadwait import scaled
+
+        # load-scaled: the subprocess replies ride three Python processes
+        # sharing the sweep's starved cores (r07 contention-flake class)
+        timeout = scaled(timeout)
         deadline = time.time() + timeout
         while True:
             left = deadline - time.time()

@@ -188,12 +188,15 @@ def test_token_bucket_limits_rate():
 
 def test_token_bucket_unlimited_is_noop():
     from dragonboat_tpu.transport.bandwidth import TokenBucket
+    from tests.loadwait import scaled
 
     tb = TokenBucket(0)
     t0 = time.monotonic()
     for _ in range(1000):
         tb.take(1 << 20)
-    assert time.monotonic() - t0 < 0.1
+    # load-aware margin: 1000 no-op takes cost microseconds; anything
+    # near the bound is scheduler preemption, not the bucket sleeping
+    assert time.monotonic() - t0 < scaled(0.5)
 
 
 def test_snapshot_send_respects_bandwidth_cap(tmp_path):

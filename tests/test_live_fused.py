@@ -1,0 +1,235 @@
+"""Differential: the live coordinator's adaptive K-batched path (ISSUE 7).
+
+The same deterministic schedule — writes, follower acks, tick bursts,
+ReadIndex ctxs with heartbeat echoes, a mid-schedule membership recycle
+and a leader change landing inside a fused block — is driven through
+
+  (1) a WARMED coordinator (tick backlogs replay as one fused
+      multi-round dispatch; ``fused_dispatches`` asserts they did), and
+  (2) an UNWARMED coordinator (the single-round per-step replay path),
+
+and both must produce identical commitIndex sequences and read-release
+outputs, which must equal the scalar oracle (kth-largest of the match
+vector under the term guard — computed independently in numpy).
+"""
+import threading
+
+import pytest
+
+pytest.importorskip("jax")
+
+
+class FakeNode:
+    """Node shim: commit/read effects re-applied under raftMu with the
+    scalar guards intact (the test_device_ticks pattern)."""
+
+    def __init__(self, cid, raft):
+        self.cluster_id = cid
+        self.raft_mu = threading.RLock()
+
+        class _P:
+            pass
+
+        self.peer = _P()
+        self.peer.raft = raft
+        self.commits = []
+        self.read_releases = []
+
+    def offload_commit(self, q):
+        r = self.peer.raft
+        with self.raft_mu:
+            if r.is_leader() and r.log.try_commit(q, r.term):
+                self.commits.append(int(q))
+
+    def offload_read_confirm(self, low, high, term):
+        r = self.peer.raft
+        with self.raft_mu:
+            if r.is_leader() and r.term == term:
+                self.read_releases.append((int(low), int(high)))
+
+    def offload_read_echo(self, from_, low, high):
+        pass
+
+    def offload_election(self, won, term):
+        pass
+
+    def offload_tick_elect(self):
+        pass
+
+    def offload_tick_heartbeat(self):
+        pass
+
+    def offload_tick_demote(self):
+        pass
+
+
+def _new_leader_raft(cid):
+    from dragonboat_tpu.raft import InMemLogDB
+    from tests.raft_harness import new_test_raft
+
+    r = new_test_raft(1, [1, 2, 3], 10, 1, InMemLogDB())
+    r.cluster_id = cid
+    r.become_candidate()
+    r.become_leader()
+    return r
+
+
+def _register(coord, cid):
+    n = FakeNode(cid, _new_leader_raft(cid))
+    n.peer.raft.offload = coord
+    coord._nodes[cid] = n
+    with coord._mu:
+        coord._sync_row_locked(n)
+    return n
+
+
+def _run_schedule(warm: bool) -> dict:
+    """Drive the full scenario through one coordinator; returns the
+    observable outcome (commit sequences, read releases, final
+    committed/last per group, fused dispatch count)."""
+    from dragonboat_tpu.tpuquorum import TpuQuorumCoordinator
+    from dragonboat_tpu.wire import Entry
+
+    G = 6
+    coord = TpuQuorumCoordinator(
+        capacity=32, n_peers=4, drive_ticks=True, interval_s=60.0,
+    )
+    if warm:
+        coord.eng.warmup_fused(background=False)
+        assert coord.eng.fused_ready
+    nodes = {}
+    try:
+        for g in range(G):
+            nodes[1 + g] = _register(coord, 1 + g)
+        coord.flush()
+
+        def append(pairs):
+            for cid, k in pairs:
+                n = nodes[cid]
+                with n.raft_mu:
+                    n.peer.raft.append_entries(
+                        [Entry(cmd=b"w") for _ in range(k)]
+                    )
+
+        def burst(acks=(), reads=(), echoes=(), ticks=3):
+            """One live round's ingest: staged acks/reads/echoes, then a
+            tick backlog and one flush."""
+            for cid, nid, idx in acks:
+                coord.ack(cid, nid, idx)
+            for cid, low, high in reads:
+                r = nodes[cid].peer.raft
+                coord.read_stage(
+                    cid, r.log.committed, low, high, r.term
+                )
+            for cid, nid, low, high in echoes:
+                coord.read_ack_hint(cid, nid, low, high)
+            for _ in range(ticks):
+                coord.request_tick()
+            coord.flush()
+
+        def last(cid):
+            return nodes[cid].peer.raft.log.last_index()
+
+        # burst 1: every group appends 2, follower 2 acks all, follower 3
+        # lags by 1 — quorum (self + f2) commits to last
+        append([(c, 2) for c in nodes])
+        burst(
+            acks=[(c, 2, last(c)) for c in nodes]
+            + [(c, 3, last(c) - 1) for c in nodes],
+        )
+        # burst 2: reads staged at the committed watermark; follower 2's
+        # echo completes the quorum in the same fused block
+        burst(
+            reads=[(c, 100 + c, c) for c in nodes],
+            echoes=[(c, 2, 100 + c, c) for c in nodes],
+        )
+        # burst 3: a leader change lands INSIDE the block for group 2 —
+        # acks staged before the transition must die with it (epoch
+        # purge; identical on both paths), and the demoted group must
+        # not commit past its pre-transition watermark
+        victim = nodes[2]
+        append([(2, 1)])
+        burst(acks=[(2, 2, last(2))])
+        with victim.raft_mu:
+            victim.peer.raft.become_follower(
+                victim.peer.raft.term + 1, 3
+            )
+        coord.set_follower(2, victim.peer.raft.term)
+        # stale acks for the now-follower row, staged same-drain as the
+        # transition: purged on both paths
+        coord.ack(2, 3, last(2))
+        append([(c, 1) for c in nodes if c != 2])
+        burst(acks=[(c, 2, last(c)) for c in nodes if c != 2])
+        # burst 4: mid-schedule membership recycle — group 3 retires and
+        # a fresh group 103 takes its row; acks staged for the dead
+        # tenant in the same drain must not leak to the new one
+        coord.ack(3, 3, last(3))
+        coord.unregister(3)
+        dead = nodes.pop(3)
+        nodes[103] = _register(coord, 103)
+        append([(103, 2)])
+        burst(acks=[(103, 2, last(103))])
+        # burst 5: the demoted group re-elects and resyncs (the rare
+        # path), then commits fresh entries
+        with victim.raft_mu:
+            victim.peer.raft.become_candidate()
+            victim.peer.raft.become_leader()
+        nodes[2] = victim
+        coord.membership_changed(2)
+        append([(2, 2)])
+        burst(acks=[(2, 2, last(2)), (2, 3, last(2))])
+        # drain any trailing flags
+        coord.flush()
+
+        return {
+            "commits": {c: tuple(n.commits) for c, n in nodes.items()},
+            "reads": {
+                c: tuple(n.read_releases) for c, n in nodes.items()
+            },
+            "dead_commits": tuple(dead.commits),
+            "committed": {
+                c: n.peer.raft.log.committed for c, n in nodes.items()
+            },
+            "last": {
+                c: n.peer.raft.log.last_index() for c, n in nodes.items()
+            },
+            "fused": coord.fused_dispatches,
+        }
+    finally:
+        coord.stop()
+
+
+def test_live_fused_matches_single_round_and_oracle():
+    single = _run_schedule(warm=False)
+    fused = _run_schedule(warm=True)
+
+    # the warmed run actually exercised the fused path; the unwarmed one
+    # never did
+    assert single["fused"] == 0
+    assert fused["fused"] >= 4, fused["fused"]
+
+    # identical observable outputs, round for round
+    for key in ("commits", "reads", "dead_commits", "committed", "last"):
+        assert single[key] == fused[key], (key, single[key], fused[key])
+
+    # scalar oracle: every surviving leader group fully committed (self +
+    # follower-2 acks reach quorum at every burst) ...
+    for cid, committed in fused["committed"].items():
+        assert committed == fused["last"][cid], (
+            cid, committed, fused["last"][cid],
+        )
+    # ... the leader-changed group released no reads after its demotion
+    # and the recycled tenant saw none of the dead tenant's acks
+    assert fused["reads"][2] == ((102, 2),)
+    # one commit advance for the fresh tenant: its promotion noop + the 2
+    # appended entries land together at the first quorum ack (q=3); the
+    # dead tenant's same-drain ack never reached it
+    assert fused["commits"][103] == (3,)
+    # every read staged on a stable leader was released exactly once, at
+    # its staging identity
+    for cid in fused["reads"]:
+        if cid in (2, 103):
+            continue
+        assert fused["reads"][cid] == ((100 + cid, cid),), (
+            cid, fused["reads"][cid],
+        )

@@ -85,7 +85,11 @@ def gcfg(cid, nid, **kw):
 
 
 def wait_leader(nhs, cid, timeout=15.0):
-    deadline = time.time() + timeout
+    # load-scaled deadline (tests/loadwait.py): the r07 contention-flake
+    # class — sound standalone, starved under the full sweep
+    from tests.loadwait import scaled
+
+    deadline = time.time() + scaled(timeout)
     while time.time() < deadline:
         for nh in nhs:
             lid, ok = nh.get_leader_id(cid)

@@ -1,0 +1,257 @@
+"""AOT warm-compile + persistent XLA compilation cache gate (ISSUE 7).
+
+The fast cpu gate behind ``make test-warmup``: the warmup pass runs
+against a TEMP compilation-cache directory and the suite asserts the two
+contracts the tentpole rests on:
+
+(a) a second enable is CACHE-HOT — after ``jax.clear_caches()`` (the
+    in-process twin of a restart) re-warming a fresh engine deserializes
+    every program from the persistent cache (hits > 0, misses == 0)
+    instead of recompiling;
+(b) proposals issued DURING warmup never block on compilation — the
+    round thread stays on the already-compiled single-round path until
+    the readiness latch flips (``fused_dispatches == 0`` while warming,
+    ``fuse_skip="warmup"`` on the round spans), and commits keep landing
+    the whole time.
+"""
+import threading
+import time
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from dragonboat_tpu.ops.engine import (  # noqa: E402
+    WARM_K_BUCKETS,
+    BatchedQuorumEngine,
+    compilation_cache_stats,
+    enable_persistent_compilation_cache,
+    k_bucket,
+    kernel_source_hash,
+)
+
+
+def test_k_bucket_covers_the_adaptive_range():
+    assert WARM_K_BUCKETS == tuple(sorted(WARM_K_BUCKETS))
+    assert k_bucket(1) == WARM_K_BUCKETS[0]
+    for k in range(1, max(WARM_K_BUCKETS) + 1):
+        b = k_bucket(k)
+        assert b >= k and b in WARM_K_BUCKETS
+    # beyond the largest bucket clamps (callers cap K there)
+    assert k_bucket(10 * max(WARM_K_BUCKETS)) == max(WARM_K_BUCKETS)
+
+
+def test_kernel_source_hash_is_stable():
+    assert kernel_source_hash() == kernel_source_hash()
+    assert len(kernel_source_hash()) == 64
+
+
+def test_second_enable_is_cache_hot(tmp_path):
+    """(a): cold warmup populates the persistent cache; after clearing
+    the in-memory jit caches, a fresh engine's warmup is served entirely
+    from disk."""
+    versioned = enable_persistent_compilation_cache(str(tmp_path / "cc"))
+    assert kernel_source_hash()[:16] in versioned
+
+    # earlier tests in the same process may already hold these programs
+    # in the in-memory jit cache (no compile → no cache-miss events);
+    # drop them so the cold warmup genuinely compiles into the temp dir
+    jax.clear_caches()
+    eng = BatchedQuorumEngine(16, 4, event_cap=64)
+    s0 = compilation_cache_stats()
+    stats = eng.warmup_fused(k_buckets=(4,), background=False)
+    assert stats["error"] is None
+    assert eng.fused_ready
+    # 2 fused (reads on/off) + 2 sparse + 2 sparse-votes (tick on/off)
+    # + 2 dense read
+    assert stats["programs"] == 8
+    s1 = compilation_cache_stats()
+    assert s1["misses"] > s0["misses"], "cold warmup must populate the cache"
+
+    # the in-process twin of a restart: drop every in-memory executable
+    jax.clear_caches()
+    eng2 = BatchedQuorumEngine(16, 4, event_cap=64)
+    st2 = eng2.warmup_fused(k_buckets=(4,), background=False)
+    assert st2["error"] is None
+    assert eng2.fused_ready
+    assert st2["cache_hits"] > 0, "second enable must hit the persistent cache"
+    assert st2["cache_misses"] == 0, (
+        f"second enable recompiled {st2['cache_misses']} programs"
+    )
+
+
+def test_warmup_failure_leaves_single_round_path(monkeypatch):
+    """A warmup that dies must leave the latch unset (the coordinator
+    simply stays on the single-round path) — never a crashed engine."""
+    eng = BatchedQuorumEngine(8, 3, event_cap=32)
+    monkeypatch.setattr(
+        eng, "_warm_one",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")),
+    )
+    stats = eng.warmup_fused(k_buckets=(4,), background=False)
+    assert stats["error"] is not None
+    assert not eng.fused_ready
+
+
+class FakeNode:
+    """Minimal node shim (the test_device_ticks pattern): commit effects
+    re-checked under raftMu with the scalar guards intact."""
+
+    def __init__(self, cid, raft):
+        self.cluster_id = cid
+        self.raft_mu = threading.RLock()
+
+        class _P:
+            pass
+
+        self.peer = _P()
+        self.peer.raft = raft
+        self.commits = []
+
+    def offload_commit(self, q):
+        r = self.peer.raft
+        with self.raft_mu:
+            if r.is_leader() and r.log.try_commit(q, r.term):
+                self.commits.append(q)
+
+    def offload_election(self, won, term):
+        pass
+
+    def offload_tick_elect(self):
+        pass
+
+    def offload_tick_heartbeat(self):
+        pass
+
+    def offload_tick_demote(self):
+        pass
+
+
+def _mk_coord_cluster(n_groups=4, warm=False):
+    from dragonboat_tpu.raft import InMemLogDB
+    from dragonboat_tpu.tpuquorum import TpuQuorumCoordinator
+    from tests.raft_harness import new_test_raft
+
+    coord = TpuQuorumCoordinator(
+        capacity=64, n_peers=4, drive_ticks=True, interval_s=60.0,
+        warm_fused=warm,
+    )
+    nodes = {}
+    for g in range(n_groups):
+        cid = 1 + g
+        r = new_test_raft(1, [1, 2, 3], 10, 1, InMemLogDB())
+        r.cluster_id = cid
+        r.become_candidate()
+        r.become_leader()
+        n = FakeNode(cid, r)
+        r.offload = coord
+        nodes[cid] = n
+        coord._nodes[cid] = n
+        with coord._mu:
+            coord._sync_row_locked(n)
+    coord.flush()
+    return coord, nodes
+
+
+def _drive_round(coord, nodes, ticks=4):
+    """One write per group + a tick burst, flushed synchronously."""
+    from dragonboat_tpu.wire import Entry
+
+    for cid, n in nodes.items():
+        r = n.peer.raft
+        with n.raft_mu:
+            r.append_entries([Entry(cmd=b"w")])
+            idx = r.log.last_index()
+        coord.ack(cid, 2, idx)
+        coord.ack(cid, 3, idx)
+    for _ in range(ticks):
+        coord.request_tick()
+    coord.flush()
+
+
+def test_proposals_never_block_on_warmup():
+    """(b): while the warmup thread compiles, rounds keep completing on
+    the single-round path — zero fused dispatches before the latch, the
+    skip reason on record, commits landing throughout; after the latch, a
+    tick backlog fuses and no dispatch span ever stalls."""
+    coord, nodes = _mk_coord_cluster(warm=False)
+    try:
+        obs = coord.enable_obs()
+        obs.recorder.stall_ms = 1000.0
+        t = coord.start_warmup()
+        assert t is not None
+        rounds_during_warm = 0
+        while not coord.eng.fused_ready and rounds_during_warm < 2000:
+            _drive_round(coord, nodes, ticks=4)
+            rounds_during_warm += 1
+            if not coord.eng.fused_ready:
+                # every round that ran before the latch stayed on the
+                # already-compiled single-round programs
+                assert coord.fused_dispatches == 0
+        t.join(timeout=300)
+        assert coord.eng.fused_ready, coord.warmup_stats
+        assert coord.warmup_stats["error"] is None
+        # commits landed the whole time (proposals were never stalled
+        # behind the compile thread)
+        for cid, n in nodes.items():
+            r = n.peer.raft
+            assert r.log.committed == r.log.last_index(), (
+                cid, r.log.committed, r.log.last_index(),
+            )
+        spans = obs.recorder.spans()
+        if rounds_during_warm:
+            assert any(
+                s.get("fuse_skip") == "warmup" for s in spans
+                if s["kind"] == "coord_round"
+            ), "deficit rounds during warmup must record the skip reason"
+        assert any(s["kind"] == "warmup" for s in spans)
+
+        # after the latch: a tick backlog replays as ONE fused dispatch
+        before = coord.fused_dispatches
+        _drive_round(coord, nodes, ticks=6)
+        assert coord.fused_dispatches == before + 1
+        fused_spans = [
+            s for s in obs.recorder.spans() if s["kind"] == "fused"
+        ]
+        assert any(s.get("k_rounds", 0) > 1 for s in fused_spans)
+        # the tentpole's headline contract: nothing on the dispatch path
+        # ever hit the stall watchdog (a first-use compile would)
+        assert not any(
+            s.get("stalled") for s in obs.recorder.spans()
+            if s["kind"] in ("fused", "dispatch")
+        )
+        for cid, n in nodes.items():
+            r = n.peer.raft
+            assert r.log.committed == r.log.last_index()
+    finally:
+        coord.stop()
+
+
+def test_warmup_metrics_published():
+    """The ``dragonboat_device_warmup_seconds`` family lands in the
+    registry the moment obs is enabled, and accumulates once warmup
+    runs."""
+    from dragonboat_tpu.events import MetricsRegistry
+    from dragonboat_tpu.obs import FlightRecorder
+
+    reg = MetricsRegistry()
+    eng = BatchedQuorumEngine(8, 3, event_cap=32)
+    eng.enable_obs(recorder=FlightRecorder(), registry=reg)
+    import io
+
+    buf = io.StringIO()
+    reg.write_health_metrics(buf)
+    assert "dragonboat_device_warmup_seconds" in buf.getvalue()
+    stats = eng.warmup_fused(
+        k_buckets=(4,), include_single=False, background=False
+    )
+    assert stats["error"] is None
+    buf = io.StringIO()
+    reg.write_health_metrics(buf)
+    text = buf.getvalue()
+    assert "dragonboat_device_warmup_programs_total 2" in text
+    # warmup spans carry the variant + compile wall, and never trip the
+    # stall watchdog (compile_ms is not a watchdog field)
+    spans = [s for s in eng._obs.recorder.spans() if s["kind"] == "warmup"]
+    assert len(spans) == 2
+    assert all("compile_ms" in s and not s.get("stalled") for s in spans)
