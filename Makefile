@@ -3,8 +3,8 @@
 
 PY ?= python
 
-.PHONY: test test-all test-kernels test-obs test-warmup native soak \
-	soak-smoke bench dryrun perf-ledger perf-ledger-check
+.PHONY: test test-all test-kernels test-obs test-warmup test-hostplane \
+	native soak soak-smoke bench dryrun perf-ledger perf-ledger-check
 
 test: native
 	$(PY) -m pytest tests/ -x -q -m "not slow"
@@ -32,6 +32,16 @@ test-obs:
 test-warmup:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_warmup.py \
 	    tests/test_live_fused.py -q
+
+# fast cpu gate for the compartmentalized host plane (ISSUE 8): the
+# batched-ingress ≡ direct-propose differential, SystemBusy/PayloadTooBig
+# semantics, group-commit merge/error-propagation, ErrorFS flusher
+# crash-durability (nothing acked before its fsync), journal replay, and
+# the compartments-off structural bit-identity — run before the full
+# tier-1 sweep whenever hostplane.py, engine.py, requests.py, queue.py
+# or logdb/{kv,sharded,journal}.py change
+test-hostplane:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_hostplane.py -q
 
 # parallel run: heavy multi-NodeHost modules carry
 # xdist_group("heavy-multiprocess") and serialize on one worker while

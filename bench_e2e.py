@@ -341,6 +341,228 @@ def _measure_mixed(leaders, cids, payload, read_ratio, stop_at, threads) -> dict
 
 
 # ======================================================================
+# many-client/many-session axis (ISSUE 8: the commit-latency-bound
+# scenario — a session serializes its series ids, so per-session
+# throughput is one write per commit latency and aggregate throughput is
+# sessions/latency; the compartmentalized host plane attacks exactly the
+# per-write host overheads this shape exposes)
+# ======================================================================
+
+
+def _session_worker(nh, cid, stop_at, out):
+    """One exactly-once session: register, serialized sync proposes until
+    the deadline, close.  Latency is the full propose→applied→notified
+    round trip (the session semantics forbid pipelining)."""
+    done = 0
+    errors = 0
+    lats = []
+    payload = _payload()
+    try:
+        s = nh.sync_get_session(cid, timeout=30.0)
+    except Exception:
+        out.append((0, 1, []))
+        return
+    try:
+        while time.time() < stop_at:
+            t0 = time.perf_counter()
+            try:
+                nh.sync_propose(s, payload, timeout=30.0)
+                lats.append(time.perf_counter() - t0)
+                done += 1
+            except Exception:
+                errors += 1
+                time.sleep(0.01)
+    finally:
+        try:
+            nh.sync_close_session(s, timeout=10.0)
+        except Exception:
+            pass
+    out.append((done, errors, lats))
+
+
+class _SlowDisk:
+    """Simulated contended durability device: every fsync costs
+    ``delay_ms`` of device time and the device serializes barrier
+    flushes (one platter / one virtio queue — physically what an HDD or
+    throttled cloud block device does).  CLEARLY A SIMULATION: the
+    slow-disk axis labels its rows with the injected cost; the fast-disk
+    axis next to it is the real device."""
+
+    def __init__(self, delay_ms: float):
+        self.delay_s = delay_ms / 1e3
+        self.mu = threading.Lock()
+        self.fsyncs = 0
+
+    def wait(self):
+        with self.mu:
+            self.fsyncs += 1
+            time.sleep(self.delay_s)
+
+
+def _slow_fs(disk):
+    from dragonboat_tpu import vfs
+
+    class SlowFS(vfs.OSFS):
+        def fsync(self, f):
+            super().fsync(f)
+            disk.wait()
+
+        def fsync_dir(self, path):
+            super().fsync_dir(path)
+            disk.wait()
+
+    return SlowFS()
+
+
+def run_sessions(
+    sessions: int = 32,
+    groups: int = 32,
+    duration: float = 10.0,
+    rtt_ms: int = 50,
+    compartments: bool = False,
+    n_hosts: int = 3,
+    engine: str = "scalar",
+    fsync_ms: float = 0.0,
+) -> dict:
+    """Durable single-process 3-host cluster, S exactly-once sessions
+    round-robined over G groups.  Returns w/s, commit p50/p99, fsyncs/s
+    and (compartments on) the host-plane stats including the measured
+    fsync amortization factor.
+
+    ``fsync_ms > 0`` switches the LogDB to the pure-Python WAL backend on
+    a SIMULATED serialized slow disk (see :class:`_SlowDisk`) — the
+    contended-durability axis where every persisting group riding its own
+    fsync is the bottleneck the cross-shard group commit removes."""
+    from dragonboat_tpu import Config, NodeHostConfig
+    from dragonboat_tpu.config import ExpertConfig, LogDBConfig
+    from dragonboat_tpu.logdb import open_logdb
+    from dragonboat_tpu.nodehost import NodeHost
+    from dragonboat_tpu.transport import ChanRouter, ChanTransport
+
+    tmp = tempfile.mkdtemp(prefix="dbtpu-sess-")
+    router = ChanRouter()
+    nhs = []
+    disk = _SlowDisk(fsync_ms) if fsync_ms > 0 else None
+    slow_fs = _slow_fs(disk) if disk is not None else None
+    shards = int(os.environ.get("E2E_SHARDS", "4"))
+    try:
+        for i in range(1, n_hosts + 1):
+            logdb_factory = None
+            if slow_fs is not None:
+                from dragonboat_tpu.logdb.kv import WalKV
+
+                ldb_dir = os.path.join(tmp, f"ldb{i}")
+                logdb_factory = (
+                    lambda nhc, d=ldb_dir: open_logdb(
+                        d, shards=shards,
+                        kv_factory=lambda sd: WalKV(
+                            sd, fsync=True, fs=slow_fs
+                        ),
+                    )
+                )
+            nhs.append(
+                NodeHost(
+                    NodeHostConfig(
+                        node_host_dir=os.path.join(tmp, f"nh{i}"),
+                        rtt_millisecond=rtt_ms,
+                        raft_address=f"e2e{i}:1",  # _start_groups wires these names
+                        raft_rpc_factory=lambda src, rh, ch: ChanTransport(
+                            src, rh, ch, router=router
+                        ),
+                        logdb_config=LogDBConfig(fsync=True),
+                        logdb_factory=logdb_factory,
+                        expert=ExpertConfig(
+                            quorum_engine=engine,
+                            engine_block_groups=max(groups, 64),
+                            logdb_shards=shards,
+                            host_compartments=compartments,
+                            # the journal rides the same simulated device
+                            fs=slow_fs,
+                        ),
+                    )
+                )
+            )
+        cids = _start_groups(nhs, groups, election_rtt=20)
+        leaders = _campaign_and_wait(nhs, cids, 120.0)
+        fsync0 = sum(nh.logdb.fsync_count() for nh in nhs)
+        t0 = time.time()
+        stop_at = t0 + duration
+        out = []
+        ts = [
+            threading.Thread(
+                target=_session_worker,
+                args=(leaders[cids[i % groups]], cids[i % groups], stop_at,
+                      out),
+            )
+            for i in range(sessions)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        elapsed = max(time.time() - t0, 1e-6)
+        fsyncs = sum(nh.logdb.fsync_count() for nh in nhs) - fsync0
+        done = sum(d for d, _, _ in out)
+        errors = sum(e for _, e, _ in out)
+        lats = [l for _, _, ls in out for l in ls]
+        res = {
+            "sessions": sessions,
+            "groups": groups,
+            "hosts": n_hosts,
+            "engine": engine,
+            "compartments": compartments,
+            # >0 = the SIMULATED serialized-device axis (fsync costs this
+            # many ms and flushes queue at one device); 0 = the real disk
+            "fsync_ms": fsync_ms,
+            "duration_s": round(elapsed, 2),
+            "writes_per_sec": round(done / elapsed, 1),
+            "completed": done,
+            "errors": errors,
+            "commit_latency_ms": _percentiles(lats),
+            "fsyncs": fsyncs,
+            "fsyncs_per_sec": round(fsyncs / elapsed, 1),
+        }
+        if compartments:
+            hp = [nh.hostplane.stats() for nh in nhs]
+            res["hostplane"] = hp
+            # cross-committer fsync amortization, load-weighted across
+            # hosts: committer submissions per flusher cycle
+            subs = sum(h["wal"]["submissions"] for h in hp)
+            flushes = sum(h["wal"]["flushes"] for h in hp)
+            res["amortization"] = round(subs / flushes, 2) if flushes else 0.0
+        return res
+    finally:
+        for nh in nhs:
+            try:
+                nh.stop()
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_sessions_ab(
+    sessions: int = 32, groups: int = 32, duration: float = 10.0,
+    fsync_ms: float = 0.0,
+) -> dict:
+    """Compartments on/off A/B on the many-session axis (ISSUE 8
+    acceptance: >= 2.5x at 32 sessions, amortization factor > 1)."""
+    off = run_sessions(
+        sessions=sessions, groups=groups, duration=duration,
+        compartments=False, fsync_ms=fsync_ms,
+    )
+    on = run_sessions(
+        sessions=sessions, groups=groups, duration=duration,
+        compartments=True, fsync_ms=fsync_ms,
+    )
+    speed = (
+        round(on["writes_per_sec"] / off["writes_per_sec"], 2)
+        if off["writes_per_sec"]
+        else None
+    )
+    return {"off": off, "on": on, "speedup": speed}
+
+
+# ======================================================================
 # single-process mode (chan transport; tests + fallback)
 # ======================================================================
 
@@ -579,6 +801,10 @@ def rank_main() -> int:
                 fast_lane_commit_window_ms=float(
                     os.environ.get("E2E_COMMIT_WINDOW_MS", "4.0")
                 ),
+                # compartmentalized host plane A/B axis (ISSUE 8);
+                # default off — the scalar path is the baseline
+                host_compartments=os.environ.get("E2E_COMPARTMENTS", "0")
+                == "1",
             ),
         )
     )

@@ -128,6 +128,26 @@ class ExpertConfig:
     # multiplies batch depth when the flush device is the bottleneck, at
     # the cost of up to this much added commit latency per durability hop
     fast_lane_commit_window_ms: float = 0.0
+    # ---- compartmentalized host plane (hostplane.py, ISSUE 8) ----
+    # master switch: build the proposal ingress batcher, the cross-shard
+    # group-commit WAL flusher and the decoupled apply/egress executor
+    # pools.  OFF (default) constructs none of it — the scalar host path
+    # stays bit-identical to the pre-compartment build.
+    host_compartments: bool = False
+    # striped ingress staging shards (0 = 2).  One group always maps to
+    # one shard, so a client's back-to-back proposals stay ordered.
+    host_ingress_shards: int = 0
+    # per-shard staging-ring capacity (0 = 4x incoming_proposal_queue_length);
+    # a full ring raises SystemBusyError like a full entry_q
+    host_ingress_ring: int = 0
+    # shared-flusher accumulation window (ms): 0 flushes whatever is
+    # queued when the flusher wakes (concurrency alone provides the
+    # cross-committer merge); >0 trades up to that much commit latency
+    # for deeper fsync amortization
+    host_wal_window_ms: float = 0.0
+    # dedicated apply / client-completion egress executors (0 = 2 / 1)
+    host_apply_workers: int = 0
+    host_egress_workers: int = 0
     # filesystem the snapshot paths go through; None = the real OS fs.
     # Setting a vfs.MemFS runs the whole stack diskless (reference memfs
     # builds); a vfs.ErrorFS enables fault-injection testing and is

@@ -120,7 +120,17 @@ class _Committer:
         t0 = _time.perf_counter()
         merged = [ud for _, updates in batch for ud in updates]
         if merged:
-            self.engine.logdb.save_raft_state(merged)
+            hp = self.engine.hostplane
+            if hp is not None:
+                # cross-shard group-commit tier: the shared flusher merges
+                # this committer's batch with every other committer's into
+                # one fsync cycle; returns only once durable, then the
+                # post-fsync half below runs here, concurrently with the
+                # other committers' halves (per-group ordering untouched —
+                # a group only ever rides its owning committer)
+                hp.wal.flush(merged)
+            else:
+                self.engine.logdb.save_raft_state(merged)
         t1 = _time.perf_counter()
         for pairs, _ in batch:
             for n, ud in pairs:
@@ -152,10 +162,15 @@ class Engine:
         apply_workers: int = 4,
         get_csi=None,  # cheap cluster-set-index read; avoids the locked
         # dict copy in get_nodes on every worker wakeup when nothing changed
+        hostplane=None,  # compartmentalized host plane (hostplane.py):
+        # committers persist through its shared group-commit flusher and
+        # apply readiness routes to its dedicated pool; None keeps the
+        # classic per-committer fsync + in-engine apply workers
     ):
         self.get_nodes = get_nodes
         self.get_csi = get_csi
         self.logdb = logdb
+        self.hostplane = hostplane
         self._stopped = threading.Event()
         self.step_ready = _WorkReady(step_workers)
         self.apply_ready = _WorkReady(apply_workers)
@@ -190,7 +205,11 @@ class Engine:
             )
             t.start()
             self._threads.append(t)
-        for i in range(apply_workers):
+        # with the host plane attached, apply readiness routes to its
+        # dedicated pool — the in-engine apply workers would never be
+        # signalled, so don't spawn them (thread budget matters on the
+        # 1-vCPU box)
+        for i in range(0 if hostplane is not None else apply_workers):
             t = threading.Thread(
                 target=self._apply_worker_main, args=(i,),
                 name=f"apply-worker-{i}", daemon=True,
@@ -204,6 +223,11 @@ class Engine:
         self.step_ready.cluster_ready(cluster_id)
 
     def set_apply_ready(self, cluster_id: int) -> None:
+        hp = self.hostplane
+        if hp is not None:
+            # decoupled apply executor (sharded by group, order preserved)
+            hp.apply_pool.submit(cluster_id)
+            return
         self.apply_ready.cluster_ready(cluster_id)
 
     def notify_all(self) -> None:

@@ -1,0 +1,178 @@
+"""Host-plane group-commit journal: ONE fsync covering every LogDB
+shard's write batches per flush cycle.
+
+The sharded LogDB keeps one WAL file per shard, and the step-worker
+committers are shard-aligned — so merging their submissions can never
+reduce fsyncs below one per touched FILE per cycle.  This journal is the
+cross-shard half of ISSUE 8's group-commit tier: the flush cycle appends
+every shard's encoded write batch to a single redo-log file, fsyncs THAT
+once, and then applies the batches to the shard stores without their own
+fsync (``commit_write_batch_nosync``).  Durability argument:
+
+- nothing is acked before the journal fsync returns;
+- every journaled-mode shard write is journal-first, so shard state is
+  always a prefix of journal history;
+- recovery (``replay``, run by ``open_logdb`` whenever a journal file
+  exists — including after a crash, a downgrade to compartments-off, or
+  a kill between journal fsync and shard apply) re-applies the whole
+  journal in append order.  Re-application is idempotent (keyed puts /
+  deletes / range-deletes), and replaying from the checkpoint base ends
+  at exactly the newest journaled state;
+- checkpoints bound the journal: after ``checkpoint_every`` cycles the
+  flusher fsyncs every shard store (``sync_all``) and truncates the
+  journal — a crash between those two steps just replays an
+  already-applied suffix.
+
+Record framing (crc-checked, torn tails dropped like WalKV):
+``<crc32 u32><len u32><nbatches u32>`` then ``nbatches`` ×
+``<shard u32><nops u32><len u32><ops payload>`` where the ops payload is
+:func:`dragonboat_tpu.logdb.kv.encode_ops`'s format.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from typing import List, Optional, Tuple
+
+from ..logger import get_logger
+from .kv import KVWriteBatch, decode_ops, encode_ops
+
+plog = get_logger("logdb")
+
+_HDR = struct.Struct("<III")  # crc32(payload), payload len, batch count
+_SUB = struct.Struct("<III")  # shard idx, op count, ops payload len
+
+JOURNAL_NAME = "host-journal.wal"
+
+
+class HostJournal:
+    """The redo log the group-commit flusher appends to.
+
+    ``fs`` (a :mod:`dragonboat_tpu.vfs` IFS) routes the journal's IO so
+    vfs.ErrorFS fault injection reaches the ACTUAL durability point of
+    journaled mode — the one fsync nothing may be acked before."""
+
+    def __init__(self, path: str, fs=None):
+        self.path = path
+        self._fs = fs
+        # append vs checkpoint/close can come from different threads
+        # (flush leader / ShardedDB journal barrier); serialize file IO
+        self._mu = threading.Lock()
+        if fs is None:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            self._f = open(path, "ab")
+        else:
+            fs.makedirs(os.path.dirname(path), exist_ok=True)
+            self._f = fs.open(path, "ab")
+        #: journal fsyncs issued (one per flush cycle + checkpoints) —
+        #: the bench's amortization factor divides committer submissions
+        #: by these
+        self.fsyncs = 0
+        self.appends = 0
+        self.bytes = 0
+
+    def append(self, batches: List[Tuple[int, KVWriteBatch]]) -> None:
+        """One flush cycle: frame every shard's batch, write, fsync ONCE."""
+        buf = bytearray()
+        n = 0
+        for shard_idx, wb in batches:
+            if not wb.ops:
+                continue
+            ops = encode_ops(wb)
+            buf += _SUB.pack(shard_idx, len(wb.ops), len(ops))
+            buf += ops
+            n += 1
+        if not n:
+            return
+        payload = bytes(buf)
+        rec = _HDR.pack(zlib.crc32(payload), len(payload), n) + payload
+        with self._mu:
+            self._f.write(rec)
+            self._f.flush()
+            self._fsync()
+            self.fsyncs += 1
+            self.appends += 1
+            self.bytes += len(rec)
+
+    def checkpoint(self, sync_all) -> None:
+        """Bound the journal: make every shard store durable on its own,
+        then truncate.  A crash between the two steps only leaves an
+        already-applied suffix for replay."""
+        sync_all()
+        with self._mu:
+            self._f.truncate(0)
+            self._f.flush()
+            self._fsync()
+            self.fsyncs += 1
+            self.bytes = 0
+
+    def _fsync(self) -> None:
+        if self._fs is None:
+            os.fsync(self._f.fileno())
+        else:
+            self._fs.fsync(self._f)
+
+    def close(self) -> None:
+        with self._mu:
+            if not self._f.closed:
+                self._f.flush()
+                try:
+                    self._fsync()
+                except OSError:
+                    plog.exception("host journal close fsync failed")
+                self._f.close()
+
+
+def replay(path: str, shards) -> int:
+    """Re-apply a leftover journal into the shard stores (called by
+    ``open_logdb`` before the DB is handed out).  Returns the number of
+    cycles replayed; the journal is truncated afterwards (the replayed
+    writes were committed durably through the stores' fsynced path)."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return 0
+    pos, n = 0, len(data)
+    cycles = 0
+    while pos + _HDR.size <= n:
+        crc, plen, nbatches = _HDR.unpack_from(data, pos)
+        body = pos + _HDR.size
+        if body + plen > n:
+            break
+        payload = data[body : body + plen]
+        if zlib.crc32(payload) != crc:
+            break  # torn tail: its writes were never acked
+        p = 0
+        ok = True
+        for _ in range(nbatches):
+            if p + _SUB.size > plen:
+                ok = False
+                break
+            shard_idx, nops, olen = _SUB.unpack_from(payload, p)
+            p += _SUB.size
+            wb = decode_ops(payload[p : p + olen], nops)
+            p += olen
+            if wb is None or shard_idx >= len(shards):
+                ok = False
+                break
+            # durable commit: replay re-lands the write through the
+            # shard's own fsynced path, so a crash mid-replay just
+            # replays again (idempotent)
+            shards[shard_idx].kv.commit_write_batch(wb)
+        if not ok:
+            break
+        cycles += 1
+        pos = body + plen
+    if cycles:
+        plog.info("host journal %s: replayed %d cycles", path, cycles)
+    try:
+        with open(path, "r+b") as f:
+            f.truncate(0)
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError:
+        pass
+    return cycles

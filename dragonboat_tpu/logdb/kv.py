@@ -141,6 +141,13 @@ class InMemKV:
         with self._mu:
             self._apply_locked(wb)
 
+    def commit_write_batch_nosync(self, wb: KVWriteBatch) -> None:
+        """No durability to skip in memory — identical to commit."""
+        self.commit_write_batch(wb)
+
+    def sync(self) -> None:
+        pass
+
     def _apply_locked(self, wb: KVWriteBatch) -> None:
         for op, k, v in wb.ops:
             if op == _PUT:
@@ -178,6 +185,40 @@ _WAL_MAGIC = 0x57414C31  # "WAL1"
 _HDR = struct.Struct("<IIi")  # crc32(payload), payload len, op count
 
 
+def encode_ops(wb: KVWriteBatch) -> bytes:
+    """The WAL record's op payload (shared with the host-plane group-commit
+    journal): ``nops`` × ``<op u8><klen u32><key><vlen u32><value>``."""
+    buf = bytearray()
+    for op, k, v in wb.ops:
+        buf.append(op)
+        buf += struct.pack("<I", len(k))
+        buf += k
+        buf += struct.pack("<I", len(v))
+        buf += v
+    return bytes(buf)
+
+
+def decode_ops(payload: bytes, nops: int) -> Optional[KVWriteBatch]:
+    """Inverse of :func:`encode_ops`; None on a malformed payload."""
+    wb = KVWriteBatch()
+    p = 0
+    for _ in range(nops):
+        try:
+            op = payload[p]
+            klen = struct.unpack_from("<I", payload, p + 1)[0]
+            p += 5
+            k = payload[p : p + klen]
+            p += klen
+            vlen = struct.unpack_from("<I", payload, p)[0]
+            p += 4
+            v = payload[p : p + vlen]
+            p += vlen
+        except (IndexError, struct.error):
+            return None
+        wb.ops.append((op, bytes(k), bytes(v)))
+    return wb
+
+
 class WalKV(InMemKV):
     """Durable KV: in-memory index + append-only WAL, one record per batch.
 
@@ -187,35 +228,59 @@ class WalKV(InMemKV):
     the WAL as a single snapshot batch of live keys.
     """
 
-    def __init__(self, dirname: str, fsync: bool = True) -> None:
+    def __init__(self, dirname: str, fsync: bool = True, fs=None) -> None:
+        """``fs`` (a :mod:`dragonboat_tpu.vfs` IFS) routes the WAL file IO
+        through a virtual filesystem — vfs.ErrorFS turns this into the
+        fault-injection backend the host-plane flusher durability test
+        uses (nothing may ack before its fsync); None keeps the direct
+        ``os`` path."""
         super().__init__()
         self._dir = dirname
         self._fsync = fsync
-        os.makedirs(dirname, exist_ok=True)
+        self._fs = fs
+        #: committed write batches fsynced through this store — the
+        #: host-plane bench derives fsyncs/s and the group-commit
+        #: amortization factor from the sum across shards
+        self.fsyncs = 0
+        if fs is None:
+            os.makedirs(dirname, exist_ok=True)
+        else:
+            fs.makedirs(dirname, exist_ok=True)
         self._path = os.path.join(dirname, "kv.wal")
         self._replay()
-        self._f = open(self._path, "ab")
+        self._f = self._open_append()
+
+    def _open_append(self):
+        if self._fs is None:
+            return open(self._path, "ab")
+        return self._fs.open(self._path, "ab")
+
+    def _do_fsync(self, f) -> None:
+        if self._fs is None:
+            os.fsync(f.fileno())
+        else:
+            self._fs.fsync(f)
+        self.fsyncs += 1
 
     def name(self) -> str:
         return "walkv"
 
     @staticmethod
     def _encode_batch(wb: KVWriteBatch) -> bytes:
-        buf = bytearray()
-        for op, k, v in wb.ops:
-            buf.append(op)
-            buf += struct.pack("<I", len(k))
-            buf += k
-            buf += struct.pack("<I", len(v))
-            buf += v
-        payload = bytes(buf)
+        payload = encode_ops(wb)
         return _HDR.pack(zlib.crc32(payload), len(payload), len(wb.ops)) + payload
 
     def _replay(self) -> None:
-        if not os.path.exists(self._path):
-            return
-        with open(self._path, "rb") as f:
-            data = f.read()
+        if self._fs is None:
+            if not os.path.exists(self._path):
+                return
+            with open(self._path, "rb") as f:
+                data = f.read()
+        else:
+            if not self._fs.exists(self._path):
+                return
+            with self._fs.open(self._path, "rb") as f:
+                data = f.read()
         pos, n = 0, len(data)
         valid_to = 0
         while pos + _HDR.size <= n:
@@ -226,31 +291,15 @@ class WalKV(InMemKV):
             payload = data[body_start : body_start + plen]
             if zlib.crc32(payload) != crc:
                 break
-            wb = KVWriteBatch()
-            p = 0
-            ok = True
-            for _ in range(nops):
-                try:
-                    op = payload[p]
-                    klen = struct.unpack_from("<I", payload, p + 1)[0]
-                    p += 5
-                    k = payload[p : p + klen]
-                    p += klen
-                    vlen = struct.unpack_from("<I", payload, p)[0]
-                    p += 4
-                    v = payload[p : p + vlen]
-                    p += vlen
-                except (IndexError, struct.error):
-                    ok = False
-                    break
-                wb.ops.append((op, bytes(k), bytes(v)))
-            if not ok:
+            wb = decode_ops(payload, nops)
+            if wb is None:
                 break
             self._apply_locked(wb)
             pos = body_start + plen
             valid_to = pos
         if valid_to < n:  # truncate torn tail
-            with open(self._path, "r+b") as f:
+            opener = open if self._fs is None else self._fs.open
+            with opener(self._path, "r+b") as f:
                 f.truncate(valid_to)
 
     def commit_write_batch(self, wb: KVWriteBatch) -> None:
@@ -259,8 +308,28 @@ class WalKV(InMemKV):
             self._f.write(rec)
             self._f.flush()
             if self._fsync:
-                os.fsync(self._f.fileno())
+                self._do_fsync(self._f)
+            # the in-memory view only moves AFTER the record is durable:
+            # a failed write/fsync (vfs.ErrorFS injection) leaves state
+            # unchanged, so nothing upstream can ack an unpersisted batch
             self._apply_locked(wb)
+
+    def commit_write_batch_nosync(self, wb: KVWriteBatch) -> None:
+        """Append + apply without the fsync — only valid under the
+        host-plane group-commit journal (logdb/journal.py), whose own
+        fsynced append covers this batch's durability."""
+        rec = self._encode_batch(wb)
+        with self._mu:
+            self._f.write(rec)
+            self._f.flush()
+            self._apply_locked(wb)
+
+    def sync(self) -> None:
+        """Fsync the WAL tail (journal checkpoint half)."""
+        with self._mu:
+            if not self._f.closed:
+                self._f.flush()
+                self._do_fsync(self._f)
 
     def full_compaction(self) -> None:
         with self._mu:
@@ -269,20 +338,24 @@ class WalKV(InMemKV):
                 wb.put(k, self._data[k])
             rec = self._encode_batch(wb)
             tmp = self._path + ".tmp"
-            with open(tmp, "wb") as f:
+            opener = open if self._fs is None else self._fs.open
+            with opener(tmp, "wb") as f:
                 f.write(rec)
                 f.flush()
-                os.fsync(f.fileno())
+                self._do_fsync(f)
             self._f.close()
-            os.replace(tmp, self._path)
-            self._f = open(self._path, "ab")
+            if self._fs is None:
+                os.replace(tmp, self._path)
+            else:
+                self._fs.replace(tmp, self._path)
+            self._f = self._open_append()
 
     def close(self) -> None:
         with self._mu:
             if not self._f.closed:
                 self._f.flush()
                 if self._fsync:
-                    os.fsync(self._f.fileno())
+                    self._do_fsync(self._f)
                 self._f.close()
 
 

@@ -114,6 +114,17 @@ class RDB:
     def save_raft_state(self, updates: List[Update], wb: KVWriteBatch) -> None:
         """One atomic, fsynced write batch for a worker round
         (reference ``rdb.go:187-210``)."""
+        self.build_raft_state(updates, wb)
+        # rounds where every record was suppressed (heartbeat traffic with
+        # unchanged State) must not pay a WAL append + fsync for an empty
+        # batch — the rdbcache exists precisely to elide these writes
+        if wb.ops:
+            self.kv.commit_write_batch(wb)
+
+    def build_raft_state(self, updates: List[Update], wb: KVWriteBatch) -> None:
+        """Fill ``wb`` with the round's records WITHOUT committing — the
+        host-plane group-commit journal path commits the batch itself
+        (journal fsync first, then ``commit_write_batch_nosync``)."""
         for ud in updates:
             self._record_state(ud, wb)
             if ud.snapshot is not None and not ud.snapshot.is_empty():
@@ -128,11 +139,6 @@ class RDB:
                 self._record_max_index(
                     wb, ud.cluster_id, ud.node_id, ud.snapshot.index
                 )
-        # rounds where every record was suppressed (heartbeat traffic with
-        # unchanged State) must not pay a WAL append + fsync for an empty
-        # batch — the rdbcache exists precisely to elide these writes
-        if wb.ops:
-            self.kv.commit_write_batch(wb)
 
     def _record_state(self, ud: Update, wb: KVWriteBatch) -> None:
         if ud.state.is_empty():

@@ -85,6 +85,19 @@ def _load():
             ctypes.c_char_p,
             ctypes.c_size_t,
         ]
+        # nkv_commit2/nkv_sync: host-plane group-commit support (a
+        # stale .so — e.g. an old DBTPU_NATIVE_LIB_DIR build — simply
+        # lacks them; NativeKV degrades to always-fsync commits)
+        if hasattr(lib, "nkv_commit2"):
+            lib.nkv_commit2.restype = ctypes.c_int
+            lib.nkv_commit2.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.c_int,
+            ]
+            lib.nkv_sync.restype = ctypes.c_int
+            lib.nkv_sync.argtypes = [ctypes.c_void_p]
         lib.nkv_bulk_remove.restype = ctypes.c_int
         lib.nkv_bulk_remove.argtypes = [
             ctypes.c_void_p,
@@ -156,6 +169,11 @@ class NativeKV:
         self._lib = lib
         self._mu = threading.Lock()
         self._closed = False
+        self._fsync = fsync
+        #: committed-batch fsyncs issued through this store (commit with
+        #: fsync enabled, plus explicit sync()) — the host-plane bench
+        #: derives fsyncs/s from the per-shard sum
+        self.fsyncs = 0
 
     # -- IKVStore --
 
@@ -224,6 +242,25 @@ class NativeKV:
     def commit_write_batch(self, wb: KVWriteBatch) -> None:
         payload = _encode_batch(wb)
         self._check(self._lib.nkv_commit(self._h, payload, len(payload)))
+        if self._fsync:
+            self.fsyncs += 1
+
+    def commit_write_batch_nosync(self, wb: KVWriteBatch) -> None:
+        """Append + apply WITHOUT the fdatasync — only valid under the
+        host-plane group-commit journal, whose own fsynced append covers
+        this batch's durability (logdb/journal.py).  Falls back to the
+        durable commit on a stale native library."""
+        if not hasattr(self._lib, "nkv_commit2"):
+            self.commit_write_batch(wb)
+            return
+        payload = _encode_batch(wb)
+        self._check(self._lib.nkv_commit2(self._h, payload, len(payload), 0))
+
+    def sync(self) -> None:
+        """Flush the active segment (journal checkpoint half)."""
+        if hasattr(self._lib, "nkv_sync"):
+            self._check(self._lib.nkv_sync(self._h))
+            self.fsyncs += 1
 
     def bulk_remove_entries(self, first: bytes, last: bytes) -> None:
         self._check(

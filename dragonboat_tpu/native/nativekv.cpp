@@ -28,6 +28,7 @@
 #include <cstdio>
 #include <cstring>
 #include <map>
+#include <set>
 #include <memory>
 #include <mutex>
 #include <string>
@@ -156,6 +157,37 @@ class NativeKV {
     return CommitLocked(batch, blen);
   }
 
+  // Commit with an explicit durability override: do_fsync=0 appends +
+  // applies without the fdatasync — the host-plane group-commit journal
+  // (logdb/journal.py) provides the durability, one fsync amortized
+  // across every shard's batches per flush cycle.  Sync() is the
+  // checkpoint half: flush the active segment so the journal can be
+  // truncated.
+  int Commit2(const uint8_t* batch, size_t blen, bool do_fsync) {
+    std::lock_guard<std::mutex> g(mu_);
+    bool saved = fsync_;
+    fsync_ = do_fsync;
+    int rc = CommitLocked(batch, blen);
+    fsync_ = saved;
+    return rc;
+  }
+
+  int Sync() {
+    std::lock_guard<std::mutex> g(mu_);
+    // every segment an unsynced commit touched since the last Sync —
+    // a Commit2(do_fsync=0) burst can rotate segments, and syncing only
+    // the active one would let the journal checkpoint truncate the sole
+    // durable copy of the rotated-out tail
+    for (uint32_t id : dirty_) {
+      auto it = segs_.find(id);
+      if (it == segs_.end()) continue;
+      if (::fdatasync(it->second.fd) != 0)
+        return Fail("fdatasync seg %u: %s", id, strerror(errno));
+    }
+    dirty_.clear();
+    return 0;
+  }
+
   int BulkRemove(const uint8_t* f, size_t fl, const uint8_t* l, size_t ll) {
     std::string payload;
     payload.push_back((char)kOpDeleteRange);
@@ -279,8 +311,12 @@ class NativeKV {
     ssize_t want = (ssize_t)(hdr.size() + plen);
     if (::writev(seg.fd, iov, 2) != want)
       return Fail("writev: %s", strerror(errno));
-    if (fsync_ && ::fdatasync(seg.fd) != 0)
-      return Fail("fdatasync: %s", strerror(errno));
+    if (fsync_) {
+      if (::fdatasync(seg.fd) != 0)
+        return Fail("fdatasync: %s", strerror(errno));
+    } else {
+      dirty_.insert(active_);  // made durable by the next Sync()
+    }
     uint64_t base = seg.size + kHdrSize;
     seg.size += (uint64_t)want;
     return ApplyPayloadWithOverwriteAccounting(payload, plen, active_, base);
@@ -404,6 +440,7 @@ class NativeKV {
   std::string dir_;
   bool fsync_ = true;
   std::mutex mu_;
+  std::set<uint32_t> dirty_;  // segments with unsynced commits (Sync())
   std::map<std::string, Loc> index_;
   std::unordered_map<uint32_t, SegInfo> segs_;
   uint32_t active_ = 1;
@@ -447,6 +484,13 @@ void nkv_buf_free(uint8_t* p) { free(p); }
 int nkv_commit(NativeKV* kv, const uint8_t* batch, size_t blen) {
   return kv->Commit(batch, blen);
 }
+
+int nkv_commit2(NativeKV* kv, const uint8_t* batch, size_t blen,
+                int do_fsync) {
+  return kv->Commit2(batch, blen, do_fsync != 0);
+}
+
+int nkv_sync(NativeKV* kv) { return kv->Sync(); }
 
 int nkv_bulk_remove(NativeKV* kv, const uint8_t* f, size_t fl,
                     const uint8_t* l, size_t ll) {

@@ -8,7 +8,9 @@ queue), snapshot task lifecycle, log compaction, tick handling and the
 """
 from __future__ import annotations
 
+import struct as _struct
 import threading
+from functools import lru_cache
 from typing import Dict, List, Optional
 
 from .client import Session
@@ -64,6 +66,12 @@ from .wire import (
 
 plog = get_logger("node")
 MT = MessageType
+
+# length-prefix header packer for native batch appends, cached per length:
+# a pipelined burst is almost always one payload size repeated, and the
+# per-entry ``struct.pack`` (plus the in-function ``import struct``) was a
+# measured leaf in PROFILE_e2e.txt's propose path
+_pack_len = lru_cache(maxsize=1024)(_struct.Struct("<I").pack)
 # wire types the native fast lane serves (natraft.cpp handle_fast)
 _FAST_WIRE_TYPES = frozenset(
     (MT.REPLICATE, MT.REPLICATE_RESP, MT.HEARTBEAT, MT.HEARTBEAT_RESP,
@@ -131,6 +139,12 @@ class Node:
         # native core owns the group's steady-state data plane
         self.fastlane = None  # FastLaneManager, set by NodeHost
         self.fast_lane = False
+        # compartmentalized host plane (hostplane.py, set by NodeHost when
+        # ExpertConfig.host_compartments is on): propose/propose_batch
+        # stage through the striped ingress batcher instead of taking the
+        # entry_q lock + step wakeup per call.  None keeps the direct path
+        # bit-identical.
+        self.ingress = None
         # device-engine effect flags (written by the coordinator round
         # thread, max-merged/idempotent, applied under raftMu by
         # _apply_offload_effects on a step worker).  _off_mu guards the
@@ -225,25 +239,31 @@ class Node:
     # Spreading application across step workers is exactly the
     # reference's partitioned-worker model (execengine.go:654-706).
 
-    def offload_commit(self, q: int) -> None:
+    def offload_commit(self, q: int, wake: bool = True) -> None:
         """Flag a device-computed commit watermark (applied in
         ``_apply_offload_effects`` where ``log.try_commit`` re-applies the
         current-term rule, raft paper p8, so stale results are rejected
-        and commit outputs stay bit-identical to the scalar path)."""
+        and commit outputs stay bit-identical to the scalar path).
+        ``wake=False`` lets a host-plane-fed coordinator coalesce the
+        step wakeup to one per group per round."""
         with self._off_mu:
             if q > self._off_commit:
                 self._off_commit = q
-        self.nh.engine.set_step_ready(self.cluster_id)
+        if wake:
+            self.nh.engine.set_step_ready(self.cluster_id)
 
-    def offload_election(self, won: bool, term: int) -> None:
+    def offload_election(self, won: bool, term: int, wake: bool = True) -> None:
         """Flag a device-tallied election outcome.  ``term`` pins the
         outcome to the campaign it tallied: a flag staged before the
         campaign restarted at a higher term is discarded at apply time."""
         with self._off_mu:
             self._off_election = (won, term)
-        self.nh.engine.set_step_ready(self.cluster_id)
+        if wake:
+            self.nh.engine.set_step_ready(self.cluster_id)
 
-    def offload_read_confirm(self, low: int, high: int, term: int) -> None:
+    def offload_read_confirm(
+        self, low: int, high: int, term: int, wake: bool = True
+    ) -> None:
         """Flag a device-confirmed ReadIndex ctx (kernels.read_confirm
         reached quorum for its slot).  Applied in
         ``_apply_offload_effects`` through ``read_index.release`` — the
@@ -252,31 +272,38 @@ class Node:
         rejected, never applied."""
         with self._off_mu:
             self._off_reads.append((low, high, term))
-        self.nh.engine.set_step_ready(self.cluster_id)
+        if wake:
+            self.nh.engine.set_step_ready(self.cluster_id)
 
-    def offload_read_echo(self, from_: int, low: int, high: int) -> None:
+    def offload_read_echo(
+        self, from_: int, low: int, high: int, wake: bool = True
+    ) -> None:
         """Fallback: a heartbeat echo for a ctx the device read plane is
         NOT tracking (pending-read slot overflow, or the echo raced a
         confirmation).  Re-routed through the scalar tally, which is a
         no-op for unknown ctxs."""
         with self._off_mu:
             self._off_read_echoes.append((from_, low, high))
-        self.nh.engine.set_step_ready(self.cluster_id)
+        if wake:
+            self.nh.engine.set_step_ready(self.cluster_id)
 
-    def offload_tick_elect(self) -> None:
+    def offload_tick_elect(self, wake: bool = True) -> None:
         with self._off_mu:
             self._off_elect = True
-        self.nh.engine.set_step_ready(self.cluster_id)
+        if wake:
+            self.nh.engine.set_step_ready(self.cluster_id)
 
-    def offload_tick_heartbeat(self) -> None:
+    def offload_tick_heartbeat(self, wake: bool = True) -> None:
         with self._off_mu:
             self._off_hb = True
-        self.nh.engine.set_step_ready(self.cluster_id)
+        if wake:
+            self.nh.engine.set_step_ready(self.cluster_id)
 
-    def offload_tick_demote(self) -> None:
+    def offload_tick_demote(self, wake: bool = True) -> None:
         with self._off_mu:
             self._off_demote = True
-        self.nh.engine.set_step_ready(self.cluster_id)
+        if wake:
+            self.nh.engine.set_step_ready(self.cluster_id)
 
     def _apply_offload_effects(self) -> None:
         """Apply flagged device-engine effects (under raftMu, from a step
@@ -403,6 +430,20 @@ class Node:
         self, session: Session, cmd: bytes, timeout_s: float
     ) -> RequestState:
         self._check_user_op(len(cmd))
+        ing = self.ingress
+        if ing is not None:
+            # host-plane ingress tier, adaptive for singles: a shard with
+            # staged or draining work coalesces this proposal into the
+            # batcher's next burst (ordered behind the in-flight ring);
+            # a QUIET shard returns None and the proposal stages inline
+            # below — the direct path, so a low-rate client never pays
+            # the extra thread handoff (the measured on/off latency tax
+            # of an always-on ring at window-1 arrival).  The precheck
+            # above keeps witness/payload semantics synchronous either
+            # way.
+            rs = ing.submit_single_if_active(self, session, cmd, timeout_s)
+            if rs is not None:
+                return rs
         # non-empty commands are stored as ENCODED entries: 1-byte
         # version/compression header (+ snappy when configured) — reference
         # requests.go:1038-1042 + rsm/encoded.go
@@ -448,12 +489,25 @@ class Node:
         self._check_user_op(max((len(c) for c in cmds), default=0))
         if not cmds:
             return []
-        entry_type = EntryType.APPLICATION
-        enc = [
-            get_encoded_payload(self._entry_ct, c) if c else c for c in cmds
-        ]
-        if any(enc):
-            entry_type = EntryType.ENCODED
+        ing = self.ingress
+        if ing is not None:
+            # bursts always ride the batcher: they are throughput-driven
+            # (pipelined window refills), tolerate the one handoff, and
+            # keep the shard active so concurrent singles coalesce
+            return ing.submit(self, session, cmds, timeout_s)
+        # encode in one pass — empty commands are never re-encoded, and
+        # the separate any(enc) scan collapsed into the same loop
+        # (PROFILE_e2e.txt propose-path leaves)
+        ct = self._entry_ct
+        enc: List[bytes] = []
+        has_encoded = False
+        for c in cmds:
+            if c:
+                enc.append(get_encoded_payload(ct, c))
+                has_encoded = True
+            else:
+                enc.append(c)
+        entry_type = EntryType.ENCODED if has_encoded else EntryType.APPLICATION
         states, entries = self.pending_proposals.propose_batch(
             session.client_id, session.series_id, enc,
             self._timeout_ticks(timeout_s),
@@ -464,10 +518,8 @@ class Node:
         if self.fast_lane and self.fastlane is not None and all(
             e.type == entry_type for e in entries
         ):
-            import struct as _struct
-
             blob = b"".join(
-                _struct.pack("<I", len(e.cmd)) + e.cmd for e in entries
+                _pack_len(len(e.cmd)) + e.cmd for e in entries
             )
             if self.fastlane.nat.propose_batch(
                 self.cluster_id, [e.key for e in entries], session.client_id,

@@ -264,6 +264,41 @@ class NodeHost:
                 # programs until the readiness latch flips, so proposals
                 # issued during warmup never block on compilation.
                 self.quorum_coordinator.start_warmup()
+        # compartmentalized host plane (ISSUE 8): proposal ingress
+        # batcher + cross-shard group-commit WAL + decoupled apply/egress
+        # executors.  Built BEFORE the engine (the committers persist
+        # through its flusher, apply readiness routes to its pool); OFF by
+        # default — nothing below is constructed and the scalar host path
+        # stays bit-identical.
+        self.hostplane = None
+        if expert.host_compartments:
+            from .hostplane import HostPlane
+
+            self.hostplane = HostPlane(
+                self.logdb,
+                self._clusters.get,  # GIL-atomic dict get; None while
+                # starting/stopped — the pool just skips the wakeup
+                ingress_shards=expert.host_ingress_shards,
+                ingress_ring=expert.host_ingress_ring,
+                wal_window_ms=expert.host_wal_window_ms,
+                apply_workers=expert.host_apply_workers,
+                egress_workers=expert.host_egress_workers,
+                # ErrorFS fault injection must reach the journaled
+                # mode's actual durability point — but ONLY the
+                # fault-injection vfs is threaded through: the journal
+                # otherwise stays on the raw OS path next to the shard
+                # stores (which never ride the snapshot vfs), keeping
+                # write and REPLAY (open_logdb, raw OS) on one medium
+                fs=self._fs if vfs.is_error_fs(self._fs) else None,
+            )
+            if nhconfig.enable_metrics:
+                self.hostplane.enable_obs(
+                    registry=self.raft_events.registry
+                )
+            if self.quorum_coordinator is not None:
+                # the device-plane coordinator feeds the same tier: its
+                # round fan-out coalesces step wakeups through the plane
+                self.quorum_coordinator.hostplane = self.hostplane
         # engine
         workers = expert.step_worker_count or 4
         self.engine = Engine(
@@ -272,6 +307,7 @@ class NodeHost:
             step_workers=workers,
             apply_workers=workers,
             get_csi=self._get_csi,
+            hostplane=self.hostplane,
         )
         # ticks
         self._tick_thread = threading.Thread(
@@ -512,6 +548,10 @@ class NodeHost:
         node.peer_raft_events = self.raft_events
         node.quorum_coordinator = self.quorum_coordinator
         node.fastlane = self.fastlane
+        if self.hostplane is not None:
+            node.ingress = self.hostplane.ingress
+            node.pending_proposals.set_egress(self.hostplane.egress)
+            node.pending_reads.set_egress(self.hostplane.egress)
         node.start(addresses, initial=not join and new_node, new_node=new_node)
         with self._mu:
             self._clusters[cluster_id] = node
@@ -566,6 +606,11 @@ class NodeHost:
         if self.fastlane is not None:
             self.fastlane.stop()
         self.engine.stop()
+        if self.hostplane is not None:
+            # after engine.stop(): the committers (joined there) are the
+            # flusher's riders — stopping the flusher first would strand
+            # an in-flight flush
+            self.hostplane.stop()
         if self.quorum_coordinator is not None:
             self.quorum_coordinator.stop()
         self.transport.stop()

@@ -56,6 +56,7 @@ LATENCY_BUCKETS_MS = DEFAULT_BUCKETS
 
 _DEV = "dragonboat_device_"
 _COORD = "dragonboat_coord_"
+_HOST = "dragonboat_host_"
 
 
 class EngineObs:
@@ -217,6 +218,114 @@ class EngineObs:
         )
         if self.recorder.stalls != stalls:
             r.counter_add(_DEV + "stalls_total")
+
+
+class HostObs:
+    """Compartmentalized host-plane instruments (hostplane.py, ISSUE 8).
+
+    Families (``dragonboat_host_*``):
+
+    - ``ingress_submitted_total`` / ``ingress_drains_total`` /
+      ``ingress_drained_total`` — ring traffic; drained/drains is the
+      drain batch size (the batcher's amortization)
+    - gauge ``ingress_ring_depth`` — staged commands still ringed at the
+      end of a drain
+    - ``wal_flushes_total`` / ``wal_riders_total`` /
+      ``wal_updates_total`` — group-commit flusher cycles, committer
+      submissions merged per cycle (riders/flushes = the fsync
+      amortization factor, published as gauge ``wal_amortization``) and
+      raft updates persisted
+    - histogram ``wal_flush_latency_ms`` — merged save+fsync wall time
+    - ``apply_batches_total`` / ``apply_groups_total`` — decoupled apply
+      executor wakeups and the groups they covered
+    - ``egress_notified_total`` — client completions delivered off the
+      apply workers
+
+    Stage spans land in the shared flight recorder (``ingress_drain`` /
+    ``wal_flush`` kinds) next to the device-plane spans; the same
+    ``is not None`` latch keeps the obs-off host plane bit-identical.
+    """
+
+    __slots__ = ("recorder", "registry")
+
+    _COUNTERS = (
+        _HOST + "ingress_submitted_total",
+        _HOST + "ingress_drains_total",
+        _HOST + "ingress_drained_total",
+        _HOST + "wal_flushes_total",
+        _HOST + "wal_riders_total",
+        _HOST + "wal_updates_total",
+        _HOST + "apply_batches_total",
+        _HOST + "apply_groups_total",
+        _HOST + "egress_notified_total",
+    )
+
+    def __init__(
+        self,
+        recorder: Optional[FlightRecorder] = None,
+        registry: Optional[MetricsRegistry] = None,
+    ):
+        from . import default_recorder
+
+        self.recorder = recorder or default_recorder()
+        self.registry = registry or DEFAULT_REGISTRY
+        r = self.registry
+        for name in self._COUNTERS:
+            r.counter_add(name, 0)
+        r.gauge_set(_HOST + "ingress_ring_depth", 0)
+        r.gauge_set(_HOST + "wal_amortization", 0)
+        r.histogram_declare(
+            _HOST + "wal_flush_latency_ms", buckets=LATENCY_BUCKETS_MS
+        )
+
+    def ingress_submit(self, n: int) -> None:
+        self.registry.counter_add(_HOST + "ingress_submitted_total", n)
+
+    def ingress_drain(
+        self, *, groups: int, cmds: int, wall_ms: float, ring_depth: int
+    ) -> dict:
+        r = self.registry
+        r.counter_add(_HOST + "ingress_drains_total")
+        if cmds:
+            r.counter_add(_HOST + "ingress_drained_total", cmds)
+        r.gauge_set(_HOST + "ingress_ring_depth", ring_depth)
+        return self.recorder.record(
+            "ingress_drain",
+            groups=groups,
+            cmds=cmds,
+            wall_ms=round(wall_ms, 4),
+        )
+
+    def wal_flush(
+        self, *, riders: int, updates: int, wall_ms: float,
+        amortization: float,
+    ) -> dict:
+        r = self.registry
+        r.counter_add(_HOST + "wal_flushes_total")
+        r.counter_add(_HOST + "wal_riders_total", riders)
+        if updates:
+            r.counter_add(_HOST + "wal_updates_total", updates)
+        r.gauge_set(_HOST + "wal_amortization", round(amortization, 3))
+        r.histogram_observe(
+            _HOST + "wal_flush_latency_ms", wall_ms,
+            buckets=LATENCY_BUCKETS_MS,
+        )
+        return self.recorder.record(
+            "wal_flush",
+            riders=riders,
+            updates=updates,
+            wall_ms=round(wall_ms, 4),
+        )
+
+    def apply_batch(self, *, groups: int) -> None:
+        r = self.registry
+        r.counter_add(_HOST + "apply_batches_total")
+        if groups:
+            r.counter_add(_HOST + "apply_groups_total", groups)
+
+    def egress_batch(self, n: int) -> None:
+        if n:
+            self.registry.counter_add(_HOST + "egress_notified_total", n)
 
 
 class CoordObs:

@@ -38,6 +38,21 @@ class EntryQueue:
             q.append(e)
             return True
 
+    def add_batch(self, entries: List[Entry]) -> int:
+        """Append a burst under ONE lock acquisition (hostplane ingress
+        batcher).  Returns how many were accepted — a full queue truncates
+        the tail exactly like per-entry ``add`` calls would."""
+        with self._mu:
+            if self._stopped or self._paused:
+                return 0
+            q = self._active()
+            room = self.size - len(q)
+            if room <= 0:
+                return 0
+            take = entries[:room]
+            q.extend(take)
+            return len(take)
+
     def get(self, paused: bool = False) -> List[Entry]:
         # lock-free empty fast path (hot: every step round polls this);
         # only valid when the pause flag isn't changing

@@ -202,6 +202,15 @@ class PendingProposal:
         self._min_deadline = 1 << 62
         self._pending_min = 1 << 62
         self._min_mu = threading.Lock()
+        # client-completion egress sink (hostplane.EgressPool): when set,
+        # ``applied`` hands the resolved future to the sink instead of
+        # running ``rs.notify`` (the client-thread ``Event.set`` wakeup)
+        # inline on the apply worker.  None (default) keeps the apply
+        # path bit-identical to the pre-compartment build.
+        self._egress = None
+
+    def set_egress(self, sink) -> None:
+        self._egress = sink
 
     def _next_key(self) -> int:
         return self._rng.getrandbits(64) or 1
@@ -266,6 +275,32 @@ class PendingProposal:
                     self._pending_min = deadline
         return states, entries
 
+    def register_batch(self, states: List[RequestState]) -> None:
+        """Insert pre-created futures (hostplane ingress batcher): the
+        client thread built the RequestStates without touching the tracker
+        locks; the batcher registers them here — grouped per shard, one
+        lock acquisition each — strictly before staging the entries, so
+        completion can never miss the registration."""
+        if self._stopped:
+            for rs in states:
+                rs.notify(RequestResult(code=RequestResultCode.TERMINATED))
+            return
+        by_shard: Dict[int, List[RequestState]] = {}
+        min_deadline = 1 << 62
+        for rs in states:
+            by_shard.setdefault(rs.key % self.nshards, []).append(rs)
+            if rs.deadline < min_deadline:
+                min_deadline = rs.deadline
+        for shard, group in by_shard.items():
+            with self._locks[shard]:
+                d = self._shards[shard]
+                for rs in group:
+                    d[rs.key] = rs
+        if min_deadline < self._pending_min:
+            with self._min_mu:
+                if min_deadline < self._pending_min:
+                    self._pending_min = min_deadline
+
     def applied(
         self,
         key: int,
@@ -286,7 +321,11 @@ class PendingProposal:
         code = (
             RequestResultCode.REJECTED if rejected else RequestResultCode.COMPLETED
         )
-        rs.notify(RequestResult(code=code, result=result))
+        egress = self._egress
+        if egress is not None:
+            egress(rs, RequestResult(code=code, result=result))
+        else:
+            rs.notify(RequestResult(code=code, result=result))
 
     def dropped(self, key: int) -> None:
         shard = key % self.nshards
@@ -346,6 +385,12 @@ class PendingReadIndex:
         self._confirmed: List[Tuple[int, RequestState]] = []
         self._clock = _LogicalClock()
         self._stopped = False
+        # completion egress sink (hostplane) — same contract as
+        # PendingProposal._egress; None keeps notify inline
+        self._egress = None
+
+    def set_egress(self, sink) -> None:
+        self._egress = sink
 
     def read(self, timeout_ticks: int) -> RequestState:
         if self._stopped:
@@ -412,8 +457,12 @@ class PendingReadIndex:
                 else:
                     keep.append((idx, rs))
             self._confirmed = keep
+        egress = self._egress
         for rs in done:
-            rs.notify(RequestResult(code=RequestResultCode.COMPLETED))
+            if egress is not None:
+                egress(rs, RequestResult(code=RequestResultCode.COMPLETED))
+            else:
+                rs.notify(RequestResult(code=RequestResultCode.COMPLETED))
 
     def dropped(self, ctxs: List[SystemCtx]) -> None:
         with self._mu:

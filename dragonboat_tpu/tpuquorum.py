@@ -175,6 +175,14 @@ class TpuQuorumCoordinator:
         self._pending = threading.Event()
         self._stopped = threading.Event()
         self._interval = interval_s
+        # compartmentalized host plane (hostplane.py, wired by NodeHost
+        # when ExpertConfig.host_compartments is on): the round fan-out
+        # below then flags offload effects with wake=False and coalesces
+        # the engine step wakeups to ONE per touched group per round —
+        # the coordinator feeds the same batched-wakeup tier the ingress
+        # batcher uses.  None keeps the per-effect wakeups (bit-identical
+        # pre-compartment behavior).
+        self.hostplane = None
         # device-plane observability (ISSUE 5): OFF by default, gated on
         # `is not None` everywhere (the engine's overhead contract); the
         # module latch covers tests/bench, NodeHostConfig.enable_metrics
@@ -689,15 +697,28 @@ class TpuQuorumCoordinator:
         # confirmed-read releases, OUTSIDE _mu like the commit callbacks:
         # the node re-checks leader/term under raftMu and releases through
         # the scalar ReadIndex prefix pop (indices identical to the pure
-        # scalar path — tests/test_read_confirm.py)
+        # scalar path — tests/test_read_confirm.py).  With the host plane
+        # attached, effects are flagged with wake=False and the step
+        # wakeups coalesce to one per touched group at the end of the
+        # round (hostplane.wake_nodes) — a commit+tick+read round for one
+        # group costs one CV notify instead of three.
+        hp = self.hostplane
+        touched: dict = {}
+        # wake_kw stays EMPTY without the host plane so duck-typed test
+        # nodes that predate the wake kwarg keep working unchanged
+        wake_kw: dict = {} if hp is None else {"wake": False}
         for cid, low, high, term in read_confirms:
             node = self._nodes.get(cid)
             if node is not None:
-                node.offload_read_confirm(low, high, term)
+                node.offload_read_confirm(low, high, term, **wake_kw)
+                if hp is not None:
+                    touched[cid] = node
         for cid, q in res.commit.items():
             node = self._nodes.get(cid)
             if node is not None:
-                node.offload_commit(q)
+                node.offload_commit(q, **wake_kw)
+                if hp is not None:
+                    touched[cid] = node
         # device tick flags: election due / heartbeat due / check-quorum
         # demote — applied through the scalar handlers under raftMu with
         # all guards intact (stale flags are rejected there)
@@ -705,15 +726,23 @@ class TpuQuorumCoordinator:
             for cid in res.elect:
                 node = self._nodes.get(cid)
                 if node is not None:
-                    node.offload_tick_elect()
+                    node.offload_tick_elect(**wake_kw)
+                    if hp is not None:
+                        touched[cid] = node
             for cid in res.heartbeat:
                 node = self._nodes.get(cid)
                 if node is not None:
-                    node.offload_tick_heartbeat()
+                    node.offload_tick_heartbeat(**wake_kw)
+                    if hp is not None:
+                        touched[cid] = node
             for cid in res.demote:
                 node = self._nodes.get(cid)
                 if node is not None:
-                    node.offload_tick_demote()
+                    node.offload_tick_demote(**wake_kw)
+                    if hp is not None:
+                        touched[cid] = node
+        if hp is not None and touched:
+            hp.wake_nodes(touched.values())
         # tag election outcomes with the term the row held when the round
         # ran: during long dispatches (first jit compile, busy host) the
         # scalar side may have restarted the campaign at a higher term, and
