@@ -3,8 +3,9 @@
 
 PY ?= python
 
-.PHONY: test test-all test-kernels test-obs test-warmup test-hostplane \
-	native soak soak-smoke bench dryrun perf-ledger perf-ledger-check
+.PHONY: test test-all test-kernels test-obs test-trace test-warmup \
+	test-hostplane native soak soak-smoke bench dryrun perf-ledger \
+	perf-ledger-check
 
 test: native
 	$(PY) -m pytest tests/ -x -q -m "not slow"
@@ -23,6 +24,15 @@ test-kernels:
 # sweep whenever obs/, events.py, or the engine/coordinator hooks change
 test-obs:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_obs.py tests/test_events.py -q
+
+# fast cpu gate for cross-plane request tracing (ISSUE 9): trace-off
+# structural identity (compartments on/off), stage-chain completeness on
+# the scalar/tpu/fused paths incl. a membership recycle mid-trace, the
+# stage-level stall watchdog (ErrorFS WAL stall), and the Perfetto
+# export — run before the full tier-1 sweep whenever obs/trace.py,
+# requests.py, or the node/engine/coordinator trace hooks change
+test-trace:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_trace.py -q
 
 # fast cpu gate for the AOT warm-compile + persistent compilation cache
 # (ISSUE 7): warmup against a temp cache dir asserts (a) a second enable

@@ -1315,6 +1315,37 @@ def main() -> None:
             timeout=900.0,
         )
 
+    # request-tracing axis (ISSUE 9): trace-on vs trace-off interleaved
+    # best-of on one live cluster per engine (<5% asserted) plus the
+    # per-stage latency attribution — the perf ledger's "Latency
+    # attribution" table derives from this section.  Runs bench_e2e in a
+    # killable subprocess like the other e2e sections (cpu backend; the
+    # axis measures host-side stage cost, backend-agnostic).
+    if os.environ.get("BENCH_SKIP_TRACE_AXIS") != "1":
+        import subprocess as _sp
+
+        try:
+            r = _sp.run(
+                [sys.executable, os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)), "bench_e2e.py"),
+                 "--trace-axis"],
+                capture_output=True, text=True,
+                timeout=float(os.environ.get("BENCH_TRACE_TIMEOUT", "900")),
+                env={**os.environ, "E2E_TPU": "0"},
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                detail["trace_axis"] = json.loads(
+                    r.stdout.strip().splitlines()[-1]
+                )
+            else:
+                detail["trace_axis"] = {
+                    "error": f"rc={r.returncode}",
+                    "tail": (r.stderr or r.stdout)[-500:],
+                }
+        except Exception as e:
+            detail["trace_axis"] = {"error": repr(e)}
+        _note(f"trace_axis: {json.dumps(detail['trace_axis'])[:300]}")
+
     # full detail (per-rank stats and all) goes to a FILE; the stdout line
     # stays small enough that the driver's 2000-char tail capture can never
     # truncate the headline (VERDICT r3 missing #1)

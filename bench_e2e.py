@@ -567,7 +567,7 @@ def run_sessions_ab(
 # ======================================================================
 
 
-def _mk_nodehosts(n_hosts, groups, rtt_ms, engine, dirs):
+def _mk_nodehosts(n_hosts, groups, rtt_ms, engine, dirs, trace=0):
     from dragonboat_tpu import NodeHostConfig
     from dragonboat_tpu.config import ExpertConfig
     from dragonboat_tpu.nodehost import NodeHost
@@ -585,6 +585,7 @@ def _mk_nodehosts(n_hosts, groups, rtt_ms, engine, dirs):
                     raft_rpc_factory=lambda src, rh, ch: ChanTransport(
                         src, rh, ch, router=router
                     ),
+                    trace_sample_every=trace,
                     expert=ExpertConfig(
                         quorum_engine=engine,
                         engine_block_groups=max(groups, 64),
@@ -713,6 +714,177 @@ def run(
                 pass
         if tmp:
             shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ======================================================================
+# trace axis (ISSUE 9): overhead A/B + per-stage latency attribution
+# ======================================================================
+
+
+def _set_tracing(nhs, on: bool) -> None:
+    """Attach/detach the request tracer across a LIVE cluster.  Every
+    hook gates on a plain ``is not None`` check, so the detached half of
+    the A/B runs the trace-off path on the very same cluster — no
+    cluster-to-cluster weather in the comparison."""
+    for nh in nhs:
+        t = nh._trace_axis_tracer if on else None
+        nh.tracer = t
+        nh.engine.tracer = t
+        if nh.quorum_coordinator is not None:
+            nh.quorum_coordinator.tracer = t
+        with nh._mu:
+            nodes = [n for n in nh._clusters.values() if n is not None]
+        for n in nodes:
+            n.tracer = t
+            n.pending_reads._tracer = t
+
+
+def _merged_stage_stats(nhs) -> dict:
+    """Per-stage p50/p99 + share-of-e2e over every host's completed
+    trace ring (leaders are spread, so each host traced its share) —
+    the library's own ``compute_stage_stats`` does the math, so this
+    table and ``nh.tracer.stage_stats()`` can never disagree."""
+    from dragonboat_tpu.obs.trace import compute_stage_stats
+
+    return compute_stage_stats(
+        t for nh in nhs for t in nh._trace_axis_tracer.traces()
+    )
+
+
+def run_trace_axis() -> dict:
+    """Request-tracing axis (ISSUE 9): trace-on vs trace-off throughput
+    on the live host loop (interleaved windows on ONE cluster, best-of —
+    the obs axis's scheduler-weather discipline; <5% asserted) plus the
+    per-stage latency attribution tables, for BOTH the scalar and the
+    tpu-engine (warmed fused) paths.  The perf ledger's "Latency
+    attribution" table derives from this section.
+
+    Env knobs: TRACE_AXIS_GROUPS (64), TRACE_AXIS_DURATION (5s/window),
+    TRACE_AXIS_WINDOW (8 in flight/group), TRACE_AXIS_SAMPLE (1-in-8).
+    """
+    groups = int(os.environ.get("TRACE_AXIS_GROUPS", "64"))
+    duration = float(os.environ.get("TRACE_AXIS_DURATION", "5"))
+    window = int(os.environ.get("TRACE_AXIS_WINDOW", "8"))
+    sample = int(os.environ.get("TRACE_AXIS_SAMPLE", "8"))
+    threads = int(os.environ.get("TRACE_AXIS_THREADS", "4"))
+    # rtt low enough that the loaded box's round thread (niced +5) sees
+    # tick deficits > 1 — the tpu rows then measure the FUSED host loop
+    # (fused_dispatches in the output evidences it), not just a warmed
+    # one
+    rtt_ms = int(os.environ.get("TRACE_AXIS_RTT_MS", "30"))
+    payload = _payload()
+    out = {
+        "groups": groups,
+        "window": window,
+        "sample_every": sample,
+        "window_duration_s": duration,
+        "rtt_ms": rtt_ms,
+        "engines": {},
+    }
+    for engine in ("scalar", "tpu"):
+        tmp = tempfile.mkdtemp(prefix=f"dbtpu-trace-{engine}-")
+        dirs = [os.path.join(tmp, f"nh{i}") for i in range(3)]
+        nhs = _mk_nodehosts(3, groups, rtt_ms, engine, dirs, trace=sample)
+        try:
+            for nh in nhs:
+                # keep a handle: the A/B detaches/reattaches mid-run
+                nh._trace_axis_tracer = nh.tracer
+            cids = _start_groups(nhs, groups)
+            leaders = _campaign_and_wait(nhs, cids, 180.0)
+            fused_before = 0
+            if engine == "tpu":
+                # the fused host loop: wait for the background AOT warm
+                # so measured rounds can replay tick backlogs fused
+                deadline = time.time() + 180
+                while time.time() < deadline and not all(
+                    nh.quorum_coordinator.eng.fused_ready for nh in nhs
+                ):
+                    time.sleep(0.25)
+                fused_before = sum(
+                    nh.quorum_coordinator.fused_dispatches for nh in nhs
+                )
+
+            def measure(on):
+                _set_tracing(nhs, on)
+                m = _measure(
+                    leaders, cids, payload, window,
+                    time.time() + duration, threads, drain_budget=15.0,
+                )
+                return m["writes_per_sec"]
+
+            measure(False)  # warmup window (compile, cache, enrollment)
+            # paired A/B, MEAN of pair-wise deltas over an EVEN number
+            # of alternating-order pairs: this axis has ±15%
+            # window-to-window weather on a 1-vCPU box (BENCH_r09 note),
+            # so single windows or best-of measure the weather, not the
+            # tracer.  Adjacent windows pair off (drift cancels within
+            # a pair); the order alternates per pair and the count is
+            # even, so a systematic second-window penalty contributes
+            # +p,-p,... and cancels EXACTLY in the mean.  The assert is
+            # one-sided with a 2-SEM noise allowance — the residual
+            # pair noise is published (pair_deltas/sem) so the artifact
+            # shows the measurement's power, not just its verdict.
+            pairs = max(2, int(os.environ.get("TRACE_AXIS_PAIRS", "6")) // 2 * 2)
+            deltas = []
+            wps_on = wps_off = 0.0
+            for pair in range(pairs):
+                if pair % 2 == 0:
+                    on = measure(True)
+                    off = measure(False)
+                else:
+                    off = measure(False)
+                    on = measure(True)
+                wps_on = max(wps_on, on)
+                wps_off = max(wps_off, off)
+                deltas.append((off - on) / off * 100.0)
+            mean = sum(deltas) / len(deltas)
+            var = sum((d - mean) ** 2 for d in deltas) / max(1, len(deltas) - 1)
+            sem = (var / len(deltas)) ** 0.5
+            overhead = round(mean, 2)
+            # attribution phase: a DEDICATED traced window — the rings
+            # are cleared (and widened past the steady-state cap) first,
+            # so the published percentiles cover exactly this window's
+            # population instead of the newest keep=256 tail of the A/B
+            for nh in nhs:
+                nh._trace_axis_tracer.reset_completed(keep=8192)
+            _set_tracing(nhs, True)
+            _measure(
+                leaders, cids, payload, window, time.time() + duration,
+                threads, drain_budget=15.0,
+            )
+            attribution = _merged_stage_stats(nhs)
+            eng_out = {
+                "writes_per_sec_trace_off": round(wps_off, 1),
+                "writes_per_sec_trace_on": round(wps_on, 1),
+                "trace_overhead_pct": overhead,  # mean pair-wise
+                "trace_overhead_sem_pct": round(sem, 2),
+                "pair_deltas_pct": [round(d, 2) for d in deltas],
+                "trace_overhead_ok": overhead < 5.0 + 2 * sem,
+                "attribution": attribution,
+            }
+            if engine == "tpu":
+                eng_out["fused_dispatches"] = sum(
+                    nh.quorum_coordinator.fused_dispatches for nh in nhs
+                ) - fused_before
+                eng_out["fused_ready"] = all(
+                    nh.quorum_coordinator.eng.fused_ready for nh in nhs
+                )
+            assert overhead < 5.0 + 2 * sem, (
+                f"trace overhead too high on {engine}: {overhead}% "
+                f"(± {sem:.1f} SEM; {wps_on:.0f} vs {wps_off:.0f} w/s)"
+            )
+            out["engines"][engine] = eng_out
+        finally:
+            for nh in nhs:
+                try:
+                    nh.stop()
+                except Exception:
+                    pass
+            shutil.rmtree(tmp, ignore_errors=True)
+    out["trace_overhead_ok"] = all(
+        e.get("trace_overhead_ok") for e in out["engines"].values()
+    )
+    return out
 
 
 # ======================================================================
@@ -1461,4 +1633,7 @@ if __name__ == "__main__":
     if "--rank" in sys.argv:
         sys.exit(rank_main())
     _force_cpu_for_engine()
+    if "--trace-axis" in sys.argv:
+        print(json.dumps(run_trace_axis()), file=sys.stdout)
+        sys.exit(0)
     print(json.dumps(run_quick()), file=sys.stdout)

@@ -217,6 +217,19 @@ class NodeHostConfig:
     # compile across the fleet).  Empty = env DBTPU_COMPILATION_CACHE,
     # else no persistent cache.
     compilation_cache_dir: str = ""
+    # cross-plane request tracing (obs/trace.py, ISSUE 9): sample 1 in N
+    # requests into a full per-stage trace context (ingress → raft step →
+    # WAL → device round → apply → egress), publish
+    # dragonboat_trace_stage_seconds{stage} / dragonboat_trace_e2e_seconds
+    # into this host's registry, and enable NodeHost.dump_trace (Chrome
+    # trace / Perfetto export).  0 (default) = tracing off, request paths
+    # bit-identical; env DBTPU_TRACE_SAMPLE is the no-config fallback.
+    trace_sample_every: int = 0
+    # opt-in SIGUSR2 live-debug dump: on signal, write the flight
+    # recorder ring + any in-flight/completed sampled traces to a
+    # timestamped JSON file next to the node host dir (soak/chaos
+    # debugging without attaching a debugger)
+    dump_signal: bool = False
     logdb_config: LogDBConfig = field(default_factory=LogDBConfig.default)
     expert: ExpertConfig = field(default_factory=ExpertConfig)
     # factories (reference config/config.go:298-305)

@@ -132,6 +132,11 @@ class _Committer:
             else:
                 self.engine.logdb.save_raft_state(merged)
         t1 = _time.perf_counter()
+        tr = self.engine.tracer
+        if tr is not None and merged:
+            # the merged batch is durable here — whichever tier fsynced
+            # it (group-commit WAL or the classic per-committer save)
+            tr.mark_updates(merged, "wal")
         for pairs, _ in batch:
             for n, ud in pairs:
                 n.process_raft_update(ud)
@@ -171,6 +176,10 @@ class Engine:
         self.get_csi = get_csi
         self.logdb = logdb
         self.hostplane = hostplane
+        # cross-plane request tracer (obs/trace.py, ISSUE 9; set by
+        # NodeHost): committers stamp the "wal" stage on sampled entries
+        # after their fsync.  None keeps the commit path bit-identical.
+        self.tracer = None
         self._stopped = threading.Event()
         self.step_ready = _WorkReady(step_workers)
         self.apply_ready = _WorkReady(apply_workers)
@@ -358,6 +367,9 @@ class Engine:
                 committer.submit(persist, updates)
             else:
                 self.logdb.save_raft_state(updates)
+                tr = self.tracer
+                if tr is not None:
+                    tr.mark_updates(updates, "wal")
                 for n, ud in persist:
                     n.process_raft_update(ud)
                     n.commit_raft_update(ud)

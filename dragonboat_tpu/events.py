@@ -38,6 +38,12 @@ def escape_label_value(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
+def escape_help_text(v: str) -> str:
+    """``# HELP`` text escaping: only ``\\`` and newline (the exposition
+    spec does NOT escape quotes in help text, unlike label values)."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 #: default histogram bucket upper bounds (ms-scale latencies); callers
 #: pass their own geometry at first observe
 DEFAULT_BUCKETS = (
@@ -56,6 +62,11 @@ class MetricsRegistry:
     or newline in a value corrupted the whole scrape): exactly one
     ``# TYPE`` line per metric name, label values escaped, and stable
     (name, labels)-sorted ordering so successive scrapes diff cleanly.
+    ISSUE 9 satellite: every family also gets exactly one ``# HELP``
+    line (immediately before its ``# TYPE``) — instruments register
+    their description via :meth:`describe`; undescribed families fall
+    back to a deterministic placeholder so the exposition is uniformly
+    self-documenting.
     """
 
     def __init__(self) -> None:
@@ -67,6 +78,18 @@ class MetricsRegistry:
         # per-series [counts (len(buckets)+1, +Inf last), sum, count]
         self._hist_buckets: Dict[str, Tuple[float, ...]] = {}
         self._hists: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], list] = {}
+        # per-family ``# HELP`` text (first describe wins, like bucket
+        # geometry — a family must read the same across scrapes)
+        self._help: Dict[str, str] = {}
+
+    def describe(self, name: str, help_text: str) -> None:
+        """Register a family's ``# HELP`` text (first call wins)."""
+        with self._mu:
+            self._help.setdefault(name, help_text)
+
+    def help_text(self, name: str) -> str:
+        with self._mu:
+            return self._help.get(name, f"dragonboat_tpu metric {name}")
 
     @staticmethod
     def _key(name: str, labels: Optional[Dict[str, str]]):
@@ -137,6 +160,34 @@ class MetricsRegistry:
             series[1] += value
             series[2] += 1
 
+    def histogram_merge(
+        self,
+        name: str,
+        counts,
+        total: float,
+        count: int,
+        labels: Optional[Dict[str, str]] = None,
+        buckets=None,
+    ) -> None:
+        """Bulk-merge pre-bucketed observations into a series (the
+        request tracer accumulates per-stage observations locally off
+        the hot path and flushes them here on the tick cadence — one
+        registry lock per flush instead of one per observation).
+        ``counts`` must match the family geometry: len(buckets)+1, +Inf
+        last."""
+        with self._mu:
+            series = self._hist_series(name, labels, buckets)
+            bk = self._hist_buckets[name]
+            if len(counts) != len(bk) + 1:
+                raise ValueError(
+                    f"histogram_merge: {len(counts)} counts for "
+                    f"{len(bk)} buckets"
+                )
+            for i, c in enumerate(counts):
+                series[0][i] += c
+            series[1] += total
+            series[2] += count
+
     def histogram_value(
         self, name: str, labels: Optional[Dict[str, str]] = None
     ):
@@ -170,9 +221,10 @@ class MetricsRegistry:
 
     def write_health_metrics(self, out) -> None:
         """Prometheus text format (reference ``WriteHealthMetrics``
-        ``event.go:31``): one ``# TYPE`` per metric name, escaped label
-        values, stable ordering (counters, then gauges, then
-        histograms; (name, labels)-sorted within each)."""
+        ``event.go:31``): one ``# HELP`` + one ``# TYPE`` per metric
+        name, escaped label values and help text, stable ordering
+        (counters, then gauges, then histograms; (name, labels)-sorted
+        within each)."""
         with self._mu:
             counters = sorted(self._counters.items())
             gauges = sorted(self._gauges.items())
@@ -180,17 +232,24 @@ class MetricsRegistry:
                 (k, self._hist_buckets[k[0]], list(v[0]), v[1], v[2])
                 for k, v in self._hists.items()
             )
+            help_texts = dict(self._help)
+
+        def _head(name: str, kind: str) -> None:
+            text = help_texts.get(name, f"dragonboat_tpu metric {name}")
+            out.write(f"# HELP {name} {escape_help_text(text)}\n")
+            out.write(f"# TYPE {name} {kind}\n")
+
         for kind, items in (("counter", counters), ("gauge", gauges)):
             prev = None
             for (name, labels), v in items:
                 if name != prev:
-                    out.write(f"# TYPE {name} {kind}\n")
+                    _head(name, kind)
                     prev = name
                 out.write(f"{self._fmt(name, labels, v)}\n")
         prev = None
         for (name, labels), bk, counts, total, count in hists:
             if name != prev:
-                out.write(f"# TYPE {name} histogram\n")
+                _head(name, "histogram")
                 prev = name
             cum = 0
             for le, c in zip(bk, counts):

@@ -149,6 +149,8 @@ class ProposalIngress:
         deadline = pp._clock.tick + node._timeout_ticks(timeout_s)
         from .requests import RequestState
 
+        tr = node.tracer
+        t0 = time.perf_counter() if tr is not None else 0.0
         states = []
         client_id, series_id = session.client_id, session.series_id
         responded_to = session.responded_to
@@ -158,6 +160,10 @@ class ProposalIngress:
             rs.client_id = client_id
             rs.series_id = series_id
             states.append(rs)
+        if tr is not None:
+            # contexts attach BEFORE the ring append, so the ingress
+            # stage measures the ring wait + batcher drain
+            tr.attach_all(states, node.cluster_id, t0)
         sh = self._shards[node.cluster_id % self.nshards]
         with sh.mu:
             # cap is in COMMANDS; an oversized burst on an otherwise
@@ -166,6 +172,12 @@ class ProposalIngress:
             if self._stopped or (
                 sh.ncmds and sh.ncmds + len(cmds) > sh.cap
             ):
+                if tr is not None:
+                    # the rejected futures never reach a tracker, so no
+                    # notify will ever finish their contexts — drop them
+                    # from the in-flight index or they leak to the
+                    # stall watchdog
+                    tr.discard(states)
                 raise SystemBusyError()
             sh.ring.append(
                 (node, states, cmds, client_id, series_id, responded_to)
@@ -307,6 +319,10 @@ class ProposalIngress:
                 # ``propose_batch`` (DROPPED futures, clients retry)
                 pp.dropped(e.key)
         node.nh.engine.set_step_ready(node.cluster_id)
+        tr = node.tracer
+        if tr is not None:
+            for rs in all_states:
+                tr.mark(rs, "ingress")
         return len(entries)
 
     # ---- lifecycle / test hooks ----

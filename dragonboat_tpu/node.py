@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import struct as _struct
 import threading
+import time
 from functools import lru_cache
 from typing import Dict, List, Optional
 
@@ -145,6 +146,12 @@ class Node:
         # entry_q lock + step wakeup per call.  None keeps the direct path
         # bit-identical.
         self.ingress = None
+        # cross-plane request tracer (obs/trace.py, ISSUE 9; set by
+        # NodeHost when NodeHostConfig.trace_sample_every > 0): propose/
+        # read allocate a sampled trace context on the future and the
+        # pipeline stages stamp it as the request passes.  None (default)
+        # keeps every request path bit-identical.
+        self.tracer = None
         # device-engine effect flags (written by the coordinator round
         # thread, max-merged/idempotent, applied under raftMu by
         # _apply_offload_effects on a step worker).  _off_mu guards the
@@ -447,6 +454,8 @@ class Node:
         # non-empty commands are stored as ENCODED entries: 1-byte
         # version/compression header (+ snappy when configured) — reference
         # requests.go:1038-1042 + rsm/encoded.go
+        tr = self.tracer
+        t0 = time.perf_counter() if tr is not None else 0.0
         entry_type = EntryType.APPLICATION
         if cmd:
             cmd = get_encoded_payload(self._entry_ct, cmd)
@@ -457,6 +466,8 @@ class Node:
         )
         entry.type = entry_type
         entry.responded_to = session.responded_to
+        if tr is not None:
+            tr.attach_one(rs, self.cluster_id, t0)
         # native fast lane: the index is assigned and the entry staged for
         # replication + WAL entirely in C++ (completion still arrives via
         # the normal apply -> pending_proposals.applied path).  A 0 return
@@ -466,11 +477,15 @@ class Node:
                 self.cluster_id, entry.key, entry.client_id, entry.series_id,
                 entry.responded_to, int(entry.type), cmd,
             ):
+                if tr is not None:
+                    tr.mark(rs, "ingress")
                 return rs
         if not self.entry_q.add(entry):
             self.pending_proposals.dropped(entry.key)
             raise SystemBusyError()
         self.nh.engine.set_step_ready(self.cluster_id)
+        if tr is not None:
+            tr.mark(rs, "ingress")
         return rs
 
     def propose_batch(
@@ -498,6 +513,8 @@ class Node:
         # encode in one pass — empty commands are never re-encoded, and
         # the separate any(enc) scan collapsed into the same loop
         # (PROFILE_e2e.txt propose-path leaves)
+        tr = self.tracer
+        t0 = time.perf_counter() if tr is not None else 0.0
         ct = self._entry_ct
         enc: List[bytes] = []
         has_encoded = False
@@ -515,6 +532,8 @@ class Node:
         for e in entries:
             e.type = entry_type if e.cmd else EntryType.APPLICATION
             e.responded_to = session.responded_to
+        if tr is not None:
+            tr.attach_all(states, self.cluster_id, t0)
         if self.fast_lane and self.fastlane is not None and all(
             e.type == entry_type for e in entries
         ):
@@ -526,6 +545,9 @@ class Node:
                 session.series_id, session.responded_to, int(entry_type),
                 blob,
             ):
+                if tr is not None:
+                    for rs in states:
+                        tr.mark(rs, "ingress")
                 return states
         ok = True
         for i, e in enumerate(entries):
@@ -536,6 +558,9 @@ class Node:
                 # future resolves like a single propose hitting a full queue
                 self.pending_proposals.dropped(e.key)
         self.nh.engine.set_step_ready(self.cluster_id)
+        if tr is not None:
+            for rs in states:
+                tr.mark(rs, "ingress")
         return states
 
     def propose_session(self, session: Session, timeout_s: float) -> RequestState:
@@ -566,7 +591,12 @@ class Node:
 
     def read(self, timeout_s: float) -> RequestState:
         self._check_user_op()
+        tr = self.tracer
+        t0 = time.perf_counter() if tr is not None else 0.0
         rs = self.pending_reads.read(self._timeout_ticks(timeout_s))
+        if tr is not None:
+            tr.attach_one(rs, self.cluster_id, t0, kind="read")
+            tr.mark(rs, "ingress")
         fl = self.fastlane
         if self.fast_lane and fl is not None:
             # native ReadIndex (natraft.cpp): the context rides hinted
@@ -1267,6 +1297,9 @@ class Node:
         if entries:
             self.quiesce_mgr.record_activity(MT.PROPOSE)
             self.peer.propose_entries(entries)
+            tr = self.tracer
+            if tr is not None:
+                tr.mark_entries(entries, "raft_step")
 
     def _handle_read_index(self) -> None:
         if self.pending_reads.peep():

@@ -7,11 +7,12 @@ with its change counter, tick fan-out and incoming-message routing.
 """
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 from . import vfs
 from .client import Session
@@ -299,6 +300,35 @@ class NodeHost:
                 # the device-plane coordinator feeds the same tier: its
                 # round fan-out coalesces step wakeups through the plane
                 self.quorum_coordinator.hostplane = self.hostplane
+        # cross-plane request tracing (obs/trace.py, ISSUE 9): allocate a
+        # sampled 1-in-N trace context at propose/read time and stamp it
+        # through ingress → raft step → WAL → device round → apply →
+        # egress.  OFF by default (trace_sample_every=0 and no env):
+        # nothing below is constructed and every request path keeps its
+        # bit-identical trace=None latch.
+        self.tracer = None
+        trace_n = nhconfig.trace_sample_every
+        if not trace_n:
+            try:
+                trace_n = int(os.environ.get("DBTPU_TRACE_SAMPLE", "0") or 0)
+            except ValueError:
+                # degrade like DBTPU_TRACE_STALL_MS: a malformed env var
+                # must not fail every NodeHost construction
+                plog.warning("malformed DBTPU_TRACE_SAMPLE; tracing off")
+                trace_n = 0
+        if trace_n > 0:
+            from .obs.trace import Tracer
+
+            self.tracer = Tracer(
+                sample_every=trace_n,
+                registry=self.raft_events.registry,
+                recorder=(
+                    self.quorum_coordinator.flight_recorder
+                    if self.quorum_coordinator is not None else None
+                ),
+            )
+            if self.quorum_coordinator is not None:
+                self.quorum_coordinator.tracer = self.tracer
         # engine
         workers = expert.step_worker_count or 4
         self.engine = Engine(
@@ -309,6 +339,14 @@ class NodeHost:
             get_csi=self._get_csi,
             hostplane=self.hostplane,
         )
+        if self.tracer is not None:
+            self.engine.tracer = self.tracer
+        # opt-in SIGUSR2 live-debug dump (ISSUE 9 satellite): the
+        # handler sets the flag; the tick worker performs the dump
+        self._dump_sig_old = None
+        self._dump_requested = False
+        if nhconfig.dump_signal:
+            self._install_dump_signal()
         # ticks
         self._tick_thread = threading.Thread(
             target=self._tick_worker_main, name="tick-worker", daemon=True
@@ -398,6 +436,73 @@ class NodeHost:
         coordinator is running with observability enabled)."""
         qc = self.quorum_coordinator
         return qc.flight_recorder if qc is not None else None
+
+    def dump_trace(self, path: Optional[str] = None,
+                   limit: Optional[int] = None) -> dict:
+        """Export the sampled request traces as Chrome-trace / Perfetto
+        JSON (one proposal = one flow across host threads and device
+        rounds; linked flight-recorder spans render on a
+        ``device-plane`` track).  Requires tracing
+        (``NodeHostConfig.trace_sample_every`` / ``DBTPU_TRACE_SAMPLE``).
+        Returns the trace dict; also writes it to ``path`` when given —
+        load the file at https://ui.perfetto.dev or about://tracing."""
+        if self.tracer is None:
+            raise RuntimeError(
+                "tracing is off — set NodeHostConfig.trace_sample_every"
+            )
+        d = self.tracer.export_chrome(limit=limit)
+        if path:
+            with open(path, "w") as f:
+                json.dump(d, f)
+        return d
+
+    def debug_dump(self, path: Optional[str] = None) -> str:
+        """Write the flight-recorder ring plus any in-flight/completed
+        sampled traces to a timestamped JSON file (the SIGUSR2 handler's
+        body; callable directly).  Returns the path written."""
+        d = {
+            "time": time.time(),
+            "raft_address": self.raft_address(),
+            "recorder": (
+                self.flight_recorder.to_json()
+                if self.flight_recorder is not None else None
+            ),
+            "traces": (
+                self.tracer.to_json() if self.tracer is not None else None
+            ),
+        }
+        if path is None:
+            base = self.nhconfig.node_host_dir
+            if not base or base == ":memory:":
+                import tempfile
+
+                base = tempfile.gettempdir()
+            path = os.path.join(
+                base,
+                time.strftime("dbtpu-dump-%Y%m%d-%H%M%S.json"),
+            )
+        with open(path, "w") as f:
+            json.dump(d, f, indent=1, default=str)
+        plog.warning("debug dump written to %s", path)
+        return path
+
+    def _install_dump_signal(self) -> None:
+        """Opt-in SIGUSR2 → :meth:`debug_dump` (live soak/chaos debugging
+        without attaching a debugger).  The handler only SETS A FLAG —
+        the dump runs on the tick worker: signal handlers execute on the
+        main thread mid-frame, and dumping inline would re-acquire
+        non-reentrant tracer/recorder locks the interrupted frame may
+        already hold (self-deadlock).  Signal handlers only install from
+        the main thread; elsewhere the opt-in degrades to a warning."""
+        import signal as _signal
+
+        def _handler(signum, frame):
+            self._dump_requested = True
+
+        try:
+            self._dump_sig_old = _signal.signal(_signal.SIGUSR2, _handler)
+        except (ValueError, OSError, AttributeError) as e:
+            plog.warning("SIGUSR2 dump handler unavailable: %r", e)
 
     # ---- cluster registry ----
 
@@ -552,6 +657,9 @@ class NodeHost:
             node.ingress = self.hostplane.ingress
             node.pending_proposals.set_egress(self.hostplane.egress)
             node.pending_reads.set_egress(self.hostplane.egress)
+        if self.tracer is not None:
+            node.tracer = self.tracer
+            node.pending_reads._tracer = self.tracer
         node.start(addresses, initial=not join and new_node, new_node=new_node)
         with self._mu:
             self._clusters[cluster_id] = node
@@ -617,6 +725,16 @@ class NodeHost:
         self.logdb.close()
         if self.server_ctx is not None:
             self.server_ctx.stop()
+        if self.tracer is not None:
+            self.tracer.close()
+        if self._dump_sig_old is not None:
+            import signal as _signal
+
+            try:
+                _signal.signal(_signal.SIGUSR2, self._dump_sig_old)
+            except (ValueError, OSError):
+                pass
+            self._dump_sig_old = None
         self.sys_events.stop()
 
     # ---- proposals / reads (reference SyncPropose :523, SyncRead :548) ----
@@ -1090,6 +1208,21 @@ class NodeHost:
             if self.quorum_coordinator is not None:
                 # one device tick round per RTT for ALL registered groups
                 self.quorum_coordinator.request_tick()
+            tracer = self.tracer
+            if tracer is not None:
+                # stage-level stall watchdog (ISSUE 9): a sampled request
+                # stuck >stall_ms in one stage auto-dumps its partial
+                # trace + the recorder ring.  Fast path (nothing sampled
+                # in flight) is two dict truthiness checks per RTT.
+                tracer.check_stalls()
+            if self._dump_requested:
+                # SIGUSR2 arrived: run the dump HERE, not in the signal
+                # handler (non-reentrant locks; see _install_dump_signal)
+                self._dump_requested = False
+                try:
+                    self.debug_dump()
+                except Exception:
+                    plog.exception("SIGUSR2 debug dump failed")
             self.snapshot_feedback.push_ready(self._now_ms())
             if ticks % max(1, int(1.0 / max(interval, 0.001))) == 0:
                 self.transport.tick()

@@ -19,7 +19,13 @@ handling lives on cheap continuous telemetry"):
   and latency histograms published into the existing
   :class:`dragonboat_tpu.events.MetricsRegistry`, so
   ``write_health_metrics`` exposes device-plane health next to the
-  transport/node counters.
+  transport/node counters;
+- :mod:`trace` — cross-plane REQUEST tracing (ISSUE 9): a sampled
+  1-in-N of proposals/reads carries a per-stage trace context through
+  ingress → raft step → WAL → device round → apply → egress, with
+  stage histograms, a Perfetto/Chrome-trace export
+  (``NodeHost.dump_trace``) and a stage-level stall watchdog that
+  dumps the stuck request's partial trace plus this recorder's ring.
 
 Overhead contract (the ``_read_plane_used`` precedent; PR 3 took a −43%
 host-path regression from ungated per-transition work): observability is

@@ -58,6 +58,59 @@ _DEV = "dragonboat_device_"
 _COORD = "dragonboat_coord_"
 _HOST = "dragonboat_host_"
 
+#: ``# HELP`` text per family (ISSUE 9 satellite: the exposition was
+#: ``# TYPE``-only).  Families not listed fall back to the registry's
+#: deterministic placeholder.
+_HELP = {
+    _DEV + "dispatch_total": "device programs launched",
+    _DEV + "rounds_total": "scanned rounds across device dispatches",
+    _DEV + "acks_staged_total": "replicate acks ingested by dispatches",
+    _DEV + "votes_staged_total": "votes ingested by dispatches",
+    _DEV + "recycles_total": "in-program membership recycles",
+    _DEV + "reads_staged_total": "ReadIndex batches staged on device",
+    _DEV + "read_echoes_total": "heartbeat read-echoes staged on device",
+    _DEV + "reads_released_total": "client reads released by confirmed slots",
+    _DEV + "upload_bytes_total": "host-to-device event tensor bytes",
+    _DEV + "egress_rows_total": "rows whose commit watermark advanced",
+    _DEV + "multidev_wait_ms_total": "milliseconds waiting on _MULTIDEV_MU",
+    _DEV + "stalls_total": "stall-watchdog-flagged dispatch spans",
+    _DEV + "warmup_seconds": "wall seconds spent AOT warm-compiling",
+    _DEV + "warmup_programs_total": "device programs AOT warm-compiled",
+    _DEV + "staged_rounds": "egress/dispatch round queue depth",
+    _DEV + "read_slots_in_use": "pending-read engine slots occupied",
+    _DEV + "dispatch_latency_ms": "host stage+launch wall time per dispatch",
+    _DEV + "egress_latency_ms": "blocking device-to-host egress wall time",
+    _COORD + "rounds_total": "coordinator rounds dispatched",
+    _COORD + "round_latency_ms": "whole-round wall time",
+    _COORD + "ops_drained_total": "staged ops drained into the engine",
+    _COORD + "tick_deficit_total": "host ticks replayed by rounds",
+    _COORD + "commits_offloaded_total": "group commits offloaded to nodes",
+    _COORD + "reads_confirmed_total": "ReadIndex ctxs confirmed on device",
+    _COORD + "fused_dispatch_total": "rounds served by one fused dispatch",
+    _COORD + "fused_rounds_total": "rounds carried by fused dispatches",
+    _COORD + "staged_depth": "ops staged for the next round",
+    _COORD + "read_fallbacks": "read echoes tallied scalar-side",
+    _HOST + "ingress_submitted_total": "commands accepted into ingress rings",
+    _HOST + "ingress_drains_total": "ingress batcher drain cycles",
+    _HOST + "ingress_drained_total": "commands drained by the batcher",
+    _HOST + "ingress_ring_depth": "commands still ringed after a drain",
+    _HOST + "wal_flushes_total": "group-commit WAL flush cycles",
+    _HOST + "wal_riders_total": "committer submissions merged into cycles",
+    _HOST + "wal_updates_total": "raft updates persisted by the WAL tier",
+    _HOST + "wal_amortization": "committer submissions per fsync cycle",
+    _HOST + "wal_flush_latency_ms": "merged save+fsync wall time",
+    _HOST + "apply_batches_total": "decoupled apply executor wakeups",
+    _HOST + "apply_groups_total": "groups covered by apply batches",
+    _HOST + "egress_notified_total": "client completions delivered off-worker",
+}
+
+
+def _describe(registry: MetricsRegistry, names) -> None:
+    for name in names:
+        text = _HELP.get(name)
+        if text:
+            registry.describe(name, text)
+
 
 class EngineObs:
     """Device-plane instruments for one ``BatchedQuorumEngine``.
@@ -95,6 +148,10 @@ class EngineObs:
         self.recorder = recorder
         self.registry = registry or DEFAULT_REGISTRY
         r = self.registry
+        _describe(r, self._COUNTERS + (
+            _DEV + "staged_rounds", _DEV + "read_slots_in_use",
+            _DEV + "dispatch_latency_ms", _DEV + "egress_latency_ms",
+        ))
         for name in self._COUNTERS:
             r.counter_add(name, 0)
         r.gauge_set(_DEV + "staged_rounds", 0)
@@ -270,6 +327,10 @@ class HostObs:
         self.recorder = recorder or default_recorder()
         self.registry = registry or DEFAULT_REGISTRY
         r = self.registry
+        _describe(r, self._COUNTERS + (
+            _HOST + "ingress_ring_depth", _HOST + "wal_amortization",
+            _HOST + "wal_flush_latency_ms",
+        ))
         for name in self._COUNTERS:
             r.counter_add(name, 0)
         r.gauge_set(_HOST + "ingress_ring_depth", 0)
@@ -352,6 +413,10 @@ class CoordObs:
         self.recorder = recorder
         self.registry = registry or DEFAULT_REGISTRY
         r = self.registry
+        _describe(r, self._COUNTERS + (
+            _COORD + "staged_depth", _COORD + "read_fallbacks",
+            _COORD + "round_latency_ms",
+        ))
         for name in self._COUNTERS:
             r.counter_add(name, 0)
         r.gauge_set(_COORD + "staged_depth", 0)

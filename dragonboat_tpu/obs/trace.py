@@ -1,0 +1,804 @@
+"""Cross-plane request tracing: follow one proposal (or read) through
+every host-plane stage and device round (ISSUE 9 tentpole).
+
+The host plane is a multi-stage pipeline (ingress rings → batcher →
+raft step → group-commit WAL → device dispatch → apply pool → egress
+sink), but per-stage aggregates cannot say which stage owns a given
+request's tail latency.  This module adds the missing connective
+tissue: a lightweight trace context allocated at ``propose`` /
+``read`` time for a sampled 1-in-N of requests and carried through the
+``RequestState`` future; each pipeline stage stamps the context as the
+request passes, and the coordinator links the FlightRecorder span seq
+of the device round that carried its commit.  The result is
+
+- per-stage latency histograms ``dragonboat_trace_stage_seconds{stage}``
+  (stage = time from the previous stamp to this one) plus an always-on
+  end-to-end histogram ``dragonboat_trace_e2e_seconds`` fed by every
+  request (non-sampled requests carry only a single monotonic enqueue
+  timestamp — no allocation, no registration);
+- an exportable Chrome-trace / Perfetto JSON (``NodeHost.dump_trace``)
+  where one request renders as ONE flow across host threads and device
+  rounds (flow events bind the stage slices; linked recorder spans are
+  emitted on a ``device-plane`` track);
+- a stage-level stall watchdog: a sampled request stuck longer than
+  ``stall_ms`` in any one stage auto-dumps its partial trace PLUS the
+  flight-recorder ring (the cross-plane twin of the recorder's own
+  span watchdog).
+
+Stage vocabulary (a request only carries the stages its path visits):
+
+==============  =========================================================
+stage           stamped when
+==============  =========================================================
+``propose``     the trace is allocated (t0; the enqueue timestamp)
+``ingress``     the entry is staged for raft — after ``entry_q.add`` /
+                the native fast-lane append on the direct path, after
+                the batcher drain on the compartmentalized path (so the
+                ring wait + drain time is the ingress stage)
+``raft_step``   raft ingested the entry (``peer.propose_entries``); for
+                reads: the ReadIndex ctx was submitted
+``wal``         the update carrying the entry is fsynced (committer /
+                group-commit WAL release)
+``device_round``the coordinator round whose dispatch released the
+                group's commit (tpu engine only; replace-style — the
+                LAST such round before apply wins — and the recorder
+                span seq is linked into ``Trace.spans``)
+``read_confirm``the ReadIndex ctx was quorum-confirmed (reads only)
+``apply``       the user SM applied the entry / the read's apply
+                watermark was reached
+``egress``      the client future was notified (trace completes)
+==============  =========================================================
+
+Overhead contract (the PR-5 ``is not None`` latch precedent): tracing
+is OFF by default — ``NodeHost.tracer`` / ``Node.tracer`` /
+``Engine.tracer`` / coordinator ``tracer`` stay ``None``,
+``RequestState.trace`` stays ``None``, and every hot-path hook gates on
+a plain attribute check, so the trace-off host path is bit-identical.
+Trace-ON overhead is measured by the bench trace axis
+(``bench_e2e.run_trace_axis``, <5% asserted on the fused host loop).
+"""
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..events import DEFAULT_REGISTRY, MetricsRegistry
+from ..logger import get_logger
+
+plog = get_logger("trace")
+
+_T = "dragonboat_trace_"
+
+#: seconds-scale stage/e2e histogram buckets: sub-ms direct-path stages
+#: at the bottom, a wedged WAL or tunnel stall at the top
+STAGE_BUCKETS_S = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0,
+)
+
+#: newest-enabled tracer — introspection only (``active()``); every
+#: request token carries its owning tracer, so completions never route
+#: through this global.
+_ACTIVE: Optional["Tracer"] = None
+
+
+def _default_stall_ms() -> float:
+    try:
+        return float(os.environ.get("DBTPU_TRACE_STALL_MS", "1000"))
+    except ValueError:
+        plog.warning("malformed DBTPU_TRACE_STALL_MS; using 1000")
+        return 1000.0
+
+
+class Trace:
+    """One sampled request's context: an append-only list of
+    ``[stage, perf_counter_t, thread_name]`` stamps plus the recorder
+    span seqs linked along the way.  Mutation is GIL-atomic appends from
+    the pipeline threads; the tracer's lock guards only the in-flight
+    index, never the stamp path."""
+
+    __slots__ = (
+        "tracer", "tid", "kind", "cluster_id", "key", "t0",
+        "events", "spans", "outcome", "stalled", "done",
+        "applied", "_round_ev",
+    )
+
+    def __init__(self, tracer: "Tracer", tid: int, kind: str,
+                 cluster_id: int, key: int, t0: float):
+        self.tracer = tracer
+        self.tid = tid
+        self.kind = kind
+        self.cluster_id = cluster_id
+        self.key = key
+        self.t0 = t0
+        self.events: List[list] = [["propose", t0, _tname()]]
+        self.spans: List[int] = []
+        self.outcome: Optional[str] = None
+        self.stalled: Optional[str] = None
+        self.done = False
+        self.applied = False       # an "apply" stamp landed
+        self._round_ev = None      # cached device_round event (replace)
+
+    def add(self, stage: str) -> None:
+        self.events.append([stage, time.perf_counter(), _tname()])
+        if stage == "apply":
+            self.applied = True
+
+    def add_round(self, span_seq: Optional[int], now: float,
+                  thread: str) -> None:
+        """Replace-style ``device_round`` stamp: a request can sit through
+        several coordinator rounds while waiting for apply — the LAST
+        round before apply is the one whose dispatch released its commit,
+        so later stamps overwrite earlier ones (every linked span seq is
+        kept in ``spans`` for the flow export).  Runs once per in-flight
+        trace per commit round — the caller hoists the timestamp/thread
+        lookup so this is flag checks plus two list stores (a
+        per-trace ``perf_counter`` here measured ~10% off the tpu e2e
+        loop on the 1-vCPU box)."""
+        if self.applied or self.done:
+            # already applied: a later round touching this group can no
+            # longer be the one that released this request
+            return
+        if span_seq is not None and (
+            not self.spans or self.spans[-1] != span_seq
+        ):
+            self.spans.append(span_seq)
+        ev = self._round_ev
+        if ev is not None:
+            ev[1] = now
+            ev[2] = thread
+        else:
+            self._round_ev = ev = ["device_round", now, thread]
+            self.events.append(ev)
+
+    def to_dict(self) -> dict:
+        """JSON-ready snapshot (stall dumps, SIGUSR2 debug dumps)."""
+        t0 = self.t0
+        return {
+            "trace_id": self.tid,
+            "kind": self.kind,
+            "cluster_id": self.cluster_id,
+            "key": self.key,
+            "outcome": self.outcome,
+            "stalled": self.stalled,
+            "done": self.done,
+            "spans": list(self.spans),
+            "events": [
+                {
+                    "stage": s,
+                    "t_ms": round((t - t0) * 1e3, 4),
+                    "thread": th,
+                }
+                for s, t, th in sorted(self.events, key=lambda e: e[1])
+            ],
+        }
+
+
+def _tname() -> str:
+    return threading.current_thread().name
+
+
+class Tracer:
+    """Sampling allocator + in-flight index + stage histogram publisher.
+
+    ``sample_every=N`` traces 1 request in N (N=1 traces everything —
+    tests and targeted debugging).  Hot-path cost for the other N-1:
+    one float timestamp on the future and one e2e histogram observation
+    at completion.  The in-flight index is keyed two ways: by entry key
+    (``mark_entries``/``mark_updates`` — the raft-step and WAL hooks see
+    entries, not futures) and by cluster id (``mark_clusters`` — the
+    coordinator round fan-out sees groups)."""
+
+    def __init__(
+        self,
+        sample_every: int = 64,
+        registry: Optional[MetricsRegistry] = None,
+        recorder=None,
+        stall_ms: Optional[float] = None,
+        keep: int = 256,
+    ):
+        if sample_every < 1:
+            raise ValueError("sample_every must be >= 1")
+        self.sample_every = int(sample_every)
+        self.registry = registry or DEFAULT_REGISTRY
+        self.recorder = recorder  # FlightRecorder or None
+        self.stall_ms = (
+            _default_stall_ms() if stall_ms is None else float(stall_ms)
+        )
+        self.dump_path = os.environ.get("DBTPU_TRACE_DUMP")
+        self._mu = threading.Lock()
+        self._n = 0          # requests seen (sampling counter)
+        self._tid = 0        # trace ids
+        self._by_key: Dict[int, Trace] = {}
+        self._by_cluster: Dict[int, set] = {}
+        self._done: deque = deque(maxlen=max(1, keep))
+        self.sampled = 0
+        self.completed = 0
+        self.discarded = 0  # contexts whose submission was rejected
+        self.stall_dumps = 0
+        self.last_stall_dump: Optional[dict] = None
+        # ---- local metric accumulators (hot-path cost control) -------
+        # The propose/notify paths run at full request rate; a registry
+        # histogram observe per completion (lock + label-key build)
+        # measured ~20% off on the 1-vCPU e2e loop.  Observations land
+        # in these plain lists under the tracer's own lock and flush to
+        # the registry in ONE merge per tick (check_stalls) or when the
+        # last in-flight trace completes — exposition lag <= one RTT.
+        self._bk = STAGE_BUCKETS_S
+        nb = len(self._bk) + 1
+        self._e2e_acc = [[0] * nb, 0.0, 0]        # counts, sum, n
+        self._stage_acc: Dict[str, list] = {}     # stage -> same shape
+        self._pend_requests = 0
+        self._pend_sampled = 0
+        self._pend_completed = 0
+        # clock anchor: stamps are perf_counter (monotonic); the export
+        # maps them onto the wall clock the recorder spans already use
+        self._wall0 = time.time()
+        self._pc0 = time.perf_counter()
+        r = self.registry
+        r.describe(
+            _T + "requests_total",
+            "requests that entered the traced pipeline (sampled or not)",
+        )
+        r.describe(_T + "sampled_total", "requests allocated a full trace")
+        r.describe(_T + "completed_total", "sampled traces completed")
+        r.describe(
+            _T + "stalls_total",
+            "sampled requests stuck >stall_ms in one stage (auto-dumped)",
+        )
+        r.describe(_T + "inflight", "sampled traces currently in flight")
+        r.describe(
+            _T + "stage_seconds",
+            "per-stage latency of sampled requests (time from the "
+            "previous pipeline stamp to this stage's stamp)",
+        )
+        r.describe(
+            _T + "e2e_seconds",
+            "end-to-end request latency (enqueue to future notify), "
+            "observed for EVERY request while tracing is on",
+        )
+        r.counter_add(_T + "requests_total", 0)
+        r.counter_add(_T + "sampled_total", 0)
+        r.counter_add(_T + "completed_total", 0)
+        r.counter_add(_T + "stalls_total", 0)
+        r.gauge_set(_T + "inflight", 0)
+        r.histogram_declare(_T + "e2e_seconds", buckets=STAGE_BUCKETS_S)
+        global _ACTIVE
+        _ACTIVE = self
+
+    # ------------------------------------------------------------------
+    # allocation (propose / read time)
+    # ------------------------------------------------------------------
+
+    def attach_all(self, states, cluster_id: int, t0: float,
+                   kind: str = "write") -> None:
+        """Allocate contexts for a burst of freshly created futures:
+        1-in-N gets a :class:`Trace` (registered by key + cluster), the
+        rest share one ``(tracer, t0)`` token (the always-on enqueue
+        timestamp feeding the e2e histogram at notify).  The common
+        no-sample-in-this-burst case touches one lock and one attribute
+        store per future — nothing else."""
+        n = self.sample_every
+        nstates = len(states)
+        tok = (self, t0)  # ONE shared token per burst: non-sampled
+        # futures carry (tracer, t0) so completion observes e2e into the
+        # tracer that owns them (a module-global sink misattributed
+        # multi-NodeHost processes), at zero per-request allocation
+        with self._mu:
+            base = self._n
+            self._n = base + nstates
+            self._pend_requests += nstates
+            first = (-base) % n  # index of the first sampled slot
+            if first >= nstates:
+                for rs in states:
+                    rs.trace = tok
+                return
+            sampled = []
+            for i, rs in enumerate(states):
+                if (i - first) % n == 0:
+                    self._tid += 1
+                    tr = Trace(self, self._tid, kind, cluster_id,
+                               rs.key, t0)
+                    rs.trace = tr
+                    if rs.key:
+                        self._by_key[rs.key] = tr
+                    self._by_cluster.setdefault(cluster_id, set()).add(tr)
+                    self.sampled += 1
+                    self._pend_sampled += 1
+                    sampled.append(rs)
+                else:
+                    rs.trace = tok
+        # a future that completed before its context landed (the pipeline
+        # can beat the attach on a hot box) must not leak in flight
+        for rs in sampled:
+            if rs.done():
+                self.finish(rs.trace, rs.trace.outcome or "completed")
+
+    def attach_one(self, rs, cluster_id: int, t0: float,
+                   kind: str = "write") -> None:
+        self.attach_all((rs,), cluster_id, t0, kind=kind)
+
+    def discard(self, states) -> None:
+        """Unregister contexts whose submission failed BEFORE the future
+        could ever be notified (e.g. the ingress ring-cap SystemBusy
+        raise happens after attach but before the futures reach any
+        tracker — no notify will ever finish these, so they must not
+        linger in flight for the stall watchdog to chase)."""
+        with self._mu:
+            for rs in states:
+                t = rs.trace
+                if t.__class__ is not Trace or t.done:
+                    continue
+                t.done = True
+                t.outcome = "unsubmitted"
+                if t.key:
+                    self._by_key.pop(t.key, None)
+                s = self._by_cluster.get(t.cluster_id)
+                if s is not None:
+                    s.discard(t)
+                    if not s:
+                        del self._by_cluster[t.cluster_id]
+                # sampled_total is NOT decremented: the sample did
+                # happen, and a tick flush may already have published it
+                # — a negative delta would read as a Prometheus counter
+                # reset.  sampled - completed - inflight = discarded.
+                self.discarded += 1
+
+    # ------------------------------------------------------------------
+    # stage stamps (pipeline hooks)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def mark(rs, stage: str) -> None:
+        """Stamp a stage on a future's trace (no-op for the non-sampled
+        token, and for a COMPLETED trace — a burst's dropped tail
+        finishes before the caller's post-staging mark loop runs, and a
+        post-egress stamp would corrupt the time-sorted export).
+        Callers gate on ``rs.trace is not None`` first."""
+        t = rs.trace
+        if t.__class__ is Trace and not t.done:
+            t.add(stage)
+
+    def mark_entries(self, entries, stage: str) -> None:
+        """Stamp by entry key (raft-step hook: the staged entries are in
+        hand, the futures are not)."""
+        bk = self._by_key
+        if not bk:
+            return
+        for e in entries:
+            t = bk.get(e.key)
+            if t is not None and not t.done:
+                t.add(stage)
+
+    def mark_updates(self, updates, stage: str) -> None:
+        """Stamp every sampled entry carried by a persisted update batch
+        (WAL hook, after the fsync)."""
+        bk = self._by_key
+        if not bk:
+            return
+        for ud in updates:
+            for e in ud.entries_to_save:
+                t = bk.get(e.key)
+                if t is not None and not t.done:
+                    t.add(stage)
+
+    def mark_clusters(self, cids, span_seq: Optional[int] = None) -> None:
+        """The coordinator round released commits/read-confirms for these
+        groups: stamp ``device_round`` (replace-style) on every in-flight
+        trace of those groups and link the dispatch span seq."""
+        if not self._by_cluster:
+            return
+        now = time.perf_counter()
+        thread = _tname()
+        with self._mu:
+            # stamp UNDER the lock: every set mutator (attach/finish/
+            # discard) holds _mu too, so direct iteration is safe and
+            # skips a per-round snapshot list — this runs on the
+            # coordinator round thread, the tpu path's bottleneck, so
+            # per-round allocations here are throughput (one lock per
+            # ROUND, add_round is flag checks + two list stores)
+            bc = self._by_cluster
+            get = bc.get
+            for cid in cids:
+                for t in get(cid, ()):
+                    t.add_round(span_seq, now, thread)
+
+    # ------------------------------------------------------------------
+    # completion
+    # ------------------------------------------------------------------
+
+    def _acc(self, acc: list, seconds: float) -> None:
+        """Accumulate one observation into a local [counts, sum, n]
+        triple; caller holds ``_mu``."""
+        acc[0][bisect.bisect_left(self._bk, seconds)] += 1
+        acc[1] += seconds
+        acc[2] += 1
+
+    def observe_e2e(self, seconds: float) -> None:
+        with self._mu:
+            self._acc(self._e2e_acc, seconds)
+
+    def finish(self, trace: Trace, outcome: str) -> None:
+        """Trace completes (future notified): final ``egress`` stamp,
+        stage + e2e observations (accumulated locally; flushed to the
+        registry on the tick cadence), move to the completed ring."""
+        with self._mu:
+            # atomic claim: attach_all's already-done cleanup and the
+            # notify thread's request_done can race here — exactly one
+            # may run the completion half
+            if trace.done:
+                return
+            trace.done = True
+        trace.outcome = outcome
+        trace.add("egress")
+        evs = sorted(trace.events, key=lambda e: e[1])
+        with self._mu:
+            if trace.key:
+                self._by_key.pop(trace.key, None)
+            s = self._by_cluster.get(trace.cluster_id)
+            if s is not None:
+                s.discard(trace)
+                if not s:
+                    del self._by_cluster[trace.cluster_id]
+            self._done.append(trace)
+            prev = evs[0][1]
+            for stage, t, _th in evs[1:]:
+                acc = self._stage_acc.get(stage)
+                if acc is None:
+                    acc = self._stage_acc[stage] = [
+                        [0] * (len(self._bk) + 1), 0.0, 0,
+                    ]
+                self._acc(acc, max(0.0, t - prev))
+                prev = t
+            self._acc(self._e2e_acc, max(0.0, evs[-1][1] - trace.t0))
+            self._pend_completed += 1
+            idle = not self._by_cluster
+        self.completed += 1
+        if idle:
+            # the last in-flight trace just completed: flush now so a
+            # quiet scrape (or a test right after the load) sees it —
+            # under sustained load the tick-worker flush covers instead
+            self.flush_metrics()
+
+    def flush_metrics(self) -> None:
+        """Publish the locally accumulated observations to the registry
+        in one pass (called by the NodeHost tick worker via
+        :meth:`check_stalls`, on going idle, and at :meth:`close`)."""
+        with self._mu:
+            e2e, self._e2e_acc = self._e2e_acc, [
+                [0] * (len(self._bk) + 1), 0.0, 0,
+            ]
+            stages, self._stage_acc = self._stage_acc, {}
+            reqs, self._pend_requests = self._pend_requests, 0
+            samp, self._pend_sampled = self._pend_sampled, 0
+            comp, self._pend_completed = self._pend_completed, 0
+            inflight = sum(len(v) for v in self._by_cluster.values())
+        reg = self.registry
+        if reqs:
+            reg.counter_add(_T + "requests_total", reqs)
+        if samp:
+            reg.counter_add(_T + "sampled_total", samp)
+        if comp:
+            reg.counter_add(_T + "completed_total", comp)
+        if samp or comp:
+            reg.gauge_set(_T + "inflight", inflight)
+        if e2e[2]:
+            reg.histogram_merge(
+                _T + "e2e_seconds", e2e[0], e2e[1], e2e[2],
+                buckets=self._bk,
+            )
+        for stage, acc in stages.items():
+            reg.histogram_merge(
+                _T + "stage_seconds", acc[0], acc[1], acc[2],
+                labels={"stage": stage}, buckets=self._bk,
+            )
+
+    def close(self) -> None:
+        """Flush and detach from the module-level e2e sink
+        (NodeHost.stop)."""
+        global _ACTIVE
+        if _ACTIVE is self:
+            _ACTIVE = None
+        self.flush_metrics()
+
+    # ------------------------------------------------------------------
+    # stall watchdog (the host-stage extension of the recorder's)
+    # ------------------------------------------------------------------
+
+    def check_stalls(self) -> int:
+        """Scan in-flight traces for one stuck longer than ``stall_ms``
+        since its last stamp; each trips at most once and auto-dumps its
+        partial trace plus the recorder ring.  Driven by the NodeHost
+        tick worker (and callable on demand); returns newly stalled
+        count.  Doubles as the metric-flush cadence.  The fast path —
+        nothing sampled in flight, nothing pending — is a few
+        truthiness checks."""
+        if self._pend_requests or self._pend_completed or self._e2e_acc[2]:
+            self.flush_metrics()
+        if not self._by_cluster and not self._by_key:
+            return 0
+        th = self.stall_ms
+        if th <= 0:
+            return 0
+        now = time.perf_counter()
+        with self._mu:
+            traces = {t for s in self._by_cluster.values() for t in s}
+            traces.update(self._by_key.values())
+        newly: List[Trace] = []
+        for t in traces:
+            if t.done or t.stalled:
+                continue
+            evs = t.events
+            if not evs:
+                continue
+            last_stage, last_t, _ = max(evs, key=lambda e: e[1])
+            if (now - last_t) * 1e3 >= th:
+                t.stalled = last_stage
+                newly.append(t)
+        if newly:
+            self.registry.counter_add(_T + "stalls_total", len(newly))
+            # ONE aggregate dump per pass: a systemic stall trips many
+            # sampled traces at once, and per-trace dumps would
+            # serialize the recorder ring N times inline on the tick
+            # worker — the thread driving raft timers — exactly when
+            # the system is already degraded
+            self._stall_dump(newly, now)
+        return len(newly)
+
+    def _stall_dump(self, stalled: List[Trace], now: float) -> None:
+        head = stalled[0]
+        last_t = max(e[1] for e in head.events)
+        d = {
+            "reason": (
+                f"trace-stall: {len(stalled)} sampled request(s) stuck "
+                f">= {self.stall_ms:g}ms in one stage (first: {head.kind} "
+                f"trace {head.tid}, {(now - last_t) * 1e3:.0f}ms after "
+                f"stage {head.stalled!r})"
+            ),
+            "time": time.time(),
+            "trace": head.to_dict(),  # the first/triggering trace
+            "traces": [t.to_dict() for t in stalled],
+            "recorder": (
+                self.recorder.to_json() if self.recorder is not None
+                else None
+            ),
+        }
+        self.last_stall_dump = d
+        self.stall_dumps += 1
+        path = self.dump_path
+        if path:
+            try:
+                with open(path, "w") as f:
+                    json.dump(d, f, indent=1, default=str)
+            except OSError as e:
+                plog.warning("trace stall dump to %s failed: %r", path, e)
+        plog.warning("%s%s", d["reason"], f" -> {path}" if path else "")
+
+    # ------------------------------------------------------------------
+    # introspection / export
+    # ------------------------------------------------------------------
+
+    def reset_completed(self, keep: Optional[int] = None) -> None:
+        """Clear the completed-trace ring (optionally resizing it) —
+        bench phases scope an attribution measurement to one window this
+        way; steady state keeps the bounded default."""
+        with self._mu:
+            self._done = deque(maxlen=max(1, keep or self._done.maxlen))
+
+    def inflight(self) -> List[Trace]:
+        with self._mu:
+            s = {t for v in self._by_cluster.values() for t in v}
+            s.update(self._by_key.values())
+            return sorted(s, key=lambda t: t.tid)
+
+    def traces(self) -> List[Trace]:
+        """Completed (oldest→newest) then in-flight traces."""
+        with self._mu:
+            done = list(self._done)
+        return done + [t for t in self.inflight() if t not in done]
+
+    def to_json(self) -> dict:
+        return {
+            "sample_every": self.sample_every,
+            "requests": self._n,
+            "sampled": self.sampled,
+            "completed": self.completed,
+            "discarded": self.discarded,
+            "stall_dumps": self.stall_dumps,
+            "inflight": [t.to_dict() for t in self.inflight()],
+            "traces": [t.to_dict() for t in self.traces() if t.done],
+        }
+
+    def stage_stats(self) -> dict:
+        """Per-stage p50/p99 (ms) + share-of-e2e over the completed ring
+        — the data behind the perf ledger's latency-attribution table."""
+        with self._mu:
+            done = list(self._done)
+        return compute_stage_stats(done)
+
+    def _wall_us(self, t_perf: float) -> float:
+        return (self._wall0 + (t_perf - self._pc0)) * 1e6
+
+    def export_chrome(self, include_recorder: bool = True,
+                      limit: Optional[int] = None) -> dict:
+        """Chrome-trace / Perfetto JSON: each sampled request is a chain
+        of ``X`` slices (one per stage, on the thread that stamped it)
+        bound into ONE flow by ``s``/``t``/``f`` events with
+        ``id=trace_id``; linked recorder spans render on a
+        ``device-plane`` track next to them.  Load in Perfetto / about:
+        //tracing, or ship to teammates as-is."""
+        events: List[dict] = []
+        tids: Dict[str, int] = {}
+
+        def tid_of(name: str) -> int:
+            tid = tids.get(name)
+            if tid is None:
+                tid = tids[name] = len(tids) + 1
+                events.append({
+                    "ph": "M", "name": "thread_name", "pid": 1,
+                    "tid": tid, "args": {"name": name},
+                })
+            return tid
+
+        traces = self.traces()
+        if limit is not None:
+            traces = traces[-limit:]
+        for t in traces:
+            evs = sorted(t.events, key=lambda e: e[1])
+            if len(evs) < 2:
+                continue
+            flow = []
+            prev_t = evs[0][1]
+            for stage, ts, thread in evs[1:]:
+                tid = tid_of(thread)
+                ev = {
+                    "name": stage,
+                    "cat": t.kind,
+                    "ph": "X",
+                    "pid": 1,
+                    "tid": tid,
+                    "ts": round(self._wall_us(prev_t), 1),
+                    "dur": round(max(0.0, ts - prev_t) * 1e6, 1),
+                    "args": {
+                        "trace_id": t.tid,
+                        "cluster_id": t.cluster_id,
+                        "outcome": t.outcome,
+                    },
+                }
+                if stage == "device_round" and t.spans:
+                    ev["args"]["recorder_spans"] = list(t.spans)
+                events.append(ev)
+                flow.append((tid, prev_t))
+                prev_t = ts
+            flow.append((tid_of(evs[-1][2]), prev_t))
+            for i, (tid, ts) in enumerate(flow):
+                ph = "s" if i == 0 else ("f" if i == len(flow) - 1 else "t")
+                ev = {
+                    "name": f"{t.kind}-{t.tid}",
+                    "cat": "request",
+                    "ph": ph,
+                    "id": t.tid,
+                    "pid": 1,
+                    "tid": tid,
+                    "ts": round(self._wall_us(ts), 1),
+                }
+                if ph == "f":
+                    ev["bp"] = "e"
+                events.append(ev)
+        if include_recorder and self.recorder is not None:
+            dev_tid = tid_of("device-plane")
+            for span in self.recorder.spans():
+                ts = span.get("ts")
+                if ts is None:
+                    continue
+                dur_ms = span.get("wall_ms") or (
+                    (span.get("dispatch_ms") or 0.0)
+                    + (span.get("egress_ms") or 0.0)
+                )
+                events.append({
+                    "name": span.get("kind", "span"),
+                    "cat": "device",
+                    "ph": "X",
+                    "pid": 1,
+                    "tid": dev_tid,
+                    "ts": round(ts * 1e6, 1),
+                    "dur": round(max(dur_ms, 0.001) * 1e3, 1),
+                    "args": {
+                        k: v for k, v in span.items()
+                        if k not in ("ts",)
+                    },
+                })
+        return {
+            "displayTimeUnit": "ms",
+            "traceEvents": events,
+            "metadata": {
+                "tracer": {
+                    "sample_every": self.sample_every,
+                    "requests": self._n,
+                    "sampled": self.sampled,
+                    "completed": self.completed,
+                },
+            },
+        }
+
+
+def compute_stage_stats(traces) -> dict:
+    """Per-stage p50/p99 (ms) + share-of-e2e over completed traces —
+    ONE implementation serving both ``Tracer.stage_stats`` and the
+    bench trace axis's cross-host merge (nearest-rank percentiles, so
+    the two surfaces can never disagree on identical data)."""
+    per: Dict[str, List[float]] = {}
+    e2e: List[float] = []
+    for t in traces:
+        if not t.done:
+            continue
+        evs = sorted(t.events, key=lambda e: e[1])
+        prev = evs[0][1]
+        for stage, ts, _th in evs[1:]:
+            per.setdefault(stage, []).append(max(0.0, ts - prev))
+            prev = ts
+        e2e.append(max(0.0, evs[-1][1] - t.t0))
+
+    def pct(vals, q):
+        vals = sorted(vals)
+        i = min(len(vals) - 1, int(round(q / 100.0 * (len(vals) - 1))))
+        return vals[i]
+
+    total = sum(e2e) or 1.0
+    out = {
+        "traces": len(e2e),
+        "e2e": {
+            "p50_ms": round(pct(e2e, 50) * 1e3, 3),
+            "p99_ms": round(pct(e2e, 99) * 1e3, 3),
+        } if e2e else None,
+        "stages": {},
+    }
+    for stage, vals in sorted(per.items()):
+        out["stages"][stage] = {
+            "p50_ms": round(pct(vals, 50) * 1e3, 3),
+            "p99_ms": round(pct(vals, 99) * 1e3, 3),
+            "share_pct": round(sum(vals) / total * 100.0, 1),
+            "n": len(vals),
+        }
+    return out
+
+
+# ----------------------------------------------------------------------
+# completion hook (requests.RequestState.notify)
+# ----------------------------------------------------------------------
+
+#: outcome names derived from requests.RequestResultCode (lazily — the
+#: requests module imports this one); a hand-copied literal table would
+#: silently drift when a code is added
+_OUTCOMES: Optional[Dict[int, str]] = None
+
+
+def _outcome_name(result) -> str:
+    global _OUTCOMES
+    if _OUTCOMES is None:
+        from ..requests import RequestResultCode
+
+        _OUTCOMES = {int(c): c.name.lower() for c in RequestResultCode}
+    return _OUTCOMES.get(int(getattr(result, "code", 1)), "completed")
+
+
+def request_done(token, result) -> None:
+    """Called by ``RequestState.notify`` when the future carries a trace
+    token.  A ``(tracer, t0)`` tuple is the always-on enqueue timestamp
+    of a non-sampled request: observe e2e into its owning tracer.  A
+    :class:`Trace` completes into the tracer that allocated it."""
+    if token.__class__ is Trace:
+        token.tracer.finish(token, _outcome_name(result))
+        return
+    tracer, t0 = token
+    tracer.observe_e2e(time.perf_counter() - t0)
+
+
+def active() -> Optional[Tracer]:
+    """The newest-enabled tracer (None when tracing is off)."""
+    return _ACTIVE

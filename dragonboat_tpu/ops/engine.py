@@ -485,6 +485,10 @@ class BatchedQuorumEngine:
         self._obs_span = None      # span of the in-flight fused dispatch
         self._obs_mu_wait = 0.0    # _MULTIDEV_MU wait of the next dispatch
         self._obs_upload = 0       # upload bytes of the current dispatch
+        # seq of the newest recorded dispatch span (-1 = none / obs off):
+        # the request tracer links this into sampled traces' device_round
+        # stage (ISSUE 9); written only inside the obs-gated branches
+        self.last_span_seq = -1
         if _obs.enabled():
             self.enable_obs()
         # --- AOT warm-compile of the fused variants (ISSUE 7 tentpole) --
@@ -1896,6 +1900,7 @@ class BatchedQuorumEngine:
                     if self._read_plane_used else None
                 ),
             )
+            self.last_span_seq = self._obs_span["seq"]
         return out
 
     def _refresh_committed_cache(self) -> None:
@@ -2167,6 +2172,7 @@ class BatchedQuorumEngine:
                     if self._read_plane_used else None
                 ),
             )
+            self.last_span_seq = span["seq"]
             t_eg = time.perf_counter()
 
         res = StepResult()

@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 from enum import IntEnum
 from typing import Dict, List, Optional, Tuple
 
+from .obs import trace as _trace
 from .settings import Soft
 from .statemachine import Result
 from .wire import Entry, ReadyToRead, SystemCtx
@@ -135,6 +136,7 @@ class RequestState:
         "_result",
         "read_index",
         "completed_at",
+        "trace",
     )
 
     def __init__(self, key: int = 0, deadline: int = 0):
@@ -149,11 +151,18 @@ class RequestState:
         #: the request's true completion latency instead of the (later)
         #: moment it got around to observing the result
         self.completed_at: Optional[float] = None
+        #: request-trace token (ISSUE 9): None while tracing is off (the
+        #: bit-identical default); with tracing on, a (tracer, t0)
+        #: enqueue-timestamp token for non-sampled requests or an
+        #: obs.trace.Trace for the sampled 1-in-N
+        self.trace = None
 
     def notify(self, result: RequestResult) -> None:
         self.completed_at = time.perf_counter()
         self._result = result
         self._event.set()
+        if self.trace is not None:
+            _trace.request_done(self.trace, result)
 
     def wait(self, timeout: Optional[float] = None) -> RequestResult:
         if not self._event.wait(timeout):
@@ -318,6 +327,8 @@ class PendingProposal:
             if rs.client_id != client_id or rs.series_id != series_id:
                 return
             del self._shards[shard][key]
+        if rs.trace is not None:
+            _trace.Tracer.mark(rs, "apply")
         code = (
             RequestResultCode.REJECTED if rejected else RequestResultCode.COMPLETED
         )
@@ -388,6 +399,10 @@ class PendingReadIndex:
         # completion egress sink (hostplane) — same contract as
         # PendingProposal._egress; None keeps notify inline
         self._egress = None
+        # request tracer (ISSUE 9, set by NodeHost wiring): reads carry
+        # no entry key, so their stage stamps ride the rs objects this
+        # tracker already holds; None keeps every loop below untouched
+        self._tracer = None
 
     def set_egress(self, sink) -> None:
         self._egress = sink
@@ -418,9 +433,14 @@ class PendingReadIndex:
         with self._mu:
             if not self._pending:
                 return False
-            self._batches[ctx] = self._pending
+            batch = self._pending
+            self._batches[ctx] = batch
             self._pending = []
-            return True
+        if self._tracer is not None:
+            for rs in batch:
+                if rs.trace is not None:
+                    self._tracer.mark(rs, "raft_step")
+        return True
 
     def pending_ctxs(self) -> List[SystemCtx]:
         """Contexts taken for confirmation but not yet ready — after a
@@ -434,6 +454,7 @@ class PendingReadIndex:
         (reference ``requests.go:821``)."""
         if not readies:
             return
+        tracer = self._tracer
         with self._mu:
             for r in readies:
                 batch = self._batches.pop(r.system_ctx, None)
@@ -442,6 +463,8 @@ class PendingReadIndex:
                 for rs in batch:
                     rs.read_index = r.index
                     self._confirmed.append((r.index, rs))
+                    if tracer is not None and rs.trace is not None:
+                        tracer.mark(rs, "read_confirm")
 
     def applied(self, applied_index: int) -> None:
         """Apply watermark moved; complete reads whose index is covered
@@ -458,7 +481,10 @@ class PendingReadIndex:
                     keep.append((idx, rs))
             self._confirmed = keep
         egress = self._egress
+        tracer = self._tracer
         for rs in done:
+            if tracer is not None and rs.trace is not None:
+                tracer.mark(rs, "apply")
             if egress is not None:
                 egress(rs, RequestResult(code=RequestResultCode.COMPLETED))
             else:

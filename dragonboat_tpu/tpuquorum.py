@@ -188,6 +188,12 @@ class TpuQuorumCoordinator:
         # module latch covers tests/bench, NodeHostConfig.enable_metrics
         # covers the live stack (nodehost.py wiring)
         self._obs = None
+        # cross-plane request tracer (obs/trace.py, ISSUE 9; set by
+        # NodeHost): the round fan-out stamps "device_round" on the
+        # in-flight traces of every group whose commit/read-confirm this
+        # round released, linking the engine's dispatch span seq.  None
+        # keeps the round loop bit-identical.
+        self.tracer = None
         if _obs.enabled():
             self.enable_obs()
         if self._warm_requested:
@@ -702,6 +708,21 @@ class TpuQuorumCoordinator:
         # wakeups coalesce to one per touched group at the end of the
         # round (hostplane.wake_nodes) — a commit+tick+read round for one
         # group costs one CV notify instead of three.
+        tracer = self.tracer
+        if tracer is not None and (res.commit or read_confirms):
+            # stamp the device round BEFORE the offload fan-out (the
+            # apply stamp must sort after this one), linking the span
+            # seq of the dispatch that served this round.  The common
+            # round has no read confirms — iterate res.commit's keys
+            # directly instead of building a merged set (this block is
+            # on the round thread, the tpu path's bottleneck)
+            seq = self.eng.last_span_seq
+            if read_confirms:
+                cids = set(res.commit)
+                cids.update(c for c, _l, _h, _t in read_confirms)
+            else:
+                cids = res.commit
+            tracer.mark_clusters(cids, seq if seq >= 0 else None)
         hp = self.hostplane
         touched: dict = {}
         # wake_kw stays EMPTY without the host plane so duck-typed test

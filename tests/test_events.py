@@ -56,6 +56,37 @@ def test_exposition_one_type_per_name_and_escaped_labels():
     assert 'g{v="a\\"b\\\\c\\nd"} 1' in text  # escaped, single line
 
 
+def test_exposition_help_lines_round_trip():
+    """ISSUE 9 satellite: every family carries exactly one ``# HELP``
+    line, immediately before its ``# TYPE``; described families round-trip
+    their text (escaped), undescribed ones get the deterministic
+    placeholder; first describe wins (a family must read the same across
+    scrapes)."""
+    reg = MetricsRegistry()
+    reg.describe("m_total", "described\nfamily")
+    reg.describe("m_total", "second describe loses")
+    reg.counter_add("m_total", labels={"cluster_id": "1"})
+    reg.counter_add("m_total", labels={"cluster_id": "2"})  # one family
+    reg.gauge_set("g", 1)
+    reg.histogram_observe("h_ms", 2.0, buckets=(1.0, 5.0))
+    out = io.StringIO()
+    reg.write_health_metrics(out)
+    lines = out.getvalue().splitlines()
+    assert lines.count("# HELP m_total described\\nfamily") == 1
+    assert "# HELP g dragonboat_tpu metric g" in lines
+    assert "# HELP h_ms dragonboat_tpu metric h_ms" in lines
+    # adjacency: each # TYPE's predecessor is its own # HELP
+    for i, line in enumerate(lines):
+        if line.startswith("# TYPE "):
+            name = line.split(" ")[2]
+            assert lines[i - 1].startswith(f"# HELP {name} "), lines[i - 1]
+    assert reg.help_text("m_total") == "described\nfamily"
+    # a second write is byte-identical (stable ordering incl. HELP)
+    out2 = io.StringIO()
+    reg.write_health_metrics(out2)
+    assert out2.getvalue().splitlines() == lines
+
+
 def test_raft_event_listener_metrics_and_forwarding():
     reg = MetricsRegistry()
     seen = []

@@ -98,14 +98,29 @@ def test_recorder_stall_watchdog_autodump(tmp_path):
 
 def _parse_exposition(text):
     """Minimal text-format parser: returns ({name: type}, {(name, labels
-    frozenset): value}) with label values UNescaped."""
-    types, samples = {}, {}
+    frozenset): value}) with label values UNescaped.  Also asserts the
+    ISSUE 9 HELP invariant: every family carries exactly one ``# HELP``
+    line immediately before its ``# TYPE``."""
+    types, samples, helps = {}, {}, {}
+    pending_help = None
     for line in text.splitlines():
         if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, name, help_text = line.split(" ", 3)
+            assert name not in helps, f"duplicate # HELP for {name}"
+            helps[name] = (
+                help_text.replace("\\n", "\n").replace("\\\\", "\\")
+            )
+            pending_help = name
             continue
         if line.startswith("# TYPE "):
             _, _, name, kind = line.split(" ")
             assert name not in types, f"duplicate # TYPE for {name}"
+            assert pending_help == name, (
+                f"# TYPE {name} not immediately preceded by its # HELP"
+            )
+            pending_help = None
             types[name] = kind
             continue
         assert not line.startswith("#")
@@ -138,9 +153,16 @@ def test_exposition_escaping_and_single_type_roundtrip():
     reg.gauge_set("depth", 7.5, labels={"q": "r"})
     reg.histogram_observe("lat_ms", 3.0, buckets=(1.0, 5.0, 10.0))
     reg.histogram_observe("lat_ms", 100.0, buckets=(1.0, 5.0, 10.0))
+    reg.describe("x_total", "an x\ncounter with back\\slash")
     out = io.StringIO()
     reg.write_health_metrics(out)
     text = out.getvalue()
+    # HELP escaping (backslash + newline only — quotes stay literal per
+    # the exposition spec) and presence for EVERY family: described ones
+    # carry their text, undescribed ones the deterministic placeholder
+    assert "# HELP x_total an x\\ncounter with back\\\\slash\n" in text
+    assert "# HELP depth dragonboat_tpu metric depth\n" in text
+    assert "# HELP lat_ms dragonboat_tpu metric lat_ms\n" in text
     # escaping: raw specials never appear inside a label value
     assert '\\"' in text and "\\\\" in text and "\\n" in text
     assert "\n" not in text.split('a="')[1].split('"')[0]

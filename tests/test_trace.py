@@ -1,0 +1,494 @@
+"""Cross-plane request tracing differential suite (ISSUE 9).
+
+Contracts under test:
+
+- trace-OFF structural identity: with ``trace_sample_every=0`` nothing
+  is constructed — no tracer on the NodeHost/engine/node/coordinator,
+  ``RequestState.trace`` stays None — with ``host_compartments`` both
+  off and on;
+- trace completeness: every sampled proposal's completed trace carries
+  the full stage chain (propose → ingress → raft_step → wal → apply →
+  egress, plus device_round on the tpu engine), including proposals
+  committed by a FUSED K-batched round (linked recorder span is the
+  fused dispatch) and proposals interleaved with a membership change
+  (engine row recycle) mid-trace;
+- the stage-level stall watchdog: a sampled request stuck in a stage by
+  an injected WAL fsync failure (vfs.ErrorFS) auto-dumps its partial
+  trace — plus the flight-recorder ring when one is attached;
+- the Perfetto/Chrome export renders one request as ONE flow (s/t/f
+  events sharing the trace id) across the stage slices.
+"""
+import json
+import time
+
+from dragonboat_tpu import Config, NodeHostConfig, Result
+from dragonboat_tpu import vfs
+from dragonboat_tpu.config import ExpertConfig
+from dragonboat_tpu.logdb import open_logdb
+from dragonboat_tpu.logdb.kv import WalKV
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.obs import FlightRecorder
+from dragonboat_tpu.obs.trace import Trace, Tracer
+from dragonboat_tpu.events import MetricsRegistry
+from dragonboat_tpu.requests import RequestState
+from dragonboat_tpu.transport import ChanRouter, ChanTransport
+
+from tests.loadwait import wait_until
+
+RTT_MS = 5
+CID = 910
+
+WRITE_STAGES = {"ingress", "raft_step", "wal", "apply", "egress"}
+
+
+class CounterSM:
+    def __init__(self, cluster_id, node_id):
+        self.count = 0
+
+    def update(self, cmd):
+        self.count += 1
+        return Result(value=self.count)
+
+    def lookup(self, query):
+        return self.count
+
+    def save_snapshot(self, w, files, done):
+        w.write(self.count.to_bytes(8, "little"))
+
+    def recover_from_snapshot(self, r, files, done):
+        self.count = int.from_bytes(r.read(8), "little")
+
+    def close(self):
+        pass
+
+
+def _mk_host(addr="tr:1", trace=0, engine="scalar", compartments=False,
+             metrics=False, tmpdir=None, logdb_factory=None, fs=None,
+             warm_fused=True):
+    router = ChanRouter()
+    return NodeHost(
+        NodeHostConfig(
+            node_host_dir=tmpdir or ":memory:",
+            rtt_millisecond=RTT_MS,
+            raft_address=addr,
+            raft_rpc_factory=lambda s, rh, ch: ChanTransport(
+                s, rh, ch, router=router
+            ),
+            enable_metrics=metrics,
+            trace_sample_every=trace,
+            logdb_factory=logdb_factory,
+            expert=ExpertConfig(
+                quorum_engine=engine,
+                engine_block_groups=64,
+                engine_warm_fused=warm_fused,
+                host_compartments=compartments,
+                fs=fs,
+            ),
+        )
+    )
+
+
+def _start(nh, cid=CID):
+    nh.start_cluster(
+        {1: nh.raft_address()}, False, CounterSM,
+        Config(cluster_id=cid, node_id=1, election_rtt=10, heartbeat_rtt=1),
+    )
+    wait_until(
+        lambda: nh.get_leader_id(cid)[1], timeout=10.0, what="leader"
+    )
+
+
+def _stages(trace):
+    return {e["stage"] for e in trace.to_dict()["events"]}
+
+
+# ----------------------------------------------------------------------
+# trace OFF: structural identity (compartments off AND on)
+# ----------------------------------------------------------------------
+
+
+def _assert_trace_off(nh):
+    assert nh.tracer is None
+    assert nh.engine.tracer is None
+    node = nh.get_node(CID)
+    assert node.tracer is None
+    assert node.pending_reads._tracer is None
+    if nh.quorum_coordinator is not None:
+        assert nh.quorum_coordinator.tracer is None
+    s = nh.get_noop_session(CID)
+    states = [nh.propose(s, b"x", timeout=10.0)]
+    states += nh.propose_batch(s, [b"y", b"y"], timeout=10.0)
+    rrs = node.read(10.0)
+    for rs in states + [rrs]:
+        assert rs.wait(10.0).completed
+        assert rs.trace is None  # the bit-identical latch
+
+
+def test_trace_off_identity_compartments_off():
+    nh = _mk_host(trace=0, compartments=False)
+    try:
+        _start(nh)
+        _assert_trace_off(nh)
+    finally:
+        nh.stop()
+
+
+def test_trace_off_identity_compartments_on():
+    nh = _mk_host(trace=0, compartments=True)
+    try:
+        _start(nh)
+        _assert_trace_off(nh)
+    finally:
+        nh.stop()
+
+
+# ----------------------------------------------------------------------
+# completeness: every sampled proposal carries the full stage chain
+# ----------------------------------------------------------------------
+
+
+def _drive_and_collect(nh, n=6):
+    s = nh.get_noop_session(CID)
+    states = [nh.propose(s, b"w", timeout=10.0) for _ in range(n // 2)]
+    states += nh.propose_batch(s, [b"b"] * (n - n // 2), timeout=10.0)
+    for rs in states:
+        assert rs.wait(10.0).completed
+    # egress stamps land inside notify, before wait() returns; finish()
+    # moved each trace to the completed ring synchronously
+    return [rs.trace for rs in states]
+
+
+def test_completeness_scalar_engine():
+    nh = _mk_host(trace=1)
+    try:
+        _start(nh)
+        traces = _drive_and_collect(nh)
+        for t in traces:
+            assert type(t) is Trace and t.done
+            assert _stages(t) >= WRITE_STAGES, t.to_dict()
+        # reads: ingress -> raft_step -> read_confirm -> apply -> egress
+        rrs = nh.get_node(CID).read(10.0)
+        assert rrs.wait(10.0).completed
+        assert _stages(rrs.trace) >= {
+            "ingress", "read_confirm", "apply", "egress"
+        }, rrs.trace.to_dict()
+        # stage histograms published per stage
+        reg = nh.metrics_registry
+        for stage in WRITE_STAGES:
+            h = reg.histogram_value(
+                "dragonboat_trace_stage_seconds", {"stage": stage}
+            )
+            assert h is not None and h[3] > 0, stage
+        assert reg.histogram_value("dragonboat_trace_e2e_seconds")[3] > 0
+    finally:
+        nh.stop()
+
+
+def test_system_busy_reject_discards_contexts():
+    """Regression (code review): a full ingress ring raises SystemBusy
+    AFTER contexts attach but before the futures reach any tracker — no
+    notify will ever finish them, so the tracer must discard them or
+    they leak in flight forever (and trip the stall watchdog)."""
+    router = ChanRouter()
+    nh = NodeHost(
+        NodeHostConfig(
+            node_host_dir=":memory:",
+            rtt_millisecond=RTT_MS,
+            raft_address="tr:1",
+            raft_rpc_factory=lambda s, rh, ch: ChanTransport(
+                s, rh, ch, router=router
+            ),
+            trace_sample_every=1,
+            expert=ExpertConfig(
+                host_compartments=True, host_ingress_ring=4,
+            ),
+        )
+    )
+    try:
+        _start(nh)
+        from dragonboat_tpu.requests import SystemBusyError
+
+        s = nh.get_noop_session(CID)
+        ing = nh.hostplane.ingress
+        ing.pause()
+        staged = []
+        try:
+            import pytest
+
+            with pytest.raises(SystemBusyError):
+                for _ in range(64):
+                    staged.extend(nh.propose_batch(s, [b"x"], timeout=10.0))
+        finally:
+            ing.resume()
+        for rs in staged:
+            assert rs.wait(10.0).completed
+        wait_until(
+            lambda: not nh.tracer.inflight(), timeout=10.0,
+            what="discarded/completed trace contexts",
+        )
+        assert nh.tracer.check_stalls() == 0
+    finally:
+        nh.stop()
+
+
+def test_completeness_compartments_ingress_path():
+    """The compartmentalized path: bursts ride the ingress ring, the WAL
+    stage lands at the group-commit flusher — the same stage chain must
+    close."""
+    nh = _mk_host(trace=1, compartments=True)
+    try:
+        _start(nh)
+        for t in _drive_and_collect(nh):
+            assert _stages(t) >= WRITE_STAGES, t.to_dict()
+    finally:
+        nh.stop()
+
+
+def test_completeness_tpu_engine_device_round_and_recycle():
+    """tpu engine: writes additionally carry the device_round stage with
+    a linked recorder span; a membership change (engine row recycle)
+    mid-stream must not break trace completeness on either side."""
+    nh = _mk_host(trace=1, engine="tpu", metrics=True, warm_fused=False)
+    try:
+        _start(nh)
+        before = _drive_and_collect(nh)
+        # membership recycle mid-trace: an observer add commits a config
+        # change and resyncs the engine row (tpuquorum membership_changed)
+        nh.sync_request_add_observer(CID, 9, "trobs:1", timeout=10.0)
+        after = _drive_and_collect(nh)
+        for t in before + after:
+            assert _stages(t) >= WRITE_STAGES | {"device_round"}, (
+                t.to_dict()
+            )
+            assert t.spans, "device_round must link a recorder span seq"
+        rec = nh.flight_recorder
+        seqs = {s["seq"] for s in rec.spans()}
+        linked = {seq for t in before + after for seq in t.spans}
+        # linked seqs are real recorder spans (the ring may have evicted
+        # the oldest; at capacity 512 in this test it has not)
+        assert linked <= seqs | set(range(min(seqs, default=0))), (
+            linked, max(seqs, default=-1)
+        )
+    finally:
+        nh.stop()
+
+
+def test_fused_round_links_fused_span():
+    """Proposals committed by a fused K-batched round: hold the round
+    lock, stage a tick backlog plus writes, release — the backlog replays
+    as ONE fused dispatch and the traces' linked span is that fused
+    span."""
+    nh = _mk_host(trace=1, engine="tpu", metrics=True, warm_fused=False)
+    try:
+        _start(nh)
+        qc = nh.quorum_coordinator
+        # warm just the K=4 bucket synchronously (the full background
+        # warm set is the live default; one bucket keeps the test fast)
+        qc.eng.warmup_fused(k_buckets=(4,), background=False)
+        assert qc.eng.fused_ready
+        s = nh.get_noop_session(CID)
+        fused_before = qc.fused_dispatches
+        with qc._mu:  # block the round thread mid-loop
+            states = nh.propose_batch(s, [b"f"] * 4, timeout=10.0)
+            time.sleep(0.05)  # let raft step + ack staging land
+            for _ in range(4):  # tick backlog -> deficit > 1
+                qc.request_tick()
+        for rs in states:
+            assert rs.wait(10.0).completed
+        wait_until(
+            lambda: qc.fused_dispatches > fused_before, timeout=10.0,
+            what="a fused dispatch",
+        )
+        rec = nh.flight_recorder
+        by_seq = {sp["seq"]: sp for sp in rec.spans()}
+        fused_linked = [
+            by_seq[seq]
+            for rs in states
+            for seq in rs.trace.spans
+            if seq in by_seq and by_seq[seq]["kind"] == "fused"
+        ]
+        assert fused_linked, [rs.trace.to_dict() for rs in states]
+        assert any(sp["rounds"] > 1 for sp in fused_linked)
+    finally:
+        nh.stop()
+
+
+# ----------------------------------------------------------------------
+# stall watchdog: injected WAL stall dumps the partial trace
+# ----------------------------------------------------------------------
+
+
+def test_watchdog_dumps_partial_trace_on_wal_stall(tmp_path):
+    """vfs.ErrorFS fails every fsync: a sampled proposal wedges after
+    raft_step (its WAL flush cycle keeps failing), and the stage-level
+    watchdog — driven by the NodeHost tick worker — auto-dumps the
+    partial trace naming the stuck stage."""
+    failing = [False]
+    inj = vfs.Injector(lambda op, path: failing[0] and op == "fsync")
+    efs = vfs.ErrorFS(vfs.OSFS(), inj)
+    ldb_dir = str(tmp_path / "wal")
+
+    def logdb_factory(nhc):
+        return open_logdb(
+            ldb_dir, shards=2,
+            kv_factory=lambda d: WalKV(d, fsync=True, fs=efs),
+        )
+
+    nh = _mk_host(
+        trace=1, compartments=True, tmpdir=str(tmp_path / "nh"),
+        logdb_factory=logdb_factory, fs=efs,
+    )
+    try:
+        _start(nh)
+        s = nh.get_noop_session(CID)
+        assert nh.sync_propose(s, b"pre", timeout=10.0).value == 1
+        nh.tracer.stall_ms = 50.0
+        failing[0] = True
+        rs = nh.propose(s, b"stuck", timeout=60.0)
+        assert not rs.wait(0.5).completed
+        wait_until(
+            lambda: nh.tracer.last_stall_dump is not None, timeout=10.0,
+            what="trace stall auto-dump (tick worker)",
+        )
+        dump = nh.tracer.last_stall_dump
+        assert "trace-stall" in dump["reason"]
+        stuck = dump["trace"]
+        stages = [e["stage"] for e in stuck["events"]]
+        assert "wal" not in stages and "apply" not in stages, stages
+        assert stuck["stalled"] in ("ingress", "raft_step"), stuck
+        assert not stuck["done"]
+        assert nh.metrics_registry.counter_value(
+            "dragonboat_trace_stalls_total"
+        ) >= 1
+        # heal: the committer retry lands it and the trace completes
+        failing[0] = False
+        assert rs.wait(10.0).completed
+        assert rs.trace.done
+    finally:
+        nh.stop()
+
+
+def test_tracer_stall_dump_includes_recorder_ring():
+    """Unit-level: when a FlightRecorder is attached the stall dump
+    carries the recorder ring next to the partial trace."""
+    rec = FlightRecorder(capacity=8, stall_ms=0)
+    rec.record("dispatch", gate="acks", rounds=1)
+    tr = Tracer(sample_every=1, registry=MetricsRegistry(), recorder=rec,
+                stall_ms=5.0)
+    try:
+        rs = RequestState(key=77)
+        tr.attach_one(rs, 3, time.perf_counter())
+        tr.mark(rs, "ingress")
+        time.sleep(0.02)
+        assert tr.check_stalls() == 1
+        d = tr.last_stall_dump
+        assert d["trace"]["stalled"] == "ingress"
+        assert d["recorder"]["spans"][0]["kind"] == "dispatch"
+        # trips at most once per trace
+        assert tr.check_stalls() == 0
+    finally:
+        tr.close()
+
+
+# ----------------------------------------------------------------------
+# export + debug dump
+# ----------------------------------------------------------------------
+
+
+def test_dump_trace_one_flow_per_request(tmp_path):
+    """Acceptance: the exported Perfetto/Chrome trace renders a sampled
+    proposal as one flow — ingress, WAL, device-round, apply and egress
+    slices bound by s/t/f flow events sharing the trace id, with linked
+    recorder spans on the device-plane track."""
+    nh = _mk_host(trace=1, engine="tpu", metrics=True, warm_fused=False)
+    try:
+        _start(nh)
+        traces = _drive_and_collect(nh, n=2)
+        path = str(tmp_path / "trace.json")
+        d = nh.dump_trace(path=path)
+        with open(path) as f:
+            assert json.load(f)["traceEvents"]  # valid JSON on disk
+        evs = d["traceEvents"]
+        tid = traces[0].tid
+        slices = [
+            e for e in evs
+            if e["ph"] == "X" and e.get("args", {}).get("trace_id") == tid
+        ]
+        names = {e["name"] for e in slices}
+        assert names >= WRITE_STAGES | {"device_round"}, names
+        flow = [e for e in evs if e["ph"] in "stf" and e.get("id") == tid]
+        phs = [e["ph"] for e in flow]
+        assert phs[0] == "s" and phs[-1] == "f" and len(flow) >= 3
+        # thread metadata names every tid used
+        tids_used = {e["tid"] for e in slices}
+        named = {
+            e["tid"] for e in evs
+            if e["ph"] == "M" and e["name"] == "thread_name"
+        }
+        assert tids_used <= named
+        # the device-plane track carries the linked recorder spans
+        dev = [e for e in evs if e.get("cat") == "device"]
+        assert dev and {s["args"]["seq"] for s in dev} >= set(
+            traces[0].spans
+        )
+    finally:
+        nh.stop()
+
+
+def test_debug_dump_writes_recorder_and_traces(tmp_path):
+    nh = _mk_host(trace=1, engine="tpu", metrics=True, warm_fused=False)
+    try:
+        _start(nh)
+        _drive_and_collect(nh, n=2)
+        path = nh.debug_dump(path=str(tmp_path / "dump.json"))
+        with open(path) as f:
+            d = json.load(f)
+        assert d["recorder"]["spans"]
+        assert d["traces"]["completed"] >= 2
+        assert d["traces"]["traces"][0]["events"]
+    finally:
+        nh.stop()
+
+
+def test_sigusr2_handler_dumps(tmp_path):
+    """Opt-in SIGUSR2: raising the signal writes a timestamped dump file
+    (and the old handler is restored at stop)."""
+    import glob
+    import os
+    import signal
+
+    old = signal.getsignal(signal.SIGUSR2)
+    nh = NodeHost(
+        NodeHostConfig(
+            node_host_dir=str(tmp_path / "nh"),
+            rtt_millisecond=RTT_MS,
+            raft_address="sig:1",
+            raft_rpc_factory=lambda s, rh, ch: ChanTransport(
+                s, rh, ch, router=ChanRouter()
+            ),
+            trace_sample_every=1,
+            dump_signal=True,
+        )
+    )
+    try:
+        assert nh._dump_sig_old is not None or (
+            signal.getsignal(signal.SIGUSR2) is old
+        )
+        _start(nh)
+        s = nh.get_noop_session(CID)
+        nh.sync_propose(s, b"x", timeout=10.0)
+        os.kill(os.getpid(), signal.SIGUSR2)
+        # the handler only flags; the tick worker performs the dump
+        # (dumping inline in signal context would re-acquire
+        # non-reentrant locks the interrupted frame may hold)
+        wait_until(
+            lambda: glob.glob(str(tmp_path / "nh" / "dbtpu-dump-*.json")),
+            timeout=5.0, what="SIGUSR2 dump file",
+        )
+        files = glob.glob(str(tmp_path / "nh" / "dbtpu-dump-*.json"))
+        with open(files[0]) as f:
+            d = json.load(f)
+        assert d["traces"]["sampled"] >= 1
+    finally:
+        nh.stop()
+    assert signal.getsignal(signal.SIGUSR2) is old
