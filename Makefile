@@ -4,8 +4,8 @@
 PY ?= python
 
 .PHONY: test test-all test-kernels test-obs test-trace test-warmup \
-	test-hostplane native soak soak-smoke bench dryrun perf-ledger \
-	perf-ledger-check
+	test-hostplane test-lease native soak soak-smoke bench dryrun \
+	perf-ledger perf-ledger-check
 
 test: native
 	$(PY) -m pytest tests/ -x -q -m "not slow"
@@ -52,6 +52,16 @@ test-warmup:
 # or logdb/{kv,sharded,journal}.py change
 test-hostplane:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_hostplane.py -q
+
+# fast cpu gate for the leader-lease read plane (ISSUE 10): the
+# lease ≡ ReadIndex ≡ scalar-oracle differential, the invalidation
+# matrix (expiry/transfer-cede/membership/term), clock-jump fault
+# injection caught by the linearizability checker, the cross-domain
+# live-stack reads and the lease metric families — run before the full
+# tier-1 sweep whenever lease.py, raft/raft.py's read path,
+# transport/latency.py or the coordinator lease table change
+test-lease:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_lease.py -q
 
 # parallel run: heavy multi-NodeHost modules carry
 # xdist_group("heavy-multiprocess") and serialize on one worker while

@@ -1315,6 +1315,29 @@ def main() -> None:
             timeout=900.0,
         )
 
+    def _run_e2e_axis(flag: str, timeout_env: str, default_timeout: str):
+        """Run a bench_e2e.py axis in a killable subprocess (cpu backend)
+        and return its last-stdout-line JSON, or an error entry — the
+        shared shape of the trace and crossdomain sections."""
+        import subprocess as _sp
+
+        try:
+            r = _sp.run(
+                [sys.executable, os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)), "bench_e2e.py"), flag],
+                capture_output=True, text=True,
+                timeout=float(os.environ.get(timeout_env, default_timeout)),
+                env={**os.environ, "E2E_TPU": "0"},
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                return json.loads(r.stdout.strip().splitlines()[-1])
+            return {
+                "error": f"rc={r.returncode}",
+                "tail": (r.stderr or r.stdout)[-500:],
+            }
+        except Exception as e:
+            return {"error": repr(e)}
+
     # request-tracing axis (ISSUE 9): trace-on vs trace-off interleaved
     # best-of on one live cluster per engine (<5% asserted) plus the
     # per-stage latency attribution — the perf ledger's "Latency
@@ -1322,29 +1345,23 @@ def main() -> None:
     # killable subprocess like the other e2e sections (cpu backend; the
     # axis measures host-side stage cost, backend-agnostic).
     if os.environ.get("BENCH_SKIP_TRACE_AXIS") != "1":
-        import subprocess as _sp
-
-        try:
-            r = _sp.run(
-                [sys.executable, os.path.join(os.path.dirname(
-                    os.path.abspath(__file__)), "bench_e2e.py"),
-                 "--trace-axis"],
-                capture_output=True, text=True,
-                timeout=float(os.environ.get("BENCH_TRACE_TIMEOUT", "900")),
-                env={**os.environ, "E2E_TPU": "0"},
-            )
-            if r.returncode == 0 and r.stdout.strip():
-                detail["trace_axis"] = json.loads(
-                    r.stdout.strip().splitlines()[-1]
-                )
-            else:
-                detail["trace_axis"] = {
-                    "error": f"rc={r.returncode}",
-                    "tail": (r.stderr or r.stdout)[-500:],
-                }
-        except Exception as e:
-            detail["trace_axis"] = {"error": repr(e)}
+        detail["trace_axis"] = _run_e2e_axis(
+            "--trace-axis", "BENCH_TRACE_TIMEOUT", "900"
+        )
         _note(f"trace_axis: {json.dumps(detail['trace_axis'])[:300]}")
+
+    # cross-domain lease axis (ISSUE 10): leader-lease local reads vs the
+    # ReadIndex fallback on a live 3-host group whose follower quorum sits
+    # one injected far link (40ms RTT) from the leader — the perf ledger's
+    # "Read plane" table derives from this section.  Always on the cpu
+    # backend (it measures the scalar read path; no device involved).
+    if os.environ.get("BENCH_SKIP_CROSSDOMAIN") != "1":
+        # outer timeout dominates the rung's own worst case (2 variants x
+        # 120s placement deadlines + load + 6-host setup/teardown)
+        detail["crossdomain"] = _run_e2e_axis(
+            "--crossdomain", "BENCH_XDOM_TIMEOUT", "600"
+        )
+        _note(f"crossdomain: {json.dumps(detail['crossdomain'])[:300]}")
 
     # full detail (per-rank stats and all) goes to a FILE; the stdout line
     # stays small enough that the driver's 2000-char tail capture can never
@@ -1378,6 +1395,31 @@ def main() -> None:
             if k in ("groups", "live_writes_per_sec",
                      "live_writes_per_sec_single", "warm_enable_seconds",
                      "fused_dispatches", "stalled_spans", "error", "tail")
+        }
+    if isinstance(slim.get("trace_axis"), dict):
+        # verdict fields only on stdout; the per-stage attribution tables
+        # and pair deltas (~KBs) live in BENCH_DETAIL.json — the adjacent
+        # sections' 2000-char tail-capture discipline applies here too
+        ta = slim["trace_axis"]
+        slim["trace_axis"] = {
+            k: v for k, v in ta.items()
+            if k in ("trace_overhead_ok", "error", "tail")
+        }
+        for eng, e in (ta.get("engines") or {}).items():
+            if isinstance(e, dict):
+                slim["trace_axis"][eng] = {
+                    k: v for k, v in e.items()
+                    if k in ("trace_overhead_pct", "trace_overhead_sem_pct",
+                             "trace_overhead_ok", "fused_dispatches")
+                }
+    if isinstance(slim.get("crossdomain"), dict):
+        # headline fields only on stdout; full variant stats live in
+        # BENCH_DETAIL.json
+        slim["crossdomain"] = {
+            k: v for k, v in slim["crossdomain"].items()
+            if k in ("read_p99_ms_lease", "read_p99_ms_fallback",
+                     "read_p99_speedup", "ops_ratio_on_off", "assert_ok",
+                     "error", "tail")
         }
     for k in ("e2e_scale_tpu", "e2e_scale_scalar"):
         # ultra-slim: the A/B verdict fields only (full data in

@@ -888,6 +888,189 @@ def run_trace_axis() -> dict:
 
 
 # ======================================================================
+# cross-domain lease axis (ISSUE 10): leader-lease local reads vs the
+# ReadIndex fallback across injected high-RTT domains
+# ======================================================================
+
+
+def _mk_xdom_hosts(rtt_ms, far_one_way_s):
+    from dragonboat_tpu import NodeHostConfig
+    from dragonboat_tpu.config import ExpertConfig
+    from dragonboat_tpu.monkey import set_latency
+    from dragonboat_tpu.nodehost import NodeHost
+    from dragonboat_tpu.transport import ChanRouter, ChanTransport
+    from dragonboat_tpu.transport.latency import crossdomain
+
+    router = ChanRouter()
+    nhs = []
+    for i in (1, 2, 3):
+        nhs.append(
+            NodeHost(
+                NodeHostConfig(
+                    node_host_dir=":memory:",
+                    rtt_millisecond=rtt_ms,
+                    raft_address=f"xd{i}:1",
+                    raft_rpc_factory=lambda src, rh, ch: ChanTransport(
+                        src, rh, ch, router=router
+                    ),
+                    expert=ExpertConfig(
+                        quorum_engine="scalar", logdb_shards=2
+                    ),
+                )
+            )
+        )
+    # host 1 is the near/leader domain; the QUORUM (hosts 2+3) sits one
+    # far link away — every ReadIndex confirmation and every commit pays
+    # the cross-domain RTT, while lease reads stay in the near domain
+    set_latency(
+        nhs, crossdomain(["xd1:1"], ["xd2:1", "xd3:1"], far_one_way_s)
+    )
+    return nhs
+
+
+def run_crossdomain() -> dict:
+    """Cross-domain lease rung (ISSUE 10; ROADMAP item 4 seed): a 3-host
+    group whose follower quorum lives one injected far link (default
+    40ms RTT) from the leader, under a 9:1 mixed read/write load.
+
+    Two variants on identical topology: ``read_lease=True`` (clock-bound
+    leader lease, reads served locally — dragonboat_tpu/lease.py) vs
+    ``read_lease=False`` (every read pays the heartbeat-echo round across
+    the far link).  Asserted: the lease variant's read p99 is single-digit
+    milliseconds (vs the r07 device mixed-phase read-dispatch p99 of
+    1.08s, and vs this rung's own ReadIndex fallback at ≥ the domain
+    RTT), with a ≥90% lease hit ratio and write throughput unchanged
+    within the box's noise band.
+
+    Env knobs: E2E_XDOM_GROUPS (8), E2E_XDOM_DURATION (8s),
+    E2E_XDOM_RTT_MS (20 tick), E2E_XDOM_FAR_MS (20 one-way),
+    E2E_XDOM_THREADS (4), E2E_XDOM_ASSERT_MS (10).
+    """
+    groups = int(os.environ.get("E2E_XDOM_GROUPS", "8"))
+    duration = float(os.environ.get("E2E_XDOM_DURATION", "8"))
+    rtt_ms = int(os.environ.get("E2E_XDOM_RTT_MS", "20"))
+    far_ms = float(os.environ.get("E2E_XDOM_FAR_MS", "20"))
+    threads = int(os.environ.get("E2E_XDOM_THREADS", "4"))
+    assert_ms = float(os.environ.get("E2E_XDOM_ASSERT_MS", "10"))
+    payload = _payload()
+    from dragonboat_tpu import Config
+
+    out = {
+        "groups": groups,
+        "rtt_ms": rtt_ms,
+        "far_one_way_ms": far_ms,
+        "duration_s": duration,
+        "topology": "leader near; 2-follower quorum one far link away",
+        "variants": {},
+    }
+    for lease in (True, False):
+        nhs = _mk_xdom_hosts(rtt_ms, far_ms / 1e3)
+        try:
+            addrs = {i: f"xd{i}:1" for i in (1, 2, 3)}
+            cids = [BASE_CID + g for g in range(groups)]
+            for cid in cids:
+                for i, nh in enumerate(nhs, start=1):
+                    nh.start_cluster(
+                        addrs, False, CounterSM,
+                        Config(
+                            cluster_id=cid, node_id=i, election_rtt=10,
+                            heartbeat_rtt=1, check_quorum=True,
+                            read_lease=lease,
+                        ),
+                    )
+            # deterministic placement: the NEAR host leads every group.
+            # The first campaign can race the bootstrap config-change
+            # apply (campaign_skipped) or lose to a randomized timeout on
+            # a far host — retry, transferring back when a far host won.
+            deadline = time.time() + 120
+            led = set()
+            while len(led) < len(cids) and time.time() < deadline:
+                for cid in cids:
+                    if cid in led:
+                        continue
+                    n1 = nhs[0].get_node(cid)
+                    if n1.is_leader():
+                        led.add(cid)
+                        continue
+                    lid, ok = n1.get_leader_id()
+                    if ok and lid != 1 and 1 <= lid <= 3:
+                        try:
+                            nhs[lid - 1].request_leader_transfer(cid, 1)
+                        except Exception:
+                            pass
+                    else:
+                        n1.request_campaign()
+                time.sleep(0.2)
+            assert len(led) == len(cids), (
+                f"near-domain leaders: {len(led)}/{len(cids)}"
+            )
+            leaders = {cid: nhs[0] for cid in cids}
+            # warm: one committed write per group (thesis §6.4 step 1 —
+            # the lease serves only past a current-term commit) and a few
+            # heartbeat round trips so quorum acks arm the lease
+            for cid in cids:
+                nhs[0].sync_propose(
+                    nhs[0].get_noop_session(cid), payload, timeout=30.0
+                )
+            time.sleep(1.0)
+            mixed = _measure_mixed(
+                leaders, cids, payload, 9, time.time() + duration, threads
+            )
+            stats = None
+            if lease:
+                agg = {"reads_local": 0, "reads_fallback": 0, "grants": 0,
+                       "expiries": 0}
+                for cid in cids:
+                    s = nhs[0].lease_status(cid) or {}
+                    for k in agg:
+                        agg[k] += s.get(k, 0)
+                total = agg["reads_local"] + agg["reads_fallback"]
+                agg["hit_ratio"] = (
+                    round(agg["reads_local"] / total, 4) if total else None
+                )
+                stats = agg
+            out["variants"]["lease_on" if lease else "lease_off"] = {
+                **{k: v for k, v in mixed.items()},
+                "lease": stats,
+            }
+        finally:
+            for nh in nhs:
+                try:
+                    nh.stop()
+                except Exception:
+                    pass
+    on = out["variants"]["lease_on"]
+    off = out["variants"]["lease_off"]
+    p99_on = (on.get("read_latency_ms") or {}).get("p99")
+    p99_off = (off.get("read_latency_ms") or {}).get("p99")
+    out["read_p99_ms_lease"] = p99_on
+    out["read_p99_ms_fallback"] = p99_off
+    out["read_p99_speedup"] = (
+        round(p99_off / p99_on, 1) if p99_on and p99_off else None
+    )
+    wps_ratio = (
+        on["ops_per_sec"] / off["ops_per_sec"] if off["ops_per_sec"] else None
+    )
+    out["ops_ratio_on_off"] = round(wps_ratio, 3) if wps_ratio else None
+    # acceptance: lease reads are single-digit ms; the fallback pays at
+    # least the far-domain RTT; throughput within the box's noise band
+    hit = (on.get("lease") or {}).get("hit_ratio") or 0.0
+    assert p99_on is not None and p99_on < assert_ms, (
+        f"lease read p99 {p99_on}ms not single-digit (limit {assert_ms}ms)"
+    )
+    assert p99_off is not None and p99_off >= 2 * far_ms, (
+        f"fallback read p99 {p99_off}ms below the {2 * far_ms}ms domain RTT "
+        "— the injected topology is not being exercised"
+    )
+    assert hit >= 0.9, f"lease hit ratio {hit} < 0.9"
+    assert wps_ratio is None or 0.5 <= wps_ratio <= 2.0, (
+        f"mixed throughput moved {wps_ratio}x between lease on/off"
+    )
+    out["assert_ok"] = True
+    return out
+
+
+# ======================================================================
 # multiprocess mode: one process per NodeHost over framed TCP
 # ======================================================================
 
@@ -1635,5 +1818,8 @@ if __name__ == "__main__":
     _force_cpu_for_engine()
     if "--trace-axis" in sys.argv:
         print(json.dumps(run_trace_axis()), file=sys.stdout)
+        sys.exit(0)
+    if "--crossdomain" in sys.argv:
+        print(json.dumps(run_crossdomain()), file=sys.stdout)
         sys.exit(0)
     print(json.dumps(run_quick()), file=sys.stdout)

@@ -37,6 +37,18 @@ class Config:
     is_observer: bool = False
     is_witness: bool = False
     quiesce: bool = False
+    # leader-lease read plane (ISSUE 10, dragonboat_tpu/lease.py): a
+    # CheckQuorum-backed, clock-bound lease lets a leader serve
+    # linearizable reads locally with ZERO confirmation rounds — valid
+    # for election_rtt − drift_epsilon ticks after the last quorum of
+    # heartbeat acks; expiry/leadership-transfer/membership-change/term
+    # change all fall back to the ReadIndex path.  OFF (default) keeps
+    # the request paths structurally bit-identical (raft.lease is None,
+    # the _read_plane_used precedent).  Requires check_quorum (the §6
+    # vote lease is what makes the clock bound hold against forced
+    # campaigns) and is rejected with quiesce (a quiesced leader's tick
+    # clock freezes while follower election clocks keep running).
+    read_lease: bool = False
 
     def validate(self) -> None:
         # mirrors reference config.Config.Validate (config/config.go:168-223)
@@ -67,6 +79,10 @@ class Config:
             raise ConfigError("witness node cannot take snapshot")
         if self.is_witness and self.is_observer:
             raise ConfigError("witness node can not be an observer")
+        if self.read_lease and not self.check_quorum:
+            raise ConfigError("read_lease requires check_quorum")
+        if self.read_lease and self.quiesce:
+            raise ConfigError("read_lease can not be used with quiesce")
 
 
 @dataclass

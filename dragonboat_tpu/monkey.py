@@ -99,3 +99,18 @@ def set_drop_rate(router, rate: float, seed: int = 0) -> None:
         return
     rng = random.Random(seed)
     router.set_drop_hook(lambda batch: rng.random() < rate)
+
+
+# ---------------------------------------------------------------------------
+# cross-domain latency injection (ISSUE 10; transport/latency.py)
+# ---------------------------------------------------------------------------
+
+
+def set_latency(nhs: Iterable[NodeHost], injector) -> None:
+    """Install a :class:`~dragonboat_tpu.transport.latency.LatencyInjector`
+    on every host's transport send plane (``injector=None`` clears).  The
+    per-remote sender threads then sleep each link's one-way delay before
+    sending — the cross-domain harness the `run_crossdomain` bench rung
+    and the lease tests drive."""
+    for nh in nhs:
+        nh.transport.latency = injector

@@ -205,6 +205,13 @@ class Node:
         )
         # metrics + LeaderUpdated forwarding (reference event.go:37)
         self.peer.raft.events = getattr(self, "peer_raft_events", None)
+        # leader-lease instruments (ISSUE 10; set by NodeHost when
+        # enable_metrics is on and the group has read_lease): the raft
+        # lease hooks gate on obs `is not None`, so metrics-off hosts
+        # never touch the registry
+        lease_obs = getattr(self, "lease_obs", None)
+        if lease_obs is not None and self.peer.raft.lease is not None:
+            self.peer.raft.lease.obs = lease_obs
         # TPU quorum plugin (ExpertConfig.quorum_engine): stage hot-path
         # tallying to the device engine and register this group's row
         coord = getattr(self, "quorum_coordinator", None)
@@ -1790,6 +1797,28 @@ class Node:
     def is_leader(self) -> bool:
         with self.raft_mu:
             return self.peer is not None and self.peer.raft.is_leader()
+
+    def lease_status(self) -> Optional[dict]:
+        """Leader-lease snapshot (ISSUE 10): ``None`` when the group runs
+        without ``Config.read_lease``; else the lease's plain-int stats
+        plus whether it is currently valid and its remaining ticks —
+        read under raftMu so the view is consistent."""
+        with self.raft_mu:
+            if self.peer is None:
+                return None
+            r = self.peer.raft
+            lease = r.lease
+            if lease is None:
+                return None
+            d = lease.stats()
+            remaining = 0
+            if r.is_leader():
+                remaining = lease.remaining(
+                    r.tick_count, r.quorum(), r.voting_members(), r.node_id
+                )
+            d["held"] = remaining > 0
+            d["remaining_ticks"] = max(remaining, 0)
+            return d
 
     def request_compaction(self) -> threading.Event:
         """User-requested LogDB compaction up to the last auto-compacted

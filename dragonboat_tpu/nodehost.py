@@ -117,6 +117,9 @@ class NodeHost:
         self.raft_events = RaftEventListener(
             nhconfig.raft_event_listener, enabled=nhconfig.enable_metrics
         )
+        # shared leader-lease instruments (ISSUE 10), created lazily by
+        # the first lease-enabled group when enable_metrics is on
+        self._lease_obs = None
         # storage
         in_memory = nhconfig.node_host_dir == ":memory:"
         # directory management: deployment-id layout + flock + compat flag
@@ -653,6 +656,16 @@ class NodeHost:
         node.peer_raft_events = self.raft_events
         node.quorum_coordinator = self.quorum_coordinator
         node.fastlane = self.fastlane
+        if config.read_lease and self.nhconfig.enable_metrics:
+            # leader-lease instruments (ISSUE 10): one shared LeaseObs
+            # per host — the dragonboat_lease_* families land in the same
+            # registry write_health_metrics exposes.  Lazy: hosts with no
+            # lease-enabled group never register the families.
+            if self._lease_obs is None:
+                from .lease import LeaseObs
+
+                self._lease_obs = LeaseObs(self.raft_events.registry)
+            node.lease_obs = self._lease_obs
         if self.hostplane is not None:
             node.ingress = self.hostplane.ingress
             node.pending_proposals.set_egress(self.hostplane.egress)
@@ -1022,6 +1035,12 @@ class NodeHost:
 
     def get_leader_id(self, cluster_id: int) -> Tuple[int, bool]:
         return self.get_node(cluster_id).get_leader_id()
+
+    def lease_status(self, cluster_id: int) -> Optional[dict]:
+        """Leader-lease snapshot for one group (ISSUE 10): ``None`` when
+        the group runs without ``Config.read_lease``; else held/remaining
+        plus the local-vs-fallback read counters (``Node.lease_status``)."""
+        return self.get_node(cluster_id).lease_status()
 
     # ---- data management ----
 

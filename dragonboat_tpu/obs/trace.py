@@ -44,6 +44,9 @@ stage           stamped when
                 LAST such round before apply wins — and the recorder
                 span seq is linked into ``Trace.spans``)
 ``read_confirm``the ReadIndex ctx was quorum-confirmed (reads only)
+``lease_read``  the read was served locally under a valid leader lease
+                (ISSUE 10) — replaces ``read_confirm``; no confirmation
+                round ran, so the trace shows the short path
 ``apply``       the user SM applied the entry / the read's apply
                 watermark was reached
 ``egress``      the client future was notified (trace completes)

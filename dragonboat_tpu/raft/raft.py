@@ -46,6 +46,7 @@ from ..wire import (
     SystemCtx,
     entries_size,
 )
+from ..lease import LeaderLease
 from .log import CompactedError, EntryLog, ILogDB, UnavailableError
 from .rate import InMemRateLimiter
 from .readindex import ReadIndex
@@ -145,6 +146,14 @@ class Raft:
         self.is_leader_transfer_target = False
         self.pending_config_change = False
         self.read_index = ReadIndex()
+        # leader-lease read plane (ISSUE 10, Config.read_lease): None is
+        # the structural latch — every hook below gates on `is not None`,
+        # so lease-off request paths are bit-identical to the pre-lease
+        # build (the _read_plane_used precedent).  Constructed before the
+        # become_* calls at the bottom of __init__ (reset() touches it).
+        self.lease = (
+            LeaderLease(c.election_rtt) if c.read_lease else None
+        )
         self.ready_to_read: List[ReadyToRead] = []
         self.dropped_entries: List[Entry] = []
         self.dropped_read_indexes: List[SystemCtx] = []
@@ -369,8 +378,18 @@ class Raft:
                 match = next_ - 1
             self.set_witness(nid, match, next_)
         self.reset_match_value_array()
+        self.lease_membership_changed()
         if self.offload is not None:
             self.offload.membership_changed(self.cluster_id)
+
+    def lease_membership_changed(self) -> None:
+        """Invalidation matrix: membership changed — the quorum the lease
+        bases were tallied against no longer exists.  Re-arm from fresh
+        acks against the new membership.  A PARTIAL reset: same-term
+        acks still in flight must keep consuming the sends that elicited
+        them (see ``LeaderLease.membership_changed``)."""
+        if self.lease is not None:
+            self.lease.membership_changed()
 
     # ------------------------------------------------------------------
     # tick
@@ -585,6 +604,15 @@ class Raft:
         for nid in sorted(vm):
             if nid != self.node_id:
                 self.send_heartbeat_message(nid, ctx, vm[nid].match)
+        if self.lease is not None:
+            # lease bookkeeping: a quorum of acks to heartbeats SENT at
+            # this tick extends the lease to tick + duration (lease.py
+            # validity rule; the send tick, not the ack tick, is the
+            # conservative basis)
+            self.lease.record_send(
+                self.tick_count,
+                (nid for nid in vm if nid != self.node_id),
+            )
         if ctx.is_empty():
             for nid in sorted(self.observers):
                 self.send_heartbeat_message(nid, SystemCtx(), self.observers[nid].match)
@@ -712,6 +740,11 @@ class Raft:
         self.heartbeat_tick = 0
         self.set_randomized_election_timeout()
         self.read_index = ReadIndex()
+        if self.lease is not None:
+            # invalidation matrix: any state transition (term change,
+            # promotion, demotion) drops the lease; it re-arms only from
+            # post-transition heartbeat acks
+            self.lease.reset()
         self.clear_pending_config_change()
         self.abort_leader_transfer()
         self.reset_remotes()
@@ -815,6 +848,7 @@ class Raft:
             raise RuntimeError("could not promote witness to full member")
         else:
             self.set_remote(node_id, 0, self.log.last_index() + 1)
+        self.lease_membership_changed()
         if self.offload is not None:
             self.offload.membership_changed(self.cluster_id)
 
@@ -825,6 +859,7 @@ class Raft:
         if node_id in self.observers:
             return
         self.set_observer(node_id, 0, self.log.last_index() + 1)
+        self.lease_membership_changed()
         if self.offload is not None:
             self.offload.membership_changed(self.cluster_id)
 
@@ -835,6 +870,7 @@ class Raft:
         if node_id in self.witnesses:
             return
         self.set_witness(node_id, 0, self.log.last_index() + 1)
+        self.lease_membership_changed()
         if self.offload is not None:
             self.offload.membership_changed(self.cluster_id)
 
@@ -848,6 +884,7 @@ class Raft:
             self.become_follower(self.term, NO_LEADER)
         if self.leader_transfering() and self.leader_transfer_target == node_id:
             self.abort_leader_transfer()
+        self.lease_membership_changed()
         if self.offload is not None:
             # quorum may have shrunk: resync the row; the next round
             # recomputes the commit watermark over the new membership
@@ -1134,8 +1171,49 @@ class Raft:
     def clear_ready_to_read(self) -> None:
         self.ready_to_read = []
 
-    def add_ready_to_read(self, index: int, ctx: SystemCtx) -> None:
-        self.ready_to_read.append(ReadyToRead(index=index, system_ctx=ctx))
+    def add_ready_to_read(
+        self, index: int, ctx: SystemCtx, lease: bool = False
+    ) -> None:
+        self.ready_to_read.append(
+            ReadyToRead(index=index, system_ctx=ctx, lease=lease)
+        )
+
+    def try_lease_read(self, m: Message, ctx: SystemCtx) -> bool:
+        """Serve a linearizable read locally under a valid leader lease
+        (ISSUE 10 tentpole; thesis §6.4.1) — ZERO confirmation rounds.
+
+        Preconditions already held by the caller: leader, multi-node
+        quorum, committed entry at the current term.  A valid lease means
+        a quorum acked heartbeats within the last ``duration`` ticks, so
+        no other leader can have been elected (CheckQuorum's §6 vote
+        lease protects the bound even against forced campaigns; leader
+        transfer — which bypasses it via TIMEOUT_NOW — ceded the lease
+        first).  Serving at ``log.committed`` and routing exactly like a
+        confirmed release keeps released indices identical to the
+        ReadIndex path (differential: tests/test_lease.py)."""
+        lease = self.lease
+        remaining = lease.check(
+            self.tick_count, self.quorum(),
+            self.voting_members(), self.node_id,
+        )
+        if remaining <= 0:
+            lease.note_read_fallback()
+            return False
+        lease.note_read_local(remaining)
+        # same routing as apply_read_releases on a confirmed ctx
+        if m.from_ == NO_NODE or m.from_ == self.node_id:
+            self.add_ready_to_read(self.log.committed, ctx, lease=True)
+        else:
+            self.send(
+                Message(
+                    to=m.from_,
+                    type=MT.READ_INDEX_RESP,
+                    log_index=self.log.committed,
+                    hint=ctx.low,
+                    hint_high=ctx.high,
+                )
+            )
+        return True
 
     def handle_leader_read_index(self, m: Message) -> None:
         # section 6.4 of the raft thesis (reference raft.go:1636-1669)
@@ -1147,6 +1225,10 @@ class Raft:
             if not self.has_committed_entry_at_current_term():
                 # thesis §6.4 step 1: leader must have committed in this term
                 self.report_dropped_read_index(m)
+                return
+            if self.lease is not None and self.try_lease_read(m, ctx):
+                # lease-served: no pending entry, no hint broadcast, no
+                # device read-plane staging — the short path ends here
                 return
             self.read_index.add_request(self.log.committed, ctx, m.from_)
             if self.offload is not None and self.device_reads:
@@ -1209,6 +1291,11 @@ class Raft:
         # reference raft.go:1702-1714
         self.must_be_leader()
         rp.set_active()
+        if self.lease is not None and (
+            m.from_ in self.remotes or m.from_ in self.witnesses
+        ):
+            # voting members only: an observer ack extends no quorum
+            self.lease.record_ack(m.from_, self.tick_count)
         if self.offload is not None and self.device_ticks:
             # device check-quorum tallies activity bits per row (its only
             # consumer is the device-tick demote flag, so scalar-tick
@@ -1242,6 +1329,14 @@ class Raft:
             return
         self.leader_transfer_target = target
         self.election_tick = 0
+        if self.lease is not None:
+            # the lease must be explicitly ceded BEFORE the transfer can
+            # complete: TIMEOUT_NOW lets the target campaign without
+            # waiting out the election timeout, voiding the clock bound.
+            # Ceding here (at target-set time) strictly precedes every
+            # send_timeout_now_message path.  Sticky until the next term:
+            # even an aborted transfer may have delivered TIMEOUT_NOW.
+            self.lease.cede()
         # fast path if the target is already caught up (p29, raft thesis)
         if rp.match == self.log.last_index():
             self.send_timeout_now_message(target)

@@ -460,11 +460,14 @@ class PendingReadIndex:
                 batch = self._batches.pop(r.system_ctx, None)
                 if batch is None:
                     continue
+                # lease-served readies (ISSUE 10) skipped the echo-quorum
+                # round entirely; the trace shows the short path
+                stage = "lease_read" if r.lease else "read_confirm"
                 for rs in batch:
                     rs.read_index = r.index
                     self._confirmed.append((r.index, rs))
                     if tracer is not None and rs.trace is not None:
-                        tracer.mark(rs, "read_confirm")
+                        tracer.mark(rs, stage)
 
     def applied(self, applied_index: int) -> None:
         """Apply watermark moved; complete reads whose index is covered

@@ -147,6 +147,13 @@ class TpuQuorumCoordinator:
         # also frees every EARLIER ctx — their engine slots are cancelled
         # here.  Guarded by _mu (round thread + drain).
         self._read_pending: Dict[int, list] = {}
+        # batched device-plane lease tracking (ISSUE 10, lease.LeaseTable):
+        # created by the first registered read_lease group; the drain loop
+        # folds the heartbeat-ack ops it is ALREADY walking into a
+        # per-round tally — lease-coverage introspection across thousands
+        # of groups with no extra host pass and no raftMu.  Advisory only:
+        # the serving authority is each group's scalar LeaderLease.
+        self.lease_table = None
         # observability: ctxs confirmed BY THE DEVICE plane vs echoes that
         # fell back to the scalar tally (overflow/stale) — the read-plane
         # tests assert the device actually served the load
@@ -272,6 +279,8 @@ class TpuQuorumCoordinator:
         with self._mu:
             self._nodes.pop(cluster_id, None)
             self._read_pending.pop(cluster_id, None)
+            if self.lease_table is not None:
+                self.lease_table.remove(cluster_id)
             if cluster_id in self.eng.groups:
                 self.eng.remove_group(cluster_id)
 
@@ -281,6 +290,18 @@ class TpuQuorumCoordinator:
         r = node.peer.raft
         cid = r.cluster_id
         self._read_pending.pop(cid, None)
+        if r.lease is not None:
+            # (re)configure the advisory lease row from scalar state —
+            # quorum/duration track membership changes through the same
+            # resync path the engine row rides
+            if self.lease_table is None:
+                from .lease import LeaseTable
+
+                self.lease_table = LeaseTable()
+            self.lease_table.configure(
+                cid, r.quorum(), r.lease.duration, r.node_id,
+                voters=list(r.remotes) + list(r.witnesses),
+            )
         if cid in self.eng.groups:
             self.eng.remove_group(cid)
         voters = sorted(set(r.remotes))
@@ -424,6 +445,8 @@ class TpuQuorumCoordinator:
             ops, self._staged = self._staged, []
             self._contacted.clear()
         recover = []
+        lt = self.lease_table
+        lease_acks: Dict[int, set] = {}
         # bulk-pull every row a transition below will mutate: one device
         # gather per field for the whole set, instead of ~20 single-row
         # reads inside each set_* call (the dominant cost of election
@@ -447,6 +470,9 @@ class TpuQuorumCoordinator:
                     self.eng.vote(cid, op[2], op[3])
                 elif kind == "hbresp":
                     self.eng.heartbeat_resp(cid, op[2])
+                    if lt is not None and lt.tracks(cid):
+                        # lease tally rides the op walk already in flight
+                        lease_acks.setdefault(cid, set()).add(op[2])
                 elif kind == "contact":
                     self.eng.leader_contact(cid)
                 elif kind == "randto":
@@ -482,22 +508,32 @@ class TpuQuorumCoordinator:
                             node.offload_read_echo(node_id, low, high)
                 elif kind == "leader":
                     self._read_pending.pop(cid, None)
+                    if lt is not None:
+                        lt.drop(cid)
                     self.eng.set_leader(
                         cid, term=op[2], term_start=op[3], last_index=op[4]
                     )
                 elif kind == "candidate":
                     self._read_pending.pop(cid, None)
+                    if lt is not None:
+                        lt.drop(cid)
                     self.eng.set_candidate(cid, term=op[2])
                 elif kind == "follower":
                     self._read_pending.pop(cid, None)
+                    if lt is not None:
+                        lt.drop(cid)
                     self.eng.set_follower(cid, term=op[2])
                 else:  # resync
                     self._read_pending.pop(cid, None)
+                    if lt is not None:
+                        lt.drop(cid)
                     recover.append(cid)
             except (ValueError, KeyError):
                 # unknown peer slot / index past the rebase window: rebuild
                 # the row from scalar state (rare)
                 recover.append(cid)
+        if lt is not None and lease_acks:
+            lt.note_round(lease_acks, self._tick_seen)
         return recover
 
     def _recover_row(self, cluster_id: int) -> None:
@@ -789,6 +825,11 @@ class TpuQuorumCoordinator:
             if node is not None:
                 node.offload_election(False, term)
         if obs is not None:
+            if self.lease_table is not None:
+                # advisory lease-coverage gauge (dragonboat_lease_groups_
+                # held), refreshed from the drain-fed table — device-plane
+                # lease introspection with zero raftMu traffic
+                self.lease_table.publish(obs.registry, self._tick_seen)
             # the recorder's stall check on wall_ms IS the round-gate
             # watchdog: a round outlasting stall_ms (wedged dispatch,
             # first-compile storm, tunnel stall) auto-dumps the ring

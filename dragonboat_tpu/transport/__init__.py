@@ -7,6 +7,7 @@ in-memory chan transport for single-process clusters and tests.
 """
 from .chan import ChanRouter, ChanTransport, DEFAULT_ROUTER  # noqa: F401
 from .chunks import Chunks  # noqa: F401
+from .latency import LatencyInjector, crossdomain  # noqa: F401
 from .registry import Registry  # noqa: F401
 from .rpc import IConnection, IRaftRPC, ISnapshotConnection, TransportError  # noqa: F401
 from .tcp import TCPTransport  # noqa: F401

@@ -29,6 +29,7 @@ class ChanRouter:
         self._handlers: Dict[str, Tuple[RequestHandler, ChunkHandler]] = {}
         self._partitioned: Set[Tuple[str, str]] = set()
         self._drop_hook: Optional[Callable[[MessageBatch], bool]] = None
+        self._delay_hook: Optional[Callable[[str, str], float]] = None
 
     def register(self, addr: str, rh: RequestHandler, ch: ChunkHandler) -> None:
         with self._mu:
@@ -72,6 +73,19 @@ class ChanRouter:
         with self._mu:
             hook = self._drop_hook
         return hook(batch) if hook else False
+
+    def set_delay_hook(self, hook) -> None:
+        """hook(src, dst) -> one-way seconds to sleep before delivery
+        (ISSUE 10 latency classes; a ``LatencyInjector.delay`` bound
+        method fits directly).  Delivery runs on the per-remote sender
+        thread, so the sleep delays that link only.  None clears."""
+        with self._mu:
+            self._delay_hook = hook
+
+    def delivery_delay(self, src: str, dst: str) -> float:
+        with self._mu:
+            hook = self._delay_hook
+        return hook(src, dst) if hook else 0.0
 
 
 DEFAULT_ROUTER = ChanRouter()
@@ -150,6 +164,13 @@ class ChanTransport(IRaftRPC):
     def deliver(self, target: str, batch: MessageBatch) -> None:
         if self.router.should_drop(batch):
             return
+        d = self.router.delivery_delay(self.source_address, target)
+        if d > 0:
+            # runs on the Transport per-remote sender thread: the sleep
+            # models this link's one-way latency only (latency.py)
+            import time
+
+            time.sleep(d)
         rh, _ = self._check(target)
         rh(batch)
 

@@ -1,0 +1,100 @@
+"""Per-peer RTT latency classes: cross-domain link injection (ISSUE 10).
+
+The hierarchical/cross-domain tier (ROADMAP item 4, CD-Raft / "Fast Raft
+for Hierarchical Consensus" in PAPERS.md) needs groups that span simulated
+high-RTT domains.  This module is the injection surface, in the
+``monkey.py`` router-hook style: nothing below mutates production behavior
+unless a harness installs an injector.
+
+Model: every transport address belongs to a **domain**; links between
+domains carry a configurable one-way delay (``classes`` maps class names
+to seconds; intra-domain traffic is free).  An explicit per-pair override
+supports asymmetric paths.
+
+Two hook points, covering both wire modules with one mechanism:
+
+- ``Transport.latency`` (transport.py): the per-remote sender thread
+  sleeps the link's one-way delay before each batch send.  Because each
+  remote has its OWN queue+thread, the sleep delays that link only, and
+  messages arriving during the sleep coalesce into the same batch — the
+  link gains latency, not a bandwidth collapse.  This covers the TCP and
+  the in-proc chan wire identically (chan delivery runs on the same
+  sender thread).
+- ``ChanRouter.set_delay_hook`` (chan.py): the direct-router variant for
+  harnesses that bypass ``Transport`` (mirrors ``set_drop_hook``).
+
+Wire it with :func:`dragonboat_tpu.monkey.set_latency`.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+#: built-in one-way delay classes (seconds); override/extend per injector
+DEFAULT_CLASSES: Dict[str, float] = {
+    "local": 0.0,        # same host / same rack
+    "near": 0.0002,      # same datacenter
+    "metro": 0.002,      # same metro region
+    "far": 0.02,         # cross-region (40ms RTT)
+    "wan": 0.04,         # cross-continent (80ms RTT)
+}
+
+
+class LatencyInjector:
+    """Address → domain assignment plus inter-domain one-way delays."""
+
+    def __init__(self, classes: Optional[Dict[str, float]] = None):
+        self._mu = threading.Lock()
+        self.classes = dict(DEFAULT_CLASSES)
+        if classes:
+            self.classes.update(classes)
+        self._domain: Dict[str, str] = {}
+        self._link: Dict[frozenset, float] = {}
+        self._pair: Dict[Tuple[str, str], float] = {}
+
+    def assign(self, addr: str, domain: str) -> "LatencyInjector":
+        """Place a transport address in a domain (chainable)."""
+        with self._mu:
+            self._domain[addr] = domain
+        return self
+
+    def link(self, dom_a: str, dom_b: str, cls) -> "LatencyInjector":
+        """Set the symmetric one-way delay between two domains; ``cls``
+        is a class name (``"far"``) or a plain seconds float."""
+        d = self.classes[cls] if isinstance(cls, str) else float(cls)
+        with self._mu:
+            self._link[frozenset((dom_a, dom_b))] = d
+        return self
+
+    def set_pair(self, src: str, dst: str, seconds: float) -> "LatencyInjector":
+        """Asymmetric per-address override (takes precedence)."""
+        with self._mu:
+            self._pair[(src, dst)] = float(seconds)
+        return self
+
+    def delay(self, src: str, dst: str) -> float:
+        """One-way delay for a batch from ``src`` to ``dst`` (seconds)."""
+        with self._mu:
+            d = self._pair.get((src, dst))
+            if d is not None:
+                return d
+            da, db = self._domain.get(src), self._domain.get(dst)
+            if da is None or db is None or da == db:
+                return 0.0
+            return self._link.get(frozenset((da, db)), 0.0)
+
+
+def crossdomain(
+    near_addrs, far_addrs, one_way="far", classes=None
+) -> LatencyInjector:
+    """Two-domain convenience builder: ``near_addrs`` in domain A,
+    ``far_addrs`` in domain B, ``one_way`` delay (class name or seconds)
+    between them — the asymmetric-RTT shape the cross-domain bench rung
+    drives."""
+    inj = LatencyInjector(classes=classes)
+    for a in near_addrs:
+        inj.assign(a, "A")
+    for a in far_addrs:
+        inj.assign(a, "B")
+    inj.link("A", "B", one_way)
+    return inj

@@ -92,6 +92,12 @@ class Transport:
         # paths that do NOT ride them — Python-socket sends, snapshot
         # jobs, inbound chunks and Python-received batches)
         self.partition_filter: Optional[Callable[[str], bool]] = None
+        # per-peer RTT injection (ISSUE 10, transport/latency.py): the
+        # per-remote sender thread sleeps the link's one-way delay before
+        # each batch — that link gains latency while messages queued
+        # during the sleep coalesce into the same batch (latency, not a
+        # bandwidth collapse).  None (default) adds zero cost.
+        self.latency = None
         self._snapshot_count_mu = threading.Lock()
         self._snapshot_jobs = 0
         from .bandwidth import TokenBucket
@@ -200,6 +206,13 @@ class Transport:
                     continue
                 if m is None:
                     return
+                lat = self.latency
+                if lat is not None:
+                    # injected link delay (latency.py): sleep FIRST so
+                    # everything arriving meanwhile rides this batch
+                    d = lat.delay(self.source_address, addr)
+                    if d > 0:
+                        time.sleep(d)
                 batch = MessageBatch(
                     requests=[m],
                     deployment_id=self.deployment_id,

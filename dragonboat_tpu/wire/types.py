@@ -243,6 +243,10 @@ class ReadyToRead:
 
     index: int = 0
     system_ctx: SystemCtx = field(default_factory=SystemCtx)
+    # True when served locally under a leader lease (ISSUE 10) with no
+    # confirmation round — in-process only (never wire-encoded); the
+    # request tracer uses it to stamp "lease_read" vs "read_confirm"
+    lease: bool = False
 
 
 @dataclass(slots=True)

@@ -87,6 +87,53 @@ def test_exposition_help_lines_round_trip():
     assert out2.getvalue().splitlines() == lines
 
 
+def test_lease_families_help_round_trip():
+    """ISSUE 10 satellite: every ``dragonboat_lease_*`` family a LeaseObs
+    registers (and the coordinator table's gauge) carries its described
+    ``# HELP`` immediately before its ``# TYPE``, and the exposition
+    round-trips byte-identically."""
+    from dragonboat_tpu.lease import LeaseObs, LeaseTable
+
+    reg = MetricsRegistry()
+    obs = LeaseObs(reg)
+    obs.grant()
+    obs.read_local(6)
+    obs.read_fallback()
+    obs.expire()
+    obs.cede()
+    lt = LeaseTable()
+    lt.configure(1, quorum=2, duration=8, self_id=1, voters=[1, 2, 3])
+    lt.note_round({1: {2}}, 10)
+    lt.publish(reg, 11)
+    out = io.StringIO()
+    reg.write_health_metrics(out)
+    lines = out.getvalue().splitlines()
+    families = (
+        "dragonboat_lease_grants_total",
+        "dragonboat_lease_expiries_total",
+        "dragonboat_lease_ceded_total",
+        "dragonboat_lease_reads_local_total",
+        "dragonboat_lease_reads_fallback_total",
+        "dragonboat_lease_remaining_validity_ticks",
+        "dragonboat_lease_groups_held",
+    )
+    for name in families:
+        tidx = [
+            i for i, l in enumerate(lines) if l.startswith(f"# TYPE {name} ")
+        ]
+        assert len(tidx) == 1, name
+        help_line = lines[tidx[0] - 1]
+        assert help_line.startswith(f"# HELP {name} "), help_line
+        # described, not the placeholder
+        assert "dragonboat_tpu metric" not in help_line, help_line
+    assert "dragonboat_lease_groups_held 1" in lines
+    assert "dragonboat_lease_reads_local_total 1" in lines
+    # a second write is byte-identical (stable ordering incl. HELP)
+    out2 = io.StringIO()
+    reg.write_health_metrics(out2)
+    assert out2.getvalue().splitlines() == lines
+
+
 def test_raft_event_listener_metrics_and_forwarding():
     reg = MetricsRegistry()
     seen = []

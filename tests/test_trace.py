@@ -231,6 +231,88 @@ def test_system_busy_reject_discards_contexts():
         nh.stop()
 
 
+def test_completeness_lease_read_short_path():
+    """ISSUE 10: a read served under a leader lease shows the SHORT path
+    — a ``lease_read`` stage in place of ``read_confirm`` (no echo-quorum
+    round ran) — while a lease-off replica on the same build keeps the
+    confirmed chain."""
+    router = ChanRouter()
+
+    def mk(i, trace):
+        return NodeHost(
+            NodeHostConfig(
+                node_host_dir=":memory:",
+                rtt_millisecond=RTT_MS,
+                raft_address=f"lr{i}:1",
+                raft_rpc_factory=lambda s, rh, ch: ChanTransport(
+                    s, rh, ch, router=router
+                ),
+                trace_sample_every=trace,
+                expert=ExpertConfig(quorum_engine="scalar"),
+            )
+        )
+
+    nhs = [mk(i, 1 if i == 1 else 0) for i in (1, 2, 3)]
+    try:
+        addrs = {i: f"lr{i}:1" for i in (1, 2, 3)}
+        for i, nh in enumerate(nhs, start=1):
+            nh.start_cluster(
+                addrs, False, CounterSM,
+                Config(
+                    cluster_id=CID, node_id=i, election_rtt=10,
+                    heartbeat_rtt=1, check_quorum=True, read_lease=True,
+                ),
+            )
+        node1 = nhs[0].get_node(CID)
+
+        def _drive_leader1():
+            if node1.is_leader():
+                return True
+            lid, ok = node1.get_leader_id()
+            if ok and lid != 1 and 1 <= lid <= 3:
+                try:
+                    nhs[lid - 1].request_leader_transfer(CID, 1)
+                except Exception:
+                    pass
+            else:
+                node1.request_campaign()
+            return False
+
+        wait_until(
+            _drive_leader1, timeout=20.0, interval=0.2,
+            what="leader on host 1",
+        )
+        s = nhs[0].get_noop_session(CID)
+        rs = nhs[0].propose(s, b"x", timeout=10.0)
+        assert rs.wait(10.0).completed
+        wait_until(
+            lambda: (nhs[0].lease_status(CID) or {}).get("held"),
+            timeout=10.0, what="lease armed",
+        )
+        rrs = node1.read(10.0)
+        assert rrs.wait(10.0).completed
+        stages = _stages(rrs.trace)
+        assert stages >= {"ingress", "lease_read", "apply", "egress"}, (
+            rrs.trace.to_dict()
+        )
+        assert "read_confirm" not in stages
+        # the stage histogram carries the new stage label (observations
+        # flush to the registry once per tick — wait one out)
+        wait_until(
+            lambda: (
+                nhs[0].metrics_registry.histogram_value(
+                    "dragonboat_trace_stage_seconds",
+                    {"stage": "lease_read"},
+                )
+                or (None,) * 4
+            )[3],
+            timeout=10.0, what="lease_read stage histogram flushed",
+        )
+    finally:
+        for nh in nhs:
+            nh.stop()
+
+
 def test_completeness_compartments_ingress_path():
     """The compartmentalized path: bursts ride the ingress ring, the WAL
     stage lands at the group-commit flusher — the same stage chain must
