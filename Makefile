@@ -4,8 +4,8 @@
 PY ?= python
 
 .PHONY: test test-all test-kernels test-obs test-trace test-warmup \
-	test-hostplane test-lease native soak soak-smoke bench dryrun \
-	perf-ledger perf-ledger-check
+	test-hostplane test-lease test-devsm native soak soak-smoke bench \
+	dryrun perf-ledger perf-ledger-check
 
 test: native
 	$(PY) -m pytest tests/ -x -q -m "not slow"
@@ -52,6 +52,16 @@ test-warmup:
 # or logdb/{kv,sharded,journal}.py change
 test-hostplane:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_hostplane.py -q
+
+# fast cpu gate for the device state machine (ISSUE 11): the device KV
+# apply ≡ scalar-oracle differential (kernel + engine level), the
+# recycle/transition/snapshot semantics, the devsm-off structural
+# identity, and the live single-node + 3-node failover paths — run
+# before the full tier-1 sweep whenever ops/kernels.py's kv plane,
+# ops/state.py's kv arrays, devsm/, or the coordinator/raft devsm hooks
+# change
+test-devsm:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_devsm.py -q
 
 # fast cpu gate for the leader-lease read plane (ISSUE 10): the
 # lease ≡ ReadIndex ≡ scalar-oracle differential, the invalidation

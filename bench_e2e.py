@@ -1071,6 +1071,259 @@ def run_crossdomain() -> dict:
 
 
 # ======================================================================
+# device state machine rung (devsm, ISSUE 11)
+# ======================================================================
+
+
+def _devsm_mixed_worker(nh, cids, read_ratio, stop_at, out):
+    """9:1 mixed KV load through the sync APIs: writes are fixed-width
+    devsm SET ops, reads are linearizable key lookups with the value
+    CHECKED against the last committed write per key (a stale device
+    read fails the rung, not just slows it)."""
+    from dragonboat_tpu.devsm import encode_op
+
+    reads = writes = errors = 0
+    lat_r, lat_w = [], []
+    stale = None
+    last = {}  # (cid, key) -> last written value
+    sessions = {cid: nh.get_noop_session(cid) for cid in cids}
+    i = 0
+    while time.time() < stop_at and stale is None:
+        cid = cids[i % len(cids)]
+        key = (i // len(cids)) % 8
+        i += 1
+        is_read = (i % (read_ratio + 1)) != 0
+        t0 = time.perf_counter()
+        try:
+            if is_read:
+                v = nh.sync_read(cid, key, timeout=10.0)
+                lat_r.append(time.perf_counter() - t0)
+                reads += 1
+                expect = last.get((cid, key))
+                if expect is not None and v != expect:
+                    # recorded, not raised: an exception on this bare
+                    # thread would die silently and the rung would
+                    # report assert_ok over a linearizability violation
+                    stale = f"stale devsm read {cid}/{key}: {v} != {expect}"
+            else:
+                val = i & 0x7FFFFFFF
+                nh.sync_propose(
+                    sessions[cid], encode_op(key, val), timeout=10.0
+                )
+                lat_w.append(time.perf_counter() - t0)
+                writes += 1
+                last[(cid, key)] = val
+        except Exception:
+            errors += 1
+    out.append((reads, writes, errors, lat_r, lat_w, stale))
+
+
+def run_devsm() -> dict:
+    """Device SM rung (ISSUE 11): a 3-host tpu-engine cluster under a
+    9:1 mixed KV load, ``Config.device_kv`` on vs off on identical
+    topology (same DeviceKVStateMachine class both ways — the off
+    variant IS the host-apply oracle).  Leaders concentrate on host 1 so
+    every client read hits the leader host, where the devsm variant
+    serves straight from device state (zero host apply on the read
+    path).  Reported per variant: mixed ops/s, read/write latency
+    percentiles, and the sampled per-stage trace attribution — the
+    acceptance signal is the READ path's ``apply`` share collapsing on
+    the devsm variant (reads release at the device commit watermark, the
+    fold having run inside that very dispatch).
+
+    Env knobs: E2E_DEVSM_GROUPS (4), E2E_DEVSM_DURATION (8s),
+    E2E_DEVSM_RTT_MS (20), E2E_DEVSM_THREADS (2),
+    E2E_DEVSM_WARM_TIMEOUT (240s).
+    """
+    from dragonboat_tpu import Config, NodeHostConfig
+    from dragonboat_tpu.config import ExpertConfig
+    from dragonboat_tpu.devsm import DeviceKVStateMachine
+    from dragonboat_tpu.nodehost import NodeHost
+    from dragonboat_tpu.obs.trace import compute_stage_stats
+    from dragonboat_tpu.transport import ChanRouter, ChanTransport
+
+    groups = int(os.environ.get("E2E_DEVSM_GROUPS", "4"))
+    duration = float(os.environ.get("E2E_DEVSM_DURATION", "8"))
+    rtt_ms = int(os.environ.get("E2E_DEVSM_RTT_MS", "20"))
+    threads = int(os.environ.get("E2E_DEVSM_THREADS", "2"))
+    warm_timeout = float(os.environ.get("E2E_DEVSM_WARM_TIMEOUT", "240"))
+    out = {
+        "groups": groups,
+        "duration_s": duration,
+        "rtt_ms": rtt_ms,
+        "read_ratio": 9,
+        "variants": {},
+    }
+    for devsm in (True, False):
+        router = ChanRouter()
+        addrs = {i: f"dsm{i}:1" for i in (1, 2, 3)}
+        nhs = [
+            NodeHost(
+                NodeHostConfig(
+                    node_host_dir=":memory:",
+                    rtt_millisecond=rtt_ms,
+                    raft_address=addrs[i],
+                    raft_rpc_factory=lambda src, rh, ch: ChanTransport(
+                        src, rh, ch, router=router
+                    ),
+                    trace_sample_every=2,
+                    expert=ExpertConfig(
+                        quorum_engine="tpu",
+                        engine_block_groups=max(groups, 64),
+                    ),
+                )
+            )
+            for i in (1, 2, 3)
+        ]
+        try:
+            cids = [BASE_CID + g for g in range(groups)]
+            for cid in cids:
+                for i, nh in enumerate(nhs, start=1):
+                    nh.start_cluster(
+                        addrs, False, DeviceKVStateMachine,
+                        Config(
+                            cluster_id=cid, node_id=i, election_rtt=10,
+                            heartbeat_rtt=1, device_kv=devsm,
+                        ),
+                    )
+            if devsm:
+                # first-use XLA compiles of the has_kv programs must not
+                # stall the round thread mid-measurement (warmup_devsm is
+                # kicked at registration; wait it out)
+                deadline = time.time() + warm_timeout
+                while time.time() < deadline:
+                    if all(
+                        nh.quorum_coordinator.eng.kv_fused_ready
+                        for nh in nhs
+                    ):
+                        break
+                    time.sleep(0.25)
+            # concentrate leaders on host 1 (the crossdomain placement
+            # dance): device-served reads require the client to read on
+            # the leader host
+            deadline = time.time() + 120
+            led = set()
+            while len(led) < len(cids) and time.time() < deadline:
+                for cid in cids:
+                    if cid in led:
+                        continue
+                    n1 = nhs[0].get_node(cid)
+                    if n1.is_leader():
+                        led.add(cid)
+                        continue
+                    lid, ok = n1.get_leader_id()
+                    if ok and lid != 1 and 1 <= lid <= 3:
+                        try:
+                            nhs[lid - 1].request_leader_transfer(cid, 1)
+                        except Exception:
+                            pass
+                    else:
+                        n1.request_campaign()
+                time.sleep(0.2)
+            assert len(led) == len(cids), (
+                f"host-1 leaders: {len(led)}/{len(cids)}"
+            )
+            if devsm:
+                plane = nhs[0].quorum_coordinator.devsm
+                deadline = time.time() + 60
+                while time.time() < deadline and not all(
+                    plane.bound(cid) for cid in cids
+                ):
+                    time.sleep(0.1)
+            time.sleep(0.5)  # settle startup config-change resyncs
+            stop_at = time.time() + duration
+            outs = []
+            slices = [cids[i::threads] for i in range(threads)]
+            ts = [
+                threading.Thread(
+                    target=_devsm_mixed_worker,
+                    args=(nhs[0], s, 9, stop_at, outs),
+                )
+                for s in slices
+                if s
+            ]
+            t_begin = time.time()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            wall = max(time.time() - t_begin, 1e-3)
+            # every worker must have reported, and none may have seen a
+            # stale read (the worker records instead of raising — a
+            # thread death would silently shrink the stats)
+            assert len(outs) == len([s for s in slices if s]), (
+                f"devsm worker died: {len(outs)} reports"
+            )
+            stales = [s for *_rest, s in outs if s]
+            assert not stales, stales[0]
+            reads = sum(r for r, _, _, _, _, _ in outs)
+            writes = sum(w for _, w, _, _, _, _ in outs)
+            errors = sum(e for _, _, e, _, _, _ in outs)
+            lat_r = [l for _, _, _, ls, _, _ in outs for l in ls]
+            lat_w = [l for _, _, _, _, ls, _ in outs for l in ls]
+            attribution = compute_stage_stats(
+                t for nh in nhs if nh.tracer is not None
+                for t in nh.tracer.traces()
+            )
+            variant = {
+                "ops_per_sec": round((reads + writes) / wall, 1),
+                "reads": reads,
+                "writes": writes,
+                "errors": errors,
+                "read_latency_ms": _percentiles(lat_r),
+                "write_latency_ms": _percentiles(lat_w),
+                "attribution": attribution,
+            }
+            if devsm:
+                plane = nhs[0].quorum_coordinator.devsm
+                served = plane.reads_served
+                fb = plane.read_fallbacks
+                variant["devsm"] = {
+                    "reads_served": served,
+                    "read_fallbacks": fb,
+                    "ops_staged": plane.ops_staged,
+                    "binds": plane.binds,
+                    "served_ratio": (
+                        round(served / (served + fb), 4)
+                        if served + fb else None
+                    ),
+                }
+            out["variants"]["devsm_on" if devsm else "devsm_off"] = variant
+        finally:
+            for nh in nhs:
+                try:
+                    nh.stop()
+                except Exception:
+                    pass
+    on = out["variants"]["devsm_on"]
+    off = out["variants"]["devsm_off"]
+
+    def _apply_share(v):
+        st = (v.get("attribution") or {}).get("stages") or {}
+        return (st.get("apply") or {}).get("share_pct")
+
+    out["apply_share_pct_devsm"] = _apply_share(on)
+    out["apply_share_pct_host"] = _apply_share(off)
+    out["read_p50_ms_devsm"] = (on.get("read_latency_ms") or {}).get("p50")
+    out["read_p50_ms_host"] = (off.get("read_latency_ms") or {}).get("p50")
+    # acceptance: the device plane (not the shadow fallback) served the
+    # read load, correctness held (the worker asserts read-your-writes
+    # inline), and the apply share collapsed on the devsm path
+    served_ratio = (on.get("devsm") or {}).get("served_ratio") or 0.0
+    assert served_ratio >= 0.5, (
+        f"device served only {served_ratio} of leader-host reads"
+    )
+    assert on["errors"] == 0 or on["errors"] < on["reads"] // 10
+    a_on, a_off = out["apply_share_pct_devsm"], out["apply_share_pct_host"]
+    if a_on is not None and a_off is not None and a_off > 1.0:
+        assert a_on <= max(5.0, 0.5 * a_off), (
+            f"devsm apply share {a_on}% did not collapse vs host {a_off}%"
+        )
+    out["assert_ok"] = True
+    return out
+
+
+# ======================================================================
 # multiprocess mode: one process per NodeHost over framed TCP
 # ======================================================================
 
@@ -1821,5 +2074,8 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--crossdomain" in sys.argv:
         print(json.dumps(run_crossdomain()), file=sys.stdout)
+        sys.exit(0)
+    if "--devsm" in sys.argv:
+        print(json.dumps(run_devsm()), file=sys.stdout)
         sys.exit(0)
     print(json.dumps(run_quick()), file=sys.stdout)

@@ -30,3 +30,13 @@ from .statemachine import (  # noqa: F401
 )
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # lazy: the device-resident KV state machine (devsm, ISSUE 11) pulls
+    # in numpy/ops machinery that plain host-SM users never need
+    if name == "DeviceKVStateMachine":
+        from .devsm.machine import DeviceKVStateMachine
+
+        return DeviceKVStateMachine
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -49,6 +49,17 @@ class Config:
     # campaigns) and is rejected with quiesce (a quiesced leader's tick
     # clock freezes while follower election clocks keep running).
     read_lease: bool = False
+    # device-resident state machine (devsm, ISSUE 11,
+    # dragonboat_tpu/devsm): with the tpu quorum engine and a
+    # DeviceKVStateMachine factory, committed fixed-width (key_slot,
+    # value) ops apply as (G, slots) tensor updates INSIDE the fused
+    # quorum dispatch — apply == commit on the device — and
+    # lease/ReadIndex reads serve straight from device state with zero
+    # host apply on the read path.  OFF (default) keeps the SM a plain
+    # host IStateMachine and every request path structurally
+    # bit-identical (the _devsm_used latch precedent).  On the scalar
+    # engine the flag is inert — the same SM just runs host-side.
+    device_kv: bool = False
 
     def validate(self) -> None:
         # mirrors reference config.Config.Validate (config/config.go:168-223)

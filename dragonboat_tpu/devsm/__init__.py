@@ -1,0 +1,40 @@
+"""Device-resident replicated KV state machine (devsm, ISSUE 11).
+
+BENCH_r10's latency-attribution ledger localizes 48-65% of e2e p50 in
+the APPLY stage — Python threads contending on one GIL while the device
+plane absorbs hundreds of millions of writes per second.  This package
+attacks it from the device side: for the fixed-width KV workload, the
+state machine itself moves into the fused program.  Committed entries
+carry ``(key_slot, value)`` SET ops, staged into per-group entry buffers
+at append time; a batched apply fold inside ``quorum_multiround``'s scan
+writes them into HBM-resident ``(G, slots)`` value tensors the moment
+the commit watermark passes their index (``ops/kernels._kv_plane``).
+Apply == commit by construction, so lease and ReadIndex reads serve
+straight from device state with ZERO host apply on the read path —
+"Compartmentalization"'s stage separation taken one step further, the
+way CD-Raft co-locates the latency-critical stages with the data they
+touch (PAPERS.md).
+
+Pieces:
+
+- :mod:`codec` — the fixed-width op wire format (8 bytes: int32 key
+  slot + int32 value, little-endian);
+- :mod:`machine` — :class:`DeviceKVStateMachine`, the user-facing SM:
+  a normal ``IStateMachine`` everywhere (the host shadow stays warm on
+  every replica — snapshots, failover and the devsm-off oracle all read
+  it), whose ``lookup`` routes through the device plane when its group
+  is device-bound;
+- :mod:`plane` — :class:`DevKVPlane`, the coordinator-side manager:
+  leadership-scoped binding (shadow upload at promotion once host apply
+  catches the bind watermark), entry-op staging from
+  ``raft.append_entries``, and the KV read service that resolves
+  lookups from the fused dispatch's capture egress.
+
+Default OFF: ``Config.device_kv`` gates registration; without it (or on
+the scalar engine) nothing here is imported on the hot path and the
+request paths stay structurally bit-identical — the engine-side
+``_devsm_used`` latch is the same contract the read plane ships under.
+"""
+from .codec import OP_WIDTH, decode_op, encode_op  # noqa: F401
+from .machine import DeviceKVStateMachine  # noqa: F401
+from .plane import DevKVPlane  # noqa: F401

@@ -175,6 +175,14 @@ class Node:
         # effects under dragonboat_node_offload_applied_total{kind=...}.
         # None (the default) keeps the apply path untouched.
         self.obs_registry = None
+        # device state machine (devsm, ISSUE 11): set by NodeHost when a
+        # DeviceKVStateMachine group registers (Config.device_kv on the
+        # tpu engine).  None keeps every path below bit-identical.  The
+        # release floor is the highest DEVICE commit watermark pending
+        # reads have been released at — the plane's shadow fallback gates
+        # host-apply catch-up on it.
+        self.devsm_plane = None
+        self.devsm_release_floor = 0
         self._natsm_attached = False  # native C-ABI SM wired to the lane
         self._next_enroll_try = 0.0
         self._tick_count_pending = 0
@@ -223,6 +231,17 @@ class Node:
             if coord.drive_ticks and not self.config.quiesce:
                 self.peer.raft.device_ticks = True
             coord.register(self)
+            # device state machine (devsm, ISSUE 11): Config.device_kv +
+            # a DeviceKVStateMachine factory moves the group's apply
+            # plane into the fused program — entry ops offload at append
+            # (raft.device_kv) and reads serve from device state once the
+            # plane binds at a leadership promotion
+            dsm_sm = getattr(self, "devsm_sm", None)
+            if dsm_sm is not None:
+                plane = coord.devsm_plane()
+                plane.register(self.cluster_id, dsm_sm)
+                self.devsm_plane = plane
+                self.peer.raft.device_kv = True
         # queue initial recovery so the apply worker restores the newest
         # local snapshot before any new entries apply.  The WAKEUP is the
         # caller's job AFTER registering the node (reference
@@ -352,6 +371,21 @@ class Node:
             return  # native core owns the group; flags are stale
         if commit_q and r.is_leader() and r.log.try_commit(commit_q, r.term):
             r.broadcast_replicate_message()
+        if (
+            commit_q
+            and self.devsm_plane is not None
+            and self.devsm_plane.bound(self.cluster_id)
+        ):
+            # devsm read-release gate (ISSUE 11): on the device plane
+            # apply == commit — the fold runs inside the dispatch that
+            # advanced this watermark — so pending reads release HERE, at
+            # the device watermark, and their lookups serve from device
+            # state.  Host apply (which only keeps the shadow warm) is
+            # off the read path entirely; the plane's shadow fallback
+            # gates on the floor recorded below.
+            if commit_q > self.devsm_release_floor:
+                self.devsm_release_floor = commit_q
+            self.pending_reads.applied(commit_q)
         if reads and r.is_leader():
             for low, high, term in reads:
                 # term-pinned like offload_election: a confirmation tallied
@@ -1392,7 +1426,11 @@ class Node:
                 self.nh.send_message(m)
         if ud.ready_to_reads:
             self.pending_reads.add_ready(ud.ready_to_reads)
-            self.pending_reads.applied(self.sm.get_last_applied())
+            # devsm groups release at the device watermark too (floor is
+            # 0 everywhere else — the max is the identity then)
+            self.pending_reads.applied(
+                max(self.sm.get_last_applied(), self.devsm_release_floor)
+            )
         self._apply_snapshot_and_update(ud)
         self._save_snapshot_required()
 

@@ -655,6 +655,20 @@ class NodeHost:
         ]
         node.peer_raft_events = self.raft_events
         node.quorum_coordinator = self.quorum_coordinator
+        # device state machine registration (devsm, ISSUE 11), gated
+        # default-OFF: both the config flag AND the SM's device_kv marker
+        # must be present, and only the tpu engine has a coordinator to
+        # serve it — anything else leaves the SM a plain host machine
+        node.devsm_sm = (
+            usersm
+            if (
+                config.device_kv
+                and getattr(usersm, "device_kv", False)
+                and self.quorum_coordinator is not None
+                and smtype == StateMachineType.REGULAR
+            )
+            else None
+        )
         node.fastlane = self.fastlane
         if config.read_lease and self.nhconfig.enable_metrics:
             # leader-lease instruments (ISSUE 10): one shared LeaseObs

@@ -29,6 +29,10 @@ Families (device plane, published by ``EngineObs``):
   AOT warm-compile wall time and programs warmed (ISSUE 7)
 - gauges: ``dragonboat_device_staged_rounds`` (egress/dispatch queue
   depth), ``dragonboat_device_read_slots_in_use``
+- ``dragonboat_devsm_ops_staged_total`` / ``…applied_total`` /
+  ``…reads_staged_total`` / ``…reads_served_total`` + gauge
+  ``…slot_occupancy`` — device state machine traffic (ISSUE 11), spanned
+  by the ``apply_kernel`` flight-recorder kind
 
 Coordinator plane (``CoordObs``): ``dragonboat_coord_rounds_total``,
 ``…round_latency_ms`` (histogram), ``…ops_drained_total``,
@@ -57,6 +61,7 @@ LATENCY_BUCKETS_MS = DEFAULT_BUCKETS
 _DEV = "dragonboat_device_"
 _COORD = "dragonboat_coord_"
 _HOST = "dragonboat_host_"
+_DEVSM = "dragonboat_devsm_"
 
 #: ``# HELP`` text per family (ISSUE 9 satellite: the exposition was
 #: ``# TYPE``-only).  Families not listed fall back to the registry's
@@ -102,6 +107,12 @@ _HELP = {
     _HOST + "apply_batches_total": "decoupled apply executor wakeups",
     _HOST + "apply_groups_total": "groups covered by apply batches",
     _HOST + "egress_notified_total": "client completions delivered off-worker",
+    # device state machine (devsm, ISSUE 11)
+    _DEVSM + "ops_staged_total": "KV entry ops staged into device buffers",
+    _DEVSM + "applied_total": "KV ops applied by the in-program fold",
+    _DEVSM + "reads_staged_total": "KV reads staged for device capture",
+    _DEVSM + "reads_served_total": "KV reads served from device state",
+    _DEVSM + "slot_occupancy": "entry-buffer slots holding unapplied ops",
 }
 
 
@@ -140,6 +151,14 @@ class EngineObs:
         # cost" column of the perf ledger reads these
         _DEV + "warmup_seconds",
         _DEV + "warmup_programs_total",
+        # device state machine (devsm, ISSUE 11): staged vs applied KV
+        # entry ops and the reads the plane served — applied/staged
+        # converging is the "apply rides the commit dispatch" invariant,
+        # reads_served is the zero-host-apply read traffic
+        _DEVSM + "ops_staged_total",
+        _DEVSM + "applied_total",
+        _DEVSM + "reads_staged_total",
+        _DEVSM + "reads_served_total",
     )
 
     def __init__(
@@ -151,11 +170,13 @@ class EngineObs:
         _describe(r, self._COUNTERS + (
             _DEV + "staged_rounds", _DEV + "read_slots_in_use",
             _DEV + "dispatch_latency_ms", _DEV + "egress_latency_ms",
+            _DEVSM + "slot_occupancy",
         ))
         for name in self._COUNTERS:
             r.counter_add(name, 0)
         r.gauge_set(_DEV + "staged_rounds", 0)
         r.gauge_set(_DEV + "read_slots_in_use", 0)
+        r.gauge_set(_DEVSM + "slot_occupancy", 0)
         r.histogram_declare(
             _DEV + "dispatch_latency_ms", buckets=LATENCY_BUCKETS_MS
         )
@@ -177,6 +198,42 @@ class EngineObs:
             "warmup",
             variant=variant,
             compile_ms=round(seconds * 1e3, 4),
+        )
+
+    def apply_kernel(
+        self, *, ops: int, reads: int, rounds: int, slot_occupancy: int
+    ) -> dict:
+        """One dispatch's devsm work launched (the ``apply_kernel`` span
+        kind, ISSUE 11): staged entry ops and KV reads riding the fused
+        program, plus the host view of entry-buffer occupancy.  The
+        applied/served counts land at harvest via :meth:`devsm_egress` —
+        the fold runs inside the same program as the commit advancement,
+        so the span brackets exactly the apply stage the host no longer
+        runs."""
+        r = self.registry
+        if ops:
+            r.counter_add(_DEVSM + "ops_staged_total", ops)
+        if reads:
+            r.counter_add(_DEVSM + "reads_staged_total", reads)
+        r.gauge_set(_DEVSM + "slot_occupancy", slot_occupancy)
+        return self.recorder.record(
+            "apply_kernel",
+            ops=ops,
+            reads=reads,
+            rounds=rounds,
+            slot_occupancy=slot_occupancy,
+        )
+
+    def devsm_egress(self, span: dict, *, applied: int, reads_served: int) -> None:
+        """Close an ``apply_kernel`` span at harvest: what the fold
+        applied and how many KV reads came back captured."""
+        r = self.registry
+        if applied:
+            r.counter_add(_DEVSM + "applied_total", applied)
+        if reads_served:
+            r.counter_add(_DEVSM + "reads_served_total", reads_served)
+        self.recorder.update(
+            span, applied=applied, reads_served=reads_served
         )
 
     def dispatch(

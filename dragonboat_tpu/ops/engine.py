@@ -19,6 +19,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from collections import deque
 from contextlib import nullcontext
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -34,6 +35,9 @@ from .kernels import quorum_step
 from .state import (
     CANDIDATE,
     FOLLOWER,
+    KV_ENT_SLOTS,
+    KV_READ_SLOTS,
+    KV_SLOTS,
     LEADER,
     OBSERVER,
     READ_SLOTS,
@@ -204,6 +208,8 @@ class StepResult:
         "_commit_cids", "_commit_abs", "_commit_dict",
         "read_cids", "read_slots", "read_index_abs", "read_counts",
         "_reads_list",
+        "kv_cids", "kv_slots", "kv_vals", "kv_index_abs",
+        "_kv_reads_list", "kv_applied_ops",
     )
 
     def __init__(self):
@@ -225,6 +231,16 @@ class StepResult:
         self.read_index_abs: Optional[np.ndarray] = None  # (n,) int64
         self.read_counts: Optional[np.ndarray] = None     # (n,) int64
         self._reads_list = None
+        # devsm KV read egress (None when the dispatch ran kv-free): per
+        # captured read slot, the cluster, the slot, the captured value
+        # and the ABSOLUTE commit watermark the value reflects; plus the
+        # total ops the apply fold consumed this dispatch.
+        self.kv_cids: Optional[np.ndarray] = None         # (n,) int64
+        self.kv_slots: Optional[np.ndarray] = None        # (n,) int64
+        self.kv_vals: Optional[np.ndarray] = None         # (n,) int64
+        self.kv_index_abs: Optional[np.ndarray] = None    # (n,) int64
+        self._kv_reads_list = None
+        self.kv_applied_ops: int = 0
 
     @property
     def commit(self) -> Dict[int, int]:
@@ -257,6 +273,25 @@ class StepResult:
                 )
         return self._reads_list
 
+    @property
+    def kv_reads(self) -> List[Tuple[int, int, int, int]]:
+        """Captured devsm KV reads as ``(cluster_id, slot, value,
+        abs_index)`` tuples; built on first access (vectorized twin: the
+        ``kv_*`` arrays)."""
+        if self._kv_reads_list is None:
+            if self.kv_cids is None or not len(self.kv_cids):
+                self._kv_reads_list = []
+            else:
+                self._kv_reads_list = list(
+                    zip(
+                        self.kv_cids.tolist(),
+                        self.kv_slots.tolist(),
+                        self.kv_vals.tolist(),
+                        self.kv_index_abs.tolist(),
+                    )
+                )
+        return self._kv_reads_list
+
 
 class MultiRoundResult(StepResult):
     """Egress of one K-round fused dispatch (``step_rounds``).
@@ -286,15 +321,19 @@ class _RoundBuf:
     (``ack_block_rounds``), sparing a per-round int64 conversion.
     ``reads`` / ``racks`` carry the round's staged ReadIndex batches
     ``(rows, slots, rels, counts)`` and heartbeat echoes
-    ``(rows, rslots, peers)`` as flat arrays (None = none)."""
+    ``(rows, rslots, peers)`` as flat arrays (None = none).
+    ``kvents`` / ``kvreads`` carry the round's devsm entry ops
+    ``(rows, slots, rels, keys, vals)`` and KV reads
+    ``(rows, rslots, keys)`` the same way."""
 
     __slots__ = (
         "rows", "slots", "rels", "votes", "churn", "cells", "reads", "racks",
+        "kvents", "kvreads",
     )
 
     def __init__(
         self, rows, slots, rels, votes, churn, cells=None,
-        reads=None, racks=None,
+        reads=None, racks=None, kvents=None, kvreads=None,
     ):
         self.rows = rows
         self.slots = slots
@@ -304,6 +343,8 @@ class _RoundBuf:
         self.cells = cells   # np (n,) int64 row*P+slot, or None
         self.reads = reads   # (rows, slots, rels, counts) int32 arrays
         self.racks = racks   # (rows, rslots, peers) int32 arrays
+        self.kvents = kvents    # (rows, slots, rels, keys, vals) int32 arrays
+        self.kvreads = kvreads  # (rows, rslots, keys) int32 arrays
 
 
 class BatchedQuorumEngine:
@@ -343,10 +384,16 @@ class BatchedQuorumEngine:
         device_ticks: bool = True,
         dense_ingest: str | bool = "auto",
         n_read_slots: int = READ_SLOTS,
+        n_kv_slots: int = KV_SLOTS,
+        n_kv_ents: int = KV_ENT_SLOTS,
+        n_kv_reads: int = KV_READ_SLOTS,
     ):
         self.n_groups = n_groups
         self.n_peers = n_peers
         self.n_read_slots = n_read_slots
+        self.n_kv_slots = n_kv_slots
+        self.n_kv_ents = n_kv_ents
+        self.n_kv_reads = n_kv_reads
         self.event_cap = event_cap
         #: dense-ingestion policy: collapse a round's acks into a (G,P)
         #: max matrix and dispatch the scatter-free dense kernel (see
@@ -372,7 +419,9 @@ class BatchedQuorumEngine:
         #: host ticks.  Engines that never tick (host-driven clocks) skip
         #: the reset scatter entirely (it is dead work there).
         self.device_ticks = device_ticks
-        self.mirror = HostMirror(n_groups, n_peers, n_read_slots)
+        self.mirror = HostMirror(
+            n_groups, n_peers, n_read_slots, n_kv_slots, n_kv_ents
+        )
         self.sharding = sharding
         n_dev = (
             len(getattr(sharding, "device_set", ())) if sharding is not None
@@ -474,6 +523,38 @@ class BatchedQuorumEngine:
         # _upload_dirty).  A read-free engine keeps the exact eager
         # program set it had before the read plane existed.
         self._read_plane_used = False
+        # --- device state machine staging (devsm, ISSUE 11) -------------
+        # LATCH, same contract as _read_plane_used: until the first devsm
+        # ingress (stage_kv_ops / stage_kv_read / kv_restore) the kv
+        # arrays are provably at their reset values, every dispatch runs
+        # has_kv=False, the rare-path row syncs skip the kv fields
+        # (_sync_keys) and the recycle purge compiles out (purge_kv) — an
+        # SM-free engine keeps today's host cost and eager-op set
+        # bit-identical.
+        self._devsm_used = False
+        # host record of the rel index staged in each device entry-buffer
+        # slot (-1 = free): slot ``rel % E`` is reusable once the
+        # HARVESTED commit watermark has passed its tenant (the device
+        # frees it the round the entry applies; the host learns at
+        # harvest).  Ops whose slot is still occupied queue per row in
+        # _kv_queue and drain — in log order — as harvests free slots.
+        self._kv_ent_rel = np.full((n_groups, n_kv_ents), -1, np.int64)
+        self._kv_queue: Dict[int, "deque"] = {}
+        # staged-but-undispatched kv ops / reads of the CURRENT open
+        # round, epoch-tagged like every other staging buffer:
+        # (row, slot, rel, key, val, epoch) / (row, rslot, key, epoch)
+        self._kv_stage: List[Tuple[int, int, int, int, int, int]] = []
+        self._kv_read_stage: List[Tuple[int, int, int, int]] = []
+        # a staged KV read captures in exactly its round, so its slot is
+        # busy from stage until that dispatch's harvest reports the
+        # capture (or a row transition purges it)
+        self._kv_read_busy = np.zeros((n_groups, n_kv_reads), bool)
+        # capture-egress callback (the devsm plane's read service): fired
+        # with the StepResult of EVERY harvest that carried captures —
+        # including rare-path internal harvests whose results the caller
+        # never sees (a row sync forcing _harvest_inflight would
+        # otherwise strand parked readers until their timeout)
+        self.kv_egress_hook = None
         # --- device-plane observability (ISSUE 5 tentpole) --------------
         # OFF by default: self._obs stays None and every hot-path site
         # gates on a plain `is not None` check, so an obs-off engine keeps
@@ -483,6 +564,7 @@ class BatchedQuorumEngine:
         # goes through NodeHostConfig.enable_metrics -> the coordinator.
         self._obs = None
         self._obs_span = None      # span of the in-flight fused dispatch
+        self._obs_kv_span = None   # apply_kernel span of the same dispatch
         self._obs_mu_wait = 0.0    # _MULTIDEV_MU wait of the next dispatch
         self._obs_upload = 0       # upload bytes of the current dispatch
         # seq of the newest recorded dispatch span (-1 = none / obs off):
@@ -500,7 +582,13 @@ class BatchedQuorumEngine:
         # control planes) may keep calling step_rounds without warmup —
         # they pay first-use compiles by construction and don't care.
         self._fused_ready = threading.Event()
+        # devsm program readiness (set by a warmup that included the
+        # has_kv variants, or by a later warmup_devsm): the coordinator
+        # only FUSES kv-carrying blocks once these compiled — before
+        # that they take the single-round dense path
+        self._kv_fused_ready = threading.Event()
         self._warmup_thread: Optional[threading.Thread] = None
+        self._kv_warmup_thread: Optional[threading.Thread] = None
         self._warmup_mu = threading.Lock()
         self._warmup_cancel = threading.Event()
         self.warmup_stats = {
@@ -546,12 +634,18 @@ class BatchedQuorumEngine:
         program set (the coordinator's gate for K>1 dispatches)."""
         return self._fused_ready.is_set()
 
+    @property
+    def kv_fused_ready(self) -> bool:
+        """True once the devsm (has_kv) program variants compiled."""
+        return self._kv_fused_ready.is_set()
+
     def warmup_fused(
         self,
         k_buckets=WARM_K_BUCKETS,
         include_reads: bool = True,
         include_single: bool = True,
         background: bool = True,
+        include_kv: bool = False,
     ):
         """Pre-compile the live path's device programs against a THROWAWAY
         state of identical shapes/shardings, so first use on the live
@@ -573,8 +667,15 @@ class BatchedQuorumEngine:
         ``background=True`` (default) runs on a niced daemon thread and
         returns it; the readiness latch (:attr:`fused_ready`) flips only
         after every fused variant compiled.  Repeat calls are no-ops.
+
+        ``include_kv`` adds the devsm (``has_kv``) fused and dense
+        variants — the coordinator passes it when a
+        ``DeviceKVStateMachine`` group is expected; SM-free hosts keep
+        the historical warm set and cost.  A devsm group registering
+        AFTER warmup warms its variants separately
+        (:meth:`warmup_devsm`).
         """
-        args = (tuple(k_buckets), include_reads, include_single)
+        args = (tuple(k_buckets), include_reads, include_single, include_kv)
         with self._warmup_mu:
             if self._warmup_thread is not None or self._fused_ready.is_set():
                 return self._warmup_thread
@@ -589,13 +690,80 @@ class BatchedQuorumEngine:
         self._warmup_main(*args)
         return self.warmup_stats
 
+    def warmup_devsm(self, k_buckets=WARM_K_BUCKETS, background: bool = True):
+        """Warm ONLY the devsm (``has_kv``) program variants — the
+        late-registration path: a ``DeviceKVStateMachine`` group joining
+        a coordinator whose main warmup ran kv-free must not stall its
+        first fused dispatch behind XLA.  Until :attr:`kv_fused_ready`
+        flips, kv-carrying rounds take the single-round dense path."""
+        args = (tuple(k_buckets),)
+        with self._warmup_mu:
+            if (
+                self._kv_warmup_thread is not None
+                or self._kv_fused_ready.is_set()
+            ):
+                return self._kv_warmup_thread
+            if background:
+                t = threading.Thread(
+                    target=self._warmup_devsm_main, args=args,
+                    name="engine-warmup-devsm", daemon=True,
+                )
+                self._kv_warmup_thread = t
+                t.start()
+                return t
+        self._warmup_devsm_main(*args)
+        return self.warmup_stats
+
+    def _warmup_devsm_main(self, k_buckets) -> None:
+        try:
+            # same deprioritization as the main warm thread: these XLA
+            # compiles run for tens of seconds and an un-niced compile
+            # thread starves raft/transport on a core-starved box —
+            # observed as leadership churn for the whole warm window
+            if threading.current_thread() is self._kv_warmup_thread:
+                try:
+                    os.setpriority(
+                        os.PRIO_PROCESS, threading.get_native_id(), 10
+                    )
+                except (OSError, AttributeError):
+                    pass
+            scratch = HostMirror(
+                self.n_groups, self.n_peers, self.n_read_slots,
+                self.n_kv_slots, self.n_kv_ents,
+            ).to_device(self.sharding)
+            for kind, a, hr, kv in self._kv_plan(k_buckets):
+                if self._warmup_cancel.is_set():
+                    return
+                scratch = self._warm_one(scratch, kind, a, hr, kv)
+                self.warmup_stats["programs"] += 1
+            self._kv_fused_ready.set()
+        except Exception as e:  # latch stays unset; dense path serves kv
+            elog.warning("devsm warmup failed (kv stays single-round): %r", e)
+            self.warmup_stats["error"] = repr(e)
+
+    @staticmethod
+    def _kv_plan(k_buckets):
+        """The devsm program variants: fused per K bucket with and
+        without the read plane riding along (a devsm round may carry
+        ReadIndex echoes too), plus the dense single-round fallbacks."""
+        plan = [
+            ("fused", k, hr, True)
+            for k in sorted({int(k) for k in k_buckets})
+            for hr in (False, True)
+        ]
+        plan += [("dense", dt, hr, True) for dt in (True, False)
+                 for hr in (False, True)]
+        return plan
+
     def cancel_warmup(self) -> None:
         """Stop warming after the current variant (coordinator shutdown);
         a cancelled warmup leaves the latch unset — the fallback
         single-round path simply stays in effect."""
         self._warmup_cancel.set()
 
-    def _warmup_main(self, k_buckets, include_reads, include_single) -> None:
+    def _warmup_main(
+        self, k_buckets, include_reads, include_single, include_kv=False
+    ) -> None:
         t0 = time.perf_counter()
         try:
             # same deprioritization as the coordinator round thread: a
@@ -614,28 +782,33 @@ class BatchedQuorumEngine:
                     pass
             hits0, miss0 = _CC["hits"], _CC["misses"]
             scratch = HostMirror(
-                self.n_groups, self.n_peers, self.n_read_slots
+                self.n_groups, self.n_peers, self.n_read_slots,
+                self.n_kv_slots, self.n_kv_ents,
             ).to_device(self.sharding)
             read_set = (False, True) if include_reads else (False,)
             plan = [
-                ("fused", k, hr)
+                ("fused", k, hr, False)
                 for k in sorted({int(k) for k in k_buckets})
                 for hr in read_set
             ]
             if include_single:
-                plan += [("sparse", dt, False) for dt in (True, False)]
+                plan += [("sparse", dt, False, False) for dt in (True, False)]
                 # elections dispatch the vote-carrying sparse variant;
                 # warm it so the first campaign after enable doesn't
                 # compile either
-                plan += [("sparse_votes", dt, False) for dt in (True, False)]
+                plan += [
+                    ("sparse_votes", dt, False, False) for dt in (True, False)
+                ]
                 if include_reads:
-                    plan += [("dense", dt, True) for dt in (True, False)]
-            for kind, a, hr in plan:
+                    plan += [("dense", dt, True, False) for dt in (True, False)]
+            if include_kv:
+                plan += self._kv_plan(k_buckets)
+            for kind, a, hr, kv in plan:
                 if self._warmup_cancel.is_set():
                     self.warmup_stats["error"] = "cancelled"
                     return
                 tv = time.perf_counter()
-                scratch = self._warm_one(scratch, kind, a, hr)
+                scratch = self._warm_one(scratch, kind, a, hr, kv)
                 dt_s = time.perf_counter() - tv
                 self.warmup_stats["programs"] += 1
                 obs = self._obs  # re-read: may attach mid-warmup
@@ -644,13 +817,15 @@ class BatchedQuorumEngine:
                         variant=(
                             f"{kind}:k{a}" if kind == "fused"
                             else f"{kind}:{'tick' if a else 'notick'}"
-                        ) + (":reads" if hr else ""),
+                        ) + (":reads" if hr else "") + (":kv" if kv else ""),
                         seconds=dt_s,
                     )
             self.warmup_stats["seconds"] = time.perf_counter() - t0
             self.warmup_stats["cache_hits"] = _CC["hits"] - hits0
             self.warmup_stats["cache_misses"] = _CC["misses"] - miss0
             self._fused_ready.set()
+            if include_kv:
+                self._kv_fused_ready.set()
             elog.info(
                 "engine warmup: %d programs in %.2fs (cache: %d hits, "
                 "%d misses)",
@@ -663,7 +838,10 @@ class BatchedQuorumEngine:
             self.warmup_stats["seconds"] = time.perf_counter() - t0
             elog.warning("engine warmup failed (fused path stays off): %r", e)
 
-    def _warm_one(self, scratch: QuorumState, kind: str, arg, has_reads: bool):
+    def _warm_one(
+        self, scratch: QuorumState, kind: str, arg, has_reads: bool,
+        has_kv: bool = False,
+    ):
         """Compile-and-run one variant against the scratch state (donated;
         the successor state is returned).  Shapes/statics must mirror the
         live call sites EXACTLY — a near-miss warms a program the live
@@ -671,16 +849,25 @@ class BatchedQuorumEngine:
         from .kernels import quorum_multiround, quorum_step_dense
 
         g, p, s = self.n_groups, self.n_peers, self.n_read_slots
+        e, rk = self.n_kv_ents, self.n_kv_reads
         if has_reads:
             read_dims = lambda *lead: (  # noqa: E731
                 jnp.full(lead + (g, s), -1, jnp.int32),
                 jnp.zeros(lead + (g, s), jnp.int32),
                 jnp.zeros(lead + (g, s, p), bool),
             )
+        if has_kv:
+            kv_dims = lambda *lead: (  # noqa: E731
+                jnp.full(lead + (g, e), -1, jnp.int32),
+                jnp.zeros(lead + (g, e), jnp.int32),
+                jnp.zeros(lead + (g, e), jnp.int32),
+                jnp.full(lead + (g, rk), -1, jnp.int32),
+            )
         with self._dispatch_mu:  # multi-device programs take the lock
             if kind == "fused":
                 k = arg
                 read_args = read_dims(k) if has_reads else (None, None, None)
+                kv_args = kv_dims(k) if has_kv else (None, None, None, None)
                 z11 = jnp.zeros((1, 1), jnp.int32)
                 out = quorum_multiround(
                     scratch,
@@ -689,26 +876,32 @@ class BatchedQuorumEngine:
                     z11, z11, z11, z11,
                     jnp.zeros((k,), bool),
                     *read_args,
+                    *kv_args,
                     do_tick=True,
                     track_contact=True,
                     has_votes=False,
                     has_churn=False,
                     has_reads=has_reads,
                     purge_reads=False,
+                    has_kv=has_kv,
+                    purge_kv=False,
                 )
             elif kind == "dense":
                 do_tick = arg
                 read_args = read_dims() if has_reads else (None, None, None)
+                kv_args = kv_dims() if has_kv else (None, None, None, None)
                 out = quorum_step_dense(
                     scratch,
                     jnp.zeros((g, p), jnp.int32),
                     jnp.zeros((g, p), bool),
                     jnp.zeros((1, 1), jnp.int8),
                     *read_args,
+                    *kv_args,
                     do_tick=do_tick,
                     track_contact=self.device_ticks or do_tick,
                     has_votes=False,
                     has_reads=has_reads,
+                    has_kv=has_kv,
                 )
             else:  # sparse single-round (the quiet-path workhorse)
                 do_tick = arg
@@ -827,6 +1020,9 @@ class BatchedQuorumEngine:
         if self._read_plane_used:  # else provably already clear
             self.mirror.clear_reads(row)
             self._reset_read_rows([row])
+        if self._devsm_used:  # fresh registration starts from an empty KV
+            self.mirror.clear_kv(row)
+            self._reset_kv_rows([row])
         self._dirty.add(row)
         return gi
 
@@ -842,11 +1038,20 @@ class BatchedQuorumEngine:
         Pending READS die with the transition too (scalar twin: every
         ``become_*`` builds a fresh ``ReadIndex``) — slot bookkeeping and
         the mirror's read fields reset here; staged read/echo events fall
-        to the same epoch filter as acks/votes."""
+        to the same epoch filter as acks/votes.
+
+        Devsm: BUFFERED entry ops die too (they sit strictly above the
+        commit watermark — an uncertain log suffix the next leadership
+        may rewrite), while the applied ``kv_value`` rows persist exactly
+        like a scalar SM across terms.  Queued ops, staged slots and
+        pending read captures drop with the host bookkeeping reset."""
         self._row_epoch[row] += 1
         self._reset_read_rows([row])
         if self._read_plane_used:  # else provably already clear
             self.mirror.clear_reads(row)
+        self._reset_kv_rows([row])
+        if self._devsm_used:  # else provably already clear
+            self.mirror.clear_kv_ents(row)
 
     def _drop_churn_records(self, row: int, drop_events: bool = False) -> None:
         """Strip every undispatched recycle record for ``row`` — from the
@@ -884,6 +1089,7 @@ class BatchedQuorumEngine:
                 if b.votes:
                     b.votes = [v for v in b.votes if v[0] != row]
                 self._purge_block_reads(b, row)
+                self._purge_block_kv(b, row)
 
     @staticmethod
     def _purge_block_reads(b, row: int) -> None:
@@ -1018,6 +1224,22 @@ class BatchedQuorumEngine:
         # floor only ever REWRITES a release index up (rel 0 = the old
         # committed), which ReadIndex semantics permit
         a["read_index"][row, :] = np.maximum(a["read_index"][row, :] - shift, 0)
+        if self._devsm_used:
+            # buffered devsm entries shift with the base (they sit above
+            # the old committed == the shift, so the result stays >= 1);
+            # host slot records whose tenants the shift proves applied
+            # free outright
+            ents = a["kv_ent_index"][row, :]
+            a["kv_ent_index"][row, :] = np.where(ents >= 0, ents - shift, -1)
+            kv = self._kv_ent_rel[row]
+            self._kv_ent_rel[row] = np.where(
+                (kv >= 0) & (kv - shift > 0), kv - shift, -1
+            )
+            q = self._kv_queue.get(row)
+            if q:
+                self._kv_queue[row] = deque(
+                    (rel - shift, key, val) for rel, key, val in q
+                )
         self._dirty.add(row)
 
     # ------------------------------------------------------------------
@@ -1380,6 +1602,235 @@ class BatchedQuorumEngine:
         )
 
     # ------------------------------------------------------------------
+    # device state machine: entry ops + KV reads (devsm, ISSUE 11)
+    # ------------------------------------------------------------------
+
+    def stage_kv_op(
+        self, cluster_id: int, index: int, key: int, value: int
+    ) -> None:
+        """Stage one committed-entry ``SET key := value`` op for log
+        ``index`` (absolute).  Scalar twin: the apply executor handing the
+        entry to the user SM's ``update`` — here the write happens inside
+        the fused program the moment the commit watermark passes the
+        index, as a ``(G, slots)`` tensor update in HBM."""
+        self.stage_kv_ops(cluster_id, [index], [key], [value])
+
+    def stage_kv_ops(self, cluster_id: int, indexes, keys, values) -> bool:
+        """Vectorized entry-op staging for one group.  ``indexes`` must be
+        strictly increasing (log-append order); ops whose buffer slot
+        (``rel % E``) still holds an unapplied tenant queue host-side and
+        drain — order preserved — as harvested commit watermarks free
+        slots.  A queued op therefore never errors; it just rides a later
+        round (the scalar twin's apply queue depth, bounded by E on
+        device and unbounded host-side).
+
+        Returns True when EVERYTHING staged immediately (nothing queued
+        for the row).  A False is the backpressure signal consumers that
+        release reads at the commit watermark must honor: a QUEUED op may
+        commit before it applies, so ``kv_value`` momentarily trails the
+        watermark — the live plane unbinds and re-arms past the batch
+        (``DevKVPlane.handle_ops``) instead of serving that window."""
+        gi = self.groups[cluster_id]
+        row = gi.row
+        indexes = np.asarray(indexes, dtype=np.int64)
+        keys = np.asarray(keys, dtype=np.int64)
+        values = np.asarray(values, dtype=np.int64)
+        if not (indexes.shape == keys.shape == values.shape) or (
+            indexes.ndim != 1
+        ):
+            raise ValueError("stage_kv_ops arrays must share a 1-D shape")
+        if indexes.size == 0:
+            return True  # nothing to stage, nothing queued
+        rels = indexes - gi.base
+        if rels.min() < 1:
+            raise ValueError("stage_kv_ops index at or below the group base")
+        if rels.max() >= REBASE_THRESHOLD:
+            raise ValueError("stage_kv_ops index needs rebase")
+        if indexes.size > 1 and (np.diff(indexes) <= 0).any():
+            raise ValueError("stage_kv_ops indexes must be strictly increasing")
+        if keys.min() < 0 or keys.max() >= self.n_kv_slots:
+            raise ValueError("stage_kv_ops key slot out of range")
+        imin, imax = np.iinfo(np.int32).min, np.iinfo(np.int32).max
+        if values.min() < imin or values.max() > imax:
+            raise ValueError("stage_kv_ops value outside int32")
+        self._devsm_used = True
+        q = self._kv_queue.setdefault(row, deque())
+        for rel, key, val in zip(
+            rels.tolist(), keys.tolist(), values.tolist()
+        ):
+            q.append((rel, key, val))
+        self._drain_kv_queue(row)
+        return row not in self._kv_queue
+
+    def _drain_kv_queue(self, row: int) -> None:
+        """Move queued ops into the open round while their slots are
+        free, in log order; stops at the first occupied slot (staging out
+        of order would let a later op apply before an earlier same-key
+        one)."""
+        q = self._kv_queue.get(row)
+        if not q:
+            self._kv_queue.pop(row, None)
+            return
+        e = self.n_kv_ents
+        ep = int(self._row_epoch[row])
+        ent_rel = self._kv_ent_rel[row]
+        while q:
+            rel, key, val = q[0]
+            slot = rel % e
+            if ent_rel[slot] != -1:
+                break
+            ent_rel[slot] = rel
+            self._kv_stage.append((row, slot, rel, key, val, ep))
+            q.popleft()
+        if not q:
+            self._kv_queue.pop(row, None)
+
+    def _kv_free_applied(self) -> None:
+        """Free entry-buffer slots whose tenants the HARVESTED commit
+        watermark has passed (the device freed them the round they
+        applied), then drain any host-queued overflow into the open
+        round.  Runs at every egress; devsm-free engines skip it via the
+        latch."""
+        mask = (self._kv_ent_rel >= 0) & (
+            self._kv_ent_rel <= self._committed_cache[:, None]
+        )
+        if mask.any():
+            self._kv_ent_rel[mask] = -1
+        for row in list(self._kv_queue):
+            self._drain_kv_queue(row)
+
+    def stage_kv_read(self, cluster_id: int, key: int) -> int:
+        """Stage a device KV read for the group; returns the read SLOT
+        the capture will egress under (``StepResult.kv_reads``).  The
+        value is captured in the read's own round, AFTER that round's
+        apply fold, together with the commit watermark it reflects — the
+        caller checks the watermark against its ReadIndex release index
+        (on this plane apply == commit, so watermark >= release index
+        means the value is linearizable for that release).
+
+        Raises ``RuntimeError`` when all R slots hold un-harvested
+        captures — backpressure, the ``stage_read`` precedent."""
+        gi = self.groups[cluster_id]
+        row = gi.row
+        if not (0 <= key < self.n_kv_slots):
+            raise ValueError(f"kv key slot {key} out of range")
+        free = np.nonzero(~self._kv_read_busy[row])[0]
+        if not free.size:
+            raise RuntimeError(
+                f"no free devsm read slot for group {cluster_id}"
+            )
+        slot = int(free[0])
+        self._devsm_used = True
+        self._kv_read_busy[row, slot] = True
+        self._kv_read_stage.append(
+            (row, slot, key, int(self._row_epoch[row]))
+        )
+        return slot
+
+    def kv_reads_free(self, cluster_id: int) -> int:
+        """Free devsm read slots for the group right now."""
+        row = self.groups[cluster_id].row
+        return int((~self._kv_read_busy[row]).sum())
+
+    def kv_values(self, cluster_id: int) -> np.ndarray:
+        """The group's device KV row (introspection / snapshot save):
+        pending mirror edits win over the device, like every rare-path
+        read."""
+        gi = self.groups[cluster_id]
+        return np.array(self._read("kv_value", gi.row), dtype=np.int64)
+
+    def kv_restore(self, cluster_id: int, values) -> None:
+        """Install a group's KV image (snapshot recover / the devsm
+        plane's leadership rebind): mirror row write + dirty upload, with
+        the pending-entry buffer cleared — the image IS the applied
+        state, nothing buffered belongs with it."""
+        gi = self.groups[cluster_id]
+        row = gi.row
+        values = np.asarray(values, dtype=np.int64)
+        if values.shape != (self.n_kv_slots,):
+            raise ValueError(
+                f"kv_restore expects shape ({self.n_kv_slots},), "
+                f"got {values.shape}"
+            )
+        self._devsm_used = True
+        self._sync_row(row)
+        a = self.mirror.arrays
+        a["kv_value"][row, :] = values.astype(np.int32)
+        self.mirror.clear_kv_ents(row)
+        self._reset_kv_rows([row])
+        self._dirty.add(row)
+
+    def _reset_kv_rows(self, rows) -> None:
+        """Drop the rows' devsm host bookkeeping (transition purge twin
+        of ``_reset_read_rows``): queued ops die, staged slots free, read
+        captures are abandoned.  Device-side entry buffers are cleared by
+        the caller's mirror write (``clear_kv_ents``) or the in-program
+        recycle reset."""
+        if not self._devsm_used:
+            return
+        self._kv_ent_rel[rows] = -1
+        self._kv_read_busy[rows] = False
+        for r in np.atleast_1d(np.asarray(rows, dtype=np.int64)):
+            self._kv_queue.pop(int(r), None)
+
+    def _gather_kv(self):
+        """Open-round devsm buffers as flat arrays with stale-epoch
+        events filtered; clears the buffers.  Returns ``(kvents,
+        kvreads)`` — tuples of int32 arrays or None.  Re-attempts the
+        overflow drain first so ops unblocked by the latest harvest ride
+        this round."""
+        if self._kv_queue:
+            for row in list(self._kv_queue):
+                self._drain_kv_queue(row)
+        kvents = kvreads = None
+        if self._kv_stage:
+            cols = np.array(self._kv_stage, dtype=np.int64)
+            rows = cols[:, 0].astype(np.int32)
+            keep = cols[:, 5].astype(np.int32) == self._row_epoch[rows]
+            if keep.any():
+                kvents = tuple(
+                    cols[keep, i].astype(np.int32) for i in range(5)
+                )
+            self._kv_stage = []
+        if self._kv_read_stage:
+            cols = np.array(self._kv_read_stage, dtype=np.int64)
+            rows = cols[:, 0].astype(np.int32)
+            keep = cols[:, 3].astype(np.int32) == self._row_epoch[rows]
+            if keep.any():
+                kvreads = tuple(
+                    cols[keep, i].astype(np.int32) for i in range(3)
+                )
+            self._kv_read_stage = []
+        return kvents, kvreads
+
+    def _kv_pending(self) -> bool:
+        return bool(
+            self._kv_stage or self._kv_read_stage or self._kv_queue
+        )
+
+    def _kv_ents_buffered(self) -> bool:
+        """True while any entry-buffer slot holds an op the harvested
+        watermark has not passed — the condition under which every
+        dispatch must carry the apply fold (see ``_step_locked``)."""
+        return self._devsm_used and bool((self._kv_ent_rel >= 0).any())
+
+    @staticmethod
+    def _purge_block_kv(b, row: int) -> None:
+        """Drop ``row``'s staged devsm ops/reads from one sealed round
+        block (recycle path: an old-tenant op applying before the
+        in-program reset is wasted work, and a read capture there would
+        egress misattributed to the new tenant — the ``_purge_block_reads``
+        rationale exactly)."""
+        if b.kvents is not None and b.kvents[0].size:
+            keep = b.kvents[0] != row
+            if not keep.all():
+                b.kvents = tuple(a[keep] for a in b.kvents)
+        if b.kvreads is not None and b.kvreads[0].size:
+            keep = b.kvreads[0] != row
+            if not keep.all():
+                b.kvreads = tuple(a[keep] for a in b.kvreads)
+
+    # ------------------------------------------------------------------
     # multi-round fused staging (ISSUE 1 tentpole)
     # ------------------------------------------------------------------
 
@@ -1403,10 +1854,11 @@ class BatchedQuorumEngine:
             votes = []
         rows, slots, rels = self._gather_acks()
         reads, racks = self._gather_reads()
+        kvents, kvreads = self._gather_kv()
         self._round_blocks.append(
             _RoundBuf(
                 rows, slots, rels, votes, self._churn,
-                reads=reads, racks=racks,
+                reads=reads, racks=racks, kvents=kvents, kvreads=kvreads,
             )
         )
         self._churn = []
@@ -1446,7 +1898,7 @@ class BatchedQuorumEngine:
             raise ValueError("ack_block_rounds slot out of range")
         if (
             self._acks or self._ack_blocks or self._votes or self._churn
-            or self._reads_pending()
+            or self._reads_pending() or self._kv_pending()
         ):
             self.begin_round()
         rows32 = rows.astype(np.int32, copy=False)
@@ -1533,15 +1985,20 @@ class BatchedQuorumEngine:
         # (G,S) accumulators can only attribute it to the row's final
         # tenant — a misdelivered read.  Reads are droppable by contract
         # (the scalar path drops on leader change/timeout and clients
-        # retry), so dropping beats misattributing.
+        # retry), so dropping beats misattributing.  Devsm ops/reads of
+        # the old tenant die the same way (_purge_block_kv rationale).
         for b in self._round_blocks:
             self._purge_block_reads(b, row)
+            self._purge_block_kv(b, row)
+        self._reset_kv_rows([row])
         # mirror coherence WITHOUT dirtying the row: the device applies
         # the identical reset in-program (state.HostMirror.recycle_row);
         # until the block dispatches, host reads of this row resolve to
         # the mirror (_read / committed caches), never the stale device
         self.mirror.recycle_row(
-            row, term, term_start, last_index, clear_reads=self._read_plane_used
+            row, term, term_start, last_index,
+            clear_reads=self._read_plane_used,
+            clear_kv=self._devsm_used,
         )
         self._committed_cache[row] = 0
         self._synced.discard(row)
@@ -1610,7 +2067,7 @@ class BatchedQuorumEngine:
     ) -> Optional[MultiRoundResult]:
         if (
             self._acks or self._ack_blocks or self._votes or self._churn
-            or self._reads_pending()
+            or self._reads_pending() or self._kv_pending()
         ):
             self.begin_round()
         if not self._round_blocks:
@@ -1667,8 +2124,12 @@ class BatchedQuorumEngine:
         self._inflight = None
         obs = self._obs
         span, self._obs_span = self._obs_span, None
+        kv_span, self._obs_kv_span = self._obs_kv_span, None
         t_eg = time.perf_counter() if obs is not None else 0.0
-        committed, won, lost, elect, hb, demote, rdc, rdi = jax.device_get(
+        (
+            committed, won, lost, elect, hb, demote, rdc, rdi,
+            kvv, kvi, kva,
+        ) = jax.device_get(
             (
                 out.committed,
                 out.won,
@@ -1678,6 +2139,9 @@ class BatchedQuorumEngine:
                 out.flags.checkq_demote,
                 out.read_done_count,
                 out.read_done_index,
+                out.kv_read_val,
+                out.kv_read_index,
+                out.kv_applied,
             )
         )
         res = MultiRoundResult(n_rounds)
@@ -1694,6 +2158,12 @@ class BatchedQuorumEngine:
             self._committed_cache[rows] = (
                 self.mirror.arrays["committed"][rows]
             )
+        if kvi is not None:
+            self._translate_kv(res, kvv, kvi, kva, row_cid, row_base)
+            if self.kv_egress_hook is not None:
+                self.kv_egress_hook(res)
+        if self._devsm_used:
+            self._kv_free_applied()
         res.commit_rows = self._translate_egress(
             res, committed, prev_committed, row_cid, row_base,
             (("won", won), ("lost", lost), ("elect", elect),
@@ -1707,6 +2177,14 @@ class BatchedQuorumEngine:
                 reads_released=(
                     int(res.read_counts.sum())
                     if res.read_counts is not None else 0
+                ),
+            )
+        if obs is not None and kv_span is not None:
+            obs.devsm_egress(
+                kv_span,
+                applied=res.kv_applied_ops,
+                reads_served=(
+                    int(len(res.kv_cids)) if res.kv_cids is not None else 0
                 ),
             )
         return res
@@ -1732,6 +2210,27 @@ class BatchedQuorumEngine:
                 cids = row_cid[idx]
                 getattr(res, name).extend(cids[cids >= 0].tolist())
         return changed
+
+    def _translate_kv(self, res, kvv, kvi, kva, row_cid, row_base) -> None:
+        """Vectorized devsm egress translation: the device's (G,R)
+        capture accumulators become flat (cid, slot, value, abs index)
+        vectors (dead rows dropped; the tuple list materializes lazily
+        via ``StepResult.kv_reads``), captured read slots free for
+        restaging, and the block's applied-op total lands on the
+        result."""
+        kvi = np.asarray(kvi)
+        res.kv_applied_ops = int(np.asarray(kva).sum())
+        rows, slots = np.nonzero(kvi >= 0)
+        if not rows.size:
+            return
+        self._kv_read_busy[rows, slots] = False
+        cids = row_cid[rows]
+        live = cids >= 0
+        rows, slots = rows[live], slots[live]
+        res.kv_cids = cids[live]
+        res.kv_slots = slots.astype(np.int64)
+        res.kv_vals = np.asarray(kvv)[rows, slots].astype(np.int64)
+        res.kv_index_abs = row_base[rows] + kvi[rows, slots]
 
     @staticmethod
     def _translate_reads(res, done_cnt, done_idx, row_cid, row_base) -> None:
@@ -1832,6 +2331,30 @@ class BatchedQuorumEngine:
             )
         else:
             read_args = (None, None, None)
+        has_kv = any(
+            b.kvents is not None or b.kvreads is not None for b in blocks
+        ) or self._kv_ents_buffered()  # fold runs while ops sit buffered
+        if has_kv:
+            e, rk = self.n_kv_ents, self.n_kv_reads
+            kv_ei = np.full((k, g, e), -1, np.int32)
+            kv_ek = np.zeros((k, g, e), np.int32)
+            kv_ev = np.zeros((k, g, e), np.int32)
+            kv_rk = np.full((k, g, rk), -1, np.int32)
+            for r, b in enumerate(blocks):
+                if b.kvents is not None and b.kvents[0].size:
+                    rr, sl, rel, key, val = b.kvents
+                    kv_ei[r, rr, sl] = rel
+                    kv_ek[r, rr, sl] = key
+                    kv_ev[r, rr, sl] = val
+                if b.kvreads is not None and b.kvreads[0].size:
+                    rr, sl, key = b.kvreads
+                    kv_rk[r, rr, sl] = key
+            kv_args = (
+                jnp.asarray(kv_ei), jnp.asarray(kv_ek),
+                jnp.asarray(kv_ev), jnp.asarray(kv_rk),
+            )
+        else:
+            kv_args = (None, None, None, None)
         out = quorum_multiround(
             self._dev,
             jnp.asarray(ack_max),
@@ -1842,6 +2365,7 @@ class BatchedQuorumEngine:
             jnp.asarray(churn_last),
             jnp.asarray(tick_mask),
             *read_args,
+            *kv_args,
             do_tick=do_tick,
             track_contact=self.device_ticks or do_tick,
             has_votes=has_votes,
@@ -1856,6 +2380,9 @@ class BatchedQuorumEngine:
             # fused program the moment the first read stages (exactly
             # the first-use stall the warmup pass exists to kill)
             purge_reads=self._read_plane_used and has_churn,
+            has_kv=has_kv,
+            # the devsm twin of purge_reads, same normalization rationale
+            purge_kv=self._devsm_used and has_churn,
         )
         self._dev = out.state
         if obs is not None:
@@ -1878,6 +2405,23 @@ class BatchedQuorumEngine:
                 )
             if has_reads:
                 up += stage_idx.nbytes + stage_cnt.nbytes + echo.nbytes
+            if has_kv:
+                up += (
+                    kv_ei.nbytes + kv_ek.nbytes + kv_ev.nbytes + kv_rk.nbytes
+                )
+                n_kvops = int(sum(
+                    b.kvents[0].size for b in blocks if b.kvents is not None
+                ))
+                n_kvreads = int(sum(
+                    b.kvreads[0].size for b in blocks
+                    if b.kvreads is not None
+                ))
+                self._obs_kv_span = obs.apply_kernel(
+                    ops=n_kvops,
+                    reads=n_kvreads,
+                    rounds=k,
+                    slot_occupancy=int((self._kv_ent_rel >= 0).sum()),
+                )
             mu_wait, self._obs_mu_wait = self._obs_mu_wait, 0.0
             self._obs_span = obs.dispatch(
                 "fused",
@@ -1970,18 +2514,24 @@ class BatchedQuorumEngine:
                 )
         self._synced.add(row)
 
+    _READ_KEYS = ("read_index", "read_count", "read_acks")
+    _KV_KEYS = ("kv_value", "kv_ent_index", "kv_ent_key", "kv_ent_val")
+
     def _sync_keys(self):
         """Mirror fields the rare-path row syncs move between host and
         device.  The read-plane arrays join only once the plane has been
         used (see the ``_read_plane_used`` latch in ``__init__``); before
         that both sides are all-zero by construction and the extra eager
-        gather/scatter programs must not be dispatched at all."""
-        if self._read_plane_used:
+        gather/scatter programs must not be dispatched at all.  The devsm
+        arrays follow the same rule on their own latch."""
+        skip = ()
+        if not self._read_plane_used:
+            skip += self._READ_KEYS
+        if not self._devsm_used:
+            skip += self._KV_KEYS
+        if not skip:
             return list(self.mirror.arrays)
-        return [
-            k for k in self.mirror.arrays
-            if k not in ("read_index", "read_count", "read_acks")
-        ]
+        return [k for k in self.mirror.arrays if k not in skip]
 
     @staticmethod
     def _pad_pow2_rows(idx: np.ndarray) -> np.ndarray:
@@ -2103,14 +2653,25 @@ class BatchedQuorumEngine:
         n_dispatches = 1
         ack_g, ack_p, ack_v = self._gather_acks()
         reads, racks = self._gather_reads()
+        kvents, kvreads = self._gather_kv()
         n_votes = len(self._votes) if obs is not None else 0
         has_reads = reads is not None or racks is not None
+        # the apply fold must ALSO run while any entry sits buffered on
+        # device: its commit may land in this (otherwise kv-free)
+        # dispatch, and a fold-free program would leave it unapplied —
+        # stale for kv_values and unsafe for the host slot-free rule.
+        # Empties back to event-driven the moment the buffers drain.
+        has_kv = (
+            kvents is not None or kvreads is not None
+            or self._kv_ents_buffered()
+        )
         # dense mode collapses ANY number of acks/votes into (G,P)
         # matrices — no cap, no chunk loop (votes are already first-wins
         # deduped per cell, so a dense matrix holds a whole round).
-        # The read plane exists only on the dense kernel, so pending
-        # reads force dense regardless of occupancy or policy.
-        if has_reads or self.dense_ingest is True or (
+        # The read plane — and the devsm plane — exist only on the dense
+        # kernel, so pending reads/kv ops force dense regardless of
+        # occupancy or policy.
+        if has_reads or has_kv or self.dense_ingest is True or (
             self.dense_ingest == "auto"
             and (
                 ack_g.size >= self._dense_threshold
@@ -2119,7 +2680,8 @@ class BatchedQuorumEngine:
             )
         ):
             out = self._dispatch_dense(
-                ack_g, ack_p, ack_v, self._votes, do_tick, reads, racks
+                ack_g, ack_p, ack_v, self._votes, do_tick, reads, racks,
+                kvents, kvreads, has_kv=has_kv,
             )
         else:
             pos = 0
@@ -2148,6 +2710,13 @@ class BatchedQuorumEngine:
         if obs is not None:
             n_reads = int(reads[0].size) if reads is not None else 0
             n_echo = int(racks[0].size) if racks is not None else 0
+            if has_kv:
+                self._obs_kv_span = obs.apply_kernel(
+                    ops=int(kvents[0].size) if kvents is not None else 0,
+                    reads=int(kvreads[0].size) if kvreads is not None else 0,
+                    rounds=1,
+                    slot_occupancy=int((self._kv_ent_rel >= 0).sum()),
+                )
             mu_wait, self._obs_mu_wait = self._obs_mu_wait, 0.0
             upload, self._obs_upload = self._obs_upload, 0
             span = obs.dispatch(
@@ -2178,7 +2747,10 @@ class BatchedQuorumEngine:
         res = StepResult()
         # one batched device→host transfer for the whole egress set (a
         # network-attached chip pays the full round trip per readback)
-        committed, won, lost, elect, hb, demote, rdc, rdi = jax.device_get(
+        (
+            committed, won, lost, elect, hb, demote, rdc, rdi,
+            kvv, kvi, kva,
+        ) = jax.device_get(
             (
                 out.committed,
                 out.won,
@@ -2188,6 +2760,9 @@ class BatchedQuorumEngine:
                 out.flags.checkq_demote,
                 out.read_done_count,
                 out.read_done_index,
+                out.kv_read_val,
+                out.kv_read_index,
+                out.kv_applied,
             )
         )
         if rdc is not None:
@@ -2195,6 +2770,14 @@ class BatchedQuorumEngine:
         # device_get arrays are read-only; the cache must stay writable
         # for _upload_dirty's row sync
         self._committed_cache = np.array(committed, dtype=np.int32)
+        if kvi is not None:
+            self._translate_kv(
+                res, kvv, kvi, kva, self._row_cid, self._row_base
+            )
+            if self.kv_egress_hook is not None:
+                self.kv_egress_hook(res)
+        if self._devsm_used:
+            self._kv_free_applied()
         changed = self._translate_egress(
             res, committed, prev_committed, self._row_cid, self._row_base,
             (("won", won), ("lost", lost), ("elect", elect),
@@ -2210,6 +2793,16 @@ class BatchedQuorumEngine:
                     if res.read_counts is not None else 0
                 ),
             )
+            kv_span, self._obs_kv_span = self._obs_kv_span, None
+            if kv_span is not None:
+                obs.devsm_egress(
+                    kv_span,
+                    applied=res.kv_applied_ops,
+                    reads_served=(
+                        int(len(res.kv_cids))
+                        if res.kv_cids is not None else 0
+                    ),
+                )
         return res
 
     def _gather_acks(self):
@@ -2298,12 +2891,14 @@ class BatchedQuorumEngine:
         return out
 
     def _dispatch_dense(
-        self, ag, ap, av, votes, do_tick: bool, reads=None, racks=None
+        self, ag, ap, av, votes, do_tick: bool, reads=None, racks=None,
+        kvents=None, kvreads=None, has_kv=None,
     ):
         """Aggregate a round's events into (G,P) matrices and run the
         scatter-free dense kernel (kernels.quorum_step_dense_impl).
         ``reads``/``racks`` are the round's gathered read-plane buffers
-        (``_gather_reads`` shape); the read plane lives only on this
+        (``_gather_reads`` shape) and ``kvents``/``kvreads`` the devsm
+        buffers (``_gather_kv`` shape); both planes live only on this
         kernel — step() forces dense whenever they are present."""
         from .kernels import quorum_step_dense
 
@@ -2343,10 +2938,36 @@ class BatchedQuorumEngine:
             )
         else:
             read_args = (None, None, None)
+        if has_kv is None:
+            has_kv = kvents is not None or kvreads is not None
+        if has_kv:
+            e, rk = self.n_kv_ents, self.n_kv_reads
+            kv_ei = np.full((g, e), -1, np.int32)
+            kv_ek = np.zeros((g, e), np.int32)
+            kv_ev = np.zeros((g, e), np.int32)
+            kv_rk = np.full((g, rk), -1, np.int32)
+            if kvents is not None and kvents[0].size:
+                rr, sl, rel, key, val = kvents
+                kv_ei[rr, sl] = rel
+                kv_ek[rr, sl] = key
+                kv_ev[rr, sl] = val
+            if kvreads is not None and kvreads[0].size:
+                rr, sl, key = kvreads
+                kv_rk[rr, sl] = key
+            kv_args = (
+                jnp.asarray(kv_ei), jnp.asarray(kv_ek),
+                jnp.asarray(kv_ev), jnp.asarray(kv_rk),
+            )
+        else:
+            kv_args = (None, None, None, None)
         if self._obs is not None:
             up = ack_max.nbytes + touched.nbytes + vote_new.nbytes
             if has_reads:
                 up += stage_idx.nbytes + stage_cnt.nbytes + echo.nbytes
+            if has_kv:
+                up += (
+                    kv_ei.nbytes + kv_ek.nbytes + kv_ev.nbytes + kv_rk.nbytes
+                )
             self._obs_upload += up
         out = quorum_step_dense(
             self.dev,
@@ -2354,10 +2975,12 @@ class BatchedQuorumEngine:
             jnp.asarray(touched),
             jnp.asarray(vote_new),
             *read_args,
+            *kv_args,
             do_tick=do_tick,
             track_contact=self.device_ticks or do_tick,
             has_votes=bool(votes),
             has_reads=has_reads,
+            has_kv=has_kv,
         )
         self._dev = out.state
         return out

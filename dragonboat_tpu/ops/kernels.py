@@ -183,6 +183,15 @@ class StepOutputs(NamedTuple):
     # prefix release does the same rewrite, readindex.py:70-74).
     read_done_count: jax.Array | None = None  # (G,S) i32
     read_done_index: jax.Array | None = None  # (G,S) i32 rel, -1 = none
+    # devsm egress (None unless has_kv): per staged KV read slot, the
+    # captured value and the commit watermark it was captured at (-1 =
+    # slot not staged this dispatch).  The engine never restages a read
+    # slot within one block, so a multi-round scan's per-round captures
+    # merge by simple overwrite-where-staged.  ``kv_applied`` counts ops
+    # the apply fold consumed (per group; summed across a block).
+    kv_read_val: jax.Array | None = None      # (G,R) i32
+    kv_read_index: jax.Array | None = None    # (G,R) i32 rel, -1 = none
+    kv_applied: jax.Array | None = None       # (G,) i32
 
 
 def read_confirm(
@@ -254,6 +263,76 @@ def _read_plane(
         read_index=read_index, read_count=read_count, read_acks=read_acks
     )
     return st, done_count, done_index
+
+
+def _kv_plane(
+    st: QuorumState,
+    ent_idx: jax.Array,   # (G,E) i32 — staged op log index per buffer slot; -1 = no stage
+    ent_key: jax.Array,   # (G,E) i32 — staged op key slot
+    ent_val: jax.Array,   # (G,E) i32 — staged op value
+    read_key: jax.Array,  # (G,R) i32 — staged KV read keys; -1 = no read
+) -> tuple[QuorumState, jax.Array, jax.Array, jax.Array]:
+    """One round of the device state machine (devsm, ISSUE 11): stage →
+    apply → read.  Returns ``(state, read_val, read_idx, applied)``.
+
+    Stage: a non-``-1`` ``ent_idx`` cell overwrites its buffer slot (the
+    engine's host bookkeeping only restages a slot whose previous tenant
+    provably applied — the slot-occupancy rule in
+    ``BatchedQuorumEngine.stage_kv_ops``).
+
+    Apply — the fold this subsystem exists for: every buffered entry
+    whose index the commit watermark has passed writes its value into
+    ``kv_value[key]`` and frees its slot, in ONE ``(G,V)`` tensor update.
+    Commit-order correctness without a sequential walk: ops are pure SETs,
+    so the post-batch value of a key is exactly the value of its
+    highest-index ready entry — selected per key by an index-max over the
+    ``(G,E,V)`` key one-hot (indexes are unique per group, so exactly one
+    winner exists; the selection is bit-identical to applying the batch
+    sequentially in log order, which ``tests/test_devsm.py`` pins against
+    the scalar oracle).  Entries above the watermark stay buffered for a
+    later round — the buffer is always a suffix strictly above
+    ``committed``.
+
+    Read: staged keys gather their post-apply value plus the commit
+    watermark it reflects.  Captured AFTER the fold, so a read staged in
+    the round an entry commits sees it — on this plane apply == commit by
+    construction, the property that lets lease/ReadIndex reads serve
+    straight from device state with zero host apply.
+    """
+    staged = ent_idx >= 0                                     # (G,E)
+    b_idx = jnp.where(staged, ent_idx, st.kv_ent_index)
+    b_key = jnp.where(staged, ent_key, st.kv_ent_key)
+    b_val = jnp.where(staged, ent_val, st.kv_ent_val)
+
+    v = st.kv_value.shape[1]
+    ready = (b_idx >= 0) & (b_idx <= st.committed[:, None])   # (G,E)
+    key_oh = jax.nn.one_hot(b_key, v, dtype=jnp.bool_)        # (G,E,V)
+    sel = ready[:, :, None] & key_oh
+    masked_idx = jnp.where(sel, b_idx[:, :, None], -1)        # (G,E,V)
+    win_idx = jnp.max(masked_idx, axis=1)                     # (G,V)
+    is_win = sel & (masked_idx == win_idx[:, None, :]) & (
+        win_idx[:, None, :] >= 0
+    )
+    new_val = jnp.sum(jnp.where(is_win, b_val[:, :, None], 0), axis=1)
+    kv_value = jnp.where(win_idx >= 0, new_val, st.kv_value)  # (G,V)
+
+    applied = jnp.sum(ready, axis=1).astype(I32)              # (G,)
+    b_idx = jnp.where(ready, -1, b_idx)                       # free applied slots
+
+    st = st._replace(
+        kv_value=kv_value,
+        kv_ent_index=b_idx,
+        kv_ent_key=b_key,
+        kv_ent_val=b_val,
+    )
+    has_read = read_key >= 0                                  # (G,R)
+    read_oh = jax.nn.one_hot(read_key, v, dtype=jnp.bool_)    # (G,R,V)
+    read_val = jnp.sum(
+        jnp.where(read_oh, kv_value[:, None, :], 0), axis=2
+    )                                                         # (G,R)
+    read_val = jnp.where(has_read, read_val, 0)
+    read_idx = jnp.where(has_read, st.committed[:, None], -1)
+    return st, read_val, read_idx, applied
 
 
 def tick_step(st: QuorumState) -> tuple[QuorumState, TickFlags]:
@@ -446,10 +525,15 @@ def quorum_step_dense_impl(
     read_stage_idx: jax.Array | None = None,  # (G,S) i32, -1 = no stage
     read_stage_cnt: jax.Array | None = None,  # (G,S) i32
     read_ack: jax.Array | None = None,        # (G,S,P) bool echo events
+    kv_ent_idx: jax.Array | None = None,      # (G,E) i32, -1 = no stage
+    kv_ent_key: jax.Array | None = None,      # (G,E) i32
+    kv_ent_val: jax.Array | None = None,      # (G,E) i32
+    kv_read_key: jax.Array | None = None,     # (G,R) i32, -1 = no read
     do_tick: bool = True,
     track_contact: bool = True,
     has_votes: bool = True,
     has_reads: bool = False,
+    has_kv: bool = False,
 ) -> StepOutputs:
     """Dense-ingestion twin of :func:`quorum_step_impl` — zero scatters.
 
@@ -508,12 +592,27 @@ def quorum_step_dense_impl(
         out = out._replace(
             state=rst, read_done_count=done_cnt, read_done_index=done_idx
         )
+    if has_kv:
+        # devsm plane after commit advancement (an entry committing this
+        # round applies this round — apply == commit is the plane's whole
+        # contract) and after the read plane (a ReadIndex slot confirming
+        # this round can pair with a KV read capture at >= its release
+        # watermark in the SAME dispatch)
+        kst, kv_rv, kv_ri, kv_ap = _kv_plane(
+            out.state, kv_ent_idx, kv_ent_key, kv_ent_val, kv_read_key
+        )
+        out = out._replace(
+            state=kst, kv_read_val=kv_rv, kv_read_index=kv_ri,
+            kv_applied=kv_ap,
+        )
     return out
 
 
 quorum_step_dense = jax.jit(
     quorum_step_dense_impl,
-    static_argnames=("do_tick", "track_contact", "has_votes", "has_reads"),
+    static_argnames=(
+        "do_tick", "track_contact", "has_votes", "has_reads", "has_kv",
+    ),
     donate_argnums=(0,),
 )
 
@@ -650,6 +749,7 @@ def _apply_recycle(
     start: jax.Array,  # (C,) i32 rel — term_start of the fresh leader
     last: jax.Array,   # (C,) i32 rel — last_index of the fresh leader
     reset_reads: bool = True,
+    reset_kv: bool = True,
 ) -> QuorumState:
     """Masked leader-recycle row reset (twin: the host's ``remove_group``
     + ``add_group`` + ``set_leader`` sequence for a SAME-GEOMETRY tenant
@@ -684,6 +784,25 @@ def _apply_recycle(
                 jnp.zeros((c, s, p), jnp.bool_), mode="drop"
             ),
         )
+    if reset_kv:
+        # the fresh tenant starts from an EMPTY device state machine
+        # (HostMirror.clear_kv twin).  Compiled OUT (static) while the
+        # engine's devsm plane has never been used — the kv arrays are
+        # provably at their reset values then, exactly the reset_reads
+        # rationale above.
+        v = st.kv_value.shape[1]
+        e = st.kv_ent_index.shape[1]
+        zke = jnp.zeros((c, e), I32)
+        st = st._replace(
+            kv_value=st.kv_value.at[row].set(
+                jnp.zeros((c, v), I32), mode="drop"
+            ),
+            kv_ent_index=st.kv_ent_index.at[row].set(
+                jnp.full((c, e), -1, I32), mode="drop"
+            ),
+            kv_ent_key=st.kv_ent_key.at[row].set(zke, mode="drop"),
+            kv_ent_val=st.kv_ent_val.at[row].set(zke, mode="drop"),
+        )
     return st._replace(
         node_state=st.node_state.at[row].set(LEADER, mode="drop"),
         live=st.live.at[row].set(True, mode="drop"),
@@ -714,12 +833,18 @@ def quorum_multiround_impl(
     read_stage_idx: jax.Array | None = None,  # (K,G,S) i32, -1 = no stage
     read_stage_cnt: jax.Array | None = None,  # (K,G,S) i32
     read_ack: jax.Array | None = None,        # (K,G,S,P) bool echoes
+    kv_ent_idx: jax.Array | None = None,      # (K,G,E) i32, -1 = no stage
+    kv_ent_key: jax.Array | None = None,      # (K,G,E) i32
+    kv_ent_val: jax.Array | None = None,      # (K,G,E) i32
+    kv_read_key: jax.Array | None = None,     # (K,G,R) i32, -1 = no read
     do_tick: bool = False,
     track_contact: bool = True,
     has_votes: bool = False,
     has_churn: bool = False,
     has_reads: bool = False,
     purge_reads: bool = True,
+    has_kv: bool = False,
+    purge_kv: bool = True,
 ) -> StepOutputs:
     """K engine rounds — INCLUDING membership churn — in ONE dispatch.
 
@@ -764,13 +889,28 @@ def quorum_multiround_impl(
     semantics permit (``tests/test_read_confirm.py`` pins all of this
     against the scalar oracle, including a recycle and a leader change
     with pending ctxs mid-block).
+
+    ``has_kv`` folds the device state machine into the same scan (devsm,
+    ISSUE 11): per round, staged ``(key_slot, value)`` entry ops land in
+    their groups' pending-entry buffers, the apply fold writes every op
+    the round's commit advancement covered into the HBM-resident
+    ``kv_value`` rows, and staged KV reads capture post-apply values plus
+    the watermark they reflect.  Read captures and applied-op counts
+    accumulate in the scan carry (overwrite-where-staged / sum; see
+    :class:`StepOutputs`), so the whole block's state-machine work rides
+    the one dispatch that advances its commits — the apply stage has no
+    host component at all (differential: ``tests/test_devsm.py``).
     """
 
     def body(carry, ev):
+        c = 0
+        stc = carry[c]; c += 1
         if has_reads:
-            stc, rcnt_acc, ridx_acc = carry
-        else:
-            stc = carry
+            rcnt_acc, ridx_acc = carry[c], carry[c + 1]
+            c += 2
+        if has_kv:
+            kval_acc, kidx_acc, kap_acc = carry[c], carry[c + 1], carry[c + 2]
+            c += 3
         i = 0
         am = ev[i]; i += 1
         if has_votes:
@@ -786,16 +926,23 @@ def quorum_multiround_impl(
             # when the engine's read plane has never been used (all-zero
             # arrays; see _apply_recycle) — the engine passes purge_reads=
             # _read_plane_used; has_reads keeps the purge for blocks that
-            # stage reads themselves
+            # stage reads themselves.  reset_kv is the devsm twin of the
+            # same rule (_devsm_used / has_kv).
             stc = _apply_recycle(
                 stc, crow, cterm, cstart, clast,
                 reset_reads=has_reads or purge_reads,
+                reset_kv=has_kv or purge_kv,
             )
         if has_reads:
             rsi, rsc, rak = ev[i], ev[i + 1], ev[i + 2]
             i += 3
         else:
             rsi = rsc = rak = None
+        if has_kv:
+            kei, kek, kev, krk = ev[i], ev[i + 1], ev[i + 2], ev[i + 3]
+            i += 4
+        else:
+            kei = kek = kev = krk = None
         out = quorum_step_dense_impl(
             stc,
             jnp.maximum(am, 0),  # -1 sentinel → 0 (a scatter-max no-op)
@@ -804,10 +951,15 @@ def quorum_multiround_impl(
             rsi,
             rsc,
             rak,
+            kei,
+            kek,
+            kev,
+            krk,
             do_tick=False,  # ticking handled below, per-round masked
             track_contact=track_contact,
             has_votes=has_votes,
             has_reads=has_reads,
+            has_kv=has_kv,
         )
         stc = out.state
         if do_tick:
@@ -820,14 +972,22 @@ def quorum_multiround_impl(
         else:
             zeros = jnp.zeros_like(out.won)
             flags = TickFlags(zeros, zeros, zeros)
+        carry = (stc,)
         if has_reads:
-            carry = (
-                stc,
+            carry = carry + (
                 rcnt_acc + out.read_done_count,
                 jnp.maximum(ridx_acc, out.read_done_index),
             )
-        else:
-            carry = stc
+        if has_kv:
+            # a KV read slot captures in exactly one round of the block
+            # (the engine never restages a slot before its harvest), so
+            # overwrite-where-staged is exact, not a merge heuristic
+            kcap = out.kv_read_index >= 0
+            carry = carry + (
+                jnp.where(kcap, out.kv_read_val, kval_acc),
+                jnp.where(kcap, out.kv_read_index, kidx_acc),
+                kap_acc + out.kv_applied,
+            )
         return carry, (out.won, out.lost, flags)
 
     xs = (ack_max,)
@@ -837,21 +997,36 @@ def quorum_multiround_impl(
         xs = xs + (churn_row, churn_term, churn_start, churn_last)
     if has_reads:
         xs = xs + (read_stage_idx, read_stage_cnt, read_ack)
+    if has_kv:
+        xs = xs + (kv_ent_idx, kv_ent_key, kv_ent_val, kv_read_key)
     if do_tick:
         xs = xs + (tick_mask,)
+    carry0 = (st,)
     if has_reads:
         g, s = st.read_index.shape
-        carry0 = (
-            st, jnp.zeros((g, s), I32), jnp.full((g, s), -1, I32)
+        carry0 = carry0 + (
+            jnp.zeros((g, s), I32), jnp.full((g, s), -1, I32)
         )
-    else:
-        carry0 = st
+    if has_kv:
+        g = st.kv_value.shape[0]
+        r = kv_read_key.shape[2]
+        carry0 = carry0 + (
+            jnp.zeros((g, r), I32), jnp.full((g, r), -1, I32),
+            jnp.zeros((g,), I32),
+        )
     carry, (won, lost, flags) = jax.lax.scan(body, carry0, xs)
+    c = 0
+    st = carry[c]; c += 1
+    read_done_count = read_done_index = None
     if has_reads:
-        st, read_done_count, read_done_index = carry
-    else:
-        st = carry
-        read_done_count = read_done_index = None
+        read_done_count, read_done_index = carry[c], carry[c + 1]
+        c += 2
+    kv_read_val = kv_read_index = kv_applied = None
+    if has_kv:
+        kv_read_val, kv_read_index, kv_applied = (
+            carry[c], carry[c + 1], carry[c + 2]
+        )
+        c += 3
     any_ = lambda x: jnp.any(x, axis=0)  # noqa: E731
     return StepOutputs(
         st,
@@ -861,6 +1036,9 @@ def quorum_multiround_impl(
         TickFlags(*(any_(f) for f in flags)),
         read_done_count,
         read_done_index,
+        kv_read_val,
+        kv_read_index,
+        kv_applied,
     )
 
 
@@ -868,7 +1046,7 @@ quorum_multiround = jax.jit(
     quorum_multiround_impl,
     static_argnames=(
         "do_tick", "track_contact", "has_votes", "has_churn", "has_reads",
-        "purge_reads",
+        "purge_reads", "has_kv", "purge_kv",
     ),
     donate_argnums=(0,),
 )

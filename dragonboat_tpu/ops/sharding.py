@@ -43,6 +43,7 @@ def state_sharding(mesh: Mesh, axis: str = GROUP_AXIS) -> QuorumState:
     mats = (
         "match", "next", "voting", "present", "active", "votes",
         "read_index", "read_count",
+        "kv_value", "kv_ent_index", "kv_ent_key", "kv_ent_val",
     )
     fields = {
         k: (cube if k == "read_acks" else mat if k in mats else row)

@@ -56,6 +56,23 @@ VOTE_NONE, VOTE_REJECT, VOTE_GRANT = -1, 0, 1
 # (``BatchedQuorumEngine.stage_read``).
 READ_SLOTS = 4
 
+# Device state machine (devsm, ISSUE 11): value slots per group (the
+# ``V`` axis of ``kv_value``) and pending-entry buffer depth (the ``E``
+# axis).  A committed entry is a fixed-width ``(key_slot, value)`` SET op;
+# the apply fold (``kernels._kv_plane``) writes it into its group's
+# ``kv_value`` row the moment the commit watermark passes its index.  An
+# entry staged at APPEND time rides buffer slot ``rel_index % E`` until it
+# commits; the engine's host bookkeeping queues ops whose slot is still
+# occupied (``BatchedQuorumEngine.stage_kv_ops``).
+KV_SLOTS = 16
+KV_ENT_SLOTS = 16
+
+# Per-round device KV read slots (the ``R`` axis): a staged read is
+# transient — it captures its value (and the committed watermark at
+# capture) in exactly its round, so unlike the ReadIndex plane there is
+# no device-resident read state, only the per-round stage tensor.
+KV_READ_SLOTS = 4
+
 
 class QuorumState(NamedTuple):
     """Struct-of-arrays state for G groups × P peer slots.
@@ -98,12 +115,29 @@ class QuorumState(NamedTuple):
     read_count: jax.Array      # (G,S) i32: client reads batched in the slot (0 = free)
     read_acks: jax.Array       # (G,S,P) bool: heartbeat-echo acks per slot
 
+    # --- device state machine (devsm, ISSUE 11) ------------------------
+    # Scalar twin: a user KV state machine's value array plus the apply
+    # queue between commit and apply.  ``kv_value`` IS the replicated
+    # state (HBM-resident, mutated in-program by the apply fold);
+    # ``kv_ent_*`` is the pending-entry buffer — a committed entry leaves
+    # it the round its index passes the commit watermark, so buffered
+    # entries are always a suffix strictly above ``committed``.
+    kv_value: jax.Array        # (G,V) i32: the replicated KV state
+    kv_ent_index: jax.Array    # (G,E) i32 rel: staged op's log index; -1 = free
+    kv_ent_key: jax.Array      # (G,E) i32: key slot of the staged op
+    kv_ent_val: jax.Array      # (G,E) i32: value of the staged op
+
 
 def make_state(
-    n_groups: int, n_peers: int, n_read_slots: int = READ_SLOTS
+    n_groups: int,
+    n_peers: int,
+    n_read_slots: int = READ_SLOTS,
+    n_kv_slots: int = KV_SLOTS,
+    n_kv_ents: int = KV_ENT_SLOTS,
 ) -> QuorumState:
     """All-dead state: rows are claimed by the host as groups start."""
     g, p, s = n_groups, n_peers, n_read_slots
+    v, e = n_kv_slots, n_kv_ents
     zi = jnp.zeros((g,), I32)
     return QuorumState(
         node_state=jnp.zeros((g,), I8),
@@ -130,6 +164,10 @@ def make_state(
         read_index=jnp.zeros((g, s), I32),
         read_count=jnp.zeros((g, s), I32),
         read_acks=jnp.zeros((g, s, p), BOOL),
+        kv_value=jnp.zeros((g, v), I32),
+        kv_ent_index=jnp.full((g, e), -1, I32),
+        kv_ent_key=jnp.zeros((g, e), I32),
+        kv_ent_val=jnp.zeros((g, e), I32),
     )
 
 
@@ -143,12 +181,19 @@ class HostMirror:
     """
 
     def __init__(
-        self, n_groups: int, n_peers: int, n_read_slots: int = READ_SLOTS
+        self,
+        n_groups: int,
+        n_peers: int,
+        n_read_slots: int = READ_SLOTS,
+        n_kv_slots: int = KV_SLOTS,
+        n_kv_ents: int = KV_ENT_SLOTS,
     ):
         self.n_groups = n_groups
         self.n_peers = n_peers
         self.n_read_slots = n_read_slots
-        dev = make_state(n_groups, n_peers, n_read_slots)
+        self.n_kv_slots = n_kv_slots
+        self.n_kv_ents = n_kv_ents
+        dev = make_state(n_groups, n_peers, n_read_slots, n_kv_slots, n_kv_ents)
         self.arrays = {k: np.asarray(v).copy() for k, v in dev._asdict().items()}
 
     def to_device(self, sharding=None) -> QuorumState:
@@ -170,6 +215,7 @@ class HostMirror:
         term_start: int,
         last_index: int,
         clear_reads: bool = True,
+        clear_kv: bool = True,
     ) -> None:
         """Numpy twin of ``kernels._apply_recycle``: reset a row to a
         fresh same-geometry leader tenant WITHOUT touching membership
@@ -194,6 +240,27 @@ class HostMirror:
         a["votes"][row, :] = VOTE_NONE
         if clear_reads:  # engine skips while its read plane is untouched
             self.clear_reads(row)
+        if clear_kv:  # engine skips while its devsm plane is untouched
+            self.clear_kv(row)
+
+    def clear_kv(self, row: int) -> None:
+        """Reset a row's device state machine: value slots to zero AND the
+        pending-entry buffer freed.  A recycle's fresh tenant starts from
+        an empty KV exactly like a fresh ``add_group`` registration."""
+        a = self.arrays
+        a["kv_value"][row, :] = 0
+        self.clear_kv_ents(row)
+
+    def clear_kv_ents(self, row: int) -> None:
+        """Free a row's pending-entry buffer WITHOUT touching the value
+        slots (leadership-transition twin: buffered entries sit strictly
+        above the commit watermark, an uncertain log suffix the next
+        leader may rewrite — they die with the transition; applied state
+        persists exactly like the scalar SM across terms)."""
+        a = self.arrays
+        a["kv_ent_index"][row, :] = -1
+        a["kv_ent_key"][row, :] = 0
+        a["kv_ent_val"][row, :] = 0
 
     def clear_reads(self, row: int) -> None:
         """Drop a row's pending ReadIndex slots (twin of the scalar path's

@@ -185,6 +185,14 @@ class Raft:
         # routes confirmed ctxs back through ``read_index.release`` with
         # leader/term guards intact (node._apply_offload_effects)
         self.device_reads = False
+        # True when the group's state machine is device-resident (devsm,
+        # ISSUE 11): the leader offloads every appended application
+        # entry's (index, payload) to the coordinator's DevKVPlane at
+        # append time, so the in-program apply fold has the op buffered
+        # before its commit can land.  Set by NodeHost registration
+        # (Config.device_kv on the tpu engine); False keeps append_entries
+        # bit-identical.
+        self.device_kv = False
         # first index of the current leadership term (set at promotion)
         self.term_start_index = 0
         # ring buffer of recent election-related events (campaigns, vote
@@ -658,6 +666,24 @@ class Raft:
             self.offload.ack(
                 self.cluster_id, self.node_id, self.log.last_index()
             )
+            if self.device_kv and self.is_leader():
+                # devsm (ISSUE 11): hand application entries to the
+                # device apply plane at append — non-ops are filtered by
+                # the plane's codec, encoded payloads are unwrapped here
+                # so the plane sees what the SM would
+                from ..rsm.encoded import get_entry_payload
+
+                ops = []
+                for e in entries:
+                    if e.type in (
+                        EntryType.APPLICATION, EntryType.ENCODED
+                    ) and e.cmd:
+                        try:
+                            ops.append((e.index, get_entry_payload(e)))
+                        except ValueError:
+                            continue
+                if ops:
+                    self.offload.stage_sm_ops(self.cluster_id, ops)
         elif self.is_single_node_quorum():
             self.try_commit()
 

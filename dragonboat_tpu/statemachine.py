@@ -185,3 +185,16 @@ class StopChecker:
 CreateStateMachineFunc = "Callable[[int, int], IStateMachine]"
 CreateConcurrentStateMachineFunc = "Callable[[int, int], IConcurrentStateMachine]"
 CreateOnDiskStateMachineFunc = "Callable[[int, int], IOnDiskStateMachine]"
+
+
+def __getattr__(name):
+    # Lazy re-export of the device-resident KV state machine (devsm,
+    # ISSUE 11): registering one with Config.device_kv on the tpu engine
+    # moves the group's apply plane into the fused device program.  Lazy
+    # because devsm imports numpy/ops machinery this interface module
+    # must not pull in for plain host SM users.
+    if name == "DeviceKVStateMachine":
+        from .devsm.machine import DeviceKVStateMachine
+
+        return DeviceKVStateMachine
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

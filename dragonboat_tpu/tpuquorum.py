@@ -159,6 +159,11 @@ class TpuQuorumCoordinator:
         # tests assert the device actually served the load
         self.read_confirms = 0
         self.read_fallbacks = 0
+        # device state machine plane (devsm, ISSUE 11; DevKVPlane):
+        # created by the FIRST DeviceKVStateMachine registration
+        # (NodeHost.start_cluster with Config.device_kv).  None keeps the
+        # round loop bit-identical — every hook below gates on it.
+        self.devsm = None
         # monotonically increasing tick sequence written ONLY by the tick
         # thread; the round compares against the last value it consumed, so
         # a tick arriving mid-round is never lost (no lock needed: single
@@ -276,6 +281,8 @@ class TpuQuorumCoordinator:
                 node.obs_registry = self._obs.registry
 
     def unregister(self, cluster_id: int) -> None:
+        if self.devsm is not None:
+            self.devsm.unregister(cluster_id)
         with self._mu:
             self._nodes.pop(cluster_id, None)
             self._read_pending.pop(cluster_id, None)
@@ -283,6 +290,21 @@ class TpuQuorumCoordinator:
                 self.lease_table.remove(cluster_id)
             if cluster_id in self.eng.groups:
                 self.eng.remove_group(cluster_id)
+
+    def devsm_plane(self):
+        """The device state machine plane, created on first use
+        (``NodeHost.start_cluster`` registration path)."""
+        if self.devsm is None:
+            from .devsm.plane import DevKVPlane
+
+            self.devsm = DevKVPlane(self)
+            # the ENGINE egress hook is the single delivery channel for
+            # KV read captures: it fires on every harvest that carried
+            # one — including rare-path internal harvests (row syncs,
+            # transitions) whose results the round loop never sees and
+            # which would otherwise strand parked readers until timeout
+            self.eng.kv_egress_hook = self.devsm.deliver
+        return self.devsm
 
     def _sync_row_locked(self, node: "Node") -> None:
         """(Re)build the group's row from scalar raft state — the rare-path
@@ -338,6 +360,10 @@ class TpuQuorumCoordinator:
             for nid, rp in list(r.remotes.items()) + list(r.witnesses.items()):
                 if rp.match > 0:
                     self.eng.ack(cid, nid, rp.match)
+            if self.devsm is not None and self.devsm.tracks(cid):
+                # a resync on a standing leader re-arms the devsm bind at
+                # the current log tail (the drain's resync op unbound it)
+                self.devsm.on_leader(cid, r.log.last_index())
         elif r.is_candidate():
             self.eng.set_candidate(cid, term=r.term)
             for nid, granted in r.votes.items():
@@ -410,6 +436,13 @@ class TpuQuorumCoordinator:
         """A heartbeat response echoed a ReadIndex hint: joins the ctx's
         pending-read slot; the device row-sum decides the quorum."""
         self._stage(("rack", cluster_id, node_id, low, high))
+
+    def stage_sm_ops(self, cluster_id: int, ops) -> None:
+        """A devsm leader appended application entries
+        (``raft.append_entries`` under raftMu): hand their ``(index,
+        payload)`` pairs to the device state machine plane — the apply
+        fold consumes them the round their commit lands."""
+        self._stage(("kvops", cluster_id, ops))
 
     def set_leader(
         self, cluster_id: int, term: int, term_start: int, last_index: int
@@ -506,6 +539,9 @@ class TpuQuorumCoordinator:
                         node = self._nodes.get(cid)
                         if node is not None:
                             node.offload_read_echo(node_id, low, high)
+                elif kind == "kvops":
+                    if self.devsm is not None:
+                        self.devsm.handle_ops(cid, op[2])
                 elif kind == "leader":
                     self._read_pending.pop(cid, None)
                     if lt is not None:
@@ -513,20 +549,28 @@ class TpuQuorumCoordinator:
                     self.eng.set_leader(
                         cid, term=op[2], term_start=op[3], last_index=op[4]
                     )
+                    if self.devsm is not None:
+                        self.devsm.on_leader(cid, op[4])
                 elif kind == "candidate":
                     self._read_pending.pop(cid, None)
                     if lt is not None:
                         lt.drop(cid)
                     self.eng.set_candidate(cid, term=op[2])
+                    if self.devsm is not None:
+                        self.devsm.on_unbind(cid)
                 elif kind == "follower":
                     self._read_pending.pop(cid, None)
                     if lt is not None:
                         lt.drop(cid)
                     self.eng.set_follower(cid, term=op[2])
+                    if self.devsm is not None:
+                        self.devsm.on_unbind(cid)
                 else:  # resync
                     self._read_pending.pop(cid, None)
                     if lt is not None:
                         lt.drop(cid)
+                    if self.devsm is not None:
+                        self.devsm.on_unbind(cid)
                     recover.append(cid)
             except (ValueError, KeyError):
                 # unknown peer slot / index past the rebase window: rebuild
@@ -633,6 +677,10 @@ class TpuQuorumCoordinator:
             if obs is not None:
                 n_ops = len(self._staged)  # racy read, gauge-grade
             recover.extend(self._drain_locked())
+            if self.devsm is not None:
+                # advance pending devsm binds (host apply catching the
+                # promotion watermark completes them)
+                self.devsm.poll()
             has_acks = bool(
                 self.eng._acks or self.eng._ack_blocks or self.eng._votes
             )
@@ -641,6 +689,9 @@ class TpuQuorumCoordinator:
             # a quiet group) nothing else would ever flush them and
             # the pending ReadIndex would hang until client timeout
             has_reads = self.eng._reads_pending()
+            # ... and so must staged devsm entry ops / KV read captures
+            # (a parked lookup is waiting on exactly one dispatch)
+            has_kv = self.eng._kv_pending()
             # dirty-only rounds (row registrations, transition
             # replays with no queued events) need no dispatch when
             # ticks drive regular rounds anyway: the upload
@@ -648,14 +699,15 @@ class TpuQuorumCoordinator:
             # registration of thousands of groups otherwise
             # interleaves a dispatch between every few registers.
             dirty_gate = bool(self.eng._dirty and not self.drive_ticks)
-            if not (do_tick or has_acks or has_reads or dirty_gate):
+            if not (do_tick or has_acks or has_reads or has_kv or dirty_gate):
                 return
             if obs is not None:
                 gate = "+".join(
                     name
                     for name, hit in (
                         ("tick", do_tick), ("acks", has_acks),
-                        ("reads", has_reads), ("dirty", dirty_gate),
+                        ("reads", has_reads), ("kv", has_kv),
+                        ("dirty", dirty_gate),
                     )
                     if hit
                 )
@@ -696,6 +748,16 @@ class TpuQuorumCoordinator:
             # variant, so fusing it would reintroduce the first-use
             # compile stall this PR exists to kill
             has_churn = bool(self.eng._churn or self.eng._round_blocks)
+            # a kv-carrying block needs the has_kv fused variants warmed
+            # (warmup_devsm, kicked at plane registration) — until then
+            # kv rounds take the already-compiling dense single-round
+            # path instead of stalling a fused dispatch behind XLA.
+            # BUFFERED device ents force the fold too (the engine runs
+            # has_kv on every dispatch while any op awaits its commit —
+            # see _kv_ents_buffered), so they gate fusing the same way
+            kv_unwarmed = (
+                has_kv or self.eng._kv_ents_buffered()
+            ) and not self.eng.kv_fused_ready
             read_confirms: list = []
             if deficit > 1:
                 if not fused_ok:
@@ -704,7 +766,12 @@ class TpuQuorumCoordinator:
                     fuse_skip = "votes"
                 elif has_churn:
                     fuse_skip = "churn"
-            if fused_ok and deficit > 1 and not has_votes and not has_churn:
+                elif kv_unwarmed:
+                    fuse_skip = "devsm"
+            if (
+                fused_ok and deficit > 1 and not has_votes
+                and not has_churn and not kv_unwarmed
+            ):
                 fused = True
                 k_rounds = deficit
                 # guarantee >= 1 round even on a pure tick-catch-up
@@ -736,6 +803,8 @@ class TpuQuorumCoordinator:
                         merged = set(getattr(res, field))
                         merged.update(getattr(extra, field))
                         setattr(res, field, list(merged))
+        # (devsm KV read captures were already delivered by the engine's
+        # kv_egress_hook inside each harvest — see devsm_plane())
         # confirmed-read releases, OUTSIDE _mu like the commit callbacks:
         # the node re-checks leader/term under raftMu and releases through
         # the scalar ReadIndex prefix pop (indices identical to the pure

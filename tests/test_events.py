@@ -87,6 +87,42 @@ def test_exposition_help_lines_round_trip():
     assert out2.getvalue().splitlines() == lines
 
 
+def test_devsm_families_help_round_trip():
+    """ISSUE 11 satellite: every ``dragonboat_devsm_*`` family an
+    EngineObs registers carries its described ``# HELP`` immediately
+    before its ``# TYPE``, and the apply_kernel/devsm_egress pair lands
+    the expected values in the exposition."""
+    from dragonboat_tpu.obs import FlightRecorder
+    from dragonboat_tpu.obs.instruments import EngineObs
+
+    reg = MetricsRegistry()
+    obs = EngineObs(FlightRecorder(capacity=4, stall_ms=0), reg)
+    span = obs.apply_kernel(ops=5, reads=2, rounds=3, slot_occupancy=4)
+    obs.devsm_egress(span, applied=5, reads_served=2)
+    out = io.StringIO()
+    reg.write_health_metrics(out)
+    lines = out.getvalue().splitlines()
+    families = (
+        "dragonboat_devsm_ops_staged_total",
+        "dragonboat_devsm_applied_total",
+        "dragonboat_devsm_reads_staged_total",
+        "dragonboat_devsm_reads_served_total",
+        "dragonboat_devsm_slot_occupancy",
+    )
+    for name in families:
+        tidx = [
+            i for i, l in enumerate(lines) if l.startswith(f"# TYPE {name} ")
+        ]
+        assert len(tidx) == 1, name
+        help_line = lines[tidx[0] - 1]
+        assert help_line.startswith(f"# HELP {name} "), help_line
+        assert "dragonboat_tpu metric" not in help_line, help_line
+    assert "dragonboat_devsm_ops_staged_total 5" in lines
+    assert "dragonboat_devsm_applied_total 5" in lines
+    assert "dragonboat_devsm_reads_served_total 2" in lines
+    assert "dragonboat_devsm_slot_occupancy 4" in lines
+
+
 def test_lease_families_help_round_trip():
     """ISSUE 10 satellite: every ``dragonboat_lease_*`` family a LeaseObs
     registers (and the coordinator table's gauge) carries its described

@@ -9,6 +9,8 @@ import time
 
 import pytest
 
+from tests import loadwait
+
 from dragonboat_tpu import (
     Config,
     IStateMachine,
@@ -77,7 +79,12 @@ def group_config(cluster_id, node_id, **kw):
 
 
 def wait_for_leader(nhs, cluster_id, timeout=10.0):
-    deadline = time.time() + timeout
+    # load-scaled deadline (tests/loadwait.py): election timing under a
+    # full tier-1 sweep on 1-2 vCPUs stretches far past the idle-box
+    # margin — the r07/r11 leadership-timing flake class
+    from tests.loadwait import scaled
+
+    deadline = time.time() + scaled(timeout)
     while time.time() < deadline:
         for nh in nhs:
             try:
@@ -122,9 +129,9 @@ def test_single_replica_propose_and_read():
         )
         wait_for_leader([nh], 5)
         s = nh.get_noop_session(5)
-        r = nh.sync_propose(s, b"a=1", timeout=5.0)
+        r = nh.sync_propose(s, b"a=1", timeout=loadwait.scaled(5.0))
         assert r.value == 1
-        assert nh.sync_read(5, "a", timeout=5.0) == "1"
+        assert nh.sync_read(5, "a", timeout=loadwait.scaled(5.0)) == "1"
         assert nh.stale_read(5, "a") == "1"
     finally:
         nh.stop()
@@ -135,13 +142,16 @@ def test_three_replicas_propose_read(cluster3):
     wait_for_leader(nhs, 100)
     s = nhs[0].get_noop_session(100)
     for i in range(10):
-        nhs[0].sync_propose(s, f"k{i}=v{i}".encode(), timeout=5.0)
+        nhs[0].sync_propose(s, f"k{i}=v{i}".encode(), timeout=loadwait.scaled(5.0))
     # linearizable read from every replica
     for nh in nhs:
-        assert nh.sync_read(100, "k9", timeout=5.0) == "v9"
-    # all replicas converge to the same state
-    time.sleep(0.3)
-    assert sms[1].kv == sms[2].kv == sms[3].kv
+        assert nh.sync_read(100, "k9", timeout=loadwait.scaled(5.0)) == "v9"
+    # all replicas converge to the same state (load-scaled poll: the
+    # raw 0.3s nap lost this assert on loaded sweeps)
+    loadwait.wait_until(
+        lambda: sms[1].kv == sms[2].kv == sms[3].kv, 5.0,
+        what="replica convergence",
+    )
 
 
 def test_propose_on_follower_forwards_to_leader(cluster3):
@@ -149,28 +159,28 @@ def test_propose_on_follower_forwards_to_leader(cluster3):
     lid = wait_for_leader(nhs, 100)
     follower_nh = nhs[0 if lid != 1 else 1]
     s = follower_nh.get_noop_session(100)
-    r = follower_nh.sync_propose(s, b"fwd=yes", timeout=5.0)
+    r = follower_nh.sync_propose(s, b"fwd=yes", timeout=loadwait.scaled(5.0))
     assert r.value >= 1
-    assert follower_nh.sync_read(100, "fwd", timeout=5.0) == "yes"
+    assert follower_nh.sync_read(100, "fwd", timeout=loadwait.scaled(5.0)) == "yes"
 
 
 def test_session_exactly_once(cluster3):
     nhs, sms, addrs, _ = cluster3
     wait_for_leader(nhs, 100)
-    s = nhs[0].sync_get_session(100, timeout=5.0)
-    r1 = nhs[0].sync_propose(s, b"x=1", timeout=5.0)
+    s = nhs[0].sync_get_session(100, timeout=loadwait.scaled(5.0))
+    r1 = nhs[0].sync_propose(s, b"x=1", timeout=loadwait.scaled(5.0))
     assert r1.value == 1
-    nhs[0].sync_close_session(s, timeout=5.0)
+    nhs[0].sync_close_session(s, timeout=loadwait.scaled(5.0))
 
 
 def test_membership_query_and_leader_transfer(cluster3):
     nhs, sms, addrs, _ = cluster3
     lid = wait_for_leader(nhs, 100)
-    m = nhs[0].sync_get_cluster_membership(100, timeout=5.0)
+    m = nhs[0].sync_get_cluster_membership(100, timeout=loadwait.scaled(5.0))
     assert set(m.addresses) == {1, 2, 3}
     target = 1 if lid != 1 else 2
     nhs[0].request_leader_transfer(100, target)
-    deadline = time.time() + 5
+    deadline = time.time() + loadwait.scaled(5.0)
     while time.time() < deadline:
         nlid, ok = nhs[target - 1].get_leader_id(100)
         if ok and nlid == target:
@@ -192,11 +202,11 @@ def test_snapshot_and_restart(tmp_path):
         wait_for_leader([nh], 7)
         s = nh.get_noop_session(7)
         for i in range(20):
-            nh.sync_propose(s, f"k{i}=v{i}".encode(), timeout=5.0)
-        idx = nh.sync_request_snapshot(7, timeout=5.0)
+            nh.sync_propose(s, f"k{i}=v{i}".encode(), timeout=loadwait.scaled(5.0))
+        idx = nh.sync_request_snapshot(7, timeout=loadwait.scaled(5.0))
         assert idx > 0
         for i in range(20, 30):
-            nh.sync_propose(s, f"k{i}=v{i}".encode(), timeout=5.0)
+            nh.sync_propose(s, f"k{i}=v{i}".encode(), timeout=loadwait.scaled(5.0))
     finally:
         nh.stop()
     # restart: state must come back from snapshot + log replay
@@ -208,8 +218,8 @@ def test_snapshot_and_restart(tmp_path):
             group_config(7, 1, compaction_overhead=2),
         )
         wait_for_leader([nh2], 7)
-        assert nh2.sync_read(7, "k5", timeout=5.0) == "v5"
-        assert nh2.sync_read(7, "k29", timeout=5.0) == "v29"
+        assert nh2.sync_read(7, "k5", timeout=loadwait.scaled(5.0)) == "v5"
+        assert nh2.sync_read(7, "k29", timeout=loadwait.scaled(5.0)) == "v29"
     finally:
         nh2.stop()
 
@@ -220,15 +230,15 @@ def test_add_node_membership_change(cluster3):
     # add a 4th replica on a new nodehost
     nh4 = make_nodehost("nh4:1", router)
     try:
-        nhs[0].sync_request_add_node(100, 4, "nh4:1", timeout=5.0)
-        m = nhs[0].sync_get_cluster_membership(100, timeout=5.0)
+        nhs[0].sync_request_add_node(100, 4, "nh4:1", timeout=loadwait.scaled(5.0))
+        m = nhs[0].sync_get_cluster_membership(100, timeout=loadwait.scaled(5.0))
         assert 4 in m.addresses
         nh4.start_cluster(
             {}, True, lambda c, n: KVSM(c, n), group_config(100, 4),
         )
         s = nhs[0].get_noop_session(100)
-        nhs[0].sync_propose(s, b"after=add", timeout=5.0)
-        deadline = time.time() + 10
+        nhs[0].sync_propose(s, b"after=add", timeout=loadwait.scaled(5.0))
+        deadline = time.time() + loadwait.scaled(10.0)
         while time.time() < deadline:
             try:
                 if nh4.sync_read(100, "after", timeout=1.0) == "add":
@@ -244,12 +254,12 @@ def test_add_node_membership_change(cluster3):
 def test_remove_node_membership_change(cluster3):
     nhs, sms, addrs, _ = cluster3
     wait_for_leader(nhs, 100)
-    nhs[0].sync_request_delete_node(100, 3, timeout=5.0)
-    m = nhs[0].sync_get_cluster_membership(100, timeout=5.0)
+    nhs[0].sync_request_delete_node(100, 3, timeout=loadwait.scaled(5.0))
+    m = nhs[0].sync_get_cluster_membership(100, timeout=loadwait.scaled(5.0))
     assert 3 not in m.addresses
     s = nhs[0].get_noop_session(100)
-    nhs[0].sync_propose(s, b"still=works", timeout=5.0)
-    assert nhs[0].sync_read(100, "still", timeout=5.0) == "works"
+    nhs[0].sync_propose(s, b"still=works", timeout=loadwait.scaled(5.0))
+    assert nhs[0].sync_read(100, "still", timeout=loadwait.scaled(5.0)) == "works"
 
 
 def test_node_host_info_and_has_node_info(cluster3):
@@ -259,11 +269,11 @@ def test_node_host_info_and_has_node_info(cluster3):
     lid = wait_for_leader(nhs, 100)
     leader = nhs[lid - 1]
     s = leader.get_noop_session(100)
-    deadline = time.time() + 20
+    deadline = time.time() + loadwait.scaled(20.0)
     j = 0
     while j < 5:  # early proposes can be DROPPED while leadership settles
         try:
-            leader.sync_propose(s, f"k{j}=v{j}".encode(), timeout=5.0)
+            leader.sync_propose(s, f"k{j}=v{j}".encode(), timeout=loadwait.scaled(5.0))
             j += 1
         except Exception:
             if time.time() > deadline:
@@ -311,8 +321,8 @@ def test_request_compaction(tmp_path):
             nh.request_compaction(100, 1)
         s = nh.get_noop_session(100)
         for j in range(80):  # crosses several snapshot+compaction points
-            nh.sync_propose(s, f"a{j}=b{j}".encode(), timeout=5.0)
-        deadline = time.time() + 30
+            nh.sync_propose(s, f"a{j}=b{j}".encode(), timeout=loadwait.scaled(5.0))
+        deadline = time.time() + loadwait.scaled(30.0)
         ev = None
         while ev is None and time.time() < deadline:
             try:

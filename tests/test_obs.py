@@ -320,6 +320,59 @@ def test_engine_stall_autodump_names_blocked_dispatch(monkeypatch, tmp_path):
 # ---------------------------------------------------------------------------
 
 
+def test_devsm_apply_kernel_span_and_families():
+    """ISSUE 11 satellite: a kv-carrying dispatch opens an
+    ``apply_kernel`` span (staged ops/reads at dispatch, applied/served
+    at harvest) and the ``dragonboat_devsm_*`` families track the fold's
+    work; kv-free engines never record the kind."""
+    rec = FlightRecorder(capacity=32, stall_ms=0)
+    reg = MetricsRegistry()
+    eng = BatchedQuorumEngine(8, 3, device_ticks=False)
+    eng.enable_obs(recorder=rec, registry=reg)
+    eng.add_group(1, node_ids=[1, 2, 3], self_id=1)
+    eng.set_leader(1, term=1, term_start=1, last_index=1)
+    eng.ack(1, 1, 3)
+    eng.step(do_tick=False)
+    assert not [s for s in rec.spans() if s["kind"] == "apply_kernel"]
+    # now a kv round: 2 ops commit, 1 read captures — single-round path
+    eng.stage_kv_ops(1, [2, 3], [0, 1], [5, 6])
+    eng.ack(1, 2, 3)
+    eng.stage_kv_read(1, 0)
+    eng.step(do_tick=False)
+    # ... and a fused block: 1 op + 1 read
+    eng.stage_kv_ops(1, [4], [2], [7])
+    eng.ack(1, 1, 4)
+    eng.ack(1, 2, 4)
+    eng.stage_kv_read(1, 2)
+    eng.begin_round()
+    eng.step_rounds(do_tick=False)
+    spans = [s for s in rec.spans() if s["kind"] == "apply_kernel"]
+    assert len(spans) == 2
+    assert spans[0]["ops"] == 2 and spans[0]["reads"] == 1
+    assert spans[0]["applied"] == 2 and spans[0]["reads_served"] == 1
+    assert spans[1]["ops"] == 1 and spans[1]["applied"] == 1
+    assert reg.counter_value("dragonboat_devsm_ops_staged_total") == 3
+    assert reg.counter_value("dragonboat_devsm_applied_total") == 3
+    assert reg.counter_value("dragonboat_devsm_reads_staged_total") == 2
+    assert reg.counter_value("dragonboat_devsm_reads_served_total") == 2
+    # exposition carries the families with their described HELP text
+    out = io.StringIO()
+    reg.write_health_metrics(out)
+    text = out.getvalue()
+    for fam in (
+        "dragonboat_devsm_ops_staged_total",
+        "dragonboat_devsm_applied_total",
+        "dragonboat_devsm_reads_staged_total",
+        "dragonboat_devsm_reads_served_total",
+        "dragonboat_devsm_slot_occupancy",
+    ):
+        assert f"# TYPE {fam} " in text, fam
+        help_line = next(
+            l for l in text.splitlines() if l.startswith(f"# HELP {fam} ")
+        )
+        assert "dragonboat_tpu metric" not in help_line, help_line
+
+
 def test_device_plane_metric_families_exposed():
     """ISSUE acceptance: with obs enabled, the health exposition carries
     >= 8 device-plane families (engine + coordinator planes)."""

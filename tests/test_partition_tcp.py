@@ -17,6 +17,8 @@ import time
 
 import pytest
 
+from tests import loadwait
+
 from dragonboat_tpu import Config, NodeHost, NodeHostConfig
 from dragonboat_tpu.config import ExpertConfig
 from dragonboat_tpu.native import natraft, natsm
@@ -64,7 +66,9 @@ def _mk(i, addrs, tmp_path, sms):
 
 
 def _leader_id(nhs, exclude=None, timeout=60.0):
-    deadline = time.time() + timeout
+    # load-scaled (tests/loadwait.py): elections under a loaded tier-1
+    # sweep stretch far past the idle-box margin (r07/r11 flake class)
+    deadline = time.time() + loadwait.scaled(timeout)
     while time.time() < deadline:
         for i, nh in nhs.items():
             if exclude is not None and i == exclude:
@@ -124,7 +128,7 @@ def test_partitioned_leader_deposed_then_heals(tmp_path):
         for i in others:
             nhs[i].fastlane.set_partition(addrs[lid], False)
             leader.fastlane.set_partition(addrs[i], False)
-        deadline = time.time() + 90
+        deadline = time.time() + loadwait.scaled(90.0)
         while time.time() < deadline:
             hs = {i: sm.get_hash() for i, sm in sms.items()}
             if len(set(hs.values())) == 1:
@@ -179,7 +183,7 @@ def test_partition_minority_follower_no_disruption(tmp_path):
             if i != victim:
                 nhs[i].fastlane.set_partition(addrs[victim], False)
                 nhs[victim].fastlane.set_partition(addrs[i], False)
-        deadline = time.time() + 90
+        deadline = time.time() + loadwait.scaled(90.0)
         while time.time() < deadline:
             hs = {i: sm.get_hash() for i, sm in sms.items()}
             if len(set(hs.values())) == 1:
@@ -237,7 +241,7 @@ def test_partition_blocks_snapshot_catchup_until_heal(tmp_path):
         # settle BEFORE partitioning: pre-split entries may still be in
         # the victim's apply pipeline, and a baseline captured mid-flight
         # would later read as a "leak" when they finish applying
-        deadline = time.time() + 60
+        deadline = time.time() + loadwait.scaled(60.0)
         while time.time() < deadline:
             if len({sm.get_hash() for sm in sms.values()}) == 1:
                 break
@@ -264,7 +268,7 @@ def test_partition_blocks_snapshot_catchup_until_heal(tmp_path):
             if i != victim:
                 nhs[i].fastlane.set_partition(addrs[victim], False)
                 nhs[victim].fastlane.set_partition(addrs[i], False)
-        deadline = time.time() + 120
+        deadline = time.time() + loadwait.scaled(120.0)
         while time.time() < deadline:
             hs = {i: sm.get_hash() for i, sm in sms.items()}
             if len(set(hs.values())) == 1:

@@ -135,8 +135,10 @@ def test_tpu_engine_leader_failover():
     nhs, addrs = _cluster(router, "tpu", prefix="fo")
     try:
         _wait_leader(nhs, CID)
+        from tests.loadwait import scaled
+
         lid = 0
-        deadline = time.time() + 10
+        deadline = time.time() + scaled(10.0)
         while not lid and time.time() < deadline:
             for nh in nhs:
                 l, ok = nh.get_leader_id(CID)
@@ -198,7 +200,9 @@ def test_tpu_engine_membership_change():
         s = nhs[0].get_noop_session(CID)
         for i in range(5):
             _propose_retry(nhs[0], s, f"m{i}=1".encode())
-        deadline = time.time() + 10
+        from tests.loadwait import scaled
+
+        deadline = time.time() + scaled(10.0)
         while time.time() < deadline:
             m = nhs[0].sync_get_cluster_membership(CID, timeout=30.0)
             if 4 in m.addresses:
