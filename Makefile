@@ -4,8 +4,8 @@
 PY ?= python
 
 .PHONY: test test-all test-kernels test-obs test-trace test-warmup \
-	test-hostplane test-lease test-devsm native soak soak-smoke bench \
-	dryrun perf-ledger perf-ledger-check
+	test-hostplane test-hostproc test-lease test-devsm native soak \
+	soak-smoke bench dryrun perf-ledger perf-ledger-check
 
 test: native
 	$(PY) -m pytest tests/ -x -q -m "not slow"
@@ -52,6 +52,17 @@ test-warmup:
 # or logdb/{kv,sharded,journal}.py change
 test-hostplane:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_hostplane.py -q
+
+# fast cpu gate for the multi-process host plane (ISSUE 12): shm-ring
+# wraparound/backpressure units, the encode-worker ≡ inline oracle, the
+# ProcStateMachine differential (incl. kill -9 exactly-once fallback and
+# self-rebase), WAL-worker durability (injected fsync failure fails the
+# whole flush cycle; dead worker degrades in-process), the rdbcache
+# failed-commit invalidation, and the workers-off structural identity —
+# run before the full tier-1 sweep whenever hostproc/, hostplane.py,
+# logdb/{journal,rdb,sharded}.py or the nodehost wiring change
+test-hostproc:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_hostproc.py -q
 
 # fast cpu gate for the device state machine (ISSUE 11): the device KV
 # apply ≡ scalar-oracle differential (kernel + engine level), the

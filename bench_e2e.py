@@ -58,29 +58,13 @@ def _force_cpu_for_engine() -> None:
         hostplatform.force_cpu()
 
 
-class CounterSM:
-    """Minimal in-memory SM (reference checkdisk uses a noop-ish SM)."""
-
-    def __init__(self, cluster_id, node_id):
-        self.count = 0
-
-    def update(self, cmd):
-        from dragonboat_tpu import Result
-
-        self.count += 1
-        return Result(value=self.count)
-
-    def lookup(self, query):
-        return self.count
-
-    def save_snapshot(self, w, files, done):
-        w.write(self.count.to_bytes(8, "little"))
-
-    def recover_from_snapshot(self, r, files, done):
-        self.count = int.from_bytes(r.read(8), "little")
-
-    def close(self):
-        pass
+# Minimal in-memory SM (reference checkdisk uses a noop-ish SM).
+# Imported — not defined here — because this file runs as __main__ for
+# the bench axes: a __main__-scoped class has no ``module:qualname``
+# spec a hostproc apply worker could import, so the worker tier would
+# silently skip it (ISSUE 12); dragonboat_tpu.testing.CounterSM is the
+# same machine in an importable home, marked process-spawnable.
+from dragonboat_tpu.testing import CounterSM  # noqa: E402
 
 
 def _payload() -> bytes:
@@ -423,6 +407,8 @@ def run_sessions(
     n_hosts: int = 3,
     engine: str = "scalar",
     fsync_ms: float = 0.0,
+    host_workers: int = 0,
+    wal_journal: str = "auto",
 ) -> dict:
     """Durable single-process 3-host cluster, S exactly-once sessions
     round-robined over G groups.  Returns w/s, commit p50/p99, fsyncs/s
@@ -476,6 +462,11 @@ def run_sessions(
                             engine_block_groups=max(groups, 64),
                             logdb_shards=shards,
                             host_compartments=compartments,
+                            # multi-process host plane (ISSUE 12): 0 =
+                            # in-process tiers; N spawns N workers per
+                            # host behind shared-memory rings
+                            host_workers=host_workers,
+                            host_wal_journal=wal_journal,
                             # the journal rides the same simulated device
                             fs=slow_fs,
                         ),
@@ -511,6 +502,7 @@ def run_sessions(
             "hosts": n_hosts,
             "engine": engine,
             "compartments": compartments,
+            "host_workers": host_workers,
             # >0 = the SIMULATED serialized-device axis (fsync costs this
             # many ms and flushes queue at one device); 0 = the real disk
             "fsync_ms": fsync_ms,
@@ -522,9 +514,14 @@ def run_sessions(
             "fsyncs": fsyncs,
             "fsyncs_per_sec": round(fsyncs / elapsed, 1),
         }
-        if compartments:
+        if compartments or host_workers:
             hp = [nh.hostplane.stats() for nh in nhs]
             res["hostplane"] = hp
+            if host_workers:
+                res["hostproc"] = [
+                    nh.hostproc.stats() for nh in nhs
+                    if nh.hostproc is not None
+                ]
             # cross-committer fsync amortization, load-weighted across
             # hosts: committer submissions per flusher cycle
             subs = sum(h["wal"]["submissions"] for h in hp)
@@ -560,6 +557,74 @@ def run_sessions_ab(
         else None
     )
     return {"off": off, "on": on, "speedup": speed}
+
+
+def run_host_workers_axis(
+    sessions: int = 32, groups: int = 8, duration: float = 8.0,
+    workers: int = 0,
+) -> dict:
+    """Multi-process host plane A/B (ISSUE 12 acceptance): the same
+    many-session durable cluster with ``host_workers=0`` (in-process
+    compartmentalized plane) vs N worker processes per host.
+
+    The assertion is CPU-topology gated, by design: on a multi-core box
+    the worker tier must deliver the scaling target (≥5x e2e w/s at 32+
+    sessions with ≥8 cores, pro-rated below that — override with env
+    ``E2E_HW_TARGET``); on a single-core box there is no parallelism to
+    win — every process time-slices one core and each ring handoff is a
+    scheduling quantum — so the axis asserts parity-within-noise
+    (workers ≥ ``E2E_HW_PARITY_FLOOR``, default 0.5x, of in-process;
+    single-window weather on the 1-vCPU box is ±15%) and LABELS itself
+    ``single_core`` so the ledger records the limitation instead of a
+    fake win."""
+    cores = os.cpu_count() or 1
+    n = workers or max(1, min(cores, 4))
+    single_core = cores < 2
+    # journal mode FORCED symmetrically: a fast-disk auto probe keeps
+    # the classic per-shard saves and the WAL worker would idle — the
+    # axis wants the redo-journal cycle on both sides so "on" routes the
+    # same durability work through the worker that "off" runs in-process
+    off = run_sessions(
+        sessions=sessions, groups=groups, duration=duration,
+        compartments=True, host_workers=0, wal_journal="force",
+    )
+    on = run_sessions(
+        sessions=sessions, groups=groups, duration=duration,
+        compartments=True, host_workers=n, wal_journal="force",
+    )
+    speedup = (
+        round(on["writes_per_sec"] / off["writes_per_sec"], 2)
+        if off["writes_per_sec"] else None
+    )
+    if single_core:
+        target = float(os.environ.get("E2E_HW_PARITY_FLOOR", "0.5"))
+        assert_ok = speedup is not None and speedup >= target
+        assertion = (
+            f"single-core parity-within-noise: {speedup}x >= {target}x"
+        )
+    else:
+        target = float(
+            os.environ.get(
+                "E2E_HW_TARGET",
+                "5.0" if cores >= 8 else str(round(0.6 * cores, 2)),
+            )
+        )
+        assert_ok = speedup is not None and speedup >= target
+        assertion = f"multi-core scaling: {speedup}x >= {target}x"
+    hp = on.get("hostproc") or []
+    return {
+        "cores": cores,
+        "single_core": single_core,
+        "workers": n,
+        "axis": [{"off": off, "on": on, "speedup": speedup}],
+        "restarts": sum(h.get("restarts", 0) for h in hp),
+        "fallbacks": {
+            k: sum(h.get("fallbacks", {}).get(k, 0) for h in hp)
+            for k in ("encode", "wal", "apply")
+        },
+        "assertion": assertion,
+        "assert_ok": assert_ok,
+    }
 
 
 # ======================================================================
@@ -2077,5 +2142,8 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--devsm" in sys.argv:
         print(json.dumps(run_devsm()), file=sys.stdout)
+        sys.exit(0)
+    if "--host-workers" in sys.argv:
+        print(json.dumps(run_host_workers_axis()), file=sys.stdout)
         sys.exit(0)
     print(json.dumps(run_quick()), file=sys.stdout)

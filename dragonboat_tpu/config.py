@@ -175,6 +175,28 @@ class ExpertConfig:
     # dedicated apply / client-completion egress executors (0 = 2 / 1)
     host_apply_workers: int = 0
     host_egress_workers: int = 0
+    # ---- multi-process host plane (hostproc/, ISSUE 12) ----
+    # promote the host-plane stages to WORKER PROCESSES connected by
+    # shared-memory staging rings: ingress payload encode, the
+    # group-commit redo-journal append+fsync, and an apply tier for
+    # state machines with process-spawnable factories (see
+    # dragonboat_tpu.hostproc.spawnable).  0 (default) = today's
+    # in-process path, structurally bit-identical; N > 0 spawns N
+    # workers and implies the compartmentalized host plane (the worker
+    # tiers are its stages' execution resources).  Worker crash/exit
+    # falls back in-process mid-flight with nothing acked-before-fsync
+    # violated; cap N at os.cpu_count() — extra workers only add
+    # handoffs.
+    host_workers: int = 0
+    # group-commit journal strategy for the host plane's WAL tier:
+    #   "auto"  — a startup fsync probe picks journaled vs classic
+    #             per-shard saves (min-of-samples, robust to a
+    #             GIL-polluted probe);
+    #   "force" — always journal; the probe still runs (re-probed with
+    #             extra samples) but only paces the accumulation window;
+    #   "off"   — never journal (classic merged per-shard saves).
+    # The chosen strategy is introspectable via NodeHost.wal_status().
+    host_wal_journal: str = "auto"
     # filesystem the snapshot paths go through; None = the real OS fs.
     # Setting a vfs.MemFS runs the whole stack diskless (reference memfs
     # builds); a vfs.ErrorFS enables fault-injection testing and is
@@ -184,6 +206,12 @@ class ExpertConfig:
     def validate(self) -> None:
         if self.quorum_engine not in ("scalar", "tpu", "auto"):
             raise ConfigError(f"unknown quorum engine {self.quorum_engine!r}")
+        if self.host_workers < 0:
+            raise ConfigError("host_workers must be >= 0")
+        if self.host_wal_journal not in ("auto", "force", "off"):
+            raise ConfigError(
+                f"unknown host_wal_journal {self.host_wal_journal!r}"
+            )
 
 
 @dataclass

@@ -39,6 +39,12 @@ class DeviceKVStateMachine(IStateMachine):
     #: registration marker the NodeHost checks (duck-typed so wrappers
     #: and factories can carry it without subclassing)
     device_kv = True
+    #: the numpy-shadow half is process-spawnable (ISSUE 12): when the
+    #: group runs WITHOUT ``Config.device_kv`` (plain host SM) and
+    #: ``host_workers > 0``, the hostproc apply tier may host it — the
+    #: NodeHost never proxies a device-BOUND machine (the devsm plane IS
+    #: its apply offload)
+    __hostproc_spawnable__ = True
     #: value slots; must fit the engine's ``n_kv_slots`` width
     kv_slots = KV_SLOTS
 

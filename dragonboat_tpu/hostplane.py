@@ -59,10 +59,11 @@ plog = get_logger("hostplane")
 class _IngressShard:
     """One staging ring + its batcher thread."""
 
-    __slots__ = ("mu", "cv", "ring", "ncmds", "cap", "thread", "mu_wait_s",
-                 "draining")
+    __slots__ = ("idx", "mu", "cv", "ring", "ncmds", "cap", "thread",
+                 "mu_wait_s", "draining")
 
-    def __init__(self, cap: int):
+    def __init__(self, cap: int, idx: int = 0):
+        self.idx = idx
         self.mu = threading.Lock()
         self.cv = threading.Condition(self.mu)
         self.ring: list = []
@@ -98,10 +99,25 @@ class ProposalIngress:
         shards: int = 2,
         ring_cap: int = 0,
         obs=None,
+        hostproc=None,
     ):
         self.nshards = max(1, shards)
         cap = ring_cap or Soft.incoming_proposal_queue_length * 4
-        self._shards = [_IngressShard(cap) for _ in range(self.nshards)]
+        self._shards = [
+            _IngressShard(cap, idx=i) for i in range(self.nshards)
+        ]
+        # multi-process encode tier (hostproc, ISSUE 12): one
+        # shared-memory encode lane per staging shard — the batcher
+        # ships the whole drained burst's payload encode to a worker
+        # process and stamps the ``ipc`` trace stage on return.  None
+        # (host_workers=0, or a topology where the handoff cannot pay —
+        # see HostProcPlane.offload_default) keeps the inline encode
+        # bit-identical.
+        self._encoders = (
+            [hostproc.encode_lane(i) for i in range(self.nshards)]
+            if hostproc is not None and hostproc.offload_default
+            else None
+        )
         self._stopped = False
         self._paused = False  # test hook: hold drains to observe ring caps
         self._obs = obs
@@ -205,7 +221,7 @@ class ProposalIngress:
                 sh.ncmds = 0
                 sh.draining = True
             try:
-                self._drain(burst)
+                self._drain(burst, sh.idx)
             except Exception:
                 plog.exception("ingress batcher drain failed")
                 # resolve every future the failed drain may have
@@ -228,7 +244,7 @@ class ProposalIngress:
             finally:
                 sh.draining = False
 
-    def _drain(self, burst: list) -> None:
+    def _drain(self, burst: list, shard_idx: int = 0) -> None:
         t0 = time.perf_counter() if self._obs is not None else 0.0
         by_node: Dict[int, list] = {}
         nodes: Dict[int, "Node"] = {}
@@ -238,7 +254,7 @@ class ProposalIngress:
             nodes[node.cluster_id] = node
         n_cmds = 0
         for cid, items in by_node.items():
-            n_cmds += self._stage_node(nodes[cid], items)
+            n_cmds += self._stage_node(nodes[cid], items, shard_idx)
         self.drains += 1
         self.drained += n_cmds
         obs = self._obs
@@ -249,7 +265,8 @@ class ProposalIngress:
                 ring_depth=sum(len(s.ring) for s in self._shards),
             )
 
-    def _stage_node(self, node: "Node", items: list) -> int:
+    def _stage_node(self, node: "Node", items: list,
+                    shard_idx: int = 0) -> int:
         """Encode + register + stage one group's burst.  Returns the
         number of commands staged.  Ordering: ring order is preserved
         (one group always maps to one shard, so a client's back-to-back
@@ -258,6 +275,33 @@ class ProposalIngress:
 
         pp = node.pending_proposals
         ct = node._entry_ct
+        tr = node.tracer
+        # hostproc encode tier: ship the burst's non-empty payloads to
+        # the shard's worker lane in ONE round trip; a None return
+        # (worker gone / ring busy) falls back to the inline encode —
+        # same bytes, just on this thread.  ``ipc`` stamps the handoff
+        # (ring enqueue -> worker dequeue -> encoded burst returned).
+        enc_iter = None
+        if self._encoders is not None:
+            raw = [
+                cmd
+                for _n, _s, cmds, *_ in items
+                for cmd in cmds
+                if cmd
+            ]
+            if raw:
+                encs = self._encoders[shard_idx].encode(int(ct), raw)
+                if encs is not None:
+                    enc_iter = iter(encs)
+                    if tr is not None:
+                        # only states whose command actually rode the
+                        # encode worker — empty commands stage inline
+                        # and must not inherit a handoff interval in
+                        # the attribution table
+                        for _n, states, cmds, *_ in items:
+                            for rs, cmd in zip(states, cmds):
+                                if cmd:
+                                    tr.mark(rs, "ipc")
         entries: List[Entry] = []
         all_states: list = []
         runs: list = []  # (client_id, series_id, responded_to, start, end)
@@ -265,7 +309,10 @@ class ProposalIngress:
             start = len(entries)
             for rs, cmd in zip(states, cmds):
                 if cmd:
-                    enc = get_encoded_payload(ct, cmd)
+                    enc = (
+                        next(enc_iter) if enc_iter is not None
+                        else get_encoded_payload(ct, cmd)
+                    )
                     etype = EntryType.ENCODED
                 else:
                     enc = cmd
@@ -319,7 +366,6 @@ class ProposalIngress:
                 # ``propose_batch`` (DROPPED futures, clients retry)
                 pp.dropped(e.key)
         node.nh.engine.set_step_ready(node.cluster_id)
-        tr = node.tracer
         if tr is not None:
             for rs in all_states:
                 tr.mark(rs, "ingress")
@@ -399,7 +445,8 @@ class GroupCommitWAL:
     #: truncation cadence; each checkpoint costs one fsync per shard)
     CHECKPOINT_EVERY = 256
 
-    def __init__(self, logdb, window_ms: float = 0.0, obs=None, fs=None):
+    def __init__(self, logdb, window_ms: float = 0.0, obs=None, fs=None,
+                 journal_mode: str = "auto", hostproc=None):
         self.logdb = logdb
         self.window_s = max(0.0, window_ms) / 1e3
         self._cv = threading.Condition()
@@ -407,39 +454,81 @@ class GroupCommitWAL:
         self._flushing = False
         self._stopped = False
         self._obs = obs
+        self._fs = fs
         self.flushes = 0
         self.submissions = 0
         self.updates_flushed = 0
+        # journal strategy (ExpertConfig.host_wal_journal): "auto" lets
+        # the device probe below pick; "force" always journals (the
+        # probe only paces the window); "off" never arms the journal
+        self._mode = (
+            journal_mode if journal_mode in ("auto", "force", "off")
+            else "auto"
+        )
         # cross-shard journal: when the LogDB supports it (durable
         # sharded backend), every flush cycle is ONE journal fsync for
         # ALL shards' batches; otherwise fall back to the per-shard
         # fsynced save (still merged across committers)
         self._journal = None
         enable = getattr(logdb, "enable_host_journal", None)
-        if enable is not None:
+        if enable is not None and self._mode != "off":
             try:
                 self._journal = enable(fs=fs)
             except OSError:
                 plog.exception("host journal unavailable; per-shard fsync")
         self._since_checkpoint = 0
         self._single_streak = 0
-        # one-shot device probe at construction (the box is quiet, so the
-        # measurement is GIL-clean — runtime persist walls are polluted
+        self._probes = 0
+        # startup device probe (the box is quiet, so the measurement is
+        # as GIL-clean as it gets — runtime persist walls are polluted
         # by GIL-reacquisition waits and cannot attribute device cost):
         # a slow durability device (ms-class barrier) engages the
         # cross-file journal and a short accumulation window, both of
         # which pay for themselves many times over there; a fast device
         # (sub-ms) keeps the classic per-shard fsynced save — merged
         # across committers by the leader protocol, but with zero extra
-        # encode/write work.  ``journal.bytes > 0`` still forces the
-        # journaled path regardless (replay-regression correctness rule,
-        # see ShardedDB.save_raft_state_journaled).
+        # encode/write work.  The probe keeps the MIN over its samples:
+        # GIL pollution only ever INFLATES a sample, so the min is the
+        # robust device-cost estimator (a polluted mean could pin the
+        # journal on a fast disk for the process lifetime).
+        # ``journal.bytes > 0`` still forces the journaled path
+        # regardless (replay-regression correctness rule, see
+        # ShardedDB.save_raft_state_journaled).
         self._device_probe_s = self._probe_device(fs)
-        self._journal_engaged = (
-            self._journal is not None and self._device_probe_s >= 0.0005
-        )
+        if self._mode == "force" and self._journal is not None:
+            # forced strategy (ISSUE 12 satellite): the probe no longer
+            # picks the strategy, only the pacing window — RE-probe so
+            # one polluted startup sample can't pin the window either
+            self.reprobe()
+            self._journal_engaged = True
+        else:
+            self._journal_engaged = (
+                self._journal is not None
+                and self._device_probe_s >= 0.0005
+            )
+        # WAL-worker sink (hostproc, ISSUE 12): the journal's
+        # append+fsync cycle runs in a worker process; raw-OS path only
+        # (a fault-injection vfs cannot cross the process boundary, and
+        # must keep reaching the in-process durability point).  Gated
+        # like the journal itself, by measurement: the cross-process
+        # round trip costs ~1-2 scheduling quanta, so it pays only when
+        # spare cores can hide it (hostproc.offload_default) or the
+        # durability barrier dwarfs it — a sub-ms fsync on a single-core
+        # box measured ~8x SLOWER through the worker.
+        if (
+            hostproc is not None and self._journal is not None
+            and fs is None
+            and (
+                hostproc.offload_default
+                or self._device_probe_s >= 0.0005
+            )
+        ):
+            try:
+                self._journal.sink = hostproc.wal_sink()
+            except Exception:
+                plog.exception("hostproc WAL sink unavailable")
 
-    def _probe_device(self, fs) -> float:
+    def _probe_device(self, fs, samples: int = 3) -> float:
         if self._journal is None:
             return 0.0
         import os as _os
@@ -448,25 +537,63 @@ class GroupCommitWAL:
         try:
             f = open(path, "ab") if fs is None else fs.open(path, "ab")
             try:
-                t0 = time.perf_counter()
-                n = 3
-                for _ in range(n):
+                cost = None
+                for _ in range(samples):
+                    t0 = time.perf_counter()
                     f.write(b"p")
                     f.flush()
                     if fs is None:
                         _os.fsync(f.fileno())
                     else:
                         fs.fsync(f)
-                cost = (time.perf_counter() - t0) / n
+                    dt = time.perf_counter() - t0
+                    cost = dt if cost is None else min(cost, dt)
             finally:
                 f.close()
                 try:
                     (_os.unlink if fs is None else fs.remove)(path)
                 except OSError:
                     pass
-            return cost
+            self._probes += 1
+            return cost or 0.0
         except OSError:
             return 0.0
+
+    def reprobe(self) -> float:
+        """Refresh the device probe (min-of-samples) and re-derive the
+        strategy: mode "auto" re-decides engagement, mode "force" only
+        re-paces the accumulation window.  Construction calls this for
+        forced mode; tests/operators may call it whenever the device
+        characteristics changed."""
+        p = self._probe_device(self._fs, samples=5)
+        self._device_probe_s = p
+        if self._mode == "auto":
+            self._journal_engaged = (
+                self._journal is not None and p >= 0.0005
+            )
+        return p
+
+    def status(self) -> dict:
+        """Introspection (the ``lease_status`` pattern): which strategy
+        the probe chose, what it measured, and where durability happens
+        (worker sink vs in-process)."""
+        j = self._journal
+        snk = getattr(j, "sink", None) if j is not None else None
+        return {
+            "mode": self._mode,
+            "engaged": self._journal_engaged,
+            "probe_ms": round(self._device_probe_s * 1e3, 4),
+            "probes": self._probes,
+            "window_ms": round(self._adaptive_window_s() * 1e3, 4),
+            "journal": j is not None,
+            "journal_bytes": j.bytes if j is not None else 0,
+            "journal_fsyncs": j.fsyncs if j is not None else 0,
+            "worker_sink": bool(
+                snk is not None and getattr(snk, "attached", False)
+            ),
+            "flushes": self.flushes,
+            "amortization": round(self.amortization, 2),
+        }
 
     def _adaptive_window_s(self) -> float:
         if self.window_s:
@@ -480,7 +607,7 @@ class GroupCommitWAL:
         """Persist ``updates`` (blocking until fsynced).  Raises whatever
         the merged persist raised."""
         if not self._journal_engaged and (
-            self._journal is None or not self._journal.bytes
+            self._journal is None or not self._journal.nonempty()
         ):
             # fast durability device: merging saves under one leader
             # measured as a net LOSS there (serializing sub-ms barriers
@@ -537,7 +664,7 @@ class GroupCommitWAL:
         try:
             if merged:
                 if self._journal is not None and (
-                    self._journal_engaged or self._journal.bytes
+                    self._journal_engaged or self._journal.nonempty()
                 ):
                     if self.logdb.save_raft_state_journaled(merged):
                         self._since_checkpoint += 1
@@ -771,12 +898,19 @@ class HostPlane:
         apply_workers: int = 0,
         egress_workers: int = 0,
         fs=None,
+        hostproc=None,
+        wal_journal_mode: str = "auto",
     ):
         self._obs = None
+        self.hostproc = hostproc
         self.ingress = ProposalIngress(
-            shards=ingress_shards or 2, ring_cap=ingress_ring
+            shards=ingress_shards or 2, ring_cap=ingress_ring,
+            hostproc=hostproc,
         )
-        self.wal = GroupCommitWAL(logdb, window_ms=wal_window_ms, fs=fs)
+        self.wal = GroupCommitWAL(
+            logdb, window_ms=wal_window_ms, fs=fs,
+            journal_mode=wal_journal_mode, hostproc=hostproc,
+        )
         # default matches the engine's apply-worker count: fewer dedicated
         # executors than the engine pool they replace measured ~5% off on
         # the many-session axis (apply batches queued behind each other)
@@ -811,14 +945,18 @@ class HostPlane:
         return fn() if fn is not None else 0
 
     def stats(self) -> dict:
-        return {
+        out = {
             "ingress": self.ingress.stats(),
             "wal": self.wal.stats(),
+            "wal_status": self.wal.status(),
             "apply_batches": self.apply_pool.batches,
             "egress_notified": self.egress.notified,
             "egress_inline": self.egress.inline,
             "fsyncs": self.fsync_count(),
         }
+        if self.hostproc is not None:
+            out["hostproc"] = self.hostproc.stats()
+        return out
 
     def stop(self) -> None:
         self.ingress.stop()

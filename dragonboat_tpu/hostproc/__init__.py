@@ -1,0 +1,64 @@
+"""Multi-process host plane (ISSUE 12): escape the GIL by promoting the
+per-shard host-plane stages to worker processes connected by
+shared-memory staging rings.
+
+Layout:
+
+- :mod:`rings`   — SPSC shared-memory byte rings (length-prefixed blobs,
+  seqlock-style head/tail cursors, busy→event doorbell layered above);
+- :mod:`workers` — the spawned worker process: ingress payload encode,
+  the redo-journal append+fsync cycle, and the apply tier holding state
+  machines built from process-spawnable factories;
+- :mod:`control` — spawn/handshake/heartbeat/restart/drain-and-stop,
+  plus the host-side lane clients and their in-process fallbacks;
+- :mod:`sm`      — the ``ProcStateMachine`` proxy with snapshot+redo
+  crash fallback.
+
+Everything is gated by ``ExpertConfig.host_workers`` (default 0 = the
+in-process compartmentalized plane, structurally bit-identical to the
+pre-hostproc build).  This ``__init__`` stays import-light on purpose:
+spawned workers execute it on their startup path.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "HostProcPlane",
+    "ProcStateMachine",
+    "spawnable",
+    "spawnable_spec",
+]
+
+
+def spawnable(factory):
+    """Mark a module-level state-machine factory (class or callable
+    taking ``(cluster_id, node_id)``) as safe to instantiate inside a
+    hostproc apply worker.  Decorator-friendly."""
+    factory.__hostproc_spawnable__ = True
+    return factory
+
+
+def spawnable_spec(factory) -> "str | None":
+    """``module:qualname`` spec for a spawnable factory, or None when
+    the factory did not opt in / cannot be imported from a worker
+    (``__main__`` scripts, closures, instance-bound callables)."""
+    if not getattr(factory, "__hostproc_spawnable__", False):
+        return None
+    mod = getattr(factory, "__module__", None)
+    qual = getattr(factory, "__qualname__", None)
+    if not mod or not qual or mod == "__main__" or "<locals>" in qual:
+        return None
+    return f"{mod}:{qual}"
+
+
+def __getattr__(name):
+    # lazy: workers importing this package must not pull the host-side
+    # control plane (multiprocessing spawn machinery) or the proxy
+    if name == "HostProcPlane":
+        from .control import HostProcPlane
+
+        return HostProcPlane
+    if name == "ProcStateMachine":
+        from .sm import ProcStateMachine
+
+        return ProcStateMachine
+    raise AttributeError(name)

@@ -1,0 +1,288 @@
+"""Apply-tier proxy: an ``IStateMachine`` whose live state lives in a
+worker process.
+
+A state machine whose factory is PROCESS-SPAWNABLE (a module-level
+class/callable marked ``__hostproc_spawnable__`` — see
+:func:`dragonboat_tpu.hostproc.spawnable_spec`) is wrapped in a
+:class:`ProcStateMachine` at ``start_cluster``: the worker builds the
+real machine from the ``module:qualname`` spec, and every ``update`` /
+``lookup`` / snapshot call becomes one shared-memory ring round trip.
+The rsm layer above is untouched — sessions, ordering and snapshot
+framing all operate on the proxy exactly as on a plain host SM, and the
+snapshot STREAM is byte-identical (the worker writes the user SM's own
+format), so replicas with and without the worker tier interoperate.
+
+Crash fallback (the part that makes kill -9 safe): the proxy keeps a
+host-side REDO BUFFER — every command the worker acknowledged since the
+last snapshot — plus the last snapshot bytes.  When the worker dies (or
+its lane re-arms under a new epoch, or a call times out), the proxy
+rebuilds in-process: fresh factory instance, recover from the cached
+snapshot, replay the redo buffer in order, then apply the in-flight
+command locally.  Every command is applied EXACTLY once in the surviving
+state — a command the dying worker may or may not have applied only ever
+mutated the now-discarded worker copy.  The proxy then LATCHES
+in-process for its lifetime (worker restarts serve only newly started
+groups).  The buffer is bounded by self-rebase: past
+``REBASE_CMDS``/``REBASE_BYTES`` the proxy snapshots the worker state
+and truncates — the same bounding discipline the raft log gets from
+snapshotting.
+"""
+from __future__ import annotations
+
+import io
+import struct
+import threading
+from typing import Optional
+
+from ..logger import get_logger
+from ..requests import SystemBusyError
+from ..statemachine import Result
+from . import workers as wp
+from .control import WorkerError, WorkerGone
+from .workers import _NeverStop
+
+plog = get_logger("hostproc")
+
+_2U64 = struct.Struct("<QQ")
+_I64 = struct.Struct("<q")
+
+
+def _infra_error(e: BaseException) -> bool:
+    """WorkerError raised by the TIER (machine missing after a respawn,
+    result too large for the ring) rather than by the user SM — these
+    warrant the in-process fallback; a user-SM exception propagates."""
+    msg = str(e)
+    return "no worker SM" in msg or "exceeds ring capacity" in msg
+
+
+class ProcStateMachine:
+    """IStateMachine facade over a worker-held machine (see module doc)."""
+
+    #: self-rebase thresholds bounding the host-side redo buffer
+    REBASE_CMDS = 2048
+    REBASE_BYTES = 8 << 20
+
+    def __init__(self, plane, spec: str, cluster_id: int, node_id: int,
+                 factory):
+        self._plane = plane
+        self._spec = spec
+        self._cid = cluster_id
+        self._nid = node_id
+        self._factory = factory
+        self._hdr = _2U64.pack(cluster_id, node_id)
+        self._mu = threading.RLock()
+        self._local = None          # not None = fallen back in-process
+        self._snap: Optional[bytes] = None
+        self._redo: list = []
+        self._redo_bytes = 0
+        self._client = None
+        self._epoch = -1
+        c = plane.apply_client(cluster_id)
+        try:
+            c.call(
+                wp.OP_SM_CREATE, self._hdr + spec.encode("utf-8"),
+                timeout=30.0,
+            )
+            self._client = c
+            self._epoch = c.epoch
+        except Exception:
+            # spec unimportable in the worker, worker down, ... — serve
+            # in-process from birth; the group never notices
+            plog.exception(
+                "hostproc SM create failed for %d:%d (%s); in-process",
+                cluster_id, node_id, spec,
+            )
+            plane._count_fallback("apply")
+            self._local = factory(cluster_id, node_id)
+
+    # ---- fallback machinery ----
+
+    @property
+    def device_bound(self) -> bool:
+        """True while the machine still lives in the worker process."""
+        with self._mu:
+            return self._local is None
+
+    def _remote_ok(self) -> bool:
+        c = self._client
+        return (
+            self._local is None
+            and c is not None
+            and c.alive
+            and c.epoch == self._epoch
+        )
+
+    def _fallback(self, pending: Optional[bytes] = None):
+        """Rebuild in-process: snapshot + redo replay (exactly-once by
+        construction — the worker copy is discarded wholesale), then the
+        in-flight command.  Latches ``_local`` for the proxy lifetime."""
+        sm = self._factory(self._cid, self._nid)
+        if self._snap is not None:
+            sm.recover_from_snapshot(io.BytesIO(self._snap), [], _NeverStop())
+        for cmd in self._redo:
+            sm.update(cmd)
+        self._local = sm
+        self._plane._count_fallback("apply")
+        # best-effort release of the abandoned worker-side machine (a
+        # transient timeout latches us local while the worker lives on
+        # — without this its copy leaks for the worker's lifetime);
+        # short timeouts: the lane may be the slow thing that got us
+        # here, and apply must not stall behind courtesy cleanup
+        c = self._client
+        if c is not None and c.alive and c.epoch == self._epoch:
+            try:
+                c.call(
+                    wp.OP_SM_CLOSE, self._hdr,
+                    timeout=1.0, busy_timeout=0.05,
+                )
+            except Exception:
+                pass
+        plog.warning(
+            "hostproc SM %d:%d fell back in-process (replayed %d cmds%s)",
+            self._cid, self._nid, len(self._redo),
+            " + snapshot" if self._snap is not None else "",
+        )
+        if pending is not None:
+            return sm.update(pending)
+        return None
+
+    def _try_rebase(self) -> None:
+        try:
+            body = self._client.call(wp.OP_SM_SNAP, self._hdr, timeout=30.0)
+        except (WorkerGone, WorkerError, SystemBusyError):
+            return  # keep the buffer; the next threshold retries
+        self._snap = body
+        self._redo = []
+        self._redo_bytes = 0
+
+    # ---- IStateMachine ----
+
+    def update(self, cmd) -> Result:
+        with self._mu:
+            if self._local is not None:
+                return self._local.update(cmd)
+            cmd_b = bytes(cmd)
+            if not self._remote_ok():
+                return self._fallback(pending=cmd_b)
+            try:
+                body = self._client.call(
+                    wp.OP_SM_UPDATE, self._hdr + cmd_b,
+                    timeout=30.0, busy_timeout=0.25,
+                )
+            except (WorkerGone, SystemBusyError):
+                return self._fallback(pending=cmd_b)
+            except WorkerError as e:
+                if _infra_error(e):
+                    # respawned worker without our machine (defensive —
+                    # the epoch check above normally catches this) or a
+                    # result the ring cannot carry
+                    return self._fallback(pending=cmd_b)
+                # the user SM raised: propagate like the in-process path
+                # (worker state unchanged, command not buffered)
+                raise RuntimeError(str(e)) from e
+            self._redo.append(cmd_b)
+            self._redo_bytes += len(cmd_b)
+            if (
+                len(self._redo) >= self.REBASE_CMDS
+                or self._redo_bytes >= self.REBASE_BYTES
+            ):
+                self._try_rebase()
+            (value,) = _I64.unpack_from(body, 0)
+            return Result(value=value, data=bytes(body[_I64.size:]))
+
+    def lookup(self, query):
+        import pickle
+
+        with self._mu:
+            if self._local is not None:
+                return self._local.lookup(query)
+            if not self._remote_ok():
+                self._fallback()
+                return self._local.lookup(query)
+            try:
+                body = self._client.call(
+                    wp.OP_SM_LOOKUP,
+                    self._hdr + pickle.dumps(
+                        query, protocol=pickle.HIGHEST_PROTOCOL
+                    ),
+                    timeout=30.0, busy_timeout=0.25,
+                )
+            except (WorkerGone, SystemBusyError):
+                self._fallback()
+                return self._local.lookup(query)
+            except WorkerError as e:
+                if _infra_error(e):
+                    self._fallback()
+                    return self._local.lookup(query)
+                # the user SM's lookup raised: propagate like the
+                # in-process path — the worker and its state are
+                # healthy, one bad query must not abandon the tier
+                raise RuntimeError(str(e)) from e
+            return pickle.loads(body)
+
+    def save_snapshot(self, w, files, done) -> None:
+        with self._mu:
+            if self._local is not None:
+                return self._local.save_snapshot(w, files, done)
+            try:
+                body = self._client.call(
+                    wp.OP_SM_SNAP, self._hdr, timeout=60.0
+                )
+            except (WorkerGone, SystemBusyError):
+                self._fallback()
+                return self._local.save_snapshot(w, files, done)
+            except WorkerError as e:
+                if _infra_error(e):
+                    self._fallback()
+                    return self._local.save_snapshot(w, files, done)
+                raise RuntimeError(str(e)) from e
+            w.write(body)
+            # the snapshot doubles as the redo buffer's rebase point
+            self._snap = body
+            self._redo = []
+            self._redo_bytes = 0
+            return None
+
+    def recover_from_snapshot(self, r, files, done) -> None:
+        data = r.read()
+        with self._mu:
+            if self._local is not None:
+                return self._local.recover_from_snapshot(
+                    io.BytesIO(data), files, done
+                )
+            try:
+                self._client.call(
+                    wp.OP_SM_RECOVER, self._hdr + data, timeout=60.0
+                )
+            except (WorkerGone, SystemBusyError):
+                sm = self._factory(self._cid, self._nid)
+                sm.recover_from_snapshot(io.BytesIO(data), files, done)
+                self._local = sm
+                self._plane._count_fallback("apply")
+                return None
+            except WorkerError as e:
+                if _infra_error(e):
+                    sm = self._factory(self._cid, self._nid)
+                    sm.recover_from_snapshot(io.BytesIO(data), files, done)
+                    self._local = sm
+                    self._plane._count_fallback("apply")
+                    return None
+                raise RuntimeError(str(e)) from e
+            self._snap = data
+            self._redo = []
+            self._redo_bytes = 0
+            return None
+
+    def close(self) -> None:
+        with self._mu:
+            if self._local is not None:
+                return self._local.close()
+            if self._remote_ok():
+                try:
+                    self._client.call(
+                        wp.OP_SM_CLOSE, self._hdr, timeout=5.0,
+                        busy_timeout=0.1,
+                    )
+                except Exception:
+                    pass
+            return None

@@ -57,6 +57,20 @@ class HostJournal:
     def __init__(self, path: str, fs=None):
         self.path = path
         self._fs = fs
+        # optional WAL-worker sink (hostproc, ISSUE 12): when attached,
+        # the append's write+fsync runs in a worker process — the host
+        # blocks until the worker acks the fsync (nothing acked before
+        # it).  Contract: ``sink.append(path, rec) -> bool`` — True =
+        # durable in the worker; False = worker tier unavailable, fall
+        # back to the in-process write+fsync below; raises OSError when
+        # the worker REALLY failed the durable op (propagates to the
+        # flush cycle like a local fsync error).  Both processes open
+        # the file O_APPEND, so fallback interleaving always lands at
+        # the true end of file, and an AMBIGUOUS worker append (worker
+        # died post-fsync pre-ack) is simply re-appended — replay is
+        # idempotent.  Only attached on the raw-OS path (fs is None):
+        # a vfs (ErrorFS/MemFS) cannot cross the process boundary.
+        self.sink = None
         # append vs checkpoint/close can come from different threads
         # (flush leader / ShardedDB journal barrier); serialize file IO
         self._mu = threading.Lock()
@@ -89,6 +103,16 @@ class HostJournal:
         payload = bytes(buf)
         rec = _HDR.pack(zlib.crc32(payload), len(payload), n) + payload
         with self._mu:
+            snk = self.sink
+            if snk is not None and self._fs is None:
+                if snk.append(self.path, rec):  # OSError propagates: the
+                    # worker ran the durable op and it FAILED — the
+                    # flush cycle must fail, exactly like a local fsync
+                    self.fsyncs += 1  # one durability barrier, worker-side
+                    self.appends += 1
+                    self.bytes += len(rec)
+                    return
+                # worker tier unavailable (dead/busy): in-process path
             self._f.write(rec)
             self._f.flush()
             self._fsync()
@@ -102,11 +126,37 @@ class HostJournal:
         already-applied suffix for replay."""
         sync_all()
         with self._mu:
+            snk = self.sink
+            if snk is not None and self._fs is None and snk.truncate(
+                self.path, self.bytes
+            ):
+                self.fsyncs += 1
+                self.bytes = 0
+                return
             self._f.truncate(0)
             self._f.flush()
             self._fsync()
             self.fsyncs += 1
             self.bytes = 0
+
+    def nonempty(self) -> bool:
+        """Whether journal history exists that a crash replay would
+        re-apply.  With a WAL-worker sink attached this consults the
+        FILE, not just the host counter: a request abandoned on a host
+        timeout can execute late in a slow-but-alive worker and land a
+        record the counter never saw — a direct (journal-bypassing)
+        write while such a record exists would be regressed by replay,
+        so the direct-path guards must see it.  (FIFO rings make
+        per-append staleness guards unsound — the stale append always
+        precedes any resync marker — hence guarding the READ side.)"""
+        if self.bytes:
+            return True
+        if self.sink is not None and self._fs is None:
+            try:
+                return os.fstat(self._f.fileno()).st_size > 0
+            except (OSError, ValueError):
+                return True  # conservative: assume history exists
+        return False
 
     def _fsync(self) -> None:
         if self._fs is None:

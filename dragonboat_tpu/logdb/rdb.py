@@ -79,6 +79,18 @@ class RDBCache:
         with self._mu:
             return self._max_index.get((cluster_id, node_id))
 
+    def invalidate(self, pairs) -> None:
+        """Drop the cached State/maxIndex for ``(cluster_id, node_id)``
+        pairs whose write batch FAILED to commit (ISSUE 12 fix): the
+        cache was advanced at build time, so without this the retry's
+        rebuild suppresses the very records the failed batch lost and
+        the state silently never lands.  A dropped entry only costs the
+        next round one unsuppressed write."""
+        with self._mu:
+            for key in pairs:
+                self._ps.pop(key, None)
+                self._max_index.pop(key, None)
+
 
 _U64 = struct.Struct(">Q")
 
@@ -119,7 +131,16 @@ class RDB:
         # unchanged State) must not pay a WAL append + fsync for an empty
         # batch — the rdbcache exists precisely to elide these writes
         if wb.ops:
-            self.kv.commit_write_batch(wb)
+            try:
+                self.kv.commit_write_batch(wb)
+            except BaseException:
+                # the build advanced the rdbcache for records this batch
+                # was carrying; a failed commit must drop those entries
+                # or the retry's rebuild suppresses them forever
+                self.cache.invalidate(
+                    {(u.cluster_id, u.node_id) for u in updates}
+                )
+                raise
 
     def build_raft_state(self, updates: List[Update], wb: KVWriteBatch) -> None:
         """Fill ``wb`` with the round's records WITHOUT committing — the

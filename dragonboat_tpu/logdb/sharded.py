@@ -153,14 +153,29 @@ class ShardedDB:
         if not prepared:
             return False
         with self._journal_mu:
-            if len(prepared) == 1 and self.journal.bytes == 0:
-                idx, wb = prepared[0]
-                self._shards[idx].kv.commit_write_batch(wb)
-                return False
-            self.journal.append(prepared)  # the ONE fsync; raises on failure
-            for idx, wb in prepared:
-                self._shards[idx].kv.commit_write_batch_nosync(wb)
-            return True
+            try:
+                if len(prepared) == 1 and not self.journal.nonempty():
+                    idx, wb = prepared[0]
+                    self._shards[idx].kv.commit_write_batch(wb)
+                    return False
+                # the ONE fsync (in-process or via the hostproc WAL
+                # worker sink); raises on failure
+                self.journal.append(prepared)
+                for idx, wb in prepared:
+                    self._shards[idx].kv.commit_write_batch_nosync(wb)
+                return True
+            except BaseException:
+                # build_raft_state advanced each shard's rdbcache for
+                # the records these batches carry; a failed append /
+                # commit must drop those entries or the committer's
+                # RETRY rebuild suppresses them and the state silently
+                # never lands (ISSUE 12 fix, caught by the WAL-worker
+                # fault-injection suite)
+                for idx, uds in buckets.items():
+                    self._shards[idx].cache.invalidate(
+                        {(u.cluster_id, u.node_id) for u in uds}
+                    )
+                raise
 
     def journal_checkpoint(self) -> None:
         """Fsync every shard store, then truncate the journal — under the
@@ -168,7 +183,7 @@ class ShardedDB:
         half-applied (see ``_journal_mu``)."""
         with self._journal_mu:
             j = self.journal
-            if j is not None and j.bytes:
+            if j is not None and j.nonempty():
                 j.checkpoint(self.sync_all)
 
     def sync_all(self) -> None:
@@ -187,7 +202,7 @@ class ShardedDB:
         checkpoint PROPAGATES — proceeding with the mutation would
         re-create the exact replay-resurrection hazard the barrier
         exists to prevent."""
-        if self.journal is not None and self.journal.bytes:
+        if self.journal is not None and self.journal.nonempty():
             self.journal_checkpoint()
 
     def fsync_count(self) -> int:

@@ -275,7 +275,28 @@ class NodeHost:
         # default — nothing below is constructed and the scalar host path
         # stays bit-identical.
         self.hostplane = None
-        if expert.host_compartments:
+        # multi-process host tier (hostproc/, ISSUE 12): worker
+        # processes behind shared-memory staging rings for the ingress
+        # encode, the WAL redo-journal fsync cycle and the spawnable-SM
+        # apply tier.  host_workers > 0 implies the compartmentalized
+        # plane (the workers are its stages' execution resources); a
+        # failed spawn degrades to the in-process plane with a log line
+        # — never a failed NodeHost.
+        self.hostproc = None
+        if expert.host_workers > 0:
+            from .hostproc.control import HostProcPlane
+
+            try:
+                self.hostproc = HostProcPlane(
+                    workers=expert.host_workers,
+                    encode_lanes=expert.host_ingress_shards or 2,
+                )
+            except Exception:
+                plog.exception(
+                    "hostproc spawn failed; in-process host plane"
+                )
+                self.hostproc = None
+        if expert.host_compartments or self.hostproc is not None:
             from .hostplane import HostPlane
 
             self.hostplane = HostPlane(
@@ -294,11 +315,17 @@ class NodeHost:
                 # stores (which never ride the snapshot vfs), keeping
                 # write and REPLAY (open_logdb, raw OS) on one medium
                 fs=self._fs if vfs.is_error_fs(self._fs) else None,
+                hostproc=self.hostproc,
+                wal_journal_mode=expert.host_wal_journal,
             )
             if nhconfig.enable_metrics:
                 self.hostplane.enable_obs(
                     registry=self.raft_events.registry
                 )
+                if self.hostproc is not None:
+                    self.hostproc.enable_obs(
+                        registry=self.raft_events.registry
+                    )
             if self.quorum_coordinator is not None:
                 # the device-plane coordinator feeds the same tier: its
                 # round fan-out coalesces step wakeups through the plane
@@ -623,7 +650,36 @@ class NodeHost:
             self.snapshot_dir(cluster_id, node_id), cluster_id, node_id,
             self.logdb, fs=self._fs,
         )
-        usersm = create_sm(cluster_id, node_id)
+        # hostproc apply tier (ISSUE 12): a REGULAR state machine whose
+        # factory registered as process-spawnable runs inside an apply
+        # worker behind a ProcStateMachine proxy — update/lookup/snapshot
+        # become shared-memory round trips off this process's GIL.
+        # Never wraps: witness replicas (no real SM work), device_kv
+        # groups (the devsm plane IS their apply offload), or factories
+        # that did not opt in.  The wrap decision is taken BEFORE
+        # construction so the user machine is built exactly once, on
+        # whichever side actually hosts it.  Worker crash ⇒ the proxy
+        # rebuilds in-process from its snapshot+redo buffer,
+        # exactly-once.
+        proc_spec = None
+        if (
+            self.hostproc is not None
+            and self.hostproc.offload_default
+            and smtype == StateMachineType.REGULAR
+            and not config.is_witness
+            and not config.device_kv
+        ):
+            from .hostproc import spawnable_spec
+
+            proc_spec = spawnable_spec(create_sm)
+        if proc_spec is not None:
+            from .hostproc.sm import ProcStateMachine
+
+            usersm = ProcStateMachine(
+                self.hostproc, proc_spec, cluster_id, node_id, create_sm
+            )
+        else:
+            usersm = create_sm(cluster_id, node_id)
         if smtype == StateMachineType.REGULAR:
             managed = from_regular_sm(usersm)
         elif smtype == StateMachineType.CONCURRENT:
@@ -746,6 +802,11 @@ class NodeHost:
             # flusher's riders — stopping the flusher first would strand
             # an in-flight flush
             self.hostplane.stop()
+        if self.hostproc is not None:
+            # after hostplane.stop(): every worker-tier caller (batcher
+            # encode, WAL sink, SM proxies) is quiesced, so the workers'
+            # drain-and-stop sees an empty backlog
+            self.hostproc.stop()
         if self.quorum_coordinator is not None:
             self.quorum_coordinator.stop()
         self.transport.stop()
@@ -1055,6 +1116,17 @@ class NodeHost:
         the group runs without ``Config.read_lease``; else held/remaining
         plus the local-vs-fallback read counters (``Node.lease_status``)."""
         return self.get_node(cluster_id).lease_status()
+
+    def wal_status(self) -> Optional[dict]:
+        """Group-commit WAL strategy snapshot (ISSUE 12 satellite, the
+        ``lease_status`` pattern): ``None`` without the compartmentalized
+        host plane; else the chosen journal strategy (mode / engaged /
+        probe cost / pacing window), the journal's byte/fsync counters
+        and whether durability currently runs through the hostproc WAL
+        worker (``worker_sink``)."""
+        if self.hostplane is None:
+            return None
+        return self.hostplane.wal.status()
 
     # ---- data management ----
 

@@ -61,12 +61,26 @@ LATENCY_BUCKETS_MS = DEFAULT_BUCKETS
 _DEV = "dragonboat_device_"
 _COORD = "dragonboat_coord_"
 _HOST = "dragonboat_host_"
+_HPROC = "dragonboat_hostproc_"
 _DEVSM = "dragonboat_devsm_"
 
 #: ``# HELP`` text per family (ISSUE 9 satellite: the exposition was
 #: ``# TYPE``-only).  Families not listed fall back to the registry's
 #: deterministic placeholder.
 _HELP = {
+    _HPROC + "workers_alive": "host-plane worker processes currently "
+    "alive (spawned minus crashed/stopped)",
+    _HPROC + "worker_restarts_total": "worker processes respawned after "
+    "a crash/exit (bounded per worker; exhausted lanes stay in-process)",
+    _HPROC + "ring_depth": "bytes staged across every shared-memory "
+    "ring (request + response), sampled by the monitor",
+    _HPROC + "ring_full_total": "ring pushes that stayed full past the "
+    "busy window and raised SystemBusy, by role",
+    _HPROC + "fallbacks_total": "stage executions that fell back "
+    "in-process (worker gone/busy), by role",
+    _HPROC + "calls_total": "completed worker round trips, by role",
+    _HPROC + "worker_wall_ms": "worker-side execution wall time per "
+    "round trip (the stage work done off the serving process), by role",
     _DEV + "dispatch_total": "device programs launched",
     _DEV + "rounds_total": "scanned rounds across device dispatches",
     _DEV + "acks_staged_total": "replicate acks ingested by dispatches",
@@ -444,6 +458,84 @@ class HostObs:
     def egress_batch(self, n: int) -> None:
         if n:
             self.registry.counter_add(_HOST + "egress_notified_total", n)
+
+
+class HostProcObs:
+    """Multi-process host-tier instruments (hostproc/, ISSUE 12).
+
+    Families (``dragonboat_hostproc_*``):
+
+    - gauge ``workers_alive`` — worker processes currently alive
+    - ``worker_restarts_total`` — crash respawns (the monitor's bounded
+      restart path)
+    - gauge ``ring_depth`` — bytes staged across all shared-memory
+      rings, sampled by the monitor thread
+    - ``ring_full_total{role}`` — sustained-full pushes that raised
+      SystemBusy
+    - ``fallbacks_total{role}`` — stage executions that fell back
+      in-process (worker gone/busy)
+    - ``calls_total{role}`` — completed worker round trips
+    - histogram ``worker_wall_ms{role}`` — worker-side execution wall
+      per round trip (the per-stage worker wall the latency attribution
+      table wants next to the ``ipc`` trace stage)
+
+    Same ``is not None`` latch contract as every other plane: obs off
+    keeps the hostproc hot path bit-identical.
+    """
+
+    __slots__ = ("registry",)
+
+    _ROLES = ("encode", "wal", "apply")
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry or DEFAULT_REGISTRY
+        r = self.registry
+        _describe(r, (
+            _HPROC + "workers_alive", _HPROC + "worker_restarts_total",
+            _HPROC + "ring_depth", _HPROC + "ring_full_total",
+            _HPROC + "fallbacks_total", _HPROC + "calls_total",
+            _HPROC + "worker_wall_ms",
+        ))
+        r.gauge_set(_HPROC + "workers_alive", 0)
+        r.gauge_set(_HPROC + "ring_depth", 0)
+        r.counter_add(_HPROC + "worker_restarts_total", 0)
+        for role in self._ROLES:
+            labels = {"role": role}
+            r.counter_add(_HPROC + "ring_full_total", 0, labels=labels)
+            r.counter_add(_HPROC + "fallbacks_total", 0, labels=labels)
+            r.counter_add(_HPROC + "calls_total", 0, labels=labels)
+            r.histogram_declare(
+                _HPROC + "worker_wall_ms", buckets=LATENCY_BUCKETS_MS,
+                labels=labels,
+            )
+
+    def workers_alive(self, n: int) -> None:
+        self.registry.gauge_set(_HPROC + "workers_alive", n)
+
+    def restart(self) -> None:
+        self.registry.counter_add(_HPROC + "worker_restarts_total")
+
+    def ring_depth(self, n: int) -> None:
+        self.registry.gauge_set(_HPROC + "ring_depth", n)
+
+    def ring_full(self, role: str) -> None:
+        self.registry.counter_add(
+            _HPROC + "ring_full_total", labels={"role": role}
+        )
+
+    def fallback(self, role: str) -> None:
+        self.registry.counter_add(
+            _HPROC + "fallbacks_total", labels={"role": role}
+        )
+
+    def call(self, role: str, wall_ms: float) -> None:
+        labels = {"role": role}
+        r = self.registry
+        r.counter_add(_HPROC + "calls_total", labels=labels)
+        r.histogram_observe(
+            _HPROC + "worker_wall_ms", wall_ms,
+            buckets=LATENCY_BUCKETS_MS, labels=labels,
+        )
 
 
 class CoordObs:

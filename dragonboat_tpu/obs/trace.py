@@ -31,6 +31,10 @@ Stage vocabulary (a request only carries the stages its path visits):
 stage           stamped when
 ==============  =========================================================
 ``propose``     the trace is allocated (t0; the enqueue timestamp)
+``ipc``         the shared-memory handoff to the hostproc encode worker
+                completed (ring enqueue → worker dequeue → encoded burst
+                returned) — workers-on path only (ISSUE 12), so the
+                latency attribution table can price the process handoff
 ``ingress``     the entry is staged for raft — after ``entry_q.add`` /
                 the native fast-lane append on the direct path, after
                 the batcher drain on the compartmentalized path (so the

@@ -17,7 +17,13 @@ from .transport import ChanRouter, ChanTransport
 
 
 class CounterSM:
-    """Minimal counter state machine for stack probes."""
+    """Minimal counter state machine for stack probes.
+
+    Process-spawnable (ISSUE 12): living in an importable module — not
+    a bench/test ``__main__`` — lets the hostproc apply tier rebuild it
+    inside a worker from its ``module:qualname`` spec."""
+
+    __hostproc_spawnable__ = True
 
     def __init__(self, cluster_id, node_id):
         self.v = 0

@@ -342,3 +342,51 @@ def test_nodehost_end_to_end_events(tmp_path):
         )
         == 1
     )
+
+
+def test_hostproc_families_help_round_trip():
+    """ISSUE 12 satellite: every ``dragonboat_hostproc_*`` family a
+    HostProcObs registers carries its described ``# HELP`` immediately
+    before its ``# TYPE`` (the lease/devsm pattern), labeled families
+    expose one series per role, and the counters land where the hooks
+    put them."""
+    from dragonboat_tpu.obs.instruments import HostProcObs
+
+    reg = MetricsRegistry()
+    obs = HostProcObs(reg)
+    obs.workers_alive(3)
+    obs.restart()
+    obs.ring_depth(512)
+    obs.ring_full("encode")
+    obs.fallback("apply")
+    obs.call("wal", 1.25)
+    out = io.StringIO()
+    reg.write_health_metrics(out)
+    lines = out.getvalue().splitlines()
+    families = (
+        "dragonboat_hostproc_workers_alive",
+        "dragonboat_hostproc_worker_restarts_total",
+        "dragonboat_hostproc_ring_depth",
+        "dragonboat_hostproc_ring_full_total",
+        "dragonboat_hostproc_fallbacks_total",
+        "dragonboat_hostproc_calls_total",
+        "dragonboat_hostproc_worker_wall_ms",
+    )
+    for name in families:
+        tidx = [
+            i for i, l in enumerate(lines)
+            if l.startswith(f"# TYPE {name} ")
+        ]
+        assert len(tidx) == 1, name
+        assert lines[tidx[0] - 1].startswith(f"# HELP {name} "), name
+    assert "dragonboat_hostproc_workers_alive 3" in lines
+    assert "dragonboat_hostproc_worker_restarts_total 1" in lines
+    assert "dragonboat_hostproc_ring_depth 512" in lines
+    assert 'dragonboat_hostproc_ring_full_total{role="encode"} 1' in lines
+    assert 'dragonboat_hostproc_fallbacks_total{role="apply"} 1' in lines
+    assert 'dragonboat_hostproc_calls_total{role="wal"} 1' in lines
+    # the per-stage worker-wall histogram has sum/count per role
+    assert any(
+        l.startswith('dragonboat_hostproc_worker_wall_ms_count{role="wal"}')
+        for l in lines
+    )

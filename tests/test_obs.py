@@ -475,3 +475,36 @@ def test_nodehost_health_metrics_device_plane():
         assert len(nh.flight_recorder.spans()) > 0
     finally:
         nh.stop()
+
+
+def test_hostproc_obs_live_plane_families():
+    """ISSUE 12: a live HostProcPlane with obs enabled publishes the
+    ``dragonboat_hostproc_*`` families into the given registry — the
+    monitor keeps workers_alive current and a worker round trip lands
+    calls_total + the worker-wall histogram observation."""
+    import time as _time
+
+    from dragonboat_tpu.events import MetricsRegistry
+    from dragonboat_tpu.hostproc.control import HostProcPlane
+
+    reg = MetricsRegistry()
+    p = HostProcPlane(workers=1, encode_lanes=1)
+    try:
+        p.enable_obs(registry=reg)
+        assert reg.gauge_value("dragonboat_hostproc_workers_alive") == 1
+        lane = p.encode_lane(0)
+        assert lane.encode(0, [b"abc"]) is not None
+        assert (
+            reg.counter_value(
+                "dragonboat_hostproc_calls_total", {"role": "encode"}
+            )
+            == 1
+        )
+        deadline = _time.time() + 5
+        while (
+            reg.gauge_value("dragonboat_hostproc_ring_depth") != 0
+            and _time.time() < deadline
+        ):
+            _time.sleep(0.05)
+    finally:
+        p.stop()
