@@ -4,8 +4,8 @@
 PY ?= python
 
 .PHONY: test test-all test-kernels test-obs test-trace test-warmup \
-	test-hostplane test-hostproc test-lease test-devsm native soak \
-	soak-smoke bench dryrun perf-ledger perf-ledger-check
+	test-hostplane test-hostproc test-lease test-devsm test-health \
+	native soak soak-smoke bench dryrun perf-ledger perf-ledger-check
 
 test: native
 	$(PY) -m pytest tests/ -x -q -m "not slow"
@@ -73,6 +73,17 @@ test-hostproc:
 # change
 test-devsm:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_devsm.py -q
+
+# fast cpu gate for the cluster health plane (ISSUE 13): health-off
+# structural identity, the detector fault-injection suite (ErrorFS WAL
+# stall -> commit_stall, netsplit -> quorum_at_risk, kill -9 ->
+# worker_flap with measured recovery), the detector unit semantics on
+# synthetic samples, and the /metrics + /healthz endpoint round-trip —
+# run before the full tier-1 sweep whenever obs/health.py,
+# obs/instruments.py, the nodehost health wiring or the plane
+# health_snapshot accessors change
+test-health:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_health.py -q
 
 # fast cpu gate for the leader-lease read plane (ISSUE 10): the
 # lease ≡ ReadIndex ≡ scalar-oracle differential, the invalidation

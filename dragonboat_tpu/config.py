@@ -285,6 +285,25 @@ class NodeHostConfig:
     # timestamped JSON file next to the node host dir (soak/chaos
     # debugging without attaching a debugger)
     dump_signal: bool = False
+    # cluster health plane (obs/health.py, ISSUE 13): sample every
+    # group's raft/host-plane health on this cadence (driven off the
+    # tick worker) into a rolling ring, run the anomaly detectors
+    # (commit-stall, apply-lag, quorum-at-risk, leader-flap,
+    # worker-flap, lease-thrash, devsm-rebind) and publish the
+    # dragonboat_health_* families + NodeHost.health_report().  0
+    # (default) = health plane off, nothing constructed, request paths
+    # bit-identical; env DBTPU_HEALTH_SAMPLE_MS is the no-config
+    # fallback.
+    health_sample_ms: int = 0
+    # live scrape endpoint (obs/health.py MetricsServer): "host:port"
+    # serves /metrics (Prometheus text exposition), /healthz
+    # (aggregated detector verdict, 503 while degraded) and
+    # /debug/health + /debug/trace dumps.  Empty (default) = no
+    # listener; bind loopback ("127.0.0.1:9090") unless you front it
+    # with auth — the exposition names clusters and addresses.  Port 0
+    # binds ephemeral (NodeHost.metrics_server.port).  Env
+    # DBTPU_METRICS_ADDR is the no-config fallback.
+    metrics_addr: str = ""
     logdb_config: LogDBConfig = field(default_factory=LogDBConfig.default)
     expert: ExpertConfig = field(default_factory=ExpertConfig)
     # factories (reference config/config.go:298-305)

@@ -66,6 +66,10 @@ class DevKVPlane:
         self.reads_served = 0
         self.read_fallbacks = 0
         self.binds = 0
+        # per-group bind counts (cluster health plane, ISSUE 13): the
+        # devsm-rebind detector needs per-group increments, not the
+        # plane-wide total
+        self._bind_counts: Dict[int, int] = {}
 
     # ------------------------------------------------------------------
     # registration (NodeHost.start_cluster wiring)
@@ -94,6 +98,7 @@ class DevKVPlane:
             self._bound.discard(cluster_id)
             self._pending_bind.pop(cluster_id, None)
             self._prebind_ops.pop(cluster_id, None)
+            self._bind_counts.pop(cluster_id, None)
             self._flush_waiters_locked(cluster_id)
 
     def tracks(self, cluster_id: int) -> bool:
@@ -103,6 +108,19 @@ class DevKVPlane:
         """True while the group's reads/applies are device-served (the
         node's read-release gate checks this per commit offload)."""
         return cluster_id in self._bound
+
+    def health_snapshot(self, cluster_id: int) -> Optional[dict]:
+        """One group's devsm status for the cluster health sampler
+        (ISSUE 13): binding state, pending bind watermark and the
+        per-group bind count the rebind-loop detector differentiates."""
+        with self._mu:
+            if cluster_id not in self._sms:
+                return None
+            return {
+                "bound": cluster_id in self._bound,
+                "pending_bind": self._pending_bind.get(cluster_id),
+                "binds": self._bind_counts.get(cluster_id, 0),
+            }
 
     # ------------------------------------------------------------------
     # leadership transitions (coordinator drain, under coord._mu)
@@ -220,6 +238,9 @@ class DevKVPlane:
                 return
             self._bound.add(cluster_id)
             self.binds += 1
+            self._bind_counts[cluster_id] = (
+                self._bind_counts.get(cluster_id, 0) + 1
+            )
         dlog.info(
             "devsm bound group %d at watermark %d (%d buffered ops)",
             cluster_id, b, len(buffered),

@@ -756,6 +756,11 @@ class ApplyPool:
             self._ready[idx].add(cluster_id)
             cv.notify()
 
+    def depth(self) -> int:
+        """Groups queued for apply across all executors (health sample;
+        GIL-atomic len reads — gauge-grade, no locks taken)."""
+        return sum(len(s) for s in self._ready)
+
     def _main(self, idx: int) -> None:
         cv = self._cvs[idx]
         while True:
@@ -854,6 +859,11 @@ class EgressPool:
         self.inline += 1
         rs.notify(result)
 
+    def depth(self) -> int:
+        """Completions queued for delivery (health sample; gauge-grade
+        GIL-atomic reads)."""
+        return sum(len(q) for q in self._qs)
+
     def _main(self, idx: int) -> None:
         cv = self._cvs[idx]
         while True:
@@ -943,6 +953,35 @@ class HostPlane:
     def fsync_count(self) -> int:
         fn = getattr(self.logdb, "fsync_count", None)
         return fn() if fn is not None else 0
+
+    def health_snapshot(self) -> dict:
+        """Host-plane depths for the cluster health sampler (ISSUE 13):
+        per-shard staging-ring occupancy, the WAL strategy/window, and
+        the apply/egress queue depths — gauge-grade unlocked reads (the
+        sampler must never queue behind a drain or a flush)."""
+        ing = self.ingress
+        shards = [
+            {"ringed": sh.ncmds, "cap": sh.cap} for sh in ing._shards
+        ]
+        w = self.wal.status()
+        return {
+            "ingress": {
+                "shards": shards,
+                "ringed": sum(s["ringed"] for s in shards),
+                "submitted": ing.submitted,
+                "drains": ing.drains,
+            },
+            "wal": {
+                "mode": w["mode"],
+                "engaged": w["engaged"],
+                "window_ms": w["window_ms"],
+                "flushes": w["flushes"],
+                "amortization": w["amortization"],
+                "worker_sink": w["worker_sink"],
+            },
+            "apply_depth": self.apply_pool.depth(),
+            "egress_depth": self.egress.depth(),
+        }
 
     def stats(self) -> dict:
         out = {

@@ -416,7 +416,14 @@ class HostProcPlane:
                     )
                     obs = self._obs
                     if obs is not None:
+                        # the dead lane's rings still hold its ghost
+                        # backlog until the respawn resets them —
+                        # ring_depth() excludes down lanes, so republish
+                        # NOW or a scrape between death and respawn
+                        # (forever, when MAX_RESTARTS is exhausted)
+                        # keeps showing the dead epoch's bytes
                         obs.workers_alive(self.alive_count())
+                        obs.ring_depth(self.ring_depth())
                     if self._stopping or rec.restarts >= self.MAX_RESTARTS:
                         continue
                     rec.restarts += 1
@@ -446,7 +453,11 @@ class HostProcPlane:
                             c.alive = True
                         plog.info("hostproc worker %d respawned", rec.wid)
                         if obs is not None:
+                            # epoch bump: fresh rings, fresh epoch —
+                            # republish both gauges so the scrape flips
+                            # with the lane, not a monitor period later
                             obs.workers_alive(self.alive_count())
+                            obs.ring_depth(self.ring_depth())
                     else:
                         plog.error(
                             "hostproc worker %d respawn handshake failed",
@@ -508,7 +519,42 @@ class HostProcPlane:
         )
 
     def ring_depth(self) -> int:
-        return sum(c.depth() for r in self._workers for c in r.pairs)
+        """Bytes staged across LIVE lanes' shared-memory rings.  Dead
+        lanes are excluded (ISSUE 13 satellite): their rings hold the
+        dead epoch's ghost backlog until the respawn resets the
+        cursors — or forever when the restart budget is exhausted —
+        and a scrape must never read that as live depth."""
+        total = 0
+        for r in self._workers:
+            p = r.proc
+            if r.down or p is None or p.exitcode is not None:
+                continue
+            total += sum(c.depth() for c in r.pairs)
+        return total
+
+    def health_snapshot(self) -> dict:
+        """Worker-tier health for the cluster health sampler (ISSUE 13):
+        liveness, restart counts and per-worker heartbeat age (the
+        lockless shared-double the monitor already watches)."""
+        now = time.monotonic()
+        per_worker = []
+        for r in self._workers:
+            p = r.proc
+            alive = p is not None and p.exitcode is None and not r.down
+            hb = r.hb.value
+            per_worker.append({
+                "wid": r.wid,
+                "alive": alive,
+                "restarts": r.restarts,
+                "hb_age_s": round(now - hb, 3) if (alive and hb) else None,
+            })
+        return {
+            "workers": self.nworkers,
+            "alive": self.alive_count(),
+            "restarts": self.restarts_total,
+            "ring_depth": self.ring_depth(),
+            "per_worker": per_worker,
+        }
 
     def worker_pid(self, wid: int) -> Optional[int]:
         p = self._workers[wid].proc

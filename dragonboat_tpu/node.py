@@ -183,6 +183,14 @@ class Node:
         # host-apply catch-up on it.
         self.devsm_plane = None
         self.devsm_release_floor = 0
+        # cluster health plane (obs/health.py, ISSUE 13): the sampler
+        # flips _health_track on its first pass over this node, and
+        # offload_commit then records the highest DEVICE commit
+        # watermark seen (the sample's dev_commit column).  False (the
+        # default, health plane off) keeps offload_commit bit-identical
+        # but for this one latch check — the trace=None precedent.
+        self._health_track = False
+        self._dev_commit_seen = 0
         self._natsm_attached = False  # native C-ABI SM wired to the lane
         self._next_enroll_try = 0.0
         self._tick_count_pending = 0
@@ -282,6 +290,8 @@ class Node:
         with self._off_mu:
             if q > self._off_commit:
                 self._off_commit = q
+            if self._health_track and q > self._dev_commit_seen:
+                self._dev_commit_seen = q
         if wake:
             self.nh.engine.set_step_ready(self.cluster_id)
 
@@ -1857,6 +1867,78 @@ class Node:
             d["held"] = remaining > 0
             d["remaining_ticks"] = max(remaining, 0)
             return d
+
+    def health_snapshot(self, lock_timeout: float = 0.05) -> dict:
+        """One health-sample row for this group (obs/health.py, ISSUE
+        13): raft plane (state/term/leader/commit/applied), request
+        pressure, reachability (check-quorum leaders), device commit
+        watermark, lease and devsm status.  Low-rate caller contract:
+        ``raft_mu`` is acquired with ``lock_timeout`` (``<= 0`` =
+        non-blocking) — a contended group reports ``busy: True`` with
+        only the lock-free fields rather than stalling the tick worker
+        behind a long step.  The SAMPLER owns the whole-pass budget:
+        it shrinks ``lock_timeout`` as its deadline approaches, so a
+        host full of contended groups degrades to busy rows instead of
+        n_groups × timeout of tick-worker stall."""
+        self._health_track = True
+        d = {
+            "node_id": self.node_id,
+            "pending_proposals": self.pending_proposals.has_pending(),
+            "pending_reads": self.pending_reads.has_pending(),
+            "applied": self.sm.get_last_applied(),
+            "dev_commit": self._dev_commit_seen,
+            "fast_lane": self.fast_lane,
+        }
+        plane = self.devsm_plane
+        if plane is not None:
+            dv = plane.health_snapshot(self.cluster_id)
+            if dv is not None:
+                dv["release_floor"] = self.devsm_release_floor
+                d["devsm"] = dv
+        if lock_timeout > 0:
+            acquired = self.raft_mu.acquire(timeout=lock_timeout)
+        else:
+            acquired = self.raft_mu.acquire(blocking=False)
+        if not acquired:
+            d["busy"] = True
+            return d
+        try:
+            peer = self.peer
+            if peer is None:
+                d["busy"] = True
+                return d
+            r = peer.raft
+            d["state"] = r.state.name
+            d["term"] = r.term
+            d["leader_id"] = r.leader_id
+            d["committed"] = r.log.committed
+            voters = r.voting_members()
+            d["voters"] = len(voters)
+            d["quorum"] = r.quorum()
+            if r.is_leader() and r.check_quorum:
+                # reachability from the check-quorum activity flags: set
+                # on every response, cleared once per election window —
+                # only meaningful where that refresh loop runs (a
+                # non-check-quorum leader's flags latch True forever)
+                d["reachable"] = sum(
+                    1
+                    for nid, rp in voters.items()
+                    if nid == r.node_id or rp.is_active()
+                )
+            lease = r.lease
+            if lease is not None:
+                ls = lease.stats()
+                remaining = 0
+                if r.is_leader():
+                    remaining = lease.remaining(
+                        r.tick_count, r.quorum(), voters, r.node_id
+                    )
+                ls["held"] = remaining > 0
+                ls["remaining_ticks"] = max(remaining, 0)
+                d["lease"] = ls
+        finally:
+            self.raft_mu.release()
+        return d
 
     def request_compaction(self) -> threading.Event:
         """User-requested LogDB compaction up to the last auto-compacted

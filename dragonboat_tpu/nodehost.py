@@ -359,6 +359,50 @@ class NodeHost:
             )
             if self.quorum_coordinator is not None:
                 self.quorum_coordinator.tracer = self.tracer
+        # cluster health plane (obs/health.py, ISSUE 13): low-rate
+        # per-group/host health sampling + anomaly detectors + the live
+        # scrape endpoint.  OFF by default (health_sample_ms=0 and no
+        # env): nothing below is constructed — no sampler, no listener,
+        # no dragonboat_health_* families — and the request paths keep
+        # their bit-identical latches.
+        self.health = None
+        self.metrics_server = None
+        health_ms = nhconfig.health_sample_ms
+        if not health_ms:
+            try:
+                health_ms = int(
+                    os.environ.get("DBTPU_HEALTH_SAMPLE_MS", "0") or 0
+                )
+            except ValueError:
+                plog.warning("malformed DBTPU_HEALTH_SAMPLE_MS; health off")
+                health_ms = 0
+        if health_ms > 0:
+            from .obs.health import HealthSampler
+
+            self.health = HealthSampler(
+                self,
+                sample_ms=health_ms,
+                registry=self.raft_events.registry,
+                recorder=self.flight_recorder,
+            )
+        metrics_addr = nhconfig.metrics_addr or os.environ.get(
+            "DBTPU_METRICS_ADDR", ""
+        )
+        if metrics_addr:
+            from .obs.health import MetricsServer
+
+            try:
+                self.metrics_server = MetricsServer(self, metrics_addr)
+            except (OSError, ValueError) as e:
+                # a taken port (OSError) or a malformed addr (ValueError
+                # — possibly from the ENV fallback, which no config
+                # validation covers) must not fail the whole NodeHost:
+                # the raft planes are fine, only the scrape surface is
+                # not (the DBTPU_HEALTH_SAMPLE_MS degrade precedent)
+                plog.warning(
+                    "metrics endpoint unavailable on %s: %r",
+                    metrics_addr, e,
+                )
         # engine
         workers = expert.step_worker_count or 4
         self.engine = Engine(
@@ -486,9 +530,22 @@ class NodeHost:
                 json.dump(d, f)
         return d
 
+    def health_report(self) -> dict:
+        """Aggregated cluster-health verdict (obs/health.py, ISSUE 13):
+        open detector events, per-detector open/close counts, and the
+        recovery-time attribution percentiles (failover / worker-respawn
+        / devsm-rebind) derived from open→close durations.  ``status``
+        is ``"ok"`` unless any detector is open — the ``/healthz``
+        endpoint serves exactly this dict (503 while degraded).  With
+        the health plane off the report is a plain ok stub."""
+        if self.health is None:
+            return {"status": "ok", "health_plane": "off"}
+        return self.health.report()
+
     def debug_dump(self, path: Optional[str] = None) -> str:
         """Write the flight-recorder ring plus any in-flight/completed
-        sampled traces to a timestamped JSON file (the SIGUSR2 handler's
+        sampled traces (and the health sample ring when the health
+        plane is on) to a timestamped JSON file (the SIGUSR2 handler's
         body; callable directly).  Returns the path written."""
         d = {
             "time": time.time(),
@@ -499,6 +556,10 @@ class NodeHost:
             ),
             "traces": (
                 self.tracer.to_json() if self.tracer is not None else None
+            ),
+            "health": (
+                self.health.to_json(limit=64)
+                if self.health is not None else None
             ),
         }
         if path is None:
@@ -787,6 +848,11 @@ class NodeHost:
         self.sys_events.publish(
             SystemEvent(type=SystemEventType.NODE_HOST_SHUTTING_DOWN)
         )
+        if self.metrics_server is not None:
+            # first: a scrape arriving mid-teardown must not race the
+            # planes it reads
+            self.metrics_server.stop()
+            self.metrics_server = None
         with self._mu:
             nodes = list(self._clusters.values())
             self._clusters.clear()
@@ -1320,6 +1386,13 @@ class NodeHost:
                 # trace + the recorder ring.  Fast path (nothing sampled
                 # in flight) is two dict truthiness checks per RTT.
                 tracer.check_stalls()
+            health = self.health
+            if health is not None:
+                # cluster health plane (ISSUE 13): one low-rate sample
+                # per health_sample_ms cadence, detectors included.
+                # Fast path (cadence not elapsed) is one float compare
+                # per RTT; sample failures are swallowed inside.
+                health.maybe_sample()
             if self._dump_requested:
                 # SIGUSR2 arrived: run the dump HERE, not in the signal
                 # handler (non-reentrant locks; see _install_dump_signal)

@@ -26,6 +26,12 @@ handling lives on cheap continuous telemetry"):
   stage histograms, a Perfetto/Chrome-trace export
   (``NodeHost.dump_trace``) and a stage-level stall watchdog that
   dumps the stuck request's partial trace plus this recorder's ring.
+- :mod:`health` — the cluster health plane (ISSUE 13): continuous
+  per-group/host health sampling into a rolling ring, anomaly
+  detectors with open/close events and recovery-time attribution
+  (``dragonboat_health_*`` families, ``NodeHost.health_report``), and
+  the live scrape endpoint (``/metrics``, ``/healthz``,
+  ``/debug/health``, ``/debug/trace``).
 
 Overhead contract (the ``_read_plane_used`` precedent; PR 3 took a −43%
 host-path regression from ungated per-transition work): observability is

@@ -1,0 +1,708 @@
+"""Cluster health plane: continuous per-group health sampling, anomaly
+detectors with recovery-time attribution, and a live scrape endpoint
+(ISSUE 13 tentpole).
+
+The trace ledger answers "where does one request's latency go"; nothing
+answered "is group 412 healthy right now" — per-group raft state,
+host-plane depth and worker liveness were only visible through on-demand
+dumps (SIGUSR2, ``dump_trace``, file-based ``write_health_metrics``).
+This module closes that gap with three layers:
+
+- :class:`HealthSampler` — on a low-rate cadence driven off the NodeHost
+  tick worker it snapshots, per group, the raft plane (state/term/
+  leader/commitIndex/appliedIndex, device commit watermark, devsm
+  binding + release floor, lease validity + hit ratio, reachable voters)
+  plus the host plane (staging-ring occupancy, WAL mode/flush window,
+  hostproc worker heartbeat age and restart count, apply/egress queue
+  depths) into a fixed-size rolling timeseries ring mirroring the
+  :class:`~dragonboat_tpu.obs.recorder.FlightRecorder` shape (bounded
+  memory, JSON dump on demand).
+
+- **Detectors** run over consecutive samples and emit structured
+  open/close health events:
+
+  ==================  ==================================================
+  detector            opens when
+  ==================  ==================================================
+  ``commit_stall``    commitIndex flat across ``commit_stall_samples``
+                      consecutive samples while proposals are pending
+  ``apply_lag``       committed − applied exceeds ``apply_lag_entries``
+                      (closes at half the threshold — hysteresis)
+  ``quorum_at_risk``  reachable voters ≤ quorum on a check-quorum
+                      leader for ``quorum_risk_samples`` samples (one
+                      more loss breaks the group); closes when every
+                      voter is reachable again
+  ``leader_flap``     ≥ ``leader_flap_changes`` leader changes inside
+                      ``flap_window_s``; closes after a quiet window
+  ``worker_flap``     hostproc workers alive < spawned; closes when the
+                      monitor's respawn restores the full set
+  ``lease_thrash``    ≥ ``lease_thrash_events`` grant/expiry
+                      transitions inside the window; closes on a quiet
+                      window with the lease held
+  ``devsm_rebind``    ≥ ``devsm_rebind_binds`` device-plane rebinds of
+                      one group inside the window (a bind/unbind loop)
+  ==================  ==================================================
+
+  Every open/close publishes ``dragonboat_health_*`` families, records a
+  ``health`` span into the flight recorder (when one is attached), and
+  the open→close duration lands in the per-detector
+  ``dragonboat_health_recovery_seconds`` histogram — the
+  **recovery-time attribution** ROADMAP item 5 (BlackWater churn soak)
+  wants in the perf ledger: ``leader_flap`` durations are failover
+  recoveries, ``worker_flap`` durations are worker respawns,
+  ``devsm_rebind`` durations are device-plane rebind loops.
+  :meth:`NodeHost.health_report` aggregates the verdict.
+
+- :class:`MetricsServer` — a tiny stdlib HTTP endpoint
+  (``NodeHostConfig.metrics_addr``, default off) serving ``/metrics``
+  (the existing Prometheus exposition, live-scrapeable at last),
+  ``/healthz`` (the aggregated detector verdict; 503 while any detector
+  is open) and ``/debug/health`` + ``/debug/trace`` JSON dumps.  It
+  binds loopback unless the operator explicitly configures otherwise
+  (the exposition names clusters and addresses — see docs/overview.md's
+  security note).
+
+Overhead contract (the ``_obs is not None`` / ``trace=None`` latch
+precedent): the health plane is OFF by default.
+``NodeHostConfig.health_sample_ms = 0`` constructs nothing — no sampler,
+no server, no registry families — and the only hot-path residue is the
+``Node._health_track`` latch check inside ``offload_commit`` (one
+attribute load under an already-held lock, asserted structurally in
+``tests/test_health.py``).  Sampling itself is bounded: one pass per
+cadence over the group set with a non-blocking-ish ``raft_mu`` acquire
+(a contended group reports ``busy`` instead of stalling the tick
+worker), measured by the bench health axis (<5% asserted).
+"""
+from __future__ import annotations
+
+import io
+import json
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from ..logger import get_logger
+
+plog = get_logger("health")
+
+DEFAULT_CAPACITY = 256
+
+#: detector vocabulary — instrument families zero-register per detector
+#: so a scrape distinguishes "health off" (families absent) from
+#: "healthy" (families at zero)
+DETECTORS = (
+    "commit_stall",
+    "apply_lag",
+    "quorum_at_risk",
+    "leader_flap",
+    "worker_flap",
+    "lease_thrash",
+    "devsm_rebind",
+)
+
+#: recovery-attribution aliases for :meth:`NodeHost.health_report` /
+#: the perf ledger: which detector's open→close durations measure which
+#: recovery class
+ATTRIBUTION = {
+    "failover": "leader_flap",
+    "worker_respawn": "worker_flap",
+    "devsm_rebind": "devsm_rebind",
+}
+
+
+def _pctile(vals: List[float], q: float) -> Optional[float]:
+    if not vals:
+        return None
+    vs = sorted(vals)
+    i = min(len(vs) - 1, max(0, int(round(q / 100.0 * (len(vs) - 1)))))
+    return vs[i]
+
+
+class HealthSampler:
+    """Rolling per-group/host health samples + anomaly detectors.
+
+    Built by NodeHost when ``health_sample_ms > 0``; :meth:`maybe_sample`
+    rides the tick worker (the tracer ``check_stalls`` precedent), so no
+    extra thread exists and a stopped host stops sampling with it.
+    ``nh=None`` (unit tests) skips live sampling — :meth:`ingest` feeds
+    hand-built samples straight to the detectors.
+    """
+
+    def __init__(
+        self,
+        nh=None,
+        sample_ms: float = 250.0,
+        capacity: int = DEFAULT_CAPACITY,
+        registry=None,
+        recorder=None,
+        # detector knobs (docs/overview.md table; tests shrink them)
+        commit_stall_samples: int = 3,
+        apply_lag_entries: int = 512,
+        quorum_risk_samples: int = 2,
+        leader_flap_changes: int = 3,
+        lease_thrash_events: int = 4,
+        devsm_rebind_binds: int = 3,
+        flap_window_s: float = 10.0,
+    ):
+        if capacity < 1:
+            raise ValueError("health ring capacity must be >= 1")
+        self.nh = nh
+        self.sample_ms = float(sample_ms)
+        self.capacity = capacity
+        self.recorder = recorder
+        self._obs = None
+        if registry is not None:
+            from .instruments import HealthObs
+
+            self._obs = HealthObs(registry=registry, detectors=DETECTORS)
+        self.commit_stall_samples = commit_stall_samples
+        self.apply_lag_entries = apply_lag_entries
+        self.quorum_risk_samples = quorum_risk_samples
+        self.leader_flap_changes = leader_flap_changes
+        self.lease_thrash_events = lease_thrash_events
+        self.devsm_rebind_binds = devsm_rebind_binds
+        self.flap_window_s = flap_window_s
+        # sample ring (the FlightRecorder shape: bounded, lock-light)
+        self._buf: List[Optional[dict]] = [None] * capacity
+        self._n = 0
+        self._mu = threading.Lock()
+        self._last_mono = 0.0
+        # detector state
+        self._open: Dict[Tuple[str, str], dict] = {}
+        self._closed: deque = deque(maxlen=1024)
+        self._recoveries: Dict[str, List[float]] = {d: [] for d in DETECTORS}
+        self.opened: Dict[str, int] = {d: 0 for d in DETECTORS}
+        # per-group evaluation memory
+        self._prev: Dict[int, dict] = {}
+        self._stall_streak: Dict[int, int] = {}
+        self._risk_streak: Dict[int, int] = {}
+        self._heal_streak: Dict[int, int] = {}
+        self._leader_changes: Dict[int, deque] = {}
+        self._lease_events: Dict[int, deque] = {}
+        self._devsm_binds: Dict[int, deque] = {}
+        self._prev_hostproc: Optional[dict] = None
+
+    # ------------------------------------------------------------------
+    # sampling (tick worker)
+    # ------------------------------------------------------------------
+
+    def maybe_sample(self) -> Optional[dict]:
+        """Take one sample when the cadence elapsed (tick-worker hook);
+        cheap two-float compare otherwise."""
+        now = time.monotonic()
+        if (now - self._last_mono) * 1e3 < self.sample_ms:
+            return None
+        self._last_mono = now
+        try:
+            return self.sample()
+        except Exception:
+            # the sampler must never hurt the tick worker
+            plog.exception("health sample failed")
+            return None
+
+    def sample(self) -> dict:
+        """Snapshot every group + the host planes, append to the ring,
+        run the detectors, publish the sample metrics."""
+        nh = self.nh
+        if nh is None:
+            raise RuntimeError("sampler has no NodeHost (unit mode)")
+        t0 = time.perf_counter()
+        groups: Dict[int, dict] = {}
+        _, nodes = nh._get_nodes()
+        # whole-PASS lock budget: the per-group raft_mu timeout shrinks
+        # as the deadline approaches, so a host full of contended
+        # groups costs one bounded stall total (busy rows past it),
+        # never n_groups × timeout on the tick worker
+        deadline = t0 + min(0.2, self.sample_ms / 1e3 / 2.0)
+        for cid, node in nodes.items():
+            try:
+                remaining = deadline - time.perf_counter()
+                groups[cid] = node.health_snapshot(
+                    lock_timeout=min(0.05, remaining)
+                )
+            except Exception:
+                groups[cid] = {"error": True}
+        host: Dict[str, Optional[dict]] = {}
+        qc = nh.quorum_coordinator
+        host["coord"] = qc.health_snapshot() if qc is not None else None
+        hp = nh.hostplane
+        host["hostplane"] = hp.health_snapshot() if hp is not None else None
+        hpp = nh.hostproc
+        host["hostproc"] = hpp.health_snapshot() if hpp is not None else None
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        sample = {
+            "ts": time.time(),
+            "mono": time.monotonic(),
+            "tick": nh.tick_count,
+            "wall_ms": round(wall_ms, 4),
+            "groups": groups,
+            "host": host,
+        }
+        self.ingest(sample)
+        return sample
+
+    def ingest(self, sample: dict) -> None:
+        """Append one sample (live or hand-built) and evaluate the
+        detectors against it."""
+        with self._mu:
+            sample["seq"] = self._n
+            self._buf[self._n % self.capacity] = sample
+            self._n += 1
+        self._evaluate(sample)
+        obs = self._obs
+        if obs is not None:
+            obs.sample(
+                wall_ms=sample.get("wall_ms", 0.0),
+                groups=len(sample.get("groups") or {}),
+            )
+
+    # ------------------------------------------------------------------
+    # detectors
+    # ------------------------------------------------------------------
+
+    def _evaluate(self, sample: dict) -> None:
+        now = sample.get("mono", time.monotonic())
+        groups = sample.get("groups") or {}
+        for cid, g in groups.items():
+            if g.get("busy") or g.get("error"):
+                continue
+            prev = self._prev.get(cid)
+            self._eval_commit_stall(cid, g, prev, now)
+            self._eval_apply_lag(cid, g, now)
+            self._eval_quorum_risk(cid, g, now)
+            self._eval_leader_flap(cid, g, prev, now)
+            self._eval_lease_thrash(cid, g, prev, now)
+            self._eval_devsm_rebind(cid, g, prev, now)
+            self._prev[cid] = g
+        # groups that disappeared (stop_cluster) close their events AND
+        # drop every per-cid evaluation memory: a leftover flap deque
+        # would charge a restarted incarnation with the old one's
+        # changes, and under long-running group churn the dicts would
+        # grow without bound
+        gone = [c for c in self._prev if c not in groups]
+        for cid in gone:
+            del self._prev[cid]
+            for d in (self._stall_streak, self._risk_streak,
+                      self._heal_streak, self._leader_changes,
+                      self._lease_events, self._devsm_binds):
+                d.pop(cid, None)
+            for det in DETECTORS:
+                self._set(det, f"group:{cid}", False, now, {})
+        hostproc = (sample.get("host") or {}).get("hostproc")
+        self._eval_worker_flap(hostproc, now)
+
+    def _eval_commit_stall(self, cid, g, prev, now) -> None:
+        flat = (
+            prev is not None
+            and g.get("committed") == prev.get("committed")
+            and g.get("pending_proposals")
+            and prev.get("pending_proposals")
+        )
+        streak = self._stall_streak.get(cid, 0) + 1 if flat else 0
+        self._stall_streak[cid] = streak
+        self._set(
+            "commit_stall", f"group:{cid}",
+            streak >= self.commit_stall_samples, now,
+            {"cluster_id": cid, "committed": g.get("committed"),
+             "samples": streak},
+        )
+
+    def _eval_apply_lag(self, cid, g, now) -> None:
+        committed, applied = g.get("committed"), g.get("applied")
+        if committed is None or applied is None:
+            return
+        lag = committed - applied
+        key = ("apply_lag", f"group:{cid}")
+        # hysteresis: open past the threshold, close at half of it
+        threshold = (
+            self.apply_lag_entries // 2
+            if key in self._open else self.apply_lag_entries
+        )
+        self._set(
+            "apply_lag", f"group:{cid}", lag > threshold, now,
+            {"cluster_id": cid, "lag": lag},
+        )
+
+    def _eval_quorum_risk(self, cid, g, now) -> None:
+        reachable = g.get("reachable")
+        voters, quorum = g.get("voters"), g.get("quorum")
+        if reachable is None or not voters or voters <= quorum:
+            # not a check-quorum leader sample, or a group (1-2 voters)
+            # that is ALWAYS one loss from quorum — no signal.  An OPEN
+            # event closes here: this replica stopped being the group's
+            # check-quorum leader (deposed/transferred), so its risk
+            # assessment ended — the new leader's host re-opens if the
+            # risk persists
+            self._risk_streak.pop(cid, None)
+            self._heal_streak.pop(cid, None)
+            self._set("quorum_at_risk", f"group:{cid}", False, now, {})
+            return
+        if reachable <= quorum:
+            self._risk_streak[cid] = self._risk_streak.get(cid, 0) + 1
+            self._heal_streak.pop(cid, None)
+        else:
+            self._risk_streak.pop(cid, None)
+            self._heal_streak[cid] = self._heal_streak.get(cid, 0) + 1
+        key = ("quorum_at_risk", f"group:{cid}")
+        if key in self._open:
+            # close only on a debounced full-reachability window — the
+            # check-quorum flag clear makes single samples optimistic
+            active = not (
+                reachable >= voters
+                and self._heal_streak.get(cid, 0) >= self.quorum_risk_samples
+            )
+        else:
+            active = self._risk_streak.get(cid, 0) >= self.quorum_risk_samples
+        self._set(
+            "quorum_at_risk", f"group:{cid}", active, now,
+            {"cluster_id": cid, "reachable": reachable, "voters": voters,
+             "quorum": quorum},
+        )
+
+    def _eval_leader_flap(self, cid, g, prev, now) -> None:
+        dq = self._leader_changes.setdefault(
+            cid, deque(maxlen=max(8, self.leader_flap_changes * 2))
+        )
+        if prev is not None and g.get("leader_id") != prev.get("leader_id"):
+            dq.append(now)
+        while dq and now - dq[0] > self.flap_window_s:
+            dq.popleft()
+        self._set(
+            "leader_flap", f"group:{cid}",
+            len(dq) >= self.leader_flap_changes, now,
+            {"cluster_id": cid, "changes": len(dq),
+             "leader_id": g.get("leader_id")},
+        )
+
+    def _eval_lease_thrash(self, cid, g, prev, now) -> None:
+        lease, please = g.get("lease"), (prev or {}).get("lease")
+        if lease is None:
+            return
+        dq = self._lease_events.setdefault(cid, deque(maxlen=64))
+        if please is not None:
+            delta = (
+                lease.get("grants", 0) + lease.get("expiries", 0)
+                - please.get("grants", 0) - please.get("expiries", 0)
+            )
+            for _ in range(max(0, delta)):
+                dq.append(now)
+        while dq and now - dq[0] > self.flap_window_s:
+            dq.popleft()
+        active = len(dq) >= self.lease_thrash_events
+        key = ("lease_thrash", f"group:{cid}")
+        if key in self._open and not active:
+            # close only once the lease is actually HELD again: a
+            # thrash that settled into permanently-expired has not
+            # recovered, even after the event window ages out — closing
+            # there would flip /healthz back to ok and record a bogus
+            # recovery duration while the lease is still down
+            active = not lease.get("held", False)
+        self._set(
+            "lease_thrash", f"group:{cid}", active, now,
+            {"cluster_id": cid, "events": len(dq),
+             "held": lease.get("held")},
+        )
+
+    def _eval_devsm_rebind(self, cid, g, prev, now) -> None:
+        dv, pdv = g.get("devsm"), (prev or {}).get("devsm")
+        if dv is None:
+            return
+        dq = self._devsm_binds.setdefault(cid, deque(maxlen=32))
+        if pdv is not None:
+            for _ in range(max(0, dv.get("binds", 0) - pdv.get("binds", 0))):
+                dq.append(now)
+        while dq and now - dq[0] > self.flap_window_s:
+            dq.popleft()
+        self._set(
+            "devsm_rebind", f"group:{cid}",
+            len(dq) >= self.devsm_rebind_binds, now,
+            {"cluster_id": cid, "binds": len(dq), "bound": dv.get("bound")},
+        )
+
+    def _eval_worker_flap(self, hostproc: Optional[dict], now) -> None:
+        if hostproc is None:
+            return
+        alive, workers = hostproc.get("alive", 0), hostproc.get("workers", 0)
+        restarts = hostproc.get("restarts", 0)
+        prev = self._prev_hostproc
+        self._prev_hostproc = hostproc
+        # a kill -9'd worker can die AND respawn inside one monitor tick
+        # — faster than any sampling cadence — so a restart-counter bump
+        # between samples opens the event even when liveness never dipped
+        # in a sample; it closes on the next healthy sample (duration =
+        # the observed outage window, lower-bounded by the cadence)
+        bumped = prev is not None and restarts > prev.get("restarts", 0)
+        self._set(
+            "worker_flap", "host", alive < workers or bumped, now,
+            {"alive": alive, "workers": workers, "restarts": restarts},
+        )
+
+    # ------------------------------------------------------------------
+    # open/close event plumbing
+    # ------------------------------------------------------------------
+
+    def _set(self, detector: str, key: str, active: bool,
+             mono: Optional[float], detail: dict) -> None:
+        now = mono if mono is not None else time.monotonic()
+        k = (detector, key)
+        ev = self._open.get(k)
+        obs = self._obs
+        if active:
+            if ev is None:
+                ev = {
+                    "detector": detector,
+                    "key": key,
+                    "opened_ts": time.time(),
+                    "opened_mono": now,
+                    "closed_ts": None,
+                    "duration_s": None,
+                    "detail": dict(detail),
+                }
+                self._open[k] = ev
+                self.opened[detector] += 1
+                plog.warning("health OPEN %s %s %s", detector, key, detail)
+                if obs is not None:
+                    obs.event_open(detector, open_count=self._open_count(detector))
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "health", detector=detector, key=key, state="open",
+                        **{f"d_{k_}": v for k_, v in detail.items()},
+                    )
+            else:
+                ev["detail"] = dict(detail)  # refresh while open
+            return
+        if ev is None:
+            return
+        del self._open[k]
+        dur = max(0.0, now - ev["opened_mono"])
+        ev["closed_ts"] = time.time()
+        ev["duration_s"] = round(dur, 4)
+        ev["detail"] = dict(detail) or ev["detail"]
+        self._closed.append(ev)
+        self._recoveries[detector].append(dur)
+        plog.warning(
+            "health CLOSE %s %s after %.3fs", detector, key, dur
+        )
+        if obs is not None:
+            obs.event_close(
+                detector, duration_s=dur,
+                open_count=self._open_count(detector),
+            )
+        if self.recorder is not None:
+            self.recorder.record(
+                "health", detector=detector, key=key, state="close",
+                recovery_ms=round(dur * 1e3, 3),
+            )
+
+    def _open_count(self, detector: str) -> int:
+        return sum(1 for d, _ in self._open if d == detector)
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return min(self._n, self.capacity)
+
+    def samples(self) -> List[dict]:
+        """Recorded samples, oldest → newest."""
+        with self._mu:
+            n = self._n
+            if n <= self.capacity:
+                return [s for s in self._buf[:n]]
+            return [
+                self._buf[i % self.capacity]
+                for i in range(n - self.capacity, n)
+            ]
+
+    def open_events(self) -> List[dict]:
+        return [dict(e) for e in self._open.values()]
+
+    def closed_events(self) -> List[dict]:
+        return [dict(e) for e in self._closed]
+
+    def recovery_stats(self) -> Dict[str, dict]:
+        """Per-detector open→close duration percentiles (seconds)."""
+        out = {}
+        for det, durs in self._recoveries.items():
+            if not durs:
+                continue
+            out[det] = {
+                "n": len(durs),
+                "p50_s": round(_pctile(durs, 50), 4),
+                "p99_s": round(_pctile(durs, 99), 4),
+                "max_s": round(max(durs), 4),
+            }
+        return out
+
+    def report(self) -> dict:
+        """The aggregated verdict ``NodeHost.health_report`` /
+        ``/healthz`` serve: ``ok`` unless any detector is open."""
+        open_evs = self.open_events()
+        recov = self.recovery_stats()
+        attribution = {}
+        for alias, det in ATTRIBUTION.items():
+            if det in recov:
+                attribution[f"{alias}_p50_s"] = recov[det]["p50_s"]
+                attribution[f"{alias}_p99_s"] = recov[det]["p99_s"]
+        return {
+            "status": "degraded" if open_evs else "ok",
+            "open": open_evs,
+            "detectors": {
+                d: {
+                    "opened": self.opened[d],
+                    "closed": len(self._recoveries[d]),
+                    "open": self._open_count(d),
+                }
+                for d in DETECTORS
+            },
+            "recovery": recov,
+            "attribution": attribution,
+            "samples": self._n,
+            "sample_ms": self.sample_ms,
+        }
+
+    def to_json(self, limit: Optional[int] = None) -> dict:
+        """JSON snapshot of the ring + events (``/debug/health``, the
+        bench health axis artifact, ``NodeHost.debug_dump``)."""
+        samples = self.samples()
+        if limit is not None and len(samples) > limit:
+            samples = samples[-limit:]
+        return {
+            "capacity": self.capacity,
+            "count": self._n,
+            "sample_ms": self.sample_ms,
+            "report": self.report(),
+            "closed": self.closed_events(),
+            "samples": samples,
+        }
+
+
+# ---------------------------------------------------------------------------
+# live scrape endpoint
+# ---------------------------------------------------------------------------
+
+
+class MetricsServer:
+    """Stdlib HTTP endpoint over one NodeHost (``metrics_addr``):
+
+    ==================  ================================================
+    path                serves
+    ==================  ================================================
+    ``/metrics``        the Prometheus text exposition
+                        (``write_health_metrics``) — live-scrapeable
+    ``/healthz``        the aggregated detector verdict as JSON; HTTP
+                        200 while ok, 503 while any detector is open
+    ``/debug/health``   the health sample ring + events (404 while the
+                        sampler is off)
+    ``/debug/trace``    the Chrome-trace export (404 while tracing is
+                        off)
+    ==================  ================================================
+
+    Serves on daemon threads (``ThreadingHTTPServer``); request handling
+    only READS (registry snapshot, ring copy) so a slow scraper can
+    never stall the host.  Port 0 binds an ephemeral port; ``port``
+    exposes the bound one (tests).  Binding a non-loopback address logs
+    a warning — the exposition names clusters and peer addresses.
+    """
+
+    def __init__(self, nh, addr: str):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        host, _, port_s = addr.rpartition(":")
+        if not host:
+            raise ValueError(f"metrics_addr needs host:port, got {addr!r}")
+        nh_ref = nh
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # no stderr chatter per scrape
+                pass
+
+            def do_GET(self):
+                try:
+                    _serve(nh_ref, self)
+                except BrokenPipeError:
+                    pass
+                except Exception:
+                    plog.exception("metrics endpoint request failed")
+                    try:
+                        self.send_error(500)
+                    except Exception:
+                        pass
+
+        self._srv = ThreadingHTTPServer((host, int(port_s)), _Handler)
+        self.host = self._srv.server_address[0]
+        self.port = self._srv.server_address[1]
+        if not (host.startswith("127.") or host in ("localhost", "::1")):
+            plog.warning(
+                "metrics endpoint bound to non-loopback %s:%d — the "
+                "exposition names clusters and addresses; front it with "
+                "auth or keep it loopback + a local scraper",
+                self.host, self.port,
+            )
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="dbtpu-metrics", daemon=True
+        )
+        self._thread.start()
+        plog.info("metrics endpoint serving on %s:%d", self.host, self.port)
+
+    def stop(self) -> None:
+        try:
+            self._srv.shutdown()
+            self._srv.server_close()
+        except Exception:
+            pass
+        self._thread.join(timeout=2.0)
+
+
+def _serve(nh, handler) -> None:
+    path = handler.path.split("?", 1)[0]
+    if path == "/metrics":
+        buf = io.StringIO()
+        nh.write_health_metrics(buf)
+        body = buf.getvalue().encode("utf-8")
+        handler.send_response(200)
+        handler.send_header(
+            "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+        )
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+        return
+    if path == "/healthz":
+        report = nh.health_report()
+        body = json.dumps(report, default=str).encode("utf-8")
+        handler.send_response(200 if report.get("status") == "ok" else 503)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+        return
+    if path == "/debug/health":
+        sampler = nh.health
+        if sampler is None:
+            handler.send_error(404, "health sampling is off")
+            return
+        body = json.dumps(sampler.to_json(), default=str).encode("utf-8")
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+        return
+    if path == "/debug/trace":
+        tracer = nh.tracer
+        if tracer is None:
+            handler.send_error(404, "tracing is off")
+            return
+        body = json.dumps(
+            tracer.export_chrome(), default=str
+        ).encode("utf-8")
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+        return
+    handler.send_error(404)

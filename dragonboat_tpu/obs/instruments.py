@@ -63,6 +63,14 @@ _COORD = "dragonboat_coord_"
 _HOST = "dragonboat_host_"
 _HPROC = "dragonboat_hostproc_"
 _DEVSM = "dragonboat_devsm_"
+_HEALTH = "dragonboat_health_"
+
+#: recovery-duration buckets (seconds): a worker respawn lands near the
+#: bottom, a failover around election timeouts, a wedged rebind loop or
+#: an unhealed netsplit at the top
+RECOVERY_BUCKETS_S = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
 
 #: ``# HELP`` text per family (ISSUE 9 satellite: the exposition was
 #: ``# TYPE``-only).  Families not listed fall back to the registry's
@@ -127,6 +135,17 @@ _HELP = {
     _DEVSM + "reads_staged_total": "KV reads staged for device capture",
     _DEVSM + "reads_served_total": "KV reads served from device state",
     _DEVSM + "slot_occupancy": "entry-buffer slots holding unapplied ops",
+    # cluster health plane (obs/health.py, ISSUE 13)
+    _HEALTH + "samples_total": "health samples taken by the tick-worker "
+    "cadence",
+    _HEALTH + "sample_ms": "wall milliseconds one health sample cost "
+    "(the sampler-overhead evidence)",
+    _HEALTH + "groups": "raft groups covered by the last health sample",
+    _HEALTH + "events_total": "health detector OPEN events, by detector",
+    _HEALTH + "open": "health events currently open, by detector",
+    _HEALTH + "recovery_seconds": "open-to-close durations per detector "
+    "(leader_flap = failover, worker_flap = worker respawn, "
+    "devsm_rebind = device rebind — the recovery-time attribution)",
 }
 
 
@@ -535,6 +554,74 @@ class HostProcObs:
         r.histogram_observe(
             _HPROC + "worker_wall_ms", wall_ms,
             buckets=LATENCY_BUCKETS_MS, labels=labels,
+        )
+
+
+class HealthObs:
+    """Cluster-health-plane instruments (obs/health.py, ISSUE 13).
+
+    Families (``dragonboat_health_*``):
+
+    - ``samples_total`` + histogram ``sample_ms`` — sampling cadence and
+      per-sample wall cost (the overhead evidence next to the bench
+      axis's <5% assertion)
+    - gauge ``groups`` — groups covered by the last sample
+    - ``events_total{detector}`` — detector OPEN events
+    - gauge ``open{detector}`` — events currently open (the ``/healthz``
+      verdict is "degraded" whenever any is nonzero)
+    - histogram ``recovery_seconds{detector}`` — open→close durations:
+      the recovery-time attribution (failover / worker-respawn /
+      devsm-rebind p99s the perf ledger publishes)
+
+    Same ``is not None`` latch contract as every other plane: health off
+    registers none of this.
+    """
+
+    __slots__ = ("registry",)
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 detectors=()):
+        self.registry = registry or DEFAULT_REGISTRY
+        r = self.registry
+        _describe(r, (
+            _HEALTH + "samples_total", _HEALTH + "sample_ms",
+            _HEALTH + "groups", _HEALTH + "events_total",
+            _HEALTH + "open", _HEALTH + "recovery_seconds",
+        ))
+        r.counter_add(_HEALTH + "samples_total", 0)
+        r.gauge_set(_HEALTH + "groups", 0)
+        r.histogram_declare(_HEALTH + "sample_ms", buckets=LATENCY_BUCKETS_MS)
+        for det in detectors:
+            labels = {"detector": det}
+            r.counter_add(_HEALTH + "events_total", 0, labels=labels)
+            r.gauge_set(_HEALTH + "open", 0, labels=labels)
+            r.histogram_declare(
+                _HEALTH + "recovery_seconds", buckets=RECOVERY_BUCKETS_S,
+                labels=labels,
+            )
+
+    def sample(self, *, wall_ms: float, groups: int) -> None:
+        r = self.registry
+        r.counter_add(_HEALTH + "samples_total")
+        r.gauge_set(_HEALTH + "groups", groups)
+        r.histogram_observe(
+            _HEALTH + "sample_ms", wall_ms, buckets=LATENCY_BUCKETS_MS
+        )
+
+    def event_open(self, detector: str, *, open_count: int) -> None:
+        labels = {"detector": detector}
+        r = self.registry
+        r.counter_add(_HEALTH + "events_total", labels=labels)
+        r.gauge_set(_HEALTH + "open", open_count, labels=labels)
+
+    def event_close(self, detector: str, *, duration_s: float,
+                    open_count: int) -> None:
+        labels = {"detector": detector}
+        r = self.registry
+        r.gauge_set(_HEALTH + "open", open_count, labels=labels)
+        r.histogram_observe(
+            _HEALTH + "recovery_seconds", duration_s,
+            buckets=RECOVERY_BUCKETS_S, labels=labels,
         )
 
 

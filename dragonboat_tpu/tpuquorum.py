@@ -265,6 +265,27 @@ class TpuQuorumCoordinator:
         """The attached flight recorder (None while obs is off)."""
         return self._obs.recorder if self._obs is not None else None
 
+    def health_snapshot(self) -> dict:
+        """Round-loop health for the cluster health sampler (ISSUE 13):
+        staged-op backlog, registered groups, warmup readiness and the
+        read-plane tallies — all lock-free or micro-locked reads, never
+        the engine lock (a sampler must not queue behind a dispatch)."""
+        with self._stage_mu:
+            staged = len(self._staged)
+        d = {
+            "groups": len(self._nodes),
+            "staged": staged,
+            "tick_deficit": self._tick_seq - self._tick_seen,
+            "fused_ready": bool(self.eng.fused_ready),
+            "fused_dispatches": self.fused_dispatches,
+            "read_confirms": self.read_confirms,
+            "read_fallbacks": self.read_fallbacks,
+        }
+        lt = self.lease_table
+        if lt is not None:
+            d["lease_groups_held"] = lt.held_count(self._tick_seen)
+        return d
+
     # ------------------------------------------------------------------
     # node lifecycle
     # ------------------------------------------------------------------

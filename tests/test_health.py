@@ -1,0 +1,683 @@
+"""Cluster health plane suite (ISSUE 13).
+
+Contracts under test:
+
+- health-OFF structural identity: ``health_sample_ms=0`` constructs
+  nothing — no sampler, no endpoint, no ``dragonboat_health_*``
+  families, ``Node._health_track`` stays False and ``offload_commit``
+  keeps its bit-identical path;
+- detectors under injected faults: an ErrorFS-induced WAL stall opens
+  ``commit_stall`` and closes on heal with a measured recovery
+  duration; a netsplit opens ``quorum_at_risk`` on the check-quorum
+  leader and closes on heal; ``kill -9`` of a hostproc worker opens
+  ``worker_flap`` with a measured recovery duration;
+- detector unit semantics on synthetic samples (apply-lag hysteresis,
+  leader-flap windowing, lease-thrash, devsm-rebind, group-gone
+  close);
+- the live scrape endpoint: ``/metrics`` round-trips the full
+  exposition (every ``# TYPE`` immediately preceded by its ``# HELP``),
+  ``/healthz`` flips 200→503 on an open detector, ``/debug/health``
+  serves the ring;
+- sampler overhead: per-sample wall cost stays bounded (the <5%
+  throughput assertion lives in the bench health axis).
+"""
+import json
+import os
+import signal
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dragonboat_tpu import Config, NodeHostConfig, Result, vfs
+from dragonboat_tpu.config import ExpertConfig
+from dragonboat_tpu.logdb import open_logdb
+from dragonboat_tpu.logdb.kv import WalKV
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.obs.health import DETECTORS, HealthSampler
+from dragonboat_tpu.events import MetricsRegistry
+from dragonboat_tpu.transport import ChanRouter, ChanTransport
+
+from tests.loadwait import scaled, wait_until
+
+RTT_MS = 5
+CID = 930
+
+
+class CounterSM:
+    def __init__(self, cluster_id, node_id):
+        self.count = 0
+
+    def update(self, cmd):
+        self.count += 1
+        return Result(value=self.count)
+
+    def lookup(self, query):
+        return self.count
+
+    def save_snapshot(self, w, files, done):
+        w.write(self.count.to_bytes(8, "little"))
+
+    def recover_from_snapshot(self, r, files, done):
+        self.count = int.from_bytes(r.read(8), "little")
+
+    def close(self):
+        pass
+
+
+def _mk_host(addr="hl:1", router=None, health_ms=0, metrics_addr="",
+             metrics=True, engine="scalar", compartments=False,
+             host_workers=0, tmpdir=None, logdb_factory=None, fs=None):
+    router = router or ChanRouter()
+    return NodeHost(
+        NodeHostConfig(
+            node_host_dir=tmpdir or ":memory:",
+            rtt_millisecond=RTT_MS,
+            raft_address=addr,
+            raft_rpc_factory=lambda s, rh, ch: ChanTransport(
+                s, rh, ch, router=router
+            ),
+            enable_metrics=metrics,
+            health_sample_ms=health_ms,
+            metrics_addr=metrics_addr,
+            logdb_factory=logdb_factory,
+            expert=ExpertConfig(
+                quorum_engine=engine,
+                engine_block_groups=64,
+                engine_warm_fused=False,
+                host_compartments=compartments,
+                host_workers=host_workers,
+                fs=fs,
+            ),
+        )
+    )
+
+
+def _start(nh, cid=CID, check_quorum=False):
+    nh.start_cluster(
+        {1: nh.raft_address()}, False, CounterSM,
+        Config(cluster_id=cid, node_id=1, election_rtt=10, heartbeat_rtt=1,
+               check_quorum=check_quorum),
+    )
+    wait_until(
+        lambda: nh.get_leader_id(cid)[1], timeout=10.0, what="leader"
+    )
+
+
+def _tune(sampler, **kw):
+    """Shrink detector knobs for test cadence."""
+    for k, v in kw.items():
+        setattr(sampler, k, v)
+
+
+# ----------------------------------------------------------------------
+# health OFF: structural identity
+# ----------------------------------------------------------------------
+
+
+def test_health_off_structural_identity():
+    nh = _mk_host(health_ms=0)
+    try:
+        _start(nh)
+        assert nh.health is None
+        assert nh.metrics_server is None
+        node = nh.get_node(CID)
+        assert node._health_track is False
+        s = nh.get_noop_session(CID)
+        for _ in range(3):
+            assert nh.sync_propose(s, b"x", timeout=10.0)
+        # the off path never touched the gated watermark tracking
+        assert node._health_track is False
+        assert node._dev_commit_seen == 0
+        # no health families registered
+        assert not any(
+            f.startswith("dragonboat_health_")
+            for f in nh.metrics_registry.families()
+        )
+        assert nh.health_report() == {"status": "ok", "health_plane": "off"}
+    finally:
+        nh.stop()
+
+
+# ----------------------------------------------------------------------
+# live sampling: ring schema, overhead, host-plane depths
+# ----------------------------------------------------------------------
+
+
+def test_sampler_ring_schema_and_overhead():
+    nh = _mk_host(health_ms=20, compartments=True)
+    try:
+        _start(nh)
+        s = nh.get_noop_session(CID)
+        for _ in range(5):
+            nh.sync_propose(s, b"x", timeout=10.0)
+        # the ring holds pre-election samples too — wait for one that
+        # observed the committed proposals
+        wait_until(
+            lambda: (nh.health.samples()[-1]["groups"].get(CID) or {}).get(
+                "committed", 0
+            ) >= 5,
+            timeout=10.0, what="post-commit sample",
+        )
+        samp = nh.health.samples()[-1]
+        g = samp["groups"][CID]
+        for field in ("state", "term", "leader_id", "committed", "applied",
+                      "voters", "quorum", "pending_proposals"):
+            assert field in g, (field, g)
+        assert g["state"] == "LEADER" and g["committed"] >= 5
+        # compartmentalized host-plane depths ride the sample
+        hp = samp["host"]["hostplane"]
+        assert hp["ingress"]["shards"] and "wal" in hp
+        assert "apply_depth" in hp and "egress_depth" in hp
+        # sampler-overhead assertion: a per-sample cost anywhere near
+        # the cadence would make the plane a load source, not a meter
+        walls = sorted(
+            s["wall_ms"] for s in nh.health.samples() if "wall_ms" in s
+        )
+        assert walls[len(walls) // 2] < scaled(25.0), walls[-5:]
+        reg = nh.metrics_registry
+        assert reg.counter_value("dragonboat_health_samples_total") >= 5
+        h = reg.histogram_value("dragonboat_health_sample_ms")
+        assert h is not None and h[3] >= 5
+        assert reg.gauge_value("dragonboat_health_groups") == 1
+        rep = nh.health_report()
+        assert rep["status"] == "ok" and rep["samples"] >= 5
+    finally:
+        nh.stop()
+
+
+# ----------------------------------------------------------------------
+# fault injection: ErrorFS WAL stall -> commit_stall
+# ----------------------------------------------------------------------
+
+
+def test_errorfs_wal_stall_opens_commit_stall(tmp_path):
+    """vfs.ErrorFS fails every fsync: commitIndex flattens with
+    proposals pending, commit_stall opens; healing the fs lets the
+    committer retry land and the event closes with a recovery
+    duration."""
+    failing = [False]
+    inj = vfs.Injector(lambda op, path: failing[0] and op == "fsync")
+    efs = vfs.ErrorFS(vfs.OSFS(), inj)
+    ldb_dir = str(tmp_path / "wal")
+
+    def logdb_factory(nhc):
+        return open_logdb(
+            ldb_dir, shards=2,
+            kv_factory=lambda d: WalKV(d, fsync=True, fs=efs),
+        )
+
+    nh = _mk_host(
+        health_ms=25, tmpdir=str(tmp_path / "nh"),
+        logdb_factory=logdb_factory, fs=efs,
+    )
+    try:
+        _start(nh)
+        _tune(nh.health, commit_stall_samples=2)
+        s = nh.get_noop_session(CID)
+        assert nh.sync_propose(s, b"pre", timeout=10.0).value == 1
+        failing[0] = True
+        rs = nh.propose(s, b"stuck", timeout=60.0)
+        assert not rs.wait(0.5).completed
+        wait_until(
+            lambda: any(
+                e["detector"] == "commit_stall"
+                for e in nh.health.open_events()
+            ),
+            timeout=10.0, what="commit_stall open",
+        )
+        reg = nh.metrics_registry
+        assert reg.counter_value(
+            "dragonboat_health_events_total", {"detector": "commit_stall"}
+        ) >= 1
+        assert nh.health_report()["status"] == "degraded"
+        # heal: the committer retry lands the entry, commit advances,
+        # the detector closes and the recovery duration is recorded
+        failing[0] = False
+        assert rs.wait(10.0).completed
+        wait_until(
+            lambda: not nh.health.open_events(), timeout=10.0,
+            what="commit_stall close",
+        )
+        recov = nh.health.recovery_stats()
+        assert recov["commit_stall"]["n"] >= 1
+        assert recov["commit_stall"]["p99_s"] > 0
+        h = reg.histogram_value(
+            "dragonboat_health_recovery_seconds",
+            {"detector": "commit_stall"},
+        )
+        assert h is not None and h[3] >= 1
+    finally:
+        nh.stop()
+
+
+# ----------------------------------------------------------------------
+# fault injection: netsplit -> quorum_at_risk, closes on heal
+# ----------------------------------------------------------------------
+
+
+def test_netsplit_opens_quorum_at_risk_and_closes_on_heal():
+    router = ChanRouter()
+    nhs = [
+        _mk_host(addr=f"qr{i}:1", router=router, health_ms=25)
+        for i in range(1, 4)
+    ]
+    addrs = {i: f"qr{i}:1" for i in range(1, 4)}
+    try:
+        for i, nh in enumerate(nhs, start=1):
+            nh.start_cluster(
+                addrs, False, CounterSM,
+                Config(cluster_id=CID, node_id=i, election_rtt=10,
+                       heartbeat_rtt=1, check_quorum=True),
+            )
+        # deterministic leadership on host 1
+        def _drive_leader1():
+            n1 = nhs[0].get_node(CID)
+            if n1.is_leader():
+                return True
+            lid, ok = n1.get_leader_id()
+            if ok and lid != 1 and 1 <= lid <= 3:
+                try:
+                    nhs[lid - 1].request_leader_transfer(CID, 1)
+                except Exception:
+                    pass
+            else:
+                n1.request_campaign()
+            return False
+
+        wait_until(_drive_leader1, timeout=20.0, interval=0.2,
+                   what="leader on host 1")
+        s = nhs[0].get_noop_session(CID)
+        nhs[0].sync_propose(s, b"x", timeout=30.0)
+        health = nhs[0].health
+        _tune(health, quorum_risk_samples=2)
+        # a couple of healthy windows first so the activity flags are
+        # warm, then cut host 3 from everyone
+        wait_until(lambda: len(health) >= 3, timeout=10.0, what="samples")
+        router.partition("qr3:1", "qr1:1")
+        router.partition("qr3:1", "qr2:1")
+        wait_until(
+            lambda: any(
+                e["detector"] == "quorum_at_risk"
+                for e in health.open_events()
+            ),
+            timeout=15.0, what="quorum_at_risk open",
+        )
+        ev = [e for e in health.open_events()
+              if e["detector"] == "quorum_at_risk"][0]
+        assert ev["detail"]["reachable"] <= ev["detail"]["quorum"]
+        # heal: the partitioned follower reconnects, activity flags
+        # refresh, the detector closes (on this host directly, or via
+        # the leadership-moved close if the rejoin deposed host 1)
+        router.heal()
+        wait_until(
+            lambda: not any(
+                e["detector"] == "quorum_at_risk"
+                for e in health.open_events()
+            ),
+            timeout=20.0, what="quorum_at_risk close",
+        )
+        assert health.recovery_stats()["quorum_at_risk"]["n"] >= 1
+    finally:
+        for nh in nhs:
+            nh.stop()
+
+
+# ----------------------------------------------------------------------
+# fault injection: kill -9 hostproc worker -> worker_flap
+# ----------------------------------------------------------------------
+
+
+def test_kill9_hostproc_worker_opens_worker_flap(tmp_path):
+    nh = _mk_host(
+        health_ms=20, host_workers=1, tmpdir=str(tmp_path / "nh"),
+    )
+    if nh.hostproc is None:
+        nh.stop()
+        pytest.skip("hostproc spawn unavailable")
+    try:
+        _start(nh)
+        wait_until(lambda: len(nh.health) >= 2, timeout=10.0, what="samples")
+        pid = nh.hostproc.worker_pid(0)
+        assert pid
+        os.kill(pid, signal.SIGKILL)
+        wait_until(
+            lambda: any(
+                e["detector"] == "worker_flap"
+                for e in nh.health.open_events()
+            ) or nh.health.recovery_stats().get("worker_flap"),
+            timeout=15.0, what="worker_flap open",
+        )
+        # the monitor respawns (bounded budget) and the event closes
+        # with a measured recovery duration
+        wait_until(
+            lambda: nh.health.recovery_stats().get("worker_flap"),
+            timeout=30.0, what="worker_flap close",
+        )
+        recov = nh.health.recovery_stats()["worker_flap"]
+        assert recov["n"] >= 1 and recov["p99_s"] > 0
+        assert nh.metrics_registry.counter_value(
+            "dragonboat_health_events_total", {"detector": "worker_flap"}
+        ) >= 1
+    finally:
+        nh.stop()
+
+
+def test_hostproc_dead_lane_ring_depth_not_ghosted(tmp_path):
+    """ISSUE 13 satellite: a dead lane's rings hold the dead epoch's
+    backlog — ring_depth() must exclude them, and the monitor must
+    republish the gauges at death so a scrape never shows a ghost
+    ring."""
+    nh = _mk_host(health_ms=0, host_workers=1, tmpdir=str(tmp_path / "nh"))
+    if nh.hostproc is None:
+        nh.stop()
+        pytest.skip("hostproc spawn unavailable")
+    try:
+        plane = nh.hostproc
+        rec = plane._workers[0]
+        # exhaust the restart budget FIRST so the monitor cannot respawn
+        # (and ring-reset) the lane — the ghost epoch then persists, the
+        # exact regime the old gauge misread forever
+        rec.restarts = plane.MAX_RESTARTS
+        pid = plane.worker_pid(0)
+        os.kill(pid, signal.SIGKILL)
+        wait_until(lambda: rec.down, timeout=10.0, what="lane marked down")
+        # stage dead-epoch bytes on the dead lane's request ring
+        assert rec.pairs[0].req.push(b"ghost-record")
+        assert rec.pairs[0].req.depth() > 0
+        # the live depth excludes the dead lane...
+        assert plane.ring_depth() == 0
+        # ...and the monitor republishes the gauge, so a scrape between
+        # death and (never-coming) respawn shows 0, not the ghost
+        wait_until(
+            lambda: nh.metrics_registry.gauge_value(
+                "dragonboat_hostproc_ring_depth"
+            ) == 0,
+            timeout=10.0, what="ring_depth gauge zeroed",
+        )
+        assert nh.metrics_registry.gauge_value(
+            "dragonboat_hostproc_workers_alive"
+        ) == 0
+    finally:
+        nh.stop()
+
+
+# ----------------------------------------------------------------------
+# detector unit semantics (synthetic samples)
+# ----------------------------------------------------------------------
+
+
+def _sample(groups=None, hostproc=None, mono=None):
+    return {
+        "ts": time.time(),
+        "mono": mono if mono is not None else time.monotonic(),
+        "groups": groups or {},
+        "host": {"hostproc": hostproc},
+    }
+
+
+def _unit_sampler(**kw):
+    return HealthSampler(nh=None, registry=MetricsRegistry(), **kw)
+
+
+def test_unit_apply_lag_hysteresis():
+    hs = _unit_sampler(apply_lag_entries=100)
+    g = {"committed": 1000, "applied": 980, "leader_id": 1}
+    hs.ingest(_sample({7: dict(g)}))
+    assert not hs.open_events()
+    g["applied"] = 850  # lag 150 > 100 -> open
+    hs.ingest(_sample({7: dict(g)}))
+    assert [e["detector"] for e in hs.open_events()] == ["apply_lag"]
+    g["applied"] = 920  # lag 80: above close threshold (50) -> stays open
+    hs.ingest(_sample({7: dict(g)}))
+    assert hs.open_events()
+    g["applied"] = 960  # lag 40 <= 50 -> closes
+    hs.ingest(_sample({7: dict(g)}))
+    assert not hs.open_events()
+    assert hs.recovery_stats()["apply_lag"]["n"] == 1
+
+
+def test_unit_leader_flap_window():
+    hs = _unit_sampler(leader_flap_changes=3, flap_window_s=5.0)
+    base = time.monotonic()
+    lid = 1
+    for i in range(4):
+        lid = 2 if lid == 1 else 1
+        hs.ingest(_sample(
+            {7: {"leader_id": lid, "committed": i}}, mono=base + i * 0.1
+        ))
+    assert any(e["detector"] == "leader_flap" for e in hs.open_events())
+    # a quiet window ages the changes out and closes the event
+    hs.ingest(_sample(
+        {7: {"leader_id": lid, "committed": 9}}, mono=base + 20.0
+    ))
+    assert not hs.open_events()
+    assert hs.recovery_stats()["leader_flap"]["n"] == 1
+
+
+def test_unit_lease_thrash_and_devsm_rebind():
+    hs = _unit_sampler(lease_thrash_events=3, devsm_rebind_binds=2,
+                       flap_window_s=5.0)
+    base = time.monotonic()
+    g0 = {
+        "leader_id": 1, "committed": 1,
+        "lease": {"grants": 0, "expiries": 0, "held": True},
+        "devsm": {"binds": 0, "bound": True},
+    }
+    hs.ingest(_sample({7: g0}, mono=base))
+    g1 = {
+        "leader_id": 1, "committed": 2,
+        "lease": {"grants": 2, "expiries": 2, "held": False},
+        "devsm": {"binds": 3, "bound": False},
+    }
+    hs.ingest(_sample({7: g1}, mono=base + 0.1))
+    dets = {e["detector"] for e in hs.open_events()}
+    assert dets == {"lease_thrash", "devsm_rebind"}
+    # a quiet window alone does NOT close a thrash that settled into
+    # permanently-expired (review-caught: the aged-out deque used to
+    # close it and record a bogus recovery while the lease was down)
+    g_expired = {
+        "leader_id": 1, "committed": 3,
+        "lease": {"grants": 2, "expiries": 2, "held": False},
+        "devsm": {"binds": 3, "bound": True},
+    }
+    hs.ingest(_sample({7: g_expired}, mono=base + 30.0))
+    assert {e["detector"] for e in hs.open_events()} == {"lease_thrash"}
+    # quiet window + lease held again -> closes
+    g2 = {
+        "leader_id": 1, "committed": 3,
+        "lease": {"grants": 2, "expiries": 2, "held": True},
+        "devsm": {"binds": 3, "bound": True},
+    }
+    hs.ingest(_sample({7: g2}, mono=base + 31.0))
+    assert not hs.open_events()
+
+
+def test_unit_commit_stall_requires_pending():
+    hs = _unit_sampler(commit_stall_samples=2)
+    g = {"committed": 5, "pending_proposals": False, "leader_id": 1}
+    for _ in range(4):  # flat but nothing pending: idle, not stalled
+        hs.ingest(_sample({7: dict(g)}))
+    assert not hs.open_events()
+    g["pending_proposals"] = True
+    for _ in range(3):
+        hs.ingest(_sample({7: dict(g)}))
+    assert [e["detector"] for e in hs.open_events()] == ["commit_stall"]
+    g["committed"] = 6  # progress closes it
+    hs.ingest(_sample({7: dict(g)}))
+    assert not hs.open_events()
+
+
+def test_unit_group_gone_closes_events_and_drops_memory():
+    hs = _unit_sampler(commit_stall_samples=1, leader_flap_changes=2)
+    base = time.monotonic()
+    g = {"committed": 5, "pending_proposals": True, "leader_id": 1}
+    hs.ingest(_sample({7: dict(g)}, mono=base))
+    g["leader_id"] = 2  # one change lands in the flap deque
+    hs.ingest(_sample({7: dict(g)}, mono=base + 0.1))
+    assert hs.open_events()
+    hs.ingest(_sample({}, mono=base + 0.2))  # stop_cluster
+    assert not hs.open_events()
+    # every per-cid evaluation memory dropped (review-caught: a
+    # restarted incarnation must not inherit the old one's flap
+    # history, and churned groups must not leak dict entries)
+    for d in (hs._prev, hs._stall_streak, hs._leader_changes,
+              hs._lease_events, hs._devsm_binds):
+        assert 7 not in d
+    # restart the cid: its first real leader change must NOT trip the
+    # flap threshold off the dead incarnation's deque
+    hs.ingest(_sample({7: {"leader_id": 1, "committed": 1}},
+                      mono=base + 0.3))
+    hs.ingest(_sample({7: {"leader_id": 2, "committed": 1}},
+                      mono=base + 0.4))
+    assert not any(
+        e["detector"] == "leader_flap" for e in hs.open_events()
+    )
+
+
+def test_unit_worker_flap_restart_bump():
+    hs = _unit_sampler()
+    hs.ingest(_sample(hostproc={"alive": 2, "workers": 2, "restarts": 0}))
+    assert not hs.open_events()
+    # death + instant respawn inside one monitor tick: liveness never
+    # dipped, only the restart counter moved
+    hs.ingest(_sample(hostproc={"alive": 2, "workers": 2, "restarts": 1}))
+    assert [e["detector"] for e in hs.open_events()] == ["worker_flap"]
+    hs.ingest(_sample(hostproc={"alive": 2, "workers": 2, "restarts": 1}))
+    assert not hs.open_events()
+    assert hs.recovery_stats()["worker_flap"]["n"] == 1
+
+
+# ----------------------------------------------------------------------
+# the live scrape endpoint
+# ----------------------------------------------------------------------
+
+
+def _get(port, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    )
+
+
+def test_endpoint_metrics_healthz_and_dumps():
+    nh = _mk_host(health_ms=20, metrics_addr="127.0.0.1:0")
+    try:
+        _start(nh)
+        s = nh.get_noop_session(CID)
+        for _ in range(3):
+            nh.sync_propose(s, b"x", timeout=10.0)
+        wait_until(lambda: len(nh.health) >= 2, timeout=10.0, what="samples")
+        port = nh.metrics_server.port
+        # /metrics: the full exposition round-trips — every # TYPE is
+        # immediately preceded by its # HELP (the acceptance criterion)
+        r = _get(port, "/metrics")
+        assert r.status == 200
+        assert "text/plain" in r.headers["Content-Type"]
+        lines = r.read().decode().splitlines()
+        assert any(l.startswith("dragonboat_health_samples_total") for l in lines)
+        for i, line in enumerate(lines):
+            if line.startswith("# TYPE"):
+                name = line.split()[2]
+                assert i > 0 and lines[i - 1].startswith(f"# HELP {name} "), (
+                    f"# TYPE without preceding # HELP: {line}"
+                )
+        # /healthz: ok -> 200
+        r = _get(port, "/healthz")
+        assert r.status == 200 and json.loads(r.read())["status"] == "ok"
+        # force-open a detector -> 503 with the event in the body
+        nh.health._set(
+            "commit_stall", "group:999", True, time.monotonic(),
+            {"cluster_id": 999},
+        )
+        try:
+            _get(port, "/healthz")
+            assert False, "degraded /healthz must 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            body = json.loads(e.read())
+            assert body["status"] == "degraded"
+            assert body["open"][0]["detector"] == "commit_stall"
+        nh.health._set("commit_stall", "group:999", False,
+                       time.monotonic(), {})
+        assert _get(port, "/healthz").status == 200
+        # /debug/health: the ring dump parses and carries samples
+        d = json.loads(_get(port, "/debug/health").read())
+        assert d["count"] >= 2 and d["samples"]
+        assert d["report"]["status"] == "ok"
+        # /debug/trace 404s while tracing is off; unknown paths 404
+        for path in ("/debug/trace", "/nope"):
+            try:
+                _get(port, path)
+                assert False, f"{path} must 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+    finally:
+        nh.stop()
+
+
+def test_endpoint_survives_restarted_scrapes_and_stop():
+    nh = _mk_host(health_ms=0, metrics_addr="127.0.0.1:0")
+    try:
+        _start(nh)
+        port = nh.metrics_server.port
+        for _ in range(3):
+            assert _get(port, "/metrics").status == 200
+        # health off: /healthz still answers (plain ok stub), the ring
+        # dump honestly 404s
+        assert json.loads(_get(port, "/healthz").read())["health_plane"] == "off"
+        try:
+            _get(port, "/debug/health")
+            assert False
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        nh.stop()
+    # after stop the port is released
+    try:
+        _get(port, "/metrics")
+        assert False, "endpoint must stop with the host"
+    except (ConnectionError, urllib.error.URLError, OSError):
+        pass
+
+
+def test_malformed_metrics_addr_degrades_not_crashes():
+    """Review-caught: a malformed metrics_addr (possibly from the env
+    fallback) raises ValueError, which must degrade to a warning — the
+    raft planes are fine, only the scrape surface is not."""
+    for bad in ("9090", "127.0.0.1:nope"):
+        nh = _mk_host(metrics_addr=bad)
+        try:
+            assert nh.metrics_server is None
+        finally:
+            nh.stop()
+
+
+def test_health_families_help_round_trip():
+    """Every dragonboat_health_* family carries # HELP + # TYPE (the
+    test_events satellite pattern)."""
+    import io
+
+    nh = _mk_host(health_ms=20)
+    try:
+        _start(nh)
+        wait_until(lambda: len(nh.health) >= 1, timeout=10.0, what="sample")
+        buf = io.StringIO()
+        nh.write_health_metrics(buf)
+        text = buf.getvalue()
+        for fam, kind in (
+            ("dragonboat_health_samples_total", "counter"),
+            ("dragonboat_health_events_total", "counter"),
+            ("dragonboat_health_open", "gauge"),
+            ("dragonboat_health_groups", "gauge"),
+            ("dragonboat_health_sample_ms", "histogram"),
+            ("dragonboat_health_recovery_seconds", "histogram"),
+        ):
+            assert f"# HELP {fam} " in text, fam
+            assert f"# TYPE {fam} {kind}" in text, fam
+        # zero-registered per detector so a scrape distinguishes
+        # "healthy" from "health off"
+        for det in DETECTORS:
+            assert f'dragonboat_health_open{{detector="{det}"}} 0' in text, det
+    finally:
+        nh.stop()

@@ -616,6 +616,36 @@ def _stop(nhs):
             pass
 
 
+def _propose_retry(nh, s, data, timeout=30.0, attempts=3):
+    """Noop-session propose with a load-scaled timeout and retry (the
+    test_tpuquorum helper, ISSUE 13 deflake): under full-suite load one
+    live-stack window can starve past a single timeout — the documented
+    r07/r10/r12 rotating leadership-timing flake — while the cluster is
+    perfectly healthy.  A noop-session duplicate is harmless here."""
+    from dragonboat_tpu.requests import TimeoutError_
+    from tests.loadwait import scaled
+
+    for a in range(attempts):
+        try:
+            return nh.sync_propose(s, data, timeout=scaled(timeout))
+        except TimeoutError_:
+            if a == attempts - 1:
+                raise
+
+
+def _read_retry(nh, cid, query, timeout=10.0, attempts=3):
+    """Load-scaled, retried sync_read (idempotent — safe to repeat)."""
+    from dragonboat_tpu.requests import TimeoutError_
+    from tests.loadwait import scaled
+
+    for a in range(attempts):
+        try:
+            return nh.sync_read(cid, query, timeout=scaled(timeout))
+        except TimeoutError_:
+            if a == attempts - 1:
+                raise
+
+
 def test_live_lease_reads_cross_domain_and_metrics():
     """3 hosts, follower quorum one injected far link away: lease reads
     complete without paying the domain RTT; the dragonboat_lease_*
@@ -629,26 +659,32 @@ def test_live_lease_reads_cross_domain_and_metrics():
         )
         _start(nhs)
         nh = nhs[0]
-        nh.sync_propose(nh.get_noop_session(CID), b"a=1", timeout=30.0)
+        _propose_retry(nh, nh.get_noop_session(CID), b"a=1")
         # let a heartbeat/ack round trip arm the lease
         wait_until(
             lambda: (nh.lease_status(CID) or {}).get("held"),
             timeout=10.0, what="lease armed",
         )
-        v = nh.sync_read(CID, "a", timeout=10.0)
+        v = _read_retry(nh, CID, "a")
         assert v == "1"
         st = nh.lease_status(CID)
         assert st["reads_local"] >= 1
         assert st["grants"] >= 1
         # lease-served reads beat the 30ms domain RTT by construction:
         # time a burst and require it to complete far under ONE far RTT
-        # per read (conservative on a loaded box)
+        # per read.  The margin is load-scaled (scheduler pressure
+        # stretches even a zero-round local read) but HARD-CAPPED below
+        # the far round trip — a read that actually paid the link can
+        # never pass (ISSUE 13 deflake of the r07/r10/r12 profile).
+        from tests.loadwait import scaled as _scaled
+
         t0 = time.perf_counter()
         n = 10
         for _ in range(n):
-            assert nh.sync_read(CID, "a", timeout=10.0) == "1"
+            assert _read_retry(nh, CID, "a") == "1"
         per_read = (time.perf_counter() - t0) / n
-        assert per_read < 0.015, f"lease read paid the far link: {per_read}"
+        bound = min(_scaled(0.015), 0.028)
+        assert per_read < bound, f"lease read paid the far link: {per_read}"
         # exposition: every lease family carries HELP + TYPE
         import io
 
@@ -743,7 +779,7 @@ def test_live_transfer_soak_linearizable_and_stale_lease_caught():
     try:
         _start(nhs, prefix="lf", election_rtt=60)
         nh1 = nhs[0]
-        nh1.sync_propose(nh1.get_noop_session(CID), b"k=v1", timeout=30.0)
+        _propose_retry(nh1, nh1.get_noop_session(CID), b"k=v1")
         wait_until(
             lambda: (nh1.lease_status(CID) or {}).get("held"),
             timeout=10.0, what="lease armed",
@@ -795,9 +831,8 @@ def test_live_transfer_soak_linearizable_and_stale_lease_caught():
         # the target now leads and commits v2 with host 3 (near link)
         # while host 1 has not yet heard of the new term
         done_v2 = rec.invoke(1, "put", "k", "v2")
-        nhs[1].sync_propose(
-            nhs[1].get_noop_session(CID), b"k=v2", timeout=10.0
-        )
+        _propose_retry(nhs[1], nhs[1].get_noop_session(CID), b"k=v2",
+                       timeout=10.0)
         done_v2(True)
         # stale read on the old leader inside the delayed-handoff window
         assert node1.is_leader()
@@ -823,16 +858,23 @@ def test_live_tpu_engine_lease_and_coordinator_table():
     try:
         _start(nhs, prefix="lt")
         nh = nhs[0]
-        nh.sync_propose(nh.get_noop_session(CID), b"a=2", timeout=60.0)
+        # retried + load-scaled: the first live-tpu propose shares the
+        # core with the engine's first-dispatch compiles, and one
+        # starved window was the documented r12 rotating flake
+        _propose_retry(nh, nh.get_noop_session(CID), b"a=2", timeout=60.0)
         # generous, load-scaled waits: a live 3-host tpu-engine cluster
         # on a contended box arms slowly (first-dispatch compiles share
-        # the core with raft) — the gate must not flake on weather
+        # the core with raft) — the gate must not flake on weather.
+        # 60s base: the 30s scaled budget still expired once per loaded
+        # sweep (the r12 rotating profile's most frequent site) while
+        # the same wait passes standalone in seconds — arming is
+        # contention-bound, not broken, so only the margin widens
         wait_until(
             lambda: (nh.lease_status(CID) or {}).get("held"),
-            timeout=30.0, what="lease armed",
+            timeout=60.0, what="lease armed",
         )
         before = (nh.lease_status(CID) or {}).get("reads_local", 0)
-        assert nh.sync_read(CID, "a", timeout=30.0) == "2"
+        assert _read_retry(nh, CID, "a", timeout=30.0) == "2"
         st = nh.lease_status(CID)
         assert st["reads_local"] > before
         qc = nh.quorum_coordinator
