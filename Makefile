@@ -5,6 +5,7 @@ PY ?= python
 
 .PHONY: test test-all test-kernels test-obs test-trace test-warmup \
 	test-hostplane test-hostproc test-lease test-devsm test-health \
+	test-repltrace \
 	native soak soak-smoke bench dryrun perf-ledger perf-ledger-check
 
 test: native
@@ -33,6 +34,18 @@ test-obs:
 # requests.py, or the node/engine/coordinator trace hooks change
 test-trace:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_trace.py -q
+
+# fast cpu gate for replication-path tracing + commit quorum attribution
+# (ISSUE 14): trace-off structural identity on the chan AND tcp wires
+# (codec byte-identity included), leader→follower→leader stage
+# completeness, quorum-closing-peer vs the scalar kth-ack oracle under
+# an injected slow peer, term-pinned records across leadership
+# transfer, the multi-host Perfetto merge, and the transport/latency
+# introspection satellites — run before the full tier-1 sweep whenever
+# obs/replattr.py, wire/codec.py's trace carriage, transport metrics or
+# the raft ack/commit hooks change
+test-repltrace:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_repltrace.py -q
 
 # fast cpu gate for the AOT warm-compile + persistent compilation cache
 # (ISSUE 7): warmup against a temp cache dir asserts (a) a second enable

@@ -790,18 +790,26 @@ def _set_tracing(nhs, on: bool) -> None:
     """Attach/detach the request tracer across a LIVE cluster.  Every
     hook gates on a plain ``is not None`` check, so the detached half of
     the A/B runs the trace-off path on the very same cluster — no
-    cluster-to-cluster weather in the comparison."""
+    cluster-to-cluster weather in the comparison.  The replication
+    attribution plane (obs/replattr.py, ISSUE 14) lives and dies with
+    the tracer: the same toggle detaches it everywhere down to the raft
+    ack/commit hooks, so the off half also prices the replattr latch."""
     for nh in nhs:
         t = nh._trace_axis_tracer if on else None
+        ra = (getattr(nh, "_trace_axis_replattr", None) or None) if on else None
         nh.tracer = t
+        nh.replattr = ra
         nh.engine.tracer = t
         if nh.quorum_coordinator is not None:
             nh.quorum_coordinator.tracer = t
+            nh.quorum_coordinator.replattr = ra
         with nh._mu:
             nodes = [n for n in nh._clusters.values() if n is not None]
         for n in nodes:
             n.tracer = t
             n.pending_reads._tracer = t
+            n.replattr = ra
+            n.peer.raft.replattr = ra
 
 
 def _merged_stage_stats(nhs) -> dict:
@@ -854,6 +862,7 @@ def run_trace_axis() -> dict:
             for nh in nhs:
                 # keep a handle: the A/B detaches/reattaches mid-run
                 nh._trace_axis_tracer = nh.tracer
+                nh._trace_axis_replattr = nh.replattr
             cids = _start_groups(nhs, groups)
             leaders = _campaign_and_wait(nhs, cids, 180.0)
             fused_before = 0
@@ -1140,7 +1149,7 @@ def run_health_axis() -> dict:
 # ======================================================================
 
 
-def _mk_xdom_hosts(rtt_ms, far_one_way_s):
+def _mk_xdom_hosts(rtt_ms, far_one_way_s, trace=0):
     from dragonboat_tpu import NodeHostConfig
     from dragonboat_tpu.config import ExpertConfig
     from dragonboat_tpu.monkey import set_latency
@@ -1160,6 +1169,7 @@ def _mk_xdom_hosts(rtt_ms, far_one_way_s):
                     raft_rpc_factory=lambda src, rh, ch: ChanTransport(
                         src, rh, ch, router=router
                     ),
+                    trace_sample_every=trace,
                     expert=ExpertConfig(
                         quorum_engine="scalar", logdb_shards=2
                     ),
@@ -1173,6 +1183,35 @@ def _mk_xdom_hosts(rtt_ms, far_one_way_s):
         nhs, crossdomain(["xd1:1"], ["xd2:1", "xd3:1"], far_one_way_s)
     )
     return nhs
+
+
+def _xdom_place_leaders(nhs, cids):
+    """Deterministic placement: the NEAR host (rank 1) leads every
+    group.  The first campaign can race the bootstrap config-change
+    apply (campaign_skipped) or lose to a randomized timeout on a far
+    host — retry, transferring back when a far host won."""
+    deadline = time.time() + 120
+    led = set()
+    while len(led) < len(cids) and time.time() < deadline:
+        for cid in cids:
+            if cid in led:
+                continue
+            n1 = nhs[0].get_node(cid)
+            if n1.is_leader():
+                led.add(cid)
+                continue
+            lid, ok = n1.get_leader_id()
+            if ok and lid != 1 and 1 <= lid <= 3:
+                try:
+                    nhs[lid - 1].request_leader_transfer(cid, 1)
+                except Exception:
+                    pass
+            else:
+                n1.request_campaign()
+        time.sleep(0.2)
+    assert len(led) == len(cids), (
+        f"near-domain leaders: {len(led)}/{len(cids)}"
+    )
 
 
 def run_crossdomain() -> dict:
@@ -1225,32 +1264,7 @@ def run_crossdomain() -> dict:
                             read_lease=lease,
                         ),
                     )
-            # deterministic placement: the NEAR host leads every group.
-            # The first campaign can race the bootstrap config-change
-            # apply (campaign_skipped) or lose to a randomized timeout on
-            # a far host — retry, transferring back when a far host won.
-            deadline = time.time() + 120
-            led = set()
-            while len(led) < len(cids) and time.time() < deadline:
-                for cid in cids:
-                    if cid in led:
-                        continue
-                    n1 = nhs[0].get_node(cid)
-                    if n1.is_leader():
-                        led.add(cid)
-                        continue
-                    lid, ok = n1.get_leader_id()
-                    if ok and lid != 1 and 1 <= lid <= 3:
-                        try:
-                            nhs[lid - 1].request_leader_transfer(cid, 1)
-                        except Exception:
-                            pass
-                    else:
-                        n1.request_campaign()
-                time.sleep(0.2)
-            assert len(led) == len(cids), (
-                f"near-domain leaders: {len(led)}/{len(cids)}"
-            )
+            _xdom_place_leaders(nhs, cids)
             leaders = {cid: nhs[0] for cid in cids}
             # warm: one committed write per group (thesis §6.4 step 1 —
             # the lease serves only past a current-term commit) and a few
@@ -1313,8 +1327,158 @@ def run_crossdomain() -> dict:
     assert wps_ratio is None or 0.5 <= wps_ratio <= 2.0, (
         f"mixed throughput moved {wps_ratio}x between lease on/off"
     )
+    # commit attribution (ISSUE 14): READS got their cross-domain story
+    # above; this phase prices what COMMITS still pay — per-peer quorum
+    # attribution on the identical topology, trace on/off paired
+    out["commit_attribution"] = _xdom_commit_attribution(
+        groups, rtt_ms, far_ms, duration, threads, payload
+    )
     out["assert_ok"] = True
     return out
+
+
+def _xdom_commit_attribution(groups, rtt_ms, far_ms, duration, threads,
+                             payload) -> dict:
+    """Commit-attribution phase of the cross-domain rung (ISSUE 14
+    tentpole): same 3-host topology (near leader, 2-follower quorum one
+    far link away), pure-write load, the replication attribution plane
+    (obs/replattr.py) decomposing every sampled commit's quorum close
+    per peer.  Asserted: the far-domain peers are the ONLY laggards and
+    closers (by latency class, not bare node id), the quorum close pays
+    the far round trip, the closing path's stage share is wire-dominated
+    (the number ROADMAP item 4's domain-local sub-quorum attacks), and
+    the paired trace-on/off overhead stays under 5% + 2·SEM (the r10
+    trace-axis pairing discipline) with the off half structurally
+    detached down to the raft hooks.
+
+    Env knobs: E2E_XDOM_TRACE_SAMPLE (1-in-4), E2E_XDOM_TRACE_PAIRS (4
+    windows), E2E_XDOM_TRACE_WINDOW (duration/2 s).
+    """
+    from dragonboat_tpu import Config
+
+    sample = int(os.environ.get("E2E_XDOM_TRACE_SAMPLE", "4"))
+    pairs = max(2, int(os.environ.get("E2E_XDOM_TRACE_PAIRS", "4")) // 2 * 2)
+    win = (
+        float(os.environ.get("E2E_XDOM_TRACE_WINDOW", "0"))
+        or max(2.0, duration / 2)
+    )
+    nhs = _mk_xdom_hosts(rtt_ms, far_ms / 1e3, trace=sample)
+    try:
+        for nh in nhs:
+            # handles for the A/B detach/reattach (_set_tracing)
+            nh._trace_axis_tracer = nh.tracer
+            nh._trace_axis_replattr = nh.replattr
+        addrs = {i: f"xd{i}:1" for i in (1, 2, 3)}
+        cids = [BASE_CID + g for g in range(groups)]
+        for cid in cids:
+            for i, nh in enumerate(nhs, start=1):
+                nh.start_cluster(
+                    addrs, False, CounterSM,
+                    Config(cluster_id=cid, node_id=i, election_rtt=10,
+                           heartbeat_rtt=1, check_quorum=True),
+                )
+        _xdom_place_leaders(nhs, cids)
+        leaders = {cid: nhs[0] for cid in cids}
+        for cid in cids:
+            nhs[0].sync_propose(
+                nhs[0].get_noop_session(cid), payload, timeout=30.0
+            )
+
+        def measure(on):
+            _set_tracing(nhs, on)
+            if not on:
+                # trace-off structural identity on the live cluster:
+                # nothing below the latch may survive the detach
+                n = nhs[0].get_node(cids[0])
+                assert n.replattr is None
+                assert n.peer.raft.replattr is None
+            m = _measure_mixed(
+                leaders, cids, payload, 0, time.time() + win, threads
+            )
+            return m["ops_per_sec"]
+
+        measure(False)  # warmup window
+        deltas = []
+        wps_on = wps_off = 0.0
+        for pair in range(pairs):
+            if pair % 2 == 0:
+                on = measure(True)
+                off = measure(False)
+            else:
+                off = measure(False)
+                on = measure(True)
+            wps_on = max(wps_on, on)
+            wps_off = max(wps_off, off)
+            deltas.append((off - on) / off * 100.0)
+        mean = sum(deltas) / len(deltas)
+        var = sum((d - mean) ** 2 for d in deltas) / max(1, len(deltas) - 1)
+        sem = (var / len(deltas)) ** 0.5
+        overhead = round(mean, 2)
+        # dedicated attribution window, then let straggler (laggard)
+        # acks land so their RTTs make the table
+        _set_tracing(nhs, True)
+        _measure_mixed(leaders, cids, payload, 0, time.time() + win, threads)
+        time.sleep(max(1.0, 4 * far_ms / 1e3))
+        summ = nhs[0].replattr.summary()
+        inj = nhs[0].transport.latency
+        out = {
+            "sample_every": sample,
+            "window_s": win,
+            "writes_per_sec_trace_on": round(wps_on, 1),
+            "writes_per_sec_trace_off": round(wps_off, 1),
+            "trace_overhead_pct": overhead,
+            "trace_overhead_sem_pct": round(sem, 2),
+            "pair_deltas_pct": [round(d, 2) for d in deltas],
+            "trace_overhead_ok": overhead < 5.0 + 2 * sem,
+            "summary": summ,
+            "latency_domains": (
+                inj.health_snapshot() if inj is not None else None
+            ),
+        }
+        # every quorum member besides the leader is far-class: each
+        # sampled commit must close on a far ack AND laggard the other
+        # far peer — per-peer attribution by latency class
+        peers = summ["peers"]
+        assert peers and all(d["cls"] == "B" for d in peers.values()), (
+            f"far quorum not labeled by latency class: {peers}"
+        )
+        laggard_total = sum(d["laggard"] for d in peers.values())
+        closer_total = sum(d["closer"] for d in peers.values())
+        assert closer_total > 0 and laggard_total > 0, (
+            f"attribution empty: closers {closer_total}, "
+            f"laggards {laggard_total} "
+            f"({summ['commits_attributed']} commits)"
+        )
+        # the quorum close pays the far round trip (lower bounds NOT
+        # load-scaled; pipelined sends coalesce onto shared far round
+        # trips, so p50 can undershoot the full RTT a little — p99 sees
+        # the uncoalesced close)
+        assert summ["close_ms"]["p99"] >= 2 * far_ms * 0.9, (
+            f"close p99 {summ['close_ms']} below the {2 * far_ms}ms "
+            "domain RTT — attribution is not seeing the far quorum"
+        )
+        assert summ["close_ms"]["p50"] >= far_ms, (
+            f"close p50 {summ['close_ms']} below the {far_ms}ms far "
+            "one-way leg"
+        )
+        shares = summ["close_stage_share_pct"]
+        wire = shares.get("wire_out", 0.0) + shares.get("wire_back", 0.0)
+        out["wire_share_pct"] = round(wire, 1)
+        assert wire >= 50.0, (
+            f"closing path not wire-dominated: {shares}"
+        )
+        assert overhead < 5.0 + 2 * sem, (
+            f"repl-trace overhead too high: {overhead}% "
+            f"(± {sem:.1f} SEM; {wps_on:.0f} vs {wps_off:.0f} w/s)"
+        )
+        out["attribution_ok"] = True
+        return out
+    finally:
+        for nh in nhs:
+            try:
+                nh.stop()
+            except Exception:
+                pass
 
 
 # ======================================================================

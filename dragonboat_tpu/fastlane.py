@@ -590,7 +590,9 @@ class FastLaneManager:
                     "group %d native eject: %s", cid, EV_NAMES.get(code, code)
                 )
                 self.count_eject(EV_NAMES.get(code, str(code)))
-                node.fast_eject(contact_lost=code in (1, 2))
+                node.fast_eject(
+                    contact_lost=code in (1, 2), reenroll_backoff=code == 13
+                )
                 continue
 
     def _read_pump(self) -> None:

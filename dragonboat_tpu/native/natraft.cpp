@@ -154,6 +154,12 @@ enum MsgType : uint64_t {
 };
 constexpr uint8_t kFlagSnapshot = 1;
 constexpr uint8_t kFlagReject = 2;
+// replication-trace trailer (wire/codec.py _MSG_HAS_TRACE, ISSUE 14):
+// the C readers never stamp or consume it, but a python peer without a
+// fast lane may attach it to a sampled REPLICATE — the parser must skip
+// the trailer (and keep it inside the forwarded span) or the next
+// message header in the batch desyncs.
+constexpr uint8_t kFlagReplTrace = 4;
 
 // logdb key schema (logdb/keys.py)
 enum KeyTag : uint8_t { TAG_STATE = 0x02, TAG_MAX_INDEX = 0x03, TAG_ENTRY = 0x05 };
@@ -337,6 +343,14 @@ static bool parse_message(const uint8_t* d, size_t len, size_t& pos, ParsedMsg& 
   }
   if (m.flags & kFlagSnapshot) {
     if (!skip_snapshot(d, len, pos)) return false;
+  }
+  if (m.flags & kFlagReplTrace) {
+    uint64_t v;
+    if (!get_uvarint(d, len, pos, v)) return false;  // tid
+    if (!skip_str(d, len, pos)) return false;        // origin
+    if (!get_uvarint(d, len, pos, v)) return false;  // index
+    if (pos + 48 > len) return false;  // 6 x f64 wall-clock stamps
+    pos += 48;
   }
   m.span_end = pos;
   return true;

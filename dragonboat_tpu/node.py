@@ -152,6 +152,12 @@ class Node:
         # pipeline stages stamp it as the request passes.  None (default)
         # keeps every request path bit-identical.
         self.tracer = None
+        # replication attribution plane (obs/replattr.py, ISSUE 14; set
+        # by NodeHost alongside the tracer): sampled proposals' REPLICATE
+        # fan-outs carry a ReplTrace context and the leader decomposes
+        # each commit's quorum close per peer.  None (default) keeps the
+        # send/ack paths bit-identical.
+        self.replattr = None
         # device-engine effect flags (written by the coordinator round
         # thread, max-merged/idempotent, applied under raftMu by
         # _apply_offload_effects on a step worker).  _off_mu guards the
@@ -228,6 +234,11 @@ class Node:
         lease_obs = getattr(self, "lease_obs", None)
         if lease_obs is not None and self.peer.raft.lease is not None:
             self.peer.raft.lease.obs = lease_obs
+        # replication attribution (ISSUE 14): the raft-level ack/commit
+        # hooks gate on `replattr is not None`, so trace-off hosts never
+        # touch the plane
+        if self.replattr is not None:
+            self.peer.raft.replattr = self.replattr
         # TPU quorum plugin (ExpertConfig.quorum_engine): stage hot-path
         # tallying to the device engine and register this group's row
         coord = getattr(self, "quorum_coordinator", None)
@@ -380,6 +391,11 @@ class Node:
         if self.fast_lane:
             return  # native core owns the group; flags are stale
         if commit_q and r.is_leader() and r.log.try_commit(commit_q, r.term):
+            # device-plane commits attribute too (ISSUE 14): the same
+            # close hook the scalar commit site runs, under raftMu with
+            # the current voter set — the coordinator already linked the
+            # releasing round's span seq via replattr.note_device_round
+            r._note_commit()
             r.broadcast_replicate_message()
         if (
             commit_q
@@ -1132,7 +1148,9 @@ class Node:
         if self.fastlane is not None:
             self.fastlane.count_eject(reason)
 
-    def fast_eject(self, contact_lost: bool = False) -> None:
+    def fast_eject(
+        self, contact_lost: bool = False, reenroll_backoff: bool = False
+    ) -> None:
         """Hand the group back from the native core to scalar raft.
 
         Rebuilds exactly the state the Python raft object would have had:
@@ -1232,18 +1250,34 @@ class Node:
             if r.is_leader():
                 for ctx in self.pending_reads.pending_ctxs():
                     self.peer.read_index(ctx)
-            if contact_lost:
+            if contact_lost or reenroll_backoff:
                 # the native clock already waited out the election window
                 # with zero leader contact — without this the group would
                 # re-enroll (leader_id still set, log quiescent), reset the
                 # native contact clock and ping-pong forever instead of
-                # ever campaigning
+                # ever campaigning.  reenroll_backoff ejects (commit-stall
+                # watchdog, inbound REQUEST_VOTE) need the same grace: on
+                # a netsplit follower the watchdog fires BEFORE the
+                # contact-loss eject (the readers_live gate defers contact
+                # loss while no bytes flow anywhere), and a peer's vote
+                # request is dropped by the §6 lease while the frozen
+                # election clock still reads "leader heard recently" — in
+                # both shapes an instant re-enroll resets every native
+                # liveness clock and the group ping-pongs forever with
+                # the election clock never running (the partition_tcp
+                # no-leader stall)
                 import time as _time
 
                 self._next_enroll_try = _time.monotonic() + 2.0 * (
                     2 * self.config.election_rtt * self.tick_millisecond
                 ) / 1000.0
-                if r.is_follower():
+                if contact_lost and r.is_follower():
+                    # zero leader contact is proven; scalar raft may
+                    # campaign immediately.  NOT on the backoff-only
+                    # shapes: the leader may be alive (flow-control
+                    # wedge), and the grace window alone lets the scalar
+                    # clock age past the vote-drop lease — heartbeats
+                    # keep resetting it if the leader is actually there
                     r.election_tick = r.randomized_election_timeout
         self.nh.engine.set_step_ready(self.cluster_id)
 
@@ -1409,6 +1443,16 @@ class Node:
     def send_replicate_messages(self, ud: Update) -> None:
         """Replicate messages go out BEFORE the fsync (thesis §10.2.1,
         reference ``execengine.go:954-961``)."""
+        ra = self.replattr
+        if ra is not None and self.fastlane is None:
+            # replication tracing (ISSUE 14): sampled proposals' fan-out
+            # messages get a per-peer ReplTrace context and open a
+            # commit record.  Gated off under the native fast lane —
+            # its C readers own the wire and do not speak the trace
+            # extension (enrolled groups bypass this path anyway).
+            tr = self.tracer
+            if tr is not None:
+                ra.attach_sends(self.cluster_id, ud.messages, tr)
         for m in ud.messages:
             if m.type == MT.REPLICATE:
                 self.nh.send_message(m)
@@ -1433,6 +1477,22 @@ class Node:
             if m.type == MT.INSTALL_SNAPSHOT:
                 self.nh.send_snapshot_message(m)
             else:
+                ctx = m.trace
+                if ctx is not None and ctx.t_append:
+                    # follower half of a sampled replication (ISSUE 14):
+                    # this loop runs AFTER the committer's fsync, so the
+                    # appended entries the ack covers are durable here —
+                    # stamp the fsync point and the ack hand-off, and
+                    # file the leg locally so this host's dump renders
+                    # the follower side of the flow
+                    now = time.time()
+                    if not ctx.t_fsync:
+                        ctx.t_fsync = now
+                    if not ctx.t_ack:
+                        ctx.t_ack = now
+                        tr = self.tracer
+                        if tr is not None:
+                            tr.add_repl_leg(ctx)
                 self.nh.send_message(m)
         if ud.ready_to_reads:
             self.pending_reads.add_ready(ud.ready_to_reads)

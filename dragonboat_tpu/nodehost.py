@@ -189,6 +189,10 @@ class NodeHost:
             snapshot_dir_fn=self.snapshot_dir,
             sys_events=self.sys_events,
             snapshot_received_handler=self._snapshot_received,
+            # the dragonboat_transport_* families land in THIS host's
+            # registry (ISSUE 14 satellite) so the /metrics endpoint and
+            # write_health_metrics actually expose them
+            metrics_registry=self.raft_events.registry,
         )
         self.logdb.on_compaction = lambda cid, nid: self.sys_events.publish(
             SystemEvent(
@@ -337,6 +341,7 @@ class NodeHost:
         # nothing below is constructed and every request path keeps its
         # bit-identical trace=None latch.
         self.tracer = None
+        self.replattr = None
         trace_n = nhconfig.trace_sample_every
         if not trace_n:
             try:
@@ -357,8 +362,39 @@ class NodeHost:
                     if self.quorum_coordinator is not None else None
                 ),
             )
+            self.tracer.host = nhconfig.raft_address
+            # replication attribution (obs/replattr.py, ISSUE 14): the
+            # cross-host half of the tracer — sampled proposals carry a
+            # ReplTrace over the wire and each commit's quorum close is
+            # decomposed per peer.  Lives and dies with the tracer; peer
+            # rows label by latency class when an injector is installed
+            # (transport.latency, read dynamically — monkey.set_latency
+            # may arrive after construction).
+            from .obs.replattr import ReplAttr
+
+            self.replattr = ReplAttr(
+                host=nhconfig.raft_address,
+                registry=self.raft_events.registry,
+                recorder=(
+                    self.quorum_coordinator.flight_recorder
+                    if self.quorum_coordinator is not None else None
+                ),
+            )
+            self.replattr.resolver = self.node_registry.resolve
+
+            def _peer_class(addr: str):
+                inj = self.transport.latency
+                if inj is not None:
+                    domain_of = getattr(inj, "domain_of", None)
+                    if domain_of is not None:
+                        return domain_of(addr)
+                return None
+
+            self.replattr.class_of = _peer_class
+            self.tracer.replattr = self.replattr
             if self.quorum_coordinator is not None:
                 self.quorum_coordinator.tracer = self.tracer
+                self.quorum_coordinator.replattr = self.replattr
         # cluster health plane (obs/health.py, ISSUE 13): low-rate
         # per-group/host health sampling + anomaly detectors + the live
         # scrape endpoint.  OFF by default (health_sample_ms=0 and no
@@ -556,6 +592,10 @@ class NodeHost:
             ),
             "traces": (
                 self.tracer.to_json() if self.tracer is not None else None
+            ),
+            "replattr": (
+                self.replattr.summary()
+                if self.replattr is not None else None
             ),
             "health": (
                 self.health.to_json(limit=64)
@@ -804,6 +844,7 @@ class NodeHost:
         if self.tracer is not None:
             node.tracer = self.tracer
             node.pending_reads._tracer = self.tracer
+            node.replattr = self.replattr
         node.start(addresses, initial=not join and new_node, new_node=new_node)
         with self._mu:
             self._clusters[cluster_id] = node
@@ -1264,6 +1305,16 @@ class NodeHost:
         touched = {}
         src = batch.source_address
         for m in batch.requests:
+            ctx = m.trace
+            if ctx is not None:
+                # replication tracing (ISSUE 14): inbound stamp in THIS
+                # host's clock.  First touch is the follower's
+                # ``repl_recv``; the same context echoed back on the ack
+                # lands here again on the leader as the ack-receive.
+                if not ctx.t_recv:
+                    ctx.t_recv = time.time()
+                elif not ctx.t_ack_recv:
+                    ctx.t_ack_recv = time.time()
             if m.type == MessageType.SNAPSHOT_RECEIVED:
                 # follower's ack for a sent snapshot: accelerates the
                 # parked status release; never delivered to raft
@@ -1303,7 +1354,18 @@ class NodeHost:
                     continue
                 if self.fastlane is not None:
                     self.fastlane.count_eject(f"router:{m.type.name}")
-                node.fast_eject()
+                # a REQUEST_VOTE reaching an enrolled follower means an
+                # election is in progress (a netsplit peer campaigning).
+                # Without the re-enroll backoff the group re-enrolls
+                # within one step — before the scalar election clock ages
+                # past the §6 vote-drop lease (frozen while enrolled, and
+                # leader_id is still the stale pre-split leader) — so the
+                # vote is dropped and every native liveness clock resets:
+                # the candidate's own retries keep the group enrolled
+                # forever (the partition_tcp no-leader stall)
+                node.fast_eject(
+                    reenroll_backoff=m.type is MessageType.REQUEST_VOTE
+                )
             if node.enqueue_message(m):
                 touched[m.cluster_id] = None
         engine = self.engine
@@ -1386,6 +1448,12 @@ class NodeHost:
                 # trace + the recorder ring.  Fast path (nothing sampled
                 # in flight) is two dict truthiness checks per RTT.
                 tracer.check_stalls()
+            replattr = self.replattr
+            if replattr is not None:
+                # expire commit records that will never close (dropped
+                # proposals, lost quorums).  Fast path (no open records)
+                # is one dict truthiness check per RTT.
+                replattr.sweep()
             health = self.health
             if health is not None:
                 # cluster health plane (ISSUE 13): one low-rate sample

@@ -64,6 +64,7 @@ _HOST = "dragonboat_host_"
 _HPROC = "dragonboat_hostproc_"
 _DEVSM = "dragonboat_devsm_"
 _HEALTH = "dragonboat_health_"
+_REPL = "dragonboat_repl_"
 
 #: recovery-duration buckets (seconds): a worker respawn lands near the
 #: bottom, a failover around election timeouts, a wedged rebind loop or
@@ -146,6 +147,25 @@ _HELP = {
     _HEALTH + "recovery_seconds": "open-to-close durations per detector "
     "(leader_flap = failover, worker_flap = worker respawn, "
     "devsm_rebind = device rebind — the recovery-time attribution)",
+    # replication attribution (obs/replattr.py, ISSUE 14)
+    _REPL + "ack_rtt_seconds": "sampled replication send-to-ack round "
+    "trip per peer (leader clock), labeled by latency class",
+    _REPL + "stage_seconds": "quorum-closing path's stage decomposition "
+    "(wire_out / follower_append / follower_fsync / ack_send / "
+    "wire_back), clock-offset corrected so stages sum to the RTT",
+    _REPL + "quorum_close_seconds": "replicate fan-out to quorum close "
+    "per sampled commit (the kth voter's ack, try_commit's own "
+    "kth_largest rule)",
+    _REPL + "quorum_closer_total": "sampled commits whose quorum this "
+    "peer's ack closed, by peer and latency class",
+    _REPL + "laggard_total": "sampled commits this peer had NOT acked "
+    "when the quorum closed, by peer and latency class",
+    _REPL + "commits_attributed_total": "sampled commits closed with a "
+    "full attribution record",
+    _REPL + "records_dropped_total": "attribution records dropped "
+    "before closing (term change, transition reset, overflow, expiry)",
+    _REPL + "clock_offset_ms": "latest NTP-style ack-pair clock-offset "
+    "estimate per peer (follower minus leader milliseconds)",
 }
 
 

@@ -1,0 +1,700 @@
+"""Per-commit replication attribution: which peer's ack closed the
+quorum, and where its round trip went (ISSUE 14 tentpole).
+
+The request tracer (obs/trace.py) prices every stage *inside* one
+NodeHost — its chain jumps from ``raft_step`` straight to ``wal`` /
+``device_round``, so the replication leg (leader send → wire → follower
+append+fsync → ack → quorum close) that costs a full far-domain RTT per
+commit was a black box.  This module is the leader-side half of the
+cross-host tracer: for every **sampled** proposal (the tracer's 1-in-N)
+it opens a commit record when the REPLICATE fan-out goes out, folds in
+each peer's ack (with the follower's stage stamps riding back on the
+REPLICATE_RESP's :class:`~dragonboat_tpu.wire.ReplTrace` context), and
+closes the record when the commit watermark passes the proposal —
+computing
+
+- **per-peer ack RTT** (``t_ack_recv - t_send``, both leader-clock);
+- **the quorum-closing ack**: commit advances when the *quorum-th*
+  voter's match covers the index — the same ``kth_largest(match,
+  quorum)`` reduction ``raft.try_commit`` (and the batched
+  ``kernels.commit_quorum``) runs — so sorting the voters' ack times
+  ascending and taking the quorum-th smallest names the peer whose ack
+  closed the commit (the leader self-acks at send time: its own match
+  already covers the index when the fan-out leaves, exactly how
+  ``try_commit`` counts it);
+- **laggard identity**: voters that had not acked when the quorum
+  closed (the peers a domain-local sub-quorum — ROADMAP item 4 — would
+  take off the commit path);
+- **the closing path's stage decomposition**: wire-out, follower
+  append, follower fsync, ack-send and wire-back, reconciled across the
+  two hosts' clocks with the NTP-style ack-pair estimate
+  ``offset = ((t_recv - t_send) + (t_ack - t_ack_recv)) / 2`` — the
+  five deltas then sum to the measured RTT *exactly* (the estimate's
+  residual error is the wire asymmetry, the classic NTP caveat,
+  documented in docs/overview.md).
+
+Everything publishes as ``dragonboat_repl_*`` families (per-peer ack
+RTT histograms, quorum-close latency, closer/laggard counters with a
+latency-class label from ``LatencyInjector.health_snapshot``), as
+``repl_commit`` flight-recorder spans, and as a ``repl`` summary on the
+sampled request's Trace (rendered by ``NodeHost.dump_trace`` and joined
+across hosts by ``tools/trace_merge.py``).
+
+Overhead contract (the ``trace=None`` latch precedent): the plane only
+exists while tracing is on — ``Raft.replattr`` / ``Node.replattr`` stay
+``None`` otherwise and every hook gates on a plain attribute check, so
+the trace-off request paths are structurally bit-identical.  Records
+are term-pinned: any leadership transition (``Raft.reset``) drops the
+group's open records, so a mid-trace transfer can never attribute one
+term's acks to another's commit (tests/test_repltrace.py).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..events import DEFAULT_REGISTRY, MetricsRegistry
+from ..logger import get_logger
+
+plog = get_logger("replattr")
+
+_R = "dragonboat_repl_"
+
+#: seconds-scale buckets shared with the request tracer's stage
+#: histograms (trace.STAGE_BUCKETS_S) — one import direction only, the
+#: tracer never imports this module
+from .trace import STAGE_BUCKETS_S  # noqa: E402
+
+#: the closing path's stage vocabulary, in pipeline order
+STAGES = (
+    "wire_out", "follower_append", "follower_fsync", "ack_send",
+    "wire_back",
+)
+
+
+class _Record:
+    """One sampled proposal's in-flight commit record on the leader."""
+
+    __slots__ = (
+        "cid", "index", "term", "tid", "trace", "t0",
+        "sends", "acks", "span_seq", "closed", "expect", "t_closed",
+        "voters",
+    )
+
+    def __init__(self, cid: int, index: int, term: int, tid: int,
+                 trace, t0: float):
+        self.cid = cid
+        self.index = index
+        self.term = term
+        self.tid = tid
+        self.trace = trace          # the sampled obs.trace.Trace (or None)
+        self.t0 = t0                # leader wall clock at fan-out
+        self.sends: Dict[int, float] = {}        # peer -> t_send
+        self.acks: Dict[int, Tuple[float, object]] = {}  # peer -> (t, ctx)
+        self.span_seq: Optional[int] = None      # device round linkage
+        self.closed: Optional[dict] = None
+        self.expect = 0             # non-self voters at close (straggler GC)
+        self.t_closed = 0.0
+        self.voters: frozenset = frozenset()     # voter set at close
+
+    def voter_acks(self) -> int:
+        """Acked VOTERS — observers/witnesses also ack sampled
+        replications, so a raw len(acks) would end the straggler window
+        before the lagging voter reported."""
+        return sum(1 for p in self.acks if p in self.voters)
+
+
+def _decompose(ctx, t_send: float, t_ack_recv: float):
+    """Offset-corrected stage deltas for one full-context ack; returns
+    ``(stages_dict, offset_seconds)`` or ``(None, None)`` when the
+    follower stamps are incomplete (witness metadata leg, reject)."""
+    if ctx is None or not (ctx.t_recv and ctx.t_append and ctx.t_ack):
+        return None, None
+    # pair against the stamp that actually rode the acked leg: a
+    # retransmit/catch-up resend re-attaches a fresh context with its
+    # own t_send, while the caller's record keeps the FIRST send (the
+    # commit-relevant RTT) — offsetting against the first send would
+    # absorb half the retransmit gap into the clock-offset estimate
+    # and inflate wire_out by the whole gap
+    if ctx.t_send:
+        t_send = ctx.t_send
+    t_fsync = ctx.t_fsync or ctx.t_append
+    # NTP-style pairing: both legs measured, half the asymmetry each way
+    off = ((ctx.t_recv - t_send) + (ctx.t_ack - t_ack_recv)) / 2.0
+    stages = {
+        "wire_out": (ctx.t_recv - off) - t_send,
+        "follower_append": ctx.t_append - ctx.t_recv,
+        "follower_fsync": t_fsync - ctx.t_append,
+        "ack_send": ctx.t_ack - t_fsync,
+        "wire_back": t_ack_recv - (ctx.t_ack - off),
+    }
+    return stages, off
+
+
+class ReplAttr:
+    """Leader-side replication attribution plane (one per NodeHost,
+    constructed only when tracing is on)."""
+
+    def __init__(
+        self,
+        host: str = "",
+        registry: Optional[MetricsRegistry] = None,
+        recorder=None,
+        keep: int = 256,
+        max_inflight: int = 512,
+        expire_s: float = 60.0,
+    ):
+        self.host = host
+        self.registry = registry or DEFAULT_REGISTRY
+        self.recorder = recorder
+        self.keep = keep
+        self.max_inflight = max_inflight
+        self.expire_s = expire_s
+        self._mu = threading.Lock()
+        self._by_cid: Dict[int, Dict[int, _Record]] = {}  # cid -> idx -> rec
+        self._inflight = 0
+        self._done: deque = deque(maxlen=max(1, keep))
+        # per-peer-address clock-offset EWMA (follower_clock - leader_clock)
+        self._offsets: Dict[str, float] = {}
+        # bounded per-peer RTT samples for the bench/introspection table
+        self._rtts: Dict[Tuple[int, int], deque] = {}
+        self._closer: Dict[Tuple[int, int], int] = {}
+        self._laggard: Dict[Tuple[int, int], int] = {}
+        # wiring (NodeHost): peer (cid, nid) -> transport address, and
+        # address -> latency class/domain label
+        self.resolver: Optional[Callable[[int, int], Optional[str]]] = None
+        self.class_of: Optional[Callable[[str], Optional[str]]] = None
+        self.commits_attributed = 0
+        self.records_dropped = 0
+        r = self.registry
+        from .instruments import _describe
+
+        _describe(r, (
+            _R + "ack_rtt_seconds", _R + "stage_seconds",
+            _R + "quorum_close_seconds", _R + "quorum_closer_total",
+            _R + "laggard_total", _R + "commits_attributed_total",
+            _R + "records_dropped_total", _R + "clock_offset_ms",
+        ))
+        r.counter_add(_R + "commits_attributed_total", 0)
+        r.histogram_declare(_R + "ack_rtt_seconds", buckets=STAGE_BUCKETS_S)
+        r.histogram_declare(_R + "stage_seconds", buckets=STAGE_BUCKETS_S)
+        r.histogram_declare(
+            _R + "quorum_close_seconds", buckets=STAGE_BUCKETS_S
+        )
+
+    # ------------------------------------------------------------------
+    # peer labels
+    # ------------------------------------------------------------------
+
+    def _addr(self, cid: int, peer: int) -> Optional[str]:
+        res = self.resolver
+        if res is None:
+            return None
+        try:
+            return res(cid, peer)
+        except Exception:
+            return None
+
+    def _labels(self, cid: int, peer: int) -> Dict[str, str]:
+        addr = self._addr(cid, peer)
+        cls = None
+        if addr is not None and self.class_of is not None:
+            try:
+                cls = self.class_of(addr)
+            except Exception:
+                cls = None
+        return {"peer": str(peer), "cls": cls or "unknown"}
+
+    # ------------------------------------------------------------------
+    # leader hooks (node/raft; every call site gates on `is not None`)
+    # ------------------------------------------------------------------
+
+    def attach_sends(self, cid: int, msgs, tracer) -> None:
+        """Scan an update's outbound messages for REPLICATEs carrying
+        sampled entries: attach one fresh :class:`ReplTrace` context per
+        message (per peer — the contexts are stamped concurrently by
+        different followers) and open/extend the per-index commit
+        records.  Called from ``Node.send_replicate_messages`` before
+        the fan-out leaves, under the step worker."""
+        from ..wire import MessageType, ReplTrace
+
+        by_key = tracer._by_key
+        open_recs = self._by_cid.get(cid)
+        if not by_key and not open_recs:
+            return
+        now = time.time()
+        staged = []   # sampled-entry sends: (msg, trace, index)
+        resends = []  # sends with no live sampled trace, for record catch-up
+        for m in msgs:
+            if m.type != MessageType.REPLICATE or not m.entries:
+                continue
+            best = None
+            best_index = 0
+            for e in m.entries:
+                t = by_key.get(e.key)
+                if t is not None and not t.done and e.index >= best_index:
+                    best = t
+                    best_index = e.index
+            if best is not None:
+                m.trace = ReplTrace(
+                    tid=best.tid, origin=self.host, index=best_index,
+                    t_send=now,
+                )
+                staged.append((m, best, best_index))
+            elif open_recs:
+                resends.append(m)
+        if not staged and not resends:
+            return
+        with self._mu:
+            recs = self._by_cid.setdefault(cid, {})
+            for m, tr, index in staged:
+                rec = recs.get(index)
+                if rec is None:
+                    if self._inflight >= self.max_inflight:
+                        self._drop_locked(reason="overflow", n=1)
+                        continue
+                    rec = _Record(cid, index, m.term, tr.tid, tr, now)
+                    recs[index] = rec
+                    self._inflight += 1
+                rec.sends.setdefault(m.to, now)
+            for m in resends:
+                # a lagging/paused peer's catch-up REPLICATE can carry a
+                # sampled index whose trace already completed (the
+                # leader committed on the fast peers long ago).  The
+                # record is still open waiting on THIS peer — stamp its
+                # send time so the late ack still prices the peer's RTT,
+                # and re-attach the newest covered record's context so
+                # the follower's stage stamps ride back too.
+                lo = m.entries[0].index
+                hi = m.entries[-1].index
+                covered = None
+                for index, rec in recs.items():
+                    if lo <= index <= hi and m.to not in rec.sends:
+                        rec.sends[m.to] = now
+                        if covered is None or index > covered.index:
+                            covered = rec
+                if covered is not None and m.trace is None:
+                    m.trace = ReplTrace(
+                        tid=covered.tid, origin=self.host,
+                        index=covered.index, t_send=now,
+                    )
+            if not recs:
+                self._by_cid.pop(cid, None)
+
+    def on_ack(self, cid: int, peer: int, match: int, term: int,
+               ctx=None) -> None:
+        """A REPLICATE_RESP advanced ``peer``'s match: fold the ack (and
+        its follower stage stamps, when the context rode back) into
+        every open record it covers.  Called from
+        ``raft.handle_leader_replicate_resp`` under raftMu, BEFORE the
+        commit advancement that may close the record."""
+        recs = self._by_cid.get(cid)
+        if not recs:
+            return
+        now = time.time()
+        t_ack_recv = (
+            ctx.t_ack_recv if ctx is not None and ctx.t_ack_recv else now
+        )
+        publish: List[Tuple[dict, float]] = []
+        offset_label = None
+        with self._mu:
+            for index in [i for i in recs if i <= match]:
+                rec = recs[index]
+                if rec.term != term:
+                    self._expire_locked(rec, reason="term")
+                    continue
+                if peer in rec.acks:
+                    continue
+                use_ctx = (
+                    ctx if ctx is not None and ctx.index == rec.index
+                    else None
+                )
+                rec.acks[peer] = (t_ack_recv, use_ctx)
+                t_send = rec.sends.get(peer)
+                if t_send is not None:
+                    rtt = max(0.0, t_ack_recv - t_send)
+                    labels = self._labels(cid, peer)
+                    publish.append((labels, rtt))
+                    self._rtts.setdefault(
+                        (cid, peer), deque(maxlen=512)
+                    ).append(rtt)
+                    if rec.closed is not None:
+                        # straggler window: the record already closed —
+                        # this peer was its laggard; enrich the summary
+                        # (and the sampled trace's repl table, the same
+                        # dict) with the late ack's measured RTT.
+                        # Copy-on-write: the summary is already published
+                        # (Trace.repl / the _done ring) and a concurrent
+                        # dump may be iterating "peers" — swap in a new
+                        # dict instead of mutating the visible one
+                        peers = dict(rec.closed["peers"])
+                        peers[str(peer)] = {
+                            "t_send": t_send,
+                            "rtt_ms": round(rtt * 1e3, 4),
+                            "cls": labels["cls"],
+                            "addr": self._addr(cid, peer),
+                            "acked": True,
+                            "after_close_ms": round(
+                                max(0.0, t_ack_recv - rec.t_closed) * 1e3,
+                                4,
+                            ),
+                        }
+                        rec.closed["peers"] = peers
+                    if use_ctx is not None:
+                        _stages, off = _decompose(
+                            use_ctx, t_send, t_ack_recv
+                        )
+                        if off is not None:
+                            addr = self._addr(cid, peer)
+                            if addr is not None:
+                                prev = self._offsets.get(addr)
+                                self._offsets[addr] = (
+                                    off if prev is None
+                                    else prev * 0.8 + off * 0.2
+                                )
+                                offset_label = (labels["peer"], off)
+                if (
+                    rec.closed is not None
+                    and rec.voter_acks() >= rec.expect
+                ):
+                    # every voter has now acked: the straggler window is
+                    # over, drop the retained record
+                    del recs[index]
+                    self._inflight -= 1
+            if not recs:
+                self._by_cid.pop(cid, None)
+        r = self.registry
+        for labels, rtt in publish:
+            r.histogram_observe(
+                _R + "ack_rtt_seconds", rtt, labels=labels,
+                buckets=STAGE_BUCKETS_S,
+            )
+        if offset_label is not None:
+            r.gauge_set(
+                _R + "clock_offset_ms", offset_label[1] * 1e3,
+                labels={"peer": offset_label[0]},
+            )
+
+    def note_device_round(self, cid: int, span_seq: Optional[int]) -> None:
+        """Device-plane linkage (tpuquorum): the staged-round ack block
+        whose dispatch released this group's commit — the closed record
+        then names the same recorder span the request trace links."""
+        recs = self._by_cid.get(cid)
+        if not recs or span_seq is None:
+            return
+        with self._mu:
+            for rec in recs.values():
+                rec.span_seq = span_seq
+
+    def on_commit(self, cid: int, committed: int, term: int, voters,
+                  quorum: int, self_id: int) -> None:
+        """The group's commit watermark advanced: close every open
+        record it covers and publish the quorum attribution.  Called
+        under raftMu from the scalar commit site
+        (``raft._note_commit``) and the device-plane apply
+        (``node._apply_offload_effects``), so the voter set and quorum
+        are read at exactly the commit's membership."""
+        recs = self._by_cid.get(cid)
+        if not recs:
+            return
+        now = time.time()
+        voter_set = set(voters)
+        closed: List[_Record] = []
+        with self._mu:
+            for index in [i for i in recs if i <= committed]:
+                rec = recs[index]
+                if rec.closed is not None:
+                    continue  # already closed, riding its straggler window
+                if rec.term != term:
+                    del recs[index]
+                    self._inflight -= 1
+                    self._drop_locked(reason="term", n=1)
+                    continue
+                # mark closed under the lock; stay registered so late
+                # (laggard) acks still fold their RTT into the summary
+                rec.t_closed = now
+                rec.voters = frozenset(voter_set)
+                rec.expect = sum(1 for p in voter_set if p != self_id)
+                closed.append(rec)
+        for rec in closed:
+            self._close(rec, now, voter_set, quorum, self_id)
+        if closed:
+            with self._mu:
+                for rec in closed:
+                    if (
+                        rec.voter_acks() >= rec.expect
+                        and recs.get(rec.index) is rec
+                    ):
+                        del recs[rec.index]
+                        self._inflight -= 1
+                if not recs:
+                    self._by_cid.pop(cid, None)
+
+    def _close(self, rec: _Record, now: float, voters, quorum: int,
+               self_id: int) -> None:
+        # ack times per voter: the leader counts at fan-out time (its own
+        # match already covered the index when the REPLICATE left — the
+        # same way try_commit's kth_largest counts it)
+        times = [(rec.t0, self_id)]
+        for peer, (t, _ctx) in rec.acks.items():
+            if peer in voters:
+                times.append((t, peer))
+        times.sort()
+        closer = None
+        t_close = None
+        if len(times) >= quorum:
+            t_close, closer = times[quorum - 1]
+        laggards = sorted(
+            p for p in voters
+            if p != self_id and p not in rec.acks
+        )
+        close_s = (
+            max(0.0, t_close - rec.t0) if t_close is not None else None
+        )
+        stages = None
+        offset = None
+        if closer is not None and closer != self_id:
+            t_ack_recv, ctx = rec.acks[closer]
+            stages, offset = _decompose(
+                ctx, rec.sends.get(closer, rec.t0), t_ack_recv
+            )
+        r = self.registry
+        self.commits_attributed += 1
+        r.counter_add(_R + "commits_attributed_total")
+        if close_s is not None:
+            r.histogram_observe(
+                _R + "quorum_close_seconds", close_s,
+                buckets=STAGE_BUCKETS_S,
+            )
+        if closer is not None:
+            labels = self._labels(rec.cid, closer)
+            r.counter_add(_R + "quorum_closer_total", labels=labels)
+            with self._mu:
+                k = (rec.cid, closer)
+                self._closer[k] = self._closer.get(k, 0) + 1
+        for p in laggards:
+            labels = self._labels(rec.cid, p)
+            r.counter_add(_R + "laggard_total", labels=labels)
+            with self._mu:
+                k = (rec.cid, p)
+                self._laggard[k] = self._laggard.get(k, 0) + 1
+        if stages is not None:
+            for stage, v in stages.items():
+                r.histogram_observe(
+                    _R + "stage_seconds", max(0.0, v),
+                    labels={"stage": stage}, buckets=STAGE_BUCKETS_S,
+                )
+        summary = {
+            "tid": rec.tid,
+            "cluster_id": rec.cid,
+            "index": rec.index,
+            "term": rec.term,
+            "origin": self.host,
+            "quorum": quorum,
+            "close_ms": (
+                round(close_s * 1e3, 4) if close_s is not None else None
+            ),
+            "closer": closer,
+            "laggards": laggards,
+            "span_seq": rec.span_seq,
+            "offset_ms": (
+                round(offset * 1e3, 4) if offset is not None else None
+            ),
+            "stages_ms": (
+                {k: round(v * 1e3, 4) for k, v in stages.items()}
+                if stages is not None else None
+            ),
+            "peers": {
+                str(peer): {
+                    "t_send": rec.sends.get(peer),
+                    "rtt_ms": (
+                        round((t - rec.sends[peer]) * 1e3, 4)
+                        if peer in rec.sends else None
+                    ),
+                    "cls": self._labels(rec.cid, peer)["cls"],
+                    "addr": self._addr(rec.cid, peer),
+                    "acked": True,
+                }
+                for peer, (t, _c) in rec.acks.items()
+            },
+        }
+        for p in laggards:
+            summary["peers"].setdefault(
+                str(p),
+                {
+                    "t_send": rec.sends.get(p),
+                    "rtt_ms": None,
+                    "cls": self._labels(rec.cid, p)["cls"],
+                    "addr": self._addr(rec.cid, p),
+                    "acked": False,
+                },
+            )
+        rec.closed = summary
+        with self._mu:
+            self._done.append(rec)
+        tr = rec.trace
+        if tr is not None and not tr.done:
+            # the quorum-close point lands in the sampled trace's stage
+            # chain (rendered between wal and apply in the export) and
+            # the per-peer table rides the trace into dump_trace
+            tr.add("repl_quorum")
+            tr.repl = summary
+        elif tr is not None:
+            tr.repl = summary
+        if self.recorder is not None:
+            self.recorder.record(
+                "repl_commit",
+                cluster_id=rec.cid,
+                index=rec.index,
+                tid=rec.tid,
+                close_ms=summary["close_ms"],
+                closer=closer,
+                laggards=len(laggards),
+                span_seq=rec.span_seq,
+            )
+
+    # ------------------------------------------------------------------
+    # invalidation
+    # ------------------------------------------------------------------
+
+    def on_reset(self, cid: int) -> None:
+        """Leadership transition (``raft.reset``): the quorum these
+        records were tallied against is gone — drop them rather than
+        attribute a stale term's acks to a later commit.  (Closed
+        records riding their straggler window just end early; only
+        records that never attributed count as dropped.)"""
+        with self._mu:
+            recs = self._by_cid.pop(cid, None)
+            if recs:
+                self._inflight -= len(recs)
+                open_n = sum(1 for r in recs.values() if r.closed is None)
+                if open_n:
+                    self._drop_locked(reason="reset", n=open_n)
+
+    def _expire_locked(self, rec: _Record, reason: str) -> None:
+        recs = self._by_cid.get(rec.cid)
+        if recs is not None and recs.get(rec.index) is rec:
+            del recs[rec.index]
+            self._inflight -= 1
+        self._drop_locked(reason=reason, n=1)
+
+    def _drop_locked(self, reason: str, n: int) -> None:
+        self.records_dropped += n
+        self.registry.counter_add(
+            _R + "records_dropped_total", n, labels={"reason": reason}
+        )
+
+    def sweep(self) -> int:
+        """Expire records that never committed (dropped proposals, lost
+        quorums) — driven by the NodeHost tick worker next to the
+        tracer's stall check.  Returns expired count."""
+        if not self._by_cid:
+            return 0
+        now = time.time()
+        n = 0
+        with self._mu:
+            for cid in list(self._by_cid):
+                recs = self._by_cid[cid]
+                for index in list(recs):
+                    rec = recs[index]
+                    if rec.closed is not None:
+                        # attributed; the straggler window ends after 5s
+                        # even if a laggard never acks (partition)
+                        if now - rec.t_closed > 5.0:
+                            del recs[index]
+                            self._inflight -= 1
+                    elif now - rec.t0 > self.expire_s:
+                        del recs[index]
+                        self._inflight -= 1
+                        n += 1
+                if not recs:
+                    del self._by_cid[cid]
+            if n:
+                self._drop_locked(reason="expired", n=n)
+        return n
+
+    # ------------------------------------------------------------------
+    # introspection (bench / tests / dump)
+    # ------------------------------------------------------------------
+
+    def offsets(self) -> Dict[str, float]:
+        """Per-peer-address clock-offset estimates (seconds; follower
+        minus leader) — ``tools/trace_merge.py`` shifts follower dumps
+        onto the leader's clock with these."""
+        with self._mu:
+            return dict(self._offsets)
+
+    def records(self) -> List[dict]:
+        """Closed attribution records, oldest→newest."""
+        with self._mu:
+            return [r.closed for r in self._done if r.closed]
+
+    def summary(self) -> dict:
+        """Aggregate table for the bench/perf-ledger: per (cid, peer)
+        ack RTT percentiles plus closer/laggard tallies, and the
+        aggregate close-stage shares over the closed ring."""
+
+        def pct(vals, q):
+            vals = sorted(vals)
+            i = min(
+                len(vals) - 1,
+                max(0, int(round(q / 100.0 * (len(vals) - 1)))),
+            )
+            return vals[i]
+
+        with self._mu:
+            rtts = {k: list(v) for k, v in self._rtts.items()}
+            closer = dict(self._closer)
+            laggard = dict(self._laggard)
+            done = [r.closed for r in self._done if r.closed]
+        peers: Dict[str, dict] = {}
+
+        def row(cid, peer):
+            return peers.setdefault(
+                str(peer),
+                {
+                    "acks": 0, "rtt_p50_ms": None, "rtt_p99_ms": None,
+                    "closer": 0, "laggard": 0,
+                    "cls": self._labels(cid, peer)["cls"],
+                },
+            )
+
+        for (cid, peer), vals in rtts.items():
+            d = row(cid, peer)
+            d["acks"] += len(vals)
+            if vals:
+                d["rtt_p50_ms"] = round(pct(vals, 50) * 1e3, 3)
+                d["rtt_p99_ms"] = round(pct(vals, 99) * 1e3, 3)
+        for (cid, peer), n in closer.items():
+            row(cid, peer)["closer"] += n
+        for (cid, peer), n in laggard.items():
+            row(cid, peer)["laggard"] += n
+        stage_sums: Dict[str, float] = {}
+        closes = []
+        for rec in done:
+            if rec.get("close_ms") is not None:
+                closes.append(rec["close_ms"])
+            st = rec.get("stages_ms")
+            if st:
+                for k, v in st.items():
+                    stage_sums[k] = stage_sums.get(k, 0.0) + max(0.0, v)
+        total = sum(stage_sums.values()) or 1.0
+        return {
+            "commits_attributed": self.commits_attributed,
+            "records_dropped": self.records_dropped,
+            "peers": peers,
+            "close_ms": {
+                "p50": round(pct(closes, 50), 3) if closes else None,
+                "p99": round(pct(closes, 99), 3) if closes else None,
+                "n": len(closes),
+            },
+            "close_stage_share_pct": {
+                k: round(v / total * 100.0, 1)
+                for k, v in sorted(stage_sums.items())
+            },
+            "clock_offsets_ms": {
+                a: round(o * 1e3, 4) for a, o in self.offsets().items()
+            },
+        }

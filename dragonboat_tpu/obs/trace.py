@@ -112,7 +112,7 @@ class Trace:
     __slots__ = (
         "tracer", "tid", "kind", "cluster_id", "key", "t0",
         "events", "spans", "outcome", "stalled", "done",
-        "applied", "_round_ev",
+        "applied", "_round_ev", "repl",
     )
 
     def __init__(self, tracer: "Tracer", tid: int, kind: str,
@@ -130,6 +130,10 @@ class Trace:
         self.done = False
         self.applied = False       # an "apply" stamp landed
         self._round_ev = None      # cached device_round event (replace)
+        # per-commit quorum attribution summary (obs/replattr.py,
+        # ISSUE 14): set by the leader's ReplAttr when the commit
+        # covering this proposal closes — None until then / off-plane
+        self.repl: Optional[dict] = None
 
     def add(self, stage: str) -> None:
         self.events.append([stage, time.perf_counter(), _tname()])
@@ -175,6 +179,7 @@ class Trace:
             "stalled": self.stalled,
             "done": self.done,
             "spans": list(self.spans),
+            "repl": self.repl,
             "events": [
                 {
                     "stage": s,
@@ -229,6 +234,16 @@ class Tracer:
         self.discarded = 0  # contexts whose submission was rejected
         self.stall_dumps = 0
         self.last_stall_dump: Optional[dict] = None
+        # ---- replication tracing (ISSUE 14) --------------------------
+        # this host's raft address (dump/merge identity) and the
+        # leader-side attribution plane — both wired by NodeHost
+        self.host = ""
+        self.replattr = None
+        # follower-leg records: a sampled REPLICATE from ANOTHER host's
+        # leader stamped its stages here; the ack-send hook files the
+        # completed leg so dump_trace renders the follower half of the
+        # flow and tools/trace_merge.py can join it to the leader's
+        self._repl_legs: deque = deque(maxlen=max(16, keep))
         # ---- local metric accumulators (hot-path cost control) -------
         # The propose/notify paths run at full request rate; a registry
         # histogram observe per completion (lock + label-key build)
@@ -414,6 +429,33 @@ class Tracer:
             for cid in cids:
                 for t in get(cid, ()):
                     t.add_round(span_seq, now, thread)
+
+    # ------------------------------------------------------------------
+    # replication legs (ISSUE 14, follower side)
+    # ------------------------------------------------------------------
+
+    def add_repl_leg(self, ctx) -> None:
+        """File one completed follower leg of a sampled replication (the
+        inbound REPLICATE's :class:`~dragonboat_tpu.wire.ReplTrace`
+        stamps, recorded when the ack leaves this host).  The leg
+        renders as ``follower_append`` / ``follower_fsync`` /
+        ``ack_send`` slices in this host's Perfetto dump, carrying the
+        LEADER's trace id + origin so ``tools/trace_merge.py`` can bind
+        it into the leader's flow."""
+        with self._mu:
+            self._repl_legs.append({
+                "tid": ctx.tid,
+                "origin": ctx.origin,
+                "index": ctx.index,
+                "t_recv": ctx.t_recv,
+                "t_append": ctx.t_append,
+                "t_fsync": ctx.t_fsync,
+                "t_ack": ctx.t_ack,
+            })
+
+    def repl_legs(self) -> List[dict]:
+        with self._mu:
+            return list(self._repl_legs)
 
     # ------------------------------------------------------------------
     # completion
@@ -697,6 +739,52 @@ class Tracer:
                 if ph == "f":
                     ev["bp"] = "e"
                 events.append(ev)
+        # follower legs of OTHER hosts' sampled replications (ISSUE 14):
+        # stage slices in this host's wall clock, flow-stepped under the
+        # leader's trace id so a cross-host merge binds them into the
+        # leader's request flow (tools/trace_merge.py)
+        for leg in self.repl_legs():
+            t_recv = leg["t_recv"]
+            if not t_recv:
+                continue
+            leg_tid = tid_of("repl-follower")
+            prev = t_recv
+            for stage, key in (
+                ("follower_append", "t_append"),
+                ("follower_fsync", "t_fsync"),
+                ("ack_send", "t_ack"),
+            ):
+                ts = leg[key]
+                if not ts:
+                    continue
+                events.append({
+                    "name": stage,
+                    "cat": "repl",
+                    "ph": "X",
+                    "pid": 1,
+                    "tid": leg_tid,
+                    "ts": round(prev * 1e6, 1),
+                    "dur": round(max(0.0, ts - prev) * 1e6, 1),
+                    "args": {
+                        "trace_id": leg["tid"],
+                        "origin": leg["origin"],
+                        "index": leg["index"],
+                    },
+                })
+                prev = ts
+            events.append({
+                "name": f"write-{leg['tid']}",
+                "cat": "request",
+                "ph": "t",
+                "id": leg["tid"],
+                "pid": 1,
+                "tid": leg_tid,
+                "ts": round(t_recv * 1e6, 1),
+                # the flow id is the LEADER's trace id — origin lets
+                # tools/trace_merge.py remap ids per originating host so
+                # two leaders' flows can never collide in a merged file
+                "args": {"origin": leg["origin"]},
+            })
         if include_recorder and self.recorder is not None:
             dev_tid = tid_of("device-plane")
             for span in self.recorder.spans():
@@ -720,6 +808,7 @@ class Tracer:
                         if k not in ("ts",)
                     },
                 })
+        ra = self.replattr
         return {
             "displayTimeUnit": "ms",
             "traceEvents": events,
@@ -730,6 +819,12 @@ class Tracer:
                     "sampled": self.sampled,
                     "completed": self.completed,
                 },
+                # multi-host merge keys (ISSUE 14): this dump's host
+                # identity plus its leader-side ack-pair clock-offset
+                # estimates per peer address (follower − leader seconds)
+                "host": self.host,
+                "repl_offsets": ra.offsets() if ra is not None else {},
+                "repl_legs": len(self._repl_legs),
             },
         }
 

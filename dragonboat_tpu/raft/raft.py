@@ -27,6 +27,7 @@ from __future__ import annotations
 import collections as _collections
 import enum
 import random as _random
+import time as _time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import logger
@@ -154,6 +155,11 @@ class Raft:
         self.lease = (
             LeaderLease(c.election_rtt) if c.read_lease else None
         )
+        # replication attribution plane (obs/replattr.py, ISSUE 14): set
+        # by the node when request tracing is on; None is the structural
+        # latch — every hook below gates on `is not None`, so trace-off
+        # request paths stay bit-identical (the lease/offload precedent)
+        self.replattr = None
         self.ready_to_read: List[ReadyToRead] = []
         self.dropped_entries: List[Entry] = []
         self.dropped_read_indexes: List[SystemCtx] = []
@@ -653,6 +659,19 @@ class Raft:
         # committed by counting replicas
         return self.log.try_commit(q, self.term)
 
+    def _note_commit(self) -> None:
+        """Commit watermark advanced (replication attribution hook,
+        ISSUE 14): close every covered record against the EXACT voter
+        set and quorum the advancing ``try_commit`` counted.  Callers
+        invoke this right after a successful commit advancement; the
+        device path's twin lives in ``node._apply_offload_effects``."""
+        ra = self.replattr
+        if ra is not None:
+            ra.on_commit(
+                self.cluster_id, self.log.committed, self.term,
+                self.voting_members(), self.quorum(), self.node_id,
+            )
+
     def append_entries(self, entries: List[Entry]) -> None:
         # reference raft.go:911-922
         last_index = self.log.last_index()
@@ -771,6 +790,11 @@ class Raft:
             # promotion, demotion) drops the lease; it re-arms only from
             # post-transition heartbeat acks
             self.lease.reset()
+        if self.replattr is not None:
+            # same matrix for replication attribution: a transition
+            # invalidates the quorum the open commit records were
+            # tallied against — drop them, never cross-term attribute
+            self.replattr.on_reset(self.cluster_id)
         self.clear_pending_config_change()
         self.abort_leader_transfer()
         self.reset_remotes()
@@ -917,6 +941,7 @@ class Raft:
             self.offload.membership_changed(self.cluster_id)
         elif self.is_leader() and self.num_voting_members() > 0:
             if self.try_commit():
+                self._note_commit()
                 self.broadcast_replicate_message()
 
     def set_remote(self, node_id: int, match: int, next_: int) -> None:
@@ -992,12 +1017,21 @@ class Raft:
     def handle_replicate_message(self, m: Message) -> None:
         # reference raft.go:1426-1450
         resp = Message(to=m.from_, type=MT.REPLICATE_RESP)
+        # replication tracing (ISSUE 14): a sampled REPLICATE's context
+        # flows onto the ack so the leader sees the follower's stamps;
+        # the fsync/ack-send stamps land later on the runtime's
+        # post-persist send path (node.process_raft_update)
+        ctx = m.trace
+        if ctx is not None:
+            resp.trace = ctx
         if m.log_index < self.log.committed:
             resp.log_index = self.log.committed
             self.send(resp)
             return
         if self.log.match_term(m.log_index, m.log_term):
             self.log.try_append(m.log_index, m.entries)
+            if ctx is not None:
+                ctx.t_append = _time.time()
             last_idx = m.log_index + len(m.entries)
             self.log.commit_to(min(last_idx, m.commit))
             resp.log_index = last_idx
@@ -1289,6 +1323,14 @@ class Raft:
             paused = rp.is_paused()
             if rp.try_update(m.log_index):
                 rp.responded_to()
+                if self.replattr is not None:
+                    # fold the ack (and its follower stage stamps) into
+                    # the open commit records BEFORE the commit
+                    # advancement below may close them
+                    self.replattr.on_ack(
+                        self.cluster_id, m.from_, rp.match, self.term,
+                        m.trace,
+                    )
                 if self.offload is not None:
                     # north-star hot path: the quorum reduction runs on
                     # device over all groups; commit lands via
@@ -1297,6 +1339,7 @@ class Raft:
                     if paused:
                         self.send_replicate_message(m.from_)
                 elif self.try_commit():
+                    self._note_commit()
                     self.broadcast_replicate_message()
                 elif paused:
                     self.send_replicate_message(m.from_)

@@ -206,6 +206,12 @@ class TpuQuorumCoordinator:
         # round released, linking the engine's dispatch span seq.  None
         # keeps the round loop bit-identical.
         self.tracer = None
+        # replication attribution (obs/replattr.py, ISSUE 14; set by
+        # NodeHost with the tracer): device-plane commits link the
+        # staged-round ack block's dispatch span into their attribution
+        # records, so a closed record names the round that released it.
+        # None keeps the round loop bit-identical.
+        self.replattr = None
         if _obs.enabled():
             self.enable_obs()
         if self._warm_requested:
@@ -849,6 +855,17 @@ class TpuQuorumCoordinator:
             else:
                 cids = res.commit
             tracer.mark_clusters(cids, seq if seq >= 0 else None)
+        replattr = self.replattr
+        if replattr is not None and res.commit:
+            # device-plane commit attribution (ISSUE 14): link THIS
+            # round's dispatch span into the groups' open commit records
+            # before the offload fan-out closes them under raftMu — the
+            # closed record then cites the same span the request trace
+            # links via mark_clusters above
+            seq = self.eng.last_span_seq
+            if seq >= 0:
+                for cid in res.commit:
+                    replattr.note_device_round(cid, seq)
         hp = self.hostplane
         touched: dict = {}
         # wake_kw stays EMPTY without the host plane so duck-typed test

@@ -171,6 +171,18 @@ class ChanTransport(IRaftRPC):
             import time
 
             time.sleep(d)
+        for m in batch.requests:
+            if m.trace is not None:
+                # replication-trace carriage parity with the TCP wire
+                # (ISSUE 14): a framed wire DECODES a fresh ReplTrace on
+                # the receiver, so the sender never observes the
+                # receiver's stamps until they ride back on the ack.
+                # The in-proc wire hands the sender's objects across
+                # directly — clone the context at the delivery boundary
+                # so both wires stamp an isolated copy (the trace=None
+                # latch keeps this loop at one attribute check per
+                # message otherwise).
+                m.trace = m.trace.clone()
         rh, _ = self._check(target)
         rh(batch)
 

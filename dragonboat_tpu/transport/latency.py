@@ -83,6 +83,56 @@ class LatencyInjector:
                 return 0.0
             return self._link.get(frozenset((da, db)), 0.0)
 
+    # ---- introspection (ISSUE 14 satellite) ----
+
+    def domain_of(self, addr: str) -> Optional[str]:
+        """The domain an address was assigned to (None when unassigned)
+        — the replication-attribution plane labels peer rows with this
+        instead of bare node ids (``ReplAttr.class_of``)."""
+        with self._mu:
+            return self._domain.get(addr)
+
+    def class_name(self, seconds: float) -> Optional[str]:
+        """The latency-class name whose one-way delay matches (nearest;
+        None when no class is within 20%)."""
+        best = None
+        with self._mu:
+            for name, d in self.classes.items():
+                err = abs(d - seconds)
+                if best is None or err < best[0]:
+                    best = (err, name, d)
+        if best is None:
+            return None
+        err, name, d = best
+        if seconds == 0.0:
+            return name if d == 0.0 else None
+        return name if err <= 0.2 * max(seconds, 1e-9) else None
+
+    def health_snapshot(self) -> dict:
+        """``health_snapshot()``-style introspection (the plane
+        accessors' contract, obs/health.py): the full domain map so
+        attribution rows and ``run_crossdomain`` can label peers by
+        latency class instead of bare node ids."""
+        with self._mu:
+            links = {
+                "|".join(sorted(k)): {
+                    "one_way_s": v,
+                    "cls": None,
+                }
+                for k, v in self._link.items()
+            }
+            out = {
+                "classes": dict(self.classes),
+                "domains": dict(self._domain),
+                "links": links,
+                "pair_overrides": {
+                    f"{s}->{d}": v for (s, d), v in self._pair.items()
+                },
+            }
+        for lk in out["links"].values():
+            lk["cls"] = self.class_name(lk["one_way_s"])
+        return out
+
 
 def crossdomain(
     near_addrs, far_addrs, one_way="far", classes=None

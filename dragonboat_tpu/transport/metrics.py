@@ -3,7 +3,17 @@
 Reference: ``internal/transport/metrics.go:21`` ``transportMetrics`` — the
 same counter family, written into the shared Prometheus-text
 MetricsRegistry (``dragonboat_tpu.events``) so ``write_health_metrics``
-exposes them alongside the per-raft-node metrics.
+and the health plane's live ``/metrics`` endpoint expose them alongside
+the per-raft-node metrics.
+
+ISSUE 14 satellite: every family is **described** (``# HELP``) and
+**zero-registered** at construction — a scrape distinguishes "transport
+idle" (families at zero) from "metrics wired elsewhere" (families
+absent), and the exposition's HELP-before-TYPE invariant holds for the
+``dragonboat_transport_*`` families from the first scrape (round-trip
+tested in tests/test_events.py).  The original reference set (messages,
+snapshots, drops) grows batch/byte counters for both directions and the
+snapshot chunk counters the chunked send plane was not reporting.
 """
 from __future__ import annotations
 
@@ -11,54 +21,100 @@ from typing import Optional
 
 from ..events import DEFAULT_REGISTRY, MetricsRegistry
 
+_T = "dragonboat_transport_"
+
+#: ``# HELP`` text per family (the obs/instruments.py discipline)
+_HELP = {
+    _T + "message_sent": "raft messages handed to remote connections",
+    _T + "message_dropped": "raft messages dropped at a full send queue",
+    _T + "message_received": "raft messages accepted from remote hosts",
+    _T + "message_receive_dropped": "inbound raft messages dropped "
+    "(deployment-id mismatch or injected partition)",
+    _T + "message_connection_failed": "per-remote sender connections "
+    "that failed (dial error, send error, breaker trip)",
+    _T + "snapshot_sent": "snapshot transfers completed to remote hosts",
+    _T + "snapshot_dropped": "snapshot sends dropped before transfer",
+    _T + "snapshot_received": "snapshot transfers completed from remote "
+    "hosts",
+    _T + "snapshot_connection_failed": "snapshot transfer connections "
+    "that failed",
+    _T + "batch_sent_total": "message batches handed to remote "
+    "connections (messages coalesce per batch)",
+    _T + "batch_received_total": "message batches accepted from remote "
+    "hosts",
+    _T + "bytes_sent_total": "approximate payload bytes handed to "
+    "remote connections (entry-size accounting, the batching cap's "
+    "own measure)",
+    _T + "bytes_received_total": "approximate payload bytes accepted "
+    "from remote hosts",
+    _T + "snapshot_chunk_sent_total": "snapshot chunks written to "
+    "transfer connections",
+    _T + "snapshot_chunk_received_total": "snapshot chunks accepted "
+    "from remote hosts",
+}
+
 
 class TransportMetrics:
-    """Reference ``newTransportMetrics`` counter set."""
+    """Reference ``newTransportMetrics`` counter set plus the ISSUE 14
+    batch/byte/chunk extensions."""
 
-    NAMES = (
-        "dragonboat_transport_message_sent",
-        "dragonboat_transport_message_dropped",
-        "dragonboat_transport_message_received",
-        "dragonboat_transport_message_receive_dropped",
-        "dragonboat_transport_message_connection_failed",
-        "dragonboat_transport_snapshot_sent",
-        "dragonboat_transport_snapshot_dropped",
-        "dragonboat_transport_snapshot_received",
-        "dragonboat_transport_snapshot_connection_failed",
-    )
+    NAMES = tuple(_HELP)
 
     def __init__(self, registry: Optional[MetricsRegistry] = None):
         self.registry = registry or DEFAULT_REGISTRY
+        r = self.registry
+        for name, text in _HELP.items():
+            r.describe(name, text)
+            r.counter_add(name, 0)
 
     def _add(self, name: str, n: int = 1) -> None:
         self.registry.counter_add(name, n)
 
     def message_sent(self, n: int = 1) -> None:
-        self._add("dragonboat_transport_message_sent", n)
+        self._add(_T + "message_sent", n)
 
     def message_dropped(self, n: int = 1) -> None:
-        self._add("dragonboat_transport_message_dropped", n)
+        self._add(_T + "message_dropped", n)
 
     def message_received(self, n: int = 1) -> None:
-        self._add("dragonboat_transport_message_received", n)
+        self._add(_T + "message_received", n)
 
     def message_receive_dropped(self, n: int = 1) -> None:
-        self._add("dragonboat_transport_message_receive_dropped", n)
+        self._add(_T + "message_receive_dropped", n)
 
     def message_connection_failed(self, n: int = 1) -> None:
-        self._add("dragonboat_transport_message_connection_failed", n)
+        self._add(_T + "message_connection_failed", n)
 
     def snapshot_sent(self, n: int = 1) -> None:
-        self._add("dragonboat_transport_snapshot_sent", n)
+        self._add(_T + "snapshot_sent", n)
 
     def snapshot_dropped(self, n: int = 1) -> None:
-        self._add("dragonboat_transport_snapshot_dropped", n)
+        self._add(_T + "snapshot_dropped", n)
 
     def snapshot_received(self, n: int = 1) -> None:
-        self._add("dragonboat_transport_snapshot_received", n)
+        self._add(_T + "snapshot_received", n)
 
     def snapshot_connection_failed(self, n: int = 1) -> None:
-        self._add("dragonboat_transport_snapshot_connection_failed", n)
+        self._add(_T + "snapshot_connection_failed", n)
+
+    # ---- ISSUE 14 satellite: batches / bytes / snapshot chunks ----
+
+    def batch_sent(self, nbytes: int) -> None:
+        self._add(_T + "batch_sent_total", 1)
+        if nbytes:
+            self._add(_T + "bytes_sent_total", nbytes)
+
+    def batch_received(self, nbytes: int) -> None:
+        self._add(_T + "batch_received_total", 1)
+        if nbytes:
+            self._add(_T + "bytes_received_total", nbytes)
+
+    def snapshot_chunks_sent(self, n: int) -> None:
+        if n:
+            self._add(_T + "snapshot_chunk_sent_total", n)
+
+    def snapshot_chunks_received(self, n: int = 1) -> None:
+        self._add(_T + "snapshot_chunk_received_total", n)
 
     def value(self, name: str) -> float:
         return self.registry.counter_value(name)

@@ -72,6 +72,7 @@ class Transport:
         max_send_queue_size: int = 0,
         snapshot_received_handler: Optional[Callable[[int, int, int], None]] = None,
         max_snapshot_send_bytes_per_second: int = 0,
+        metrics_registry=None,
     ):
         self.source_address = source_address
         self.deployment_id = deployment_id
@@ -103,7 +104,10 @@ class Transport:
         from .bandwidth import TokenBucket
         from .metrics import TransportMetrics
 
-        self.metrics = TransportMetrics()
+        # the owning NodeHost's registry (ISSUE 14 satellite) — the
+        # dragonboat_transport_* families then ride the same exposition
+        # write_health_metrics and the /metrics endpoint serve
+        self.metrics = TransportMetrics(registry=metrics_registry)
         # snapshot-plane bandwidth cap (reference tcp.go:430-437); 0 = off
         self.snapshot_bucket = TokenBucket(max_snapshot_send_bytes_per_second)
         from .chunks import Chunks
@@ -147,7 +151,13 @@ class Transport:
             addr = self.registry.resolve(c.cluster_id, c.from_)
             if addr is not None and pf(addr):
                 return False
-        return self.chunks.add_chunk(c)
+        ok = self.chunks.add_chunk(c)
+        if ok:
+            # count only ACCEPTED chunks (the family's HELP contract) —
+            # a stale/out-of-order chunk add_chunk rejects must not
+            # inflate the receive counter against the sender's
+            self.metrics.snapshot_chunks_received()
+        return ok
 
     # ---- send path ----
 
@@ -231,6 +241,7 @@ class Transport:
                     size += _msg_size(nxt)
                 conn.send_message_batch(batch)
                 self.metrics.message_sent(len(batch.requests))
+                self.metrics.batch_sent(size)
         except (TransportError, OSError) as e:
             plog.warning("sender to %s failed: %s", addr, e)
             self.metrics.message_connection_failed()
@@ -319,6 +330,7 @@ class Transport:
                 conn, chunks, self._stopped, bucket=self.snapshot_bucket
             )
             self.metrics.snapshot_sent()
+            self.metrics.snapshot_chunks_sent(len(chunks))
         except (TransportError, OSError, RuntimeError) as e:
             plog.warning("snapshot send to %s failed: %s", addr, e)
             self.metrics.snapshot_connection_failed()
@@ -399,6 +411,7 @@ class Transport:
             self.metrics.message_receive_dropped(len(batch.requests))
             return  # injected netsplit: Python-received batch dropped
         self.metrics.message_received(len(batch.requests))
+        self.metrics.batch_received(sum(_msg_size(m) for m in batch.requests))
         self.message_handler(batch)
 
     def tick(self) -> None:
@@ -429,6 +442,7 @@ def create_transport(
     snapshot_dir_fn=None,
     sys_events=None,
     snapshot_received_handler=None,
+    metrics_registry=None,
 ) -> Transport:
     """Reference ``nodehost.go:1677`` ``createTransport``: pick the RPC module
     from config (factory override, else TCP; chan under in-memory test runs)."""
@@ -463,4 +477,5 @@ def create_transport(
         max_snapshot_send_bytes_per_second=(
             nhconfig.max_snapshot_send_bytes_per_second
         ),
+        metrics_registry=metrics_registry,
     )

@@ -485,6 +485,44 @@ def decode_snapshot(data: bytes) -> Snapshot:
 
 _MSG_HAS_SNAPSHOT = 1
 _MSG_REJECT = 2
+_MSG_HAS_TRACE = 4  # replication-trace context appended (ISSUE 14)
+
+# the six ReplTrace wall-clock stamps, in dataclass field order
+_TRACE_TS = struct.Struct("<6d")
+
+
+def _encode_repl_trace_into(buf: bytearray, t) -> None:
+    _write_uvarint(buf, t.tid)
+    _write_str(buf, t.origin)
+    _write_uvarint(buf, t.index)
+    buf += _TRACE_TS.pack(
+        t.t_send, t.t_recv, t.t_append, t.t_fsync, t.t_ack, t.t_ack_recv
+    )
+
+
+def _decode_repl_trace_from(data: bytes, pos: int):
+    from .types import ReplTrace
+
+    tid, pos = _read_uvarint(data, pos)
+    origin, pos = _read_str(data, pos)
+    index, pos = _read_uvarint(data, pos)
+    if pos + _TRACE_TS.size > len(data):
+        raise CodecError("truncated ReplTrace")
+    ts = _TRACE_TS.unpack_from(data, pos)
+    return (
+        ReplTrace(
+            tid=tid,
+            origin=origin,
+            index=index,
+            t_send=ts[0],
+            t_recv=ts[1],
+            t_append=ts[2],
+            t_fsync=ts[3],
+            t_ack=ts[4],
+            t_ack_recv=ts[5],
+        ),
+        pos + _TRACE_TS.size,
+    )
 
 
 def encode_message_into(buf: bytearray, m: Message) -> None:
@@ -493,6 +531,8 @@ def encode_message_into(buf: bytearray, m: Message) -> None:
         flags |= _MSG_HAS_SNAPSHOT
     if m.reject:
         flags |= _MSG_REJECT
+    if m.trace is not None:
+        flags |= _MSG_HAS_TRACE
     if _native is not None:
         try:
             _native.encode_message_header(
@@ -519,6 +559,8 @@ def encode_message_into(buf: bytearray, m: Message) -> None:
         encode_entry_into(buf, e)
     if m.snapshot is not None:
         encode_snapshot_into(buf, m.snapshot)
+    if m.trace is not None:
+        _encode_repl_trace_into(buf, m.trace)
 
 
 def decode_message_from(data: bytes, pos: int) -> Tuple[Message, int]:
@@ -553,6 +595,9 @@ def decode_message_from(data: bytes, pos: int) -> Tuple[Message, int]:
     snapshot = None
     if flags & _MSG_HAS_SNAPSHOT:
         snapshot, pos = decode_snapshot_from(data, pos)
+    trace = None
+    if flags & _MSG_HAS_TRACE:
+        trace, pos = _decode_repl_trace_from(data, pos)
     return (
         Message(
             type=MessageType(mtype),
@@ -568,6 +613,7 @@ def decode_message_from(data: bytes, pos: int) -> Tuple[Message, int]:
             entries=entries,
             snapshot=snapshot,
             hint_high=hint_high,
+            trace=trace,
         ),
         pos,
     )

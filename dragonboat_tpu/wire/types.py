@@ -250,6 +250,40 @@ class ReadyToRead:
 
 
 @dataclass(slots=True)
+class ReplTrace:
+    """Compact replication-trace context riding a sampled REPLICATE and
+    its REPLICATE_RESP across the transport boundary (ISSUE 14).
+
+    Carried only when the leader's request tracer sampled the proposal
+    the message replicates — every other message keeps ``Message.trace``
+    at ``None`` and its wire encoding bit-identical to the pre-trace
+    build (the ``trace=None`` latch, asserted structurally in
+    tests/test_repltrace.py).
+
+    Timestamps are ``time.time()`` wall-clock **in the stamping host's
+    own clock**: ``t_send``/``t_ack_recv`` tick on the leader,
+    ``t_recv``/``t_append``/``t_fsync``/``t_ack`` on the follower.  The
+    leader's attribution plane (obs/replattr.py) reconciles the two
+    clocks with the NTP-style ack-pair estimate
+    ``offset = ((t_recv - t_send) + (t_ack - t_ack_recv)) / 2``, which
+    makes the five stage deltas sum to the measured RTT exactly.
+    """
+
+    tid: int = 0          # leader trace id (the sampled proposal's)
+    origin: str = ""      # leader host raft address (multi-host merge key)
+    index: int = 0        # traced entry index this context attributes
+    t_send: float = 0.0   # leader: REPLICATE handed to the transport
+    t_recv: float = 0.0   # follower: message reached the inbound router
+    t_append: float = 0.0  # follower: raft step appended the entries
+    t_fsync: float = 0.0  # follower: WAL made the entries durable
+    t_ack: float = 0.0    # follower: RESP handed to the transport
+    t_ack_recv: float = 0.0  # leader: RESP reached the inbound router
+
+    def clone(self) -> "ReplTrace":
+        return replace(self)
+
+
+@dataclass(slots=True)
 class Message:
     """Raft protocol message (reference ``raftpb/raft.proto:155-169``)."""
 
@@ -266,6 +300,10 @@ class Message:
     entries: List[Entry] = field(default_factory=list)
     snapshot: Optional[Snapshot] = None
     hint_high: int = 0
+    # replication-trace context (ISSUE 14): None for every non-sampled
+    # message — the wire codec emits NOTHING for None (no flag bit, no
+    # payload), so the trace-off encoding stays bit-identical
+    trace: Optional[ReplTrace] = None
 
 
 @dataclass(slots=True)

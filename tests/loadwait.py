@@ -49,17 +49,46 @@ def scaled(seconds: float) -> float:
 
 
 def wait_until(pred, timeout: float, interval: float = 0.05, what: str = ""):
-    """Poll ``pred`` until truthy; the deadline is ``scaled(timeout)``.
-    Returns the predicate's value; raises AssertionError on timeout."""
+    """Poll ``pred`` until truthy; the deadline is ``scaled(timeout)``,
+    RE-SAMPLED while waiting.  Returns the predicate's value; raises
+    AssertionError on timeout.
+
+    The re-sampling closes the r14 flake window: a budget computed once
+    at entry underprices waits that START on a momentarily-idle box and
+    then share it with a heavy neighbor spinning up (the test_lease
+    live-tpu site failed at "67.8s (load 3.04)" — the 60s base was
+    scaled by the ~1.1 load of the instant it began).  The budget only
+    ever GROWS toward ``timeout * current_scale``, so idle-box behavior
+    and the no-scaled-lower-bounds rule are unchanged."""
+    start = time.time()
     budget = scaled(timeout)
-    deadline = time.time() + budget
     while True:
         v = pred()
         if v:
             return v
-        if time.time() >= deadline:
+        budget = max(budget, timeout * scale())
+        if time.time() - start >= budget:
             raise AssertionError(
                 f"{what or 'condition'} not reached within "
                 f"{budget:.1f}s (base {timeout:.1f}s x load {scale():.2f})"
             )
         time.sleep(interval)
+
+
+def ports(n: int):
+    """``n`` distinct ephemeral 127.0.0.1 ports for in-proc TCP hosts.
+
+    All sockets stay open until every port is collected: the historical
+    close-then-rebind loop let the OS hand the same ephemeral port out
+    twice under a loaded sweep (observed r14: two ranks launched on one
+    port, ``check_launch_request`` duplicate-address rejection)."""
+    import socket
+
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()

@@ -15,6 +15,8 @@ Progress-gated throughout (no fixed-rate asserts — VERDICT r3 weak #7).
 from __future__ import annotations
 
 import socket
+
+from tests import loadwait
 import threading
 import time
 
@@ -37,13 +39,7 @@ GROUPS = 48
 
 
 def _ports(n):
-    out = []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        out.append(s.getsockname()[1])
-        s.close()
-    return out
+    return loadwait.ports(n)
 
 
 def _mk(i, addrs, tmp_path):

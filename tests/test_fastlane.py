@@ -11,6 +11,8 @@ restart replaying natively written WAL records through the Python path.
 from __future__ import annotations
 
 import socket
+
+from tests import loadwait
 import time
 
 import pytest
@@ -58,13 +60,7 @@ class CountSM:
 
 
 def _ports(n):
-    out = []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        out.append(s.getsockname()[1])
-        s.close()
-    return out
+    return loadwait.ports(n)
 
 
 def _mk(i, addrs, tmp_path, sms, snapshot_entries=0, join=False,

@@ -36,6 +36,8 @@ import json
 import os
 import signal
 import socket
+
+from tests import loadwait
 import subprocess
 import sys
 import threading
@@ -203,13 +205,7 @@ class _Host:
 
 
 def _ports(n):
-    out = []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        out.append(s.getsockname()[1])
-        s.close()
-    return out
+    return loadwait.ports(n)
 
 
 def test_sigstop_resume_without_contact_loss_ejects(tmp_path):

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import io
 import socket
+
+from tests import loadwait
 import time
 
 import pytest
@@ -83,13 +85,7 @@ def test_adapter_matches_python_dict_sm():
 
 
 def _ports(n):
-    out = []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        out.append(s.getsockname()[1])
-        s.close()
-    return out
+    return loadwait.ports(n)
 
 
 def _mk(i, addrs, tmp_path, sms, snapshot_entries=0):

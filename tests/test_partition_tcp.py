@@ -33,13 +33,7 @@ CID = 61
 
 
 def _ports(n):
-    out = []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        out.append(s.getsockname()[1])
-        s.close()
-    return out
+    return loadwait.ports(n)
 
 
 def _mk(i, addrs, tmp_path, sms):
@@ -67,9 +61,13 @@ def _mk(i, addrs, tmp_path, sms):
 
 def _leader_id(nhs, exclude=None, timeout=60.0):
     # load-scaled (tests/loadwait.py): elections under a loaded tier-1
-    # sweep stretch far past the idle-box margin (r07/r11 flake class)
-    deadline = time.time() + loadwait.scaled(timeout)
-    while time.time() < deadline:
+    # sweep stretch far past the idle-box margin (r07/r11 flake class).
+    # The budget RE-SAMPLES while waiting (the r14 wait_until treatment)
+    # — a deadline priced at an idle instant underprices a heavy
+    # neighbor spinning up mid-election
+    start = time.time()
+    budget = loadwait.scaled(timeout)
+    while True:
         for i, nh in nhs.items():
             if exclude is not None and i == exclude:
                 continue  # the isolated rank's own (stale) view
@@ -79,8 +77,10 @@ def _leader_id(nhs, exclude=None, timeout=60.0):
                     return lid
             except Exception:
                 pass
+        budget = max(budget, timeout * loadwait.scale())
+        if time.time() - start >= budget:
+            raise TimeoutError("no leader")
         time.sleep(0.05)
-    raise TimeoutError("no leader")
 
 
 def test_partitioned_leader_deposed_then_heals(tmp_path):

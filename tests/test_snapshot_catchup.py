@@ -11,6 +11,8 @@ MUST stream a snapshot while writes keep racing it.
 from __future__ import annotations
 
 import socket
+
+from tests import loadwait
 import threading
 import time
 
@@ -60,13 +62,7 @@ class KVSM:
 
 
 def _ports(n):
-    out = []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        out.append(s.getsockname()[1])
-        s.close()
-    return out
+    return loadwait.ports(n)
 
 
 def _mk(i, addrs, tmp_path, sms):

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Join per-host ``NodeHost.dump_trace`` Perfetto dumps into ONE
+timeline (ISSUE 14).
+
+Each host's dump renders only its own half of a sampled replication —
+the leader's request flow (propose → raft_step → repl_quorum → wal →
+apply → egress) on one host, the ``follower_append`` /
+``follower_fsync`` / ``ack_send`` leg slices on the others.  This tool
+merges N dumps so one proposal reads as a SINGLE flow spanning leader
+and followers:
+
+- every host becomes its own Perfetto process (``pid``), named by its
+  raft address (``metadata.host``);
+- follower timestamps shift onto the leader's clock using the leader's
+  NTP-style ack-pair offset estimates (``metadata.repl_offsets``:
+  peer address → follower-minus-leader seconds, estimated by
+  obs/replattr.py from the four send/recv/ack stamps each sampled
+  replication carries).  The estimate's residual error is the wire
+  asymmetry — the classic NTP caveat (docs/overview.md);
+- flow ids are remapped per ORIGINATING host (the leader whose trace id
+  the flow carries — follower leg events name their origin), so two
+  hosts' independently-numbered trace ids can never collide in the
+  merged file.
+
+Usage::
+
+    python tools/trace_merge.py -o merged.json leader.json f1.json f2.json
+
+Load ``merged.json`` at https://ui.perfetto.dev — the leader's
+``write-<tid>`` flow now steps through the follower processes'
+replication slices.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+def _host_of(dump: dict, fallback: str) -> str:
+    md = dump.get("metadata") or {}
+    return md.get("host") or fallback
+
+
+def _offset_for(addr: str, dumps: List[dict],
+                _seen: Optional[frozenset] = None) -> Optional[float]:
+    """The clock offset (seconds, ``addr``'s clock minus the reference
+    clock) for one host, from the first dump whose leader-side
+    attribution estimated it.  The REFERENCE dump is dumps[0]; offsets
+    estimated by a non-reference leader chain through that leader's own
+    offset so everything lands on one clock."""
+    ref_host = _host_of(dumps[0], "")
+    if addr == ref_host:
+        return 0.0
+    seen = _seen or frozenset()
+    if addr in seen:
+        return None  # estimate cycle (two leaders estimating each other)
+    # direct estimate from the reference host's leader-side attribution
+    ref_offs = (dumps[0].get("metadata") or {}).get("repl_offsets") or {}
+    if addr in ref_offs:
+        return float(ref_offs[addr])
+    # chained: some other dump estimated addr, and the reference (or a
+    # prior chain step) estimated THAT dump's host
+    for d in dumps[1:]:
+        offs = (d.get("metadata") or {}).get("repl_offsets") or {}
+        if addr in offs:
+            base = _offset_for(_host_of(d, ""), dumps, seen | {addr})
+            if base is not None:
+                return base + float(offs[addr])
+    return None
+
+
+def merge_dumps(dumps: List[dict]) -> dict:
+    """Merge dump dicts (``NodeHost.dump_trace`` return values), first
+    one is the reference clock (normally the leader — its dump carries
+    the ``repl_offsets`` the shift needs)."""
+    if not dumps:
+        raise ValueError("no dumps to merge")
+    hosts = [_host_of(d, f"host{i}") for i, d in enumerate(dumps)]
+    events: List[dict] = []
+    flow_ids: Dict[Tuple[str, int], int] = {}
+    unsynced: List[str] = []
+
+    def flow_id(origin: str, tid: int) -> int:
+        key = (origin, tid)
+        fid = flow_ids.get(key)
+        if fid is None:
+            fid = flow_ids[key] = len(flow_ids) + 1
+        return fid
+
+    shifts = {}
+    for i, (host, dump) in enumerate(zip(hosts, dumps)):
+        off = _offset_for(host, dumps)
+        if off is None:
+            unsynced.append(host)
+            off = 0.0
+        shifts[host] = off
+        pid = i + 1
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid,
+            "args": {"name": host},
+        })
+        shift_us = off * 1e6
+        for ev in dump.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            if ev.get("ph") != "M" and "ts" in ev:
+                # follower stamps ride onto the reference clock
+                ev["ts"] = round(ev["ts"] - shift_us, 1)
+            if "id" in ev:
+                args = ev.get("args") or {}
+                origin = args.get("origin") or host
+                ev["id"] = flow_id(origin, ev["id"])
+            events.append(ev)
+    return {
+        "displayTimeUnit": "ms",
+        "traceEvents": events,
+        "metadata": {
+            "merged_hosts": hosts,
+            "reference_host": hosts[0],
+            "clock_shift_ms": {
+                h: round(s * 1e3, 4) for h, s in shifts.items()
+            },
+            # hosts with no ack-pair estimate stay on their own clock —
+            # their slices still render, just unshifted
+            "unsynced_hosts": unsynced,
+            "flows": len(flow_ids),
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dumps", nargs="+", help="per-host dump_trace JSON "
+                    "files; FIRST one is the reference clock (leader)")
+    ap.add_argument("-o", "--out", default="merged_trace.json")
+    args = ap.parse_args(argv)
+    loaded = []
+    for p in args.dumps:
+        with open(p) as f:
+            loaded.append(json.load(f))
+    merged = merge_dumps(loaded)
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+    md = merged["metadata"]
+    print(
+        f"merged {len(loaded)} hosts -> {args.out}: "
+        f"{len(merged['traceEvents'])} events, {md['flows']} flows, "
+        f"shifts {md['clock_shift_ms']} ms"
+        + (f", UNSYNCED {md['unsynced_hosts']}" if md["unsynced_hosts"]
+           else "")
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
