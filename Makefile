@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test test-all test-kernels test-obs test-trace test-warmup \
 	test-hostplane test-hostproc test-lease test-devsm test-health \
-	test-repltrace \
+	test-repltrace test-devprof \
 	native soak soak-smoke bench dryrun perf-ledger perf-ledger-check
 
 test: native
@@ -97,6 +97,16 @@ test-devsm:
 # health_snapshot accessors change
 test-health:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_health.py -q
+
+# fast cpu gate for the device capacity & profiling plane (ISSUE 15):
+# profile-off structural identity, the HBM ledger ≡ live-array bytes
+# differential, the capacity model's no-drift assertions against the
+# shared upload accounting, warm-set program-registry coverage,
+# padding-waste accounting and the /debug/devprof + capture-window
+# lifecycle — run before the full tier-1 sweep whenever obs/devprof.py,
+# the engine's dispatch accounting or ops/state.py's layout change
+test-devprof:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_devprof.py -q
 
 # fast cpu gate for the leader-lease read plane (ISSUE 10): the
 # lease ≡ ReadIndex ≡ scalar-oracle differential, the invalidation

@@ -1400,6 +1400,18 @@ def main() -> None:
         )
         _note(f"health_axis: {json.dumps(detail['health_axis'])[:300]}")
 
+    # device capacity & profiling axis (ISSUE 15): profile-on/off paired
+    # windows on a live tpu-engine cluster (<5% + 2·SEM asserted), the
+    # capacity model diffed against measured resident bytes (<10%
+    # asserted) and the warm-set program registry with per-program XLA
+    # cost/memory analysis — the perf ledger's "Device programs" and
+    # "Device capacity" tables derive from this section.
+    if os.environ.get("BENCH_SKIP_DEVPROF_AXIS") != "1":
+        detail["devprof_axis"] = _run_e2e_axis(
+            "--devprof-axis", "BENCH_DEVPROF_TIMEOUT", "900"
+        )
+        _note(f"devprof_axis: {json.dumps(detail['devprof_axis'])[:300]}")
+
     # full detail (per-rank stats and all) goes to a FILE; the stdout line
     # stays small enough that the driver's 2000-char tail capture can never
     # truncate the headline (VERDICT r3 missing #1)
@@ -1474,6 +1486,16 @@ def main() -> None:
             if k in ("health_overhead_pct", "health_overhead_ok",
                      "churn_events_ok", "samples_total", "error", "tail")
         }
+    if isinstance(slim.get("devprof_axis"), dict):
+        # verdict fields only on stdout; the program table + per-plane
+        # ledger live in BENCH_DETAIL.json
+        slim["devprof_axis"] = {
+            k: v for k, v in slim["devprof_axis"].items()
+            if k in ("devprof_overhead_pct", "devprof_overhead_ok",
+                     "programs_ok", "error", "tail")
+        }
+        cap = (detail["devprof_axis"] or {}).get("capacity") or {}
+        slim["devprof_axis"]["model_error_pct"] = cap.get("model_error_pct")
     if isinstance(slim.get("host_workers"), dict):
         # headline fields only; the full A/B records live in
         # BENCH_DETAIL.json's host_workers.axis section

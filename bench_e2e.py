@@ -1144,6 +1144,195 @@ def run_health_axis() -> dict:
 
 
 # ======================================================================
+# device capacity & profiling axis (ISSUE 15): profile-on/off overhead
+# + capacity-model-vs-measured error + the warm-set program registry
+# ======================================================================
+
+
+def _set_devprof(nhs, on: bool) -> None:
+    """Attach/detach the device profiling plane across a LIVE tpu-engine
+    cluster (the ``_set_health``/``_set_tracing`` discipline): every
+    engine dispatch site gates on a plain ``_devprof is not None``
+    check, so the detached half of the A/B runs the profile-off path on
+    the very same cluster."""
+    for nh in nhs:
+        if on:
+            # the coordinator helper is THE wiring point (binds the
+            # engine, records coordinator.devprof, hands the plane the
+            # coordinator for devsm snapshots) — hand-rolled binds here
+            # would silently fork from it
+            nh.quorum_coordinator.enable_devprof(nh._devprof_axis)
+        else:
+            nh.quorum_coordinator.eng.disable_devprof()
+
+
+def run_devprof_axis() -> dict:
+    """Device capacity & profiling axis (ISSUE 15): profile-on vs
+    profile-off throughput on a live 3-host TPU-ENGINE cluster —
+    interleaved windows on one cluster, scored as the MEAN pair-wise
+    delta ± SEM over alternating-order pairs (the r13 health-axis
+    discipline: single-window weather on a 1-vCPU box is ±15%, pairing
+    + alternation cancels it) — <5% + 2·SEM asserted.  Then the
+    capacity phase: every host's HBM ledger is diffed against the
+    capacity model (|error| < 10% asserted — the model is the sizing
+    input for ROADMAP items 2/3), and the warm-set program registry is
+    collected on one host with non-zero cost/memory analysis asserted
+    per program (the perf ledger's "Device programs" table).
+
+    Env knobs: DEVPROF_AXIS_GROUPS (8), DEVPROF_AXIS_DURATION
+    (4s/window), DEVPROF_AXIS_PAIRS (4), DEVPROF_AXIS_SAMPLE (8),
+    DEVPROF_AXIS_THREADS (4).
+    """
+    from dragonboat_tpu.obs.devprof import DevProf
+
+    groups = int(os.environ.get("DEVPROF_AXIS_GROUPS", "8"))
+    duration = float(os.environ.get("DEVPROF_AXIS_DURATION", "4"))
+    pairs = max(2, int(os.environ.get("DEVPROF_AXIS_PAIRS", "4")) // 2 * 2)
+    sample_every = int(os.environ.get("DEVPROF_AXIS_SAMPLE", "8"))
+    window = int(os.environ.get("DEVPROF_AXIS_WINDOW", "8"))
+    threads = int(os.environ.get("DEVPROF_AXIS_THREADS", "4"))
+    payload = _payload()
+    tmp = tempfile.mkdtemp(prefix="dbtpu-devprof-")
+    dirs = [os.path.join(tmp, f"nh{i}") for i in range(3)]
+    nhs = _mk_nodehosts(3, groups, 30, "tpu", dirs)
+    out = {
+        "groups": groups,
+        "window_duration_s": duration,
+        "pairs": pairs,
+        "sample_every": sample_every,
+    }
+    try:
+        cids = _start_groups(nhs, groups)
+        leaders = _campaign_and_wait(nhs, cids, 180.0)
+        for nh in nhs:
+            # one DevProf per host, constructed once and A/B-toggled;
+            # the registry is the host's own so the exposition carries
+            # the families during the on-windows
+            nh._devprof_axis = DevProf(
+                registry=nh.metrics_registry,
+                recorder=nh.flight_recorder,
+                sample_every=sample_every,
+            )
+
+        def measure(on):
+            _set_devprof(nhs, on)
+            m = _measure(
+                leaders, cids, payload, window,
+                time.time() + duration, threads, drain_budget=15.0,
+            )
+            return m["writes_per_sec"]
+
+        measure(False)  # warmup window
+        deltas = []
+        wps_on = wps_off = 0.0
+        for pair in range(pairs):
+            if pair % 2 == 0:
+                on = measure(True)
+                off = measure(False)
+            else:
+                off = measure(False)
+                on = measure(True)
+            wps_on = max(wps_on, on)
+            wps_off = max(wps_off, off)
+            deltas.append((off - on) / off * 100.0)
+        mean = sum(deltas) / len(deltas)
+        var = sum((d - mean) ** 2 for d in deltas) / max(1, len(deltas) - 1)
+        sem = (var / len(deltas)) ** 0.5
+        overhead = round(mean, 2)
+        out["writes_per_sec_devprof_on"] = round(wps_on, 1)
+        out["writes_per_sec_devprof_off"] = round(wps_off, 1)
+        out["devprof_overhead_pct"] = overhead
+        out["devprof_overhead_sem_pct"] = round(sem, 2)
+        out["pair_deltas_pct"] = [round(d, 2) for d in deltas]
+        out["devprof_overhead_ok"] = overhead < 5.0 + 2 * sem
+        assert overhead < 5.0 + 2 * sem, (
+            f"devprof overhead too high: {overhead}% (± {sem:.1f} SEM; "
+            f"{wps_on:.0f} vs {wps_off:.0f} w/s)"
+        )
+
+        # capacity phase (profile back ON so the ledger gauges are live)
+        _set_devprof(nhs, True)
+        errors = []
+        for nh in nhs:
+            led = nh._devprof_axis.hbm_ledger()
+            cap = led["capacity"]
+            errors.append(abs(cap["model_error_pct"]))
+            assert abs(cap["model_error_pct"]) < 10.0, cap
+        dp0 = nhs[0]._devprof_axis
+        led0 = dp0.hbm_ledger()
+        cap0 = led0["capacity"]
+        # reference sizing at a 16 GiB HBM budget (no chip attached on
+        # the capture box — the per-group figure is backend-exact, the
+        # budget is the documented reference input)
+        ref = dp0.capacity_model(budget_bytes=16 << 30)
+        out["capacity"] = {
+            "planes": led0["planes"],
+            "state_bytes": led0["state_bytes"],
+            "measured_state_bytes": cap0.get("measured_state_bytes"),
+            "bytes_per_group": round(cap0["bytes_per_group"], 1),
+            "bytes_per_group_with_dispatch": round(
+                cap0["bytes_per_group_with_dispatch"], 1
+            ),
+            "dispatch_bytes": cap0["dispatch_bytes"],
+            "model_error_pct": cap0["model_error_pct"],
+            "model_error_max_abs_pct": round(max(errors), 4),
+            "max_groups_at_16gib": ref["max_groups"],
+            "capacity_model_ok": max(errors) < 10.0,
+        }
+
+        # program registry on host 0's engine: the whole warm set with
+        # non-zero cost/memory analysis per program (compiles ride the
+        # jit/persistent caches where warm)
+        rows = dp0.collect_programs(include_kv=False)
+        assert rows and all(
+            r.get("flops", 0) > 0 and r.get("bytes_accessed", 0) > 0
+            for r in rows
+        ), rows
+        out["programs"] = rows
+        out["programs_ok"] = True
+
+        # estimator evidence from the on-windows (plus this phase) —
+        # counters summed AND the device-ms sample windows MERGED before
+        # the percentiles, so the ledger row's percentiles describe the
+        # same population as its sample counts (host-0-only percentiles
+        # against cluster-wide counts would misattribute)
+        est = dp0.estimator_stats()
+        merged_ms = list(dp0._device_ms)
+        for nh in nhs[1:]:
+            e2 = nh._devprof_axis.estimator_stats()
+            est["dispatches"] += e2["dispatches"]
+            est["sampled"] += e2["sampled"]
+            est["padded_rounds"] += e2["padded_rounds"]
+            est["wasted_rounds"] += e2["wasted_rounds"]
+            merged_ms.extend(nh._devprof_axis._device_ms)
+        est["padding_waste_ratio"] = (
+            round(est["wasted_rounds"] / est["padded_rounds"], 4)
+            if est["padded_rounds"] else 0.0
+        )
+        if merged_ms:
+            from dragonboat_tpu.obs.health import _pctile
+
+            est["device_ms"] = {
+                "n": len(merged_ms),
+                "p50": round(_pctile(merged_ms, 50), 4),
+                "p99": round(_pctile(merged_ms, 99), 4),
+                "max": round(max(merged_ms), 4),
+            }
+        out["estimator"] = est
+        out["fused_ready"] = all(
+            nh.quorum_coordinator.eng.fused_ready for nh in nhs
+        )
+        return out
+    finally:
+        for nh in nhs:
+            try:
+                nh.stop()
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ======================================================================
 # cross-domain lease axis (ISSUE 10): leader-lease local reads vs the
 # ReadIndex fallback across injected high-RTT domains
 # ======================================================================
@@ -2494,5 +2683,8 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--health-axis" in sys.argv:
         print(json.dumps(run_health_axis()), file=sys.stdout)
+        sys.exit(0)
+    if "--devprof-axis" in sys.argv:
+        print(json.dumps(run_devprof_axis()), file=sys.stdout)
         sys.exit(0)
     print(json.dumps(run_quick()), file=sys.stdout)

@@ -304,6 +304,20 @@ class NodeHostConfig:
     # binds ephemeral (NodeHost.metrics_server.port).  Env
     # DBTPU_METRICS_ADDR is the no-config fallback.
     metrics_addr: str = ""
+    # device capacity & profiling plane (obs/devprof.py, ISSUE 15):
+    # N > 0 attaches a DevProf to the batched quorum engine — the HBM
+    # memory ledger + capacity model (dragonboat_devprof_hbm_bytes /
+    # max-groups extrapolation), fused padding-waste accounting, and a
+    # device-time estimator that samples every N-th dispatch with a
+    # blocking block_until_ready delta (N is this value; 16 is the
+    # measured-overhead default).  Enables NodeHost.profile_device
+    # (on-demand jax.profiler capture windows) and the read-only
+    # /debug/devprof endpoint on the MetricsServer.  0 (default) =
+    # nothing constructed, the engine keeps its bit-identical
+    # _devprof=None path; env DBTPU_DEVICE_PROFILE is the no-config
+    # fallback.  Inert without the tpu quorum engine (the plane profiles
+    # the device engine).
+    device_profile: int = 0
     logdb_config: LogDBConfig = field(default_factory=LogDBConfig.default)
     expert: ExpertConfig = field(default_factory=ExpertConfig)
     # factories (reference config/config.go:298-305)

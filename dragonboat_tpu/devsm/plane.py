@@ -122,6 +122,28 @@ class DevKVPlane:
                 "binds": self._bind_counts.get(cluster_id, 0),
             }
 
+    def devprof_snapshot(self) -> dict:
+        """Plane-level residency for the device profiling plane (ISSUE
+        15): the device-side kv slabs are already priced by the engine's
+        HBM ledger — what only this plane knows is the HOST-side shadow
+        residency (one warm numpy image per registered SM on every
+        replica) and how many groups are actually device-serving."""
+        with self._mu:
+            shadow = 0
+            for sm in self._sms.values():
+                vals = getattr(sm, "values", None)
+                if vals is not None and hasattr(vals, "nbytes"):
+                    shadow += int(vals.nbytes)
+            return {
+                "groups": len(self._sms),
+                "bound": len(self._bound),
+                "pending_binds": len(self._pending_bind),
+                "shadow_bytes": shadow,
+                "binds": self.binds,
+                "reads_served": self.reads_served,
+                "read_fallbacks": self.read_fallbacks,
+            }
+
     # ------------------------------------------------------------------
     # leadership transitions (coordinator drain, under coord._mu)
     # ------------------------------------------------------------------

@@ -421,6 +421,44 @@ class NodeHost:
                 registry=self.raft_events.registry,
                 recorder=self.flight_recorder,
             )
+        # device capacity & profiling plane (obs/devprof.py, ISSUE 15):
+        # HBM ledger + capacity model, warm-set program registry,
+        # sampled device-time estimator and on-demand jax.profiler
+        # capture windows over the batched quorum engine.  OFF by
+        # default (device_profile=0 and no env): nothing constructed,
+        # the engine keeps its bit-identical _devprof=None latch.
+        self.devprof = None
+        devprof_n = nhconfig.device_profile
+        if not devprof_n:
+            try:
+                devprof_n = int(
+                    os.environ.get("DBTPU_DEVICE_PROFILE", "0") or 0
+                )
+            except ValueError:
+                plog.warning("malformed DBTPU_DEVICE_PROFILE; devprof off")
+                devprof_n = 0
+        if devprof_n > 0:
+            if self.quorum_coordinator is None:
+                # the plane profiles the DEVICE engine; on a scalar host
+                # the knob is inert (visible, not fatal — the health
+                # plane's degrade precedent)
+                plog.warning(
+                    "device_profile set but no tpu quorum engine; "
+                    "devprof off"
+                )
+            else:
+                from .obs.devprof import DevProf
+
+                base = nhconfig.node_host_dir
+                self.devprof = DevProf(
+                    registry=self.raft_events.registry,
+                    recorder=self.flight_recorder,
+                    sample_every=devprof_n,
+                    artifact_dir=(
+                        base if base and base != ":memory:" else None
+                    ),
+                )
+                self.quorum_coordinator.enable_devprof(self.devprof)
         metrics_addr = nhconfig.metrics_addr or os.environ.get(
             "DBTPU_METRICS_ADDR", ""
         )
@@ -566,6 +604,24 @@ class NodeHost:
                 json.dump(d, f)
         return d
 
+    def profile_device(
+        self, ms: float = 1000.0, path: Optional[str] = None
+    ) -> str:
+        """Open an on-demand ``jax.profiler`` capture window for ``ms``
+        milliseconds (obs/devprof.py, ISSUE 15) and return the artifact
+        directory — written beside the ``dump_trace``/``debug_dump``
+        artifacts so ``tools/trace_merge.py`` sessions and device
+        profiles are collected from one place (load the result at
+        https://ui.perfetto.dev).  Requires the device profiling plane
+        (``NodeHostConfig.device_profile`` / ``DBTPU_DEVICE_PROFILE``);
+        one window at a time — the profiler is process-global."""
+        if self.devprof is None:
+            raise RuntimeError(
+                "device profiling is off — set "
+                "NodeHostConfig.device_profile"
+            )
+        return self.devprof.capture(ms, path=path)
+
     def health_report(self) -> dict:
         """Aggregated cluster-health verdict (obs/health.py, ISSUE 13):
         open detector events, per-detector open/close counts, and the
@@ -600,6 +656,10 @@ class NodeHost:
             "health": (
                 self.health.to_json(limit=64)
                 if self.health is not None else None
+            ),
+            "devprof": (
+                self.devprof.to_json()
+                if self.devprof is not None else None
             ),
         }
         if path is None:
@@ -914,6 +974,11 @@ class NodeHost:
             # encode, WAL sink, SM proxies) is quiesced, so the workers'
             # drain-and-stop sees an empty backlog
             self.hostproc.stop()
+        if self.devprof is not None:
+            # before the coordinator: an open jax.profiler window must
+            # close while the engine it observes still exists
+            self.devprof.stop()
+            self.devprof = None
         if self.quorum_coordinator is not None:
             self.quorum_coordinator.stop()
         self.transport.stop()

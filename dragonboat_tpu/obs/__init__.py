@@ -31,7 +31,14 @@ handling lives on cheap continuous telemetry"):
   detectors with open/close events and recovery-time attribution
   (``dragonboat_health_*`` families, ``NodeHost.health_report``), and
   the live scrape endpoint (``/metrics``, ``/healthz``,
-  ``/debug/health``, ``/debug/trace``).
+  ``/debug/health``, ``/debug/trace``, ``/debug/devprof``).
+- :mod:`devprof` — the device capacity & profiling plane (ISSUE 15):
+  the HBM memory ledger + capacity model
+  (``dragonboat_devprof_hbm_bytes{plane,artifact}``, max groups per
+  device), the warm-set program registry (per-program XLA cost/memory
+  analysis), a sampled device-time estimator with fused padding-waste
+  accounting, and on-demand ``jax.profiler`` capture windows
+  (``NodeHost.profile_device``).
 
 Overhead contract (the ``_read_plane_used`` precedent; PR 3 took a −43%
 host-path regression from ungated per-transition work): observability is

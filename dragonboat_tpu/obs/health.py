@@ -598,6 +598,10 @@ class MetricsServer:
                         sampler is off)
     ``/debug/trace``    the Chrome-trace export (404 while tracing is
                         off)
+    ``/debug/devprof``  the device capacity & profiling snapshot —
+                        HBM ledger, capacity model, estimator stats,
+                        collected program registry (404 while devprof
+                        is off)
     ==================  ================================================
 
     Serves on daemon threads (``ThreadingHTTPServer``); request handling
@@ -699,6 +703,20 @@ def _serve(nh, handler) -> None:
         body = json.dumps(
             tracer.export_chrome(), default=str
         ).encode("utf-8")
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+        return
+    if path == "/debug/devprof":
+        devprof = getattr(nh, "devprof", None)
+        if devprof is None:
+            handler.send_error(404, "device profiling is off")
+            return
+        # read-only by contract: to_json never triggers compiles or
+        # capture windows — a scraper can poll this freely
+        body = json.dumps(devprof.to_json(), default=str).encode("utf-8")
         handler.send_response(200)
         handler.send_header("Content-Type", "application/json")
         handler.send_header("Content-Length", str(len(body)))

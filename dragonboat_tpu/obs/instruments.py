@@ -65,6 +65,7 @@ _HPROC = "dragonboat_hostproc_"
 _DEVSM = "dragonboat_devsm_"
 _HEALTH = "dragonboat_health_"
 _REPL = "dragonboat_repl_"
+_DEVPROF = "dragonboat_devprof_"
 
 #: recovery-duration buckets (seconds): a worker respawn lands near the
 #: bottom, a failover around election timeouts, a wedged rebind loop or
@@ -166,6 +167,45 @@ _HELP = {
     "before closing (term change, transition reset, overflow, expiry)",
     _REPL + "clock_offset_ms": "latest NTP-style ack-pair clock-offset "
     "estimate per peer (follower minus leader milliseconds)",
+    # device capacity & profiling plane (obs/devprof.py, ISSUE 15)
+    _DEVPROF + "hbm_bytes": "device-resident bytes per state artifact "
+    "(the HBM ledger), by plane and artifact",
+    _DEVPROF + "hbm_plane_bytes": "device-resident bytes per plane "
+    "(quorum / read / devsm / dispatch)",
+    _DEVPROF + "bytes_per_group": "resident state bytes one group row "
+    "costs (the capacity model's extrapolation base)",
+    _DEVPROF + "capacity_groups": "predicted max groups per device from "
+    "the capacity model (0 = no memory budget known for this backend)",
+    _DEVPROF + "model_error_pct": "capacity-model prediction vs "
+    "actually-allocated resident bytes, percent",
+    _DEVPROF + "device_ms": "sampled post-launch block_until_ready "
+    "delta per dispatch — the device-execution estimate the host "
+    "dispatch wall does not separate",
+    _DEVPROF + "duty_cycle": "estimated device busy fraction over the "
+    "last sampling window (sampled device time x stride / wall, "
+    "clamped to 1)",
+    _DEVPROF + "dispatches_total": "dispatches seen by the profiling "
+    "plane",
+    _DEVPROF + "sampled_total": "dispatches whose device time was "
+    "sampled (1-in-N block_until_ready)",
+    _DEVPROF + "padded_rounds_total": "rounds shipped inside fused "
+    "K-batched programs (padded program K)",
+    _DEVPROF + "wasted_rounds_total": "provable no-op padding rounds "
+    "(padded K minus live/ticked rounds) — measurable wasted device work",
+    _DEVPROF + "padding_waste_ratio": "wasted over padded rounds across "
+    "the plane's lifetime",
+    _DEVPROF + "programs": "warm-set programs analyzed by the registry",
+    _DEVPROF + "program_compile_ms": "AOT lower+compile wall per "
+    "analyzed program (cache-hot compiles deserialize)",
+    _DEVPROF + "program_flops": "XLA cost-analysis flops per warmed "
+    "program, by variant",
+    _DEVPROF + "program_bytes": "XLA cost-analysis bytes accessed per "
+    "warmed program, by variant",
+    _DEVPROF + "program_temp_bytes": "XLA peak temp allocation per "
+    "warmed program, by variant",
+    _DEVPROF + "captures_total": "on-demand jax.profiler capture "
+    "windows started",
+    _DEVPROF + "capture_active": "1 while a capture window is recording",
 }
 
 
@@ -643,6 +683,144 @@ class HealthObs:
             _HEALTH + "recovery_seconds", duration_s,
             buckets=RECOVERY_BUCKETS_S, labels=labels,
         )
+
+
+class DevProfObs:
+    """Device capacity & profiling instruments (obs/devprof.py, ISSUE 15).
+
+    Families (``dragonboat_devprof_*``):
+
+    - gauges ``hbm_bytes{plane,artifact}`` / ``hbm_plane_bytes{plane}``
+      — the HBM ledger: every resident device artifact priced by bytes
+    - gauges ``bytes_per_group`` / ``capacity_groups`` /
+      ``model_error_pct`` — the capacity model (max groups per device;
+      prediction vs actually-allocated bytes)
+    - histogram ``device_ms`` + gauge ``duty_cycle`` — the sampled
+      device-time estimator (block_until_ready deltas, 1-in-N)
+    - ``dispatches_total`` / ``sampled_total`` /
+      ``padded_rounds_total`` / ``wasted_rounds_total`` + gauge
+      ``padding_waste_ratio`` — fused padding-waste accounting
+    - gauge ``programs`` + histogram ``program_compile_ms`` + gauges
+      ``program_{flops,bytes,temp_bytes}{variant}`` — the warm-set
+      program registry (XLA cost/memory analysis per program)
+    - ``captures_total`` + gauge ``capture_active`` — on-demand
+      ``jax.profiler`` capture windows
+
+    Same ``is not None`` latch contract as every other plane: devprof
+    off registers none of this.
+    """
+
+    __slots__ = ("registry",)
+
+    _PLANES = ("quorum", "read", "devsm", "dispatch")
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry or DEFAULT_REGISTRY
+        r = self.registry
+        _describe(r, (
+            _DEVPROF + "hbm_bytes", _DEVPROF + "hbm_plane_bytes",
+            _DEVPROF + "bytes_per_group", _DEVPROF + "capacity_groups",
+            _DEVPROF + "model_error_pct", _DEVPROF + "device_ms",
+            _DEVPROF + "duty_cycle", _DEVPROF + "dispatches_total",
+            _DEVPROF + "sampled_total", _DEVPROF + "padded_rounds_total",
+            _DEVPROF + "wasted_rounds_total",
+            _DEVPROF + "padding_waste_ratio", _DEVPROF + "programs",
+            _DEVPROF + "program_compile_ms", _DEVPROF + "program_flops",
+            _DEVPROF + "program_bytes", _DEVPROF + "program_temp_bytes",
+            _DEVPROF + "captures_total", _DEVPROF + "capture_active",
+        ))
+        for name in (
+            "dispatches_total", "sampled_total", "padded_rounds_total",
+            "wasted_rounds_total", "captures_total",
+        ):
+            r.counter_add(_DEVPROF + name, 0)
+        for name in (
+            "bytes_per_group", "capacity_groups", "model_error_pct",
+            "duty_cycle", "padding_waste_ratio", "programs",
+            "capture_active",
+        ):
+            r.gauge_set(_DEVPROF + name, 0)
+        for plane in self._PLANES:
+            r.gauge_set(
+                _DEVPROF + "hbm_plane_bytes", 0, labels={"plane": plane}
+            )
+        r.histogram_declare(
+            _DEVPROF + "device_ms", buckets=LATENCY_BUCKETS_MS
+        )
+        r.histogram_declare(
+            _DEVPROF + "program_compile_ms", buckets=LATENCY_BUCKETS_MS
+        )
+
+    def device_ms(self, ms: float) -> None:
+        self.registry.histogram_observe(
+            _DEVPROF + "device_ms", ms, buckets=LATENCY_BUCKETS_MS
+        )
+
+    def flush_dispatch(
+        self, *, dispatches: int, sampled: int, padded: int, wasted: int,
+        waste_ratio: float, duty_cycle: float,
+    ) -> None:
+        """Counter DELTAS accumulated since the last flush (the tracer's
+        local-accumulate/periodic-flush discipline — a registry bump per
+        dispatch would tax the round thread) plus the window gauges."""
+        r = self.registry
+        if dispatches:
+            r.counter_add(_DEVPROF + "dispatches_total", dispatches)
+        if sampled:
+            r.counter_add(_DEVPROF + "sampled_total", sampled)
+        if padded:
+            r.counter_add(_DEVPROF + "padded_rounds_total", padded)
+        if wasted:
+            r.counter_add(_DEVPROF + "wasted_rounds_total", wasted)
+        r.gauge_set(_DEVPROF + "padding_waste_ratio", round(waste_ratio, 4))
+        r.gauge_set(_DEVPROF + "duty_cycle", round(duty_cycle, 4))
+
+    def ledger(
+        self, *, artifacts: dict, planes: dict, bytes_per_group: float,
+        capacity_groups: int, model_error_pct: Optional[float],
+    ) -> None:
+        r = self.registry
+        for (plane, artifact), nbytes in artifacts.items():
+            r.gauge_set(
+                _DEVPROF + "hbm_bytes", nbytes,
+                labels={"plane": plane, "artifact": artifact},
+            )
+        for plane in self._PLANES:
+            r.gauge_set(
+                _DEVPROF + "hbm_plane_bytes", planes.get(plane, 0),
+                labels={"plane": plane},
+            )
+        r.gauge_set(_DEVPROF + "bytes_per_group", round(bytes_per_group, 1))
+        r.gauge_set(_DEVPROF + "capacity_groups", capacity_groups)
+        if model_error_pct is not None:
+            r.gauge_set(
+                _DEVPROF + "model_error_pct", round(model_error_pct, 3)
+            )
+
+    def program(
+        self, *, variant: str, flops: float, bytes_accessed: float,
+        temp_bytes: int, compile_ms: float,
+    ) -> None:
+        r = self.registry
+        labels = {"variant": variant}
+        r.gauge_set(_DEVPROF + "program_flops", flops, labels=labels)
+        r.gauge_set(_DEVPROF + "program_bytes", bytes_accessed, labels=labels)
+        r.gauge_set(
+            _DEVPROF + "program_temp_bytes", temp_bytes, labels=labels
+        )
+        r.histogram_observe(
+            _DEVPROF + "program_compile_ms", compile_ms,
+            buckets=LATENCY_BUCKETS_MS,
+        )
+
+    def programs_done(self, n: int) -> None:
+        self.registry.gauge_set(_DEVPROF + "programs", n)
+
+    def capture(self, active: bool) -> None:
+        r = self.registry
+        if active:
+            r.counter_add(_DEVPROF + "captures_total")
+        r.gauge_set(_DEVPROF + "capture_active", 1 if active else 0)
 
 
 class CoordObs:

@@ -83,8 +83,16 @@ class FlightRecorder:
     ``reads_released``      client reads released by confirmed slots
     ``mu_wait_ms``          time spent waiting on ``_MULTIDEV_MU``
     ``wall_ms``             whole-round wall time (coordinator spans)
+    ``device_ms``           sampled post-launch ``block_until_ready``
+                            delta (the devprof device-time estimator,
+                            ISSUE 15; only on sampled dispatch spans —
+                            deliberately NOT a stall-watchdog field,
+                            the blocking sample is the measurement)
     ``stalled``             set by the watchdog: which field tripped
     ======================  ==================================================
+
+    ``devprof`` spans mark on-demand ``jax.profiler`` capture windows
+    (``window_ms``/``dir``, obs/devprof.py).
     """
 
     def __init__(

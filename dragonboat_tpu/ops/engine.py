@@ -73,6 +73,21 @@ def k_bucket(k: int, buckets=WARM_K_BUCKETS) -> int:
     return buckets[-1]
 
 
+def upload_nbytes(*arrays) -> int:
+    """Total bytes of the host tensors one dispatch ships (``None``
+    entries — compiled-out optional planes — are skipped).  The ONE
+    accounting point for host→device event-tensor volume: the flight
+    recorder's ``upload_bytes`` span field, the
+    ``dragonboat_device_upload_bytes_total`` counter and the devprof
+    capacity model's per-dispatch term all read this, so the sum can
+    never drift from the tensors actually passed to the kernel (ISSUE 15
+    satellite — three hand-maintained per-site sums preceded it).
+    Callers pass EXACTLY the argument tuple the kernel receives; the
+    few-byte dummies of compiled-out planes are counted (they are
+    genuinely uploaded)."""
+    return int(sum(a.nbytes for a in arrays if a is not None))
+
+
 # ----------------------------------------------------------------------
 # persistent XLA compilation cache (ISSUE 7 tentpole)
 # ----------------------------------------------------------------------
@@ -567,6 +582,15 @@ class BatchedQuorumEngine:
         self._obs_kv_span = None   # apply_kernel span of the same dispatch
         self._obs_mu_wait = 0.0    # _MULTIDEV_MU wait of the next dispatch
         self._obs_upload = 0       # upload bytes of the current dispatch
+        # --- device capacity & profiling plane (ISSUE 15) ---------------
+        # LATCH, same contract as _obs: None by default, every hot-path
+        # site gates on `is not None`, so a profile-off engine keeps a
+        # bit-identical host path.  Attached via enable_devprof (live
+        # wiring: NodeHostConfig.device_profile → the coordinator).  The
+        # attached DevProf samples 1-in-N dispatches with a
+        # block_until_ready delta (the device-time estimator), accounts
+        # fused padding waste, and walks self._dev for the HBM ledger.
+        self._devprof = None
         # seq of the newest recorded dispatch span (-1 = none / obs off):
         # the request tracer links this into sampled traces' device_round
         # stage (ISSUE 9); written only inside the obs-gated branches
@@ -623,6 +647,16 @@ class BatchedQuorumEngine:
 
     def disable_obs(self) -> None:
         self._obs = None
+
+    def enable_devprof(self, devprof) -> None:
+        """Attach a :class:`dragonboat_tpu.obs.devprof.DevProf` plane:
+        sampled device-time estimation, fused padding-waste accounting
+        and the HBM ledger all key off this latch (``is not None`` on
+        every hot-path site — the ``_obs`` contract exactly)."""
+        self._devprof = devprof
+
+    def disable_devprof(self) -> None:
+        self._devprof = None
 
     # ------------------------------------------------------------------
     # AOT warm-compile (ISSUE 7 tentpole)
@@ -755,6 +789,47 @@ class BatchedQuorumEngine:
                  for hr in (False, True)]
         return plan
 
+    def warm_plan(
+        self,
+        k_buckets=WARM_K_BUCKETS,
+        include_reads: bool = True,
+        include_single: bool = True,
+        include_kv: bool = False,
+    ):
+        """The closed live-path program set as ``(kind, arg, has_reads,
+        has_kv)`` tuples — the ONE enumeration both the warmup pass
+        (``_warmup_main``) and the devprof program registry
+        (``obs/devprof.py`` via :meth:`lower_variant`) walk, so the
+        registry can never analyze a program the live path doesn't run
+        nor miss one it does."""
+        read_set = (False, True) if include_reads else (False,)
+        plan = [
+            ("fused", k, hr, False)
+            for k in sorted({int(k) for k in k_buckets})
+            for hr in read_set
+        ]
+        if include_single:
+            plan += [("sparse", dt, False, False) for dt in (True, False)]
+            # elections dispatch the vote-carrying sparse variant; warm
+            # it so the first campaign after enable doesn't compile
+            plan += [
+                ("sparse_votes", dt, False, False) for dt in (True, False)
+            ]
+            if include_reads:
+                plan += [("dense", dt, True, False) for dt in (True, False)]
+        if include_kv:
+            plan += self._kv_plan(k_buckets)
+        return plan
+
+    @staticmethod
+    def variant_label(kind: str, arg, has_reads: bool, has_kv: bool) -> str:
+        """Stable display name of a warm-plan variant (warmup spans and
+        the devprof "Device programs" table share it)."""
+        return (
+            f"{kind}:k{arg}" if kind == "fused"
+            else f"{kind}:{'tick' if arg else 'notick'}"
+        ) + (":reads" if has_reads else "") + (":kv" if has_kv else "")
+
     def cancel_warmup(self) -> None:
         """Stop warming after the current variant (coordinator shutdown);
         a cancelled warmup leaves the latch unset — the fallback
@@ -785,24 +860,9 @@ class BatchedQuorumEngine:
                 self.n_groups, self.n_peers, self.n_read_slots,
                 self.n_kv_slots, self.n_kv_ents,
             ).to_device(self.sharding)
-            read_set = (False, True) if include_reads else (False,)
-            plan = [
-                ("fused", k, hr, False)
-                for k in sorted({int(k) for k in k_buckets})
-                for hr in read_set
-            ]
-            if include_single:
-                plan += [("sparse", dt, False, False) for dt in (True, False)]
-                # elections dispatch the vote-carrying sparse variant;
-                # warm it so the first campaign after enable doesn't
-                # compile either
-                plan += [
-                    ("sparse_votes", dt, False, False) for dt in (True, False)
-                ]
-                if include_reads:
-                    plan += [("dense", dt, True, False) for dt in (True, False)]
-            if include_kv:
-                plan += self._kv_plan(k_buckets)
+            plan = self.warm_plan(
+                k_buckets, include_reads, include_single, include_kv
+            )
             for kind, a, hr, kv in plan:
                 if self._warmup_cancel.is_set():
                     self.warmup_stats["error"] = "cancelled"
@@ -814,10 +874,7 @@ class BatchedQuorumEngine:
                 obs = self._obs  # re-read: may attach mid-warmup
                 if obs is not None:
                     obs.warmup(
-                        variant=(
-                            f"{kind}:k{a}" if kind == "fused"
-                            else f"{kind}:{'tick' if a else 'notick'}"
-                        ) + (":reads" if hr else "") + (":kv" if kv else ""),
+                        variant=self.variant_label(kind, a, hr, kv),
                         seconds=dt_s,
                     )
             self.warmup_stats["seconds"] = time.perf_counter() - t0
@@ -838,95 +895,155 @@ class BatchedQuorumEngine:
             self.warmup_stats["seconds"] = time.perf_counter() - t0
             elog.warning("engine warmup failed (fused path stays off): %r", e)
 
+    def _variant_args(
+        self, kind: str, arg, has_reads: bool, has_kv: bool = False,
+        abstract: bool = False,
+    ):
+        """Kernel entry point, argument tensors (state excluded) and
+        static kwargs for one warm-plan variant.  ``abstract=False``
+        builds the concrete zero/fill tensors the warm dispatch runs
+        (``_warm_one``); ``abstract=True`` builds
+        :class:`jax.ShapeDtypeStruct` stand-ins for the devprof program
+        registry's AOT ``lower().compile()`` (``lower_variant``) — ONE
+        builder, so the registry analyzes byte-for-byte the programs the
+        warmup compiled.  Shapes/statics must mirror the live call sites
+        EXACTLY — a near-miss warms a program the live path never uses."""
+        from .kernels import quorum_multiround, quorum_step_dense
+
+        g, p, s = self.n_groups, self.n_peers, self.n_read_slots
+        e, rk = self.n_kv_ents, self.n_kv_reads
+        if abstract:
+            def mk(shape, dtype, fill=0):
+                del fill  # shape/dtype is all a lowering needs
+                return jax.ShapeDtypeStruct(shape, dtype)
+        else:
+            def mk(shape, dtype, fill=0):
+                if fill:
+                    return jnp.full(shape, fill, dtype)
+                return jnp.zeros(shape, dtype)
+
+        def read_dims(*lead):
+            return (
+                mk(lead + (g, s), jnp.int32, -1),
+                mk(lead + (g, s), jnp.int32),
+                mk(lead + (g, s, p), bool),
+            )
+
+        def kv_dims(*lead):
+            return (
+                mk(lead + (g, e), jnp.int32, -1),
+                mk(lead + (g, e), jnp.int32),
+                mk(lead + (g, e), jnp.int32),
+                mk(lead + (g, rk), jnp.int32, -1),
+            )
+
+        if kind == "fused":
+            k = arg
+            read_args = read_dims(k) if has_reads else (None, None, None)
+            kv_args = kv_dims(k) if has_kv else (None, None, None, None)
+            z11 = mk((1, 1), jnp.int32)
+            args = (
+                mk((k, g, p), jnp.int32, -1),
+                mk((1, 1, 1), jnp.int8),
+                z11, z11, z11, z11,
+                mk((k,), bool),
+            ) + read_args + kv_args
+            statics = dict(
+                do_tick=True,
+                track_contact=True,
+                has_votes=False,
+                has_churn=False,
+                has_reads=has_reads,
+                purge_reads=False,
+                has_kv=has_kv,
+                purge_kv=False,
+            )
+            return quorum_multiround, args, statics
+        if kind == "dense":
+            do_tick = arg
+            read_args = read_dims() if has_reads else (None, None, None)
+            kv_args = kv_dims() if has_kv else (None, None, None, None)
+            args = (
+                mk((g, p), jnp.int32),
+                mk((g, p), bool),
+                mk((1, 1), jnp.int8),
+            ) + read_args + kv_args
+            statics = dict(
+                do_tick=do_tick,
+                track_contact=self.device_ticks or do_tick,
+                has_votes=False,
+                has_reads=has_reads,
+                has_kv=has_kv,
+            )
+            return quorum_step_dense, args, statics
+        # sparse single-round (the quiet-path workhorse)
+        do_tick = arg
+        cap = self.event_cap
+        z32 = mk((cap,), jnp.int32)
+        has_votes = kind == "sparse_votes"
+        if has_votes:  # vote events pad to the full event cap
+            vg = vp = z32
+            vv = mk((cap,), jnp.int8)
+            vvalid = mk((cap,), bool)
+        else:
+            vg = vp = mk((1,), jnp.int32)
+            vv = mk((1,), jnp.int8)
+            vvalid = mk((1,), bool)
+        args = (z32, z32, z32, mk((cap,), bool), vg, vp, vv, vvalid)
+        statics = dict(
+            do_tick=do_tick,
+            track_contact=self.device_ticks or do_tick,
+            has_votes=has_votes,
+        )
+        return quorum_step, args, statics
+
     def _warm_one(
         self, scratch: QuorumState, kind: str, arg, has_reads: bool,
         has_kv: bool = False,
     ):
         """Compile-and-run one variant against the scratch state (donated;
-        the successor state is returned).  Shapes/statics must mirror the
-        live call sites EXACTLY — a near-miss warms a program the live
-        path never uses."""
-        from .kernels import quorum_multiround, quorum_step_dense
-
-        g, p, s = self.n_groups, self.n_peers, self.n_read_slots
-        e, rk = self.n_kv_ents, self.n_kv_reads
-        if has_reads:
-            read_dims = lambda *lead: (  # noqa: E731
-                jnp.full(lead + (g, s), -1, jnp.int32),
-                jnp.zeros(lead + (g, s), jnp.int32),
-                jnp.zeros(lead + (g, s, p), bool),
-            )
-        if has_kv:
-            kv_dims = lambda *lead: (  # noqa: E731
-                jnp.full(lead + (g, e), -1, jnp.int32),
-                jnp.zeros(lead + (g, e), jnp.int32),
-                jnp.zeros(lead + (g, e), jnp.int32),
-                jnp.full(lead + (g, rk), -1, jnp.int32),
-            )
+        the successor state is returned)."""
+        fn, args, statics = self._variant_args(kind, arg, has_reads, has_kv)
         with self._dispatch_mu:  # multi-device programs take the lock
-            if kind == "fused":
-                k = arg
-                read_args = read_dims(k) if has_reads else (None, None, None)
-                kv_args = kv_dims(k) if has_kv else (None, None, None, None)
-                z11 = jnp.zeros((1, 1), jnp.int32)
-                out = quorum_multiround(
-                    scratch,
-                    jnp.full((k, g, p), -1, jnp.int32),
-                    jnp.zeros((1, 1, 1), jnp.int8),
-                    z11, z11, z11, z11,
-                    jnp.zeros((k,), bool),
-                    *read_args,
-                    *kv_args,
-                    do_tick=True,
-                    track_contact=True,
-                    has_votes=False,
-                    has_churn=False,
-                    has_reads=has_reads,
-                    purge_reads=False,
-                    has_kv=has_kv,
-                    purge_kv=False,
-                )
-            elif kind == "dense":
-                do_tick = arg
-                read_args = read_dims() if has_reads else (None, None, None)
-                kv_args = kv_dims() if has_kv else (None, None, None, None)
-                out = quorum_step_dense(
-                    scratch,
-                    jnp.zeros((g, p), jnp.int32),
-                    jnp.zeros((g, p), bool),
-                    jnp.zeros((1, 1), jnp.int8),
-                    *read_args,
-                    *kv_args,
-                    do_tick=do_tick,
-                    track_contact=self.device_ticks or do_tick,
-                    has_votes=False,
-                    has_reads=has_reads,
-                    has_kv=has_kv,
-                )
-            else:  # sparse single-round (the quiet-path workhorse)
-                do_tick = arg
-                cap = self.event_cap
-                z32 = jnp.zeros((cap,), jnp.int32)
-                has_votes = kind == "sparse_votes"
-                if has_votes:  # vote events pad to the full event cap
-                    vg = vp = z32
-                    vv = jnp.zeros((cap,), jnp.int8)
-                    vvalid = jnp.zeros((cap,), bool)
-                else:
-                    vg = vp = jnp.zeros((1,), jnp.int32)
-                    vv = jnp.zeros((1,), jnp.int8)
-                    vvalid = jnp.zeros((1,), bool)
-                out = quorum_step(
-                    scratch,
-                    z32, z32, z32,
-                    jnp.zeros((cap,), bool),
-                    vg, vp, vv, vvalid,
-                    do_tick=do_tick,
-                    track_contact=self.device_ticks or do_tick,
-                    has_votes=has_votes,
-                )
+            out = fn(scratch, *args, **statics)
             jax.block_until_ready(out.committed)
         return out.state
+
+    def lower_variant(
+        self, kind: str, arg, has_reads: bool, has_kv: bool = False
+    ):
+        """AOT-lower one warm-plan variant against abstract shapes — no
+        allocation, no dispatch.  ``.compile()`` on the result yields the
+        XLA executable's ``cost_analysis()`` / ``memory_analysis()``:
+        the devprof program registry's per-program flops/bytes/peak-temp
+        figures (ISSUE 15).  With the persistent compilation cache
+        enabled the compile step deserializes the warmed executable
+        instead of recompiling."""
+        fn, args, statics = self._variant_args(
+            kind, arg, has_reads, has_kv, abstract=True
+        )
+        from .state import make_state
+
+        st = jax.eval_shape(
+            lambda: make_state(
+                self.n_groups, self.n_peers, self.n_read_slots,
+                self.n_kv_slots, self.n_kv_ents,
+            )
+        )
+        if self.sharding is not None:
+            # a mesh-sharded engine's live/warmed programs are GSPMD
+            # partitions of the state — lowering unsharded here would
+            # analyze an executable the cluster never runs (and miss
+            # the persistent cache).  The event args stay unsharded,
+            # matching the live call sites (host numpy → replication
+            # decided by GSPMD, exactly as _warm_one dispatches them).
+            st = jax.tree_util.tree_map(
+                lambda s: jax.ShapeDtypeStruct(
+                    s.shape, s.dtype, sharding=self.sharding
+                ),
+                st,
+            )
+        return fn.lower(st, *args, **statics)
 
     @staticmethod
     def _obs_gate(do_tick, acks, votes, recycles, reads, echoes) -> str:
@@ -2395,20 +2512,15 @@ class BatchedQuorumEngine:
             n_echo = int(sum(
                 b.racks[0].size for b in blocks if b.racks is not None
             ))
-            up = ack_max.nbytes
-            if has_votes:
-                up += vote_new.nbytes
-            if has_churn:
-                up += (
-                    churn_row.nbytes + churn_term.nbytes
-                    + churn_start.nbytes + churn_last.nbytes
-                )
-            if has_reads:
-                up += stage_idx.nbytes + stage_cnt.nbytes + echo.nbytes
+            # EXACTLY the argument tuple the kernel received (dummies of
+            # compiled-out planes included — they are genuinely shipped):
+            # the one accounting point shared with the devprof capacity
+            # model (upload_nbytes docstring)
+            up = upload_nbytes(
+                ack_max, vote_new, churn_row, churn_term, churn_start,
+                churn_last, tick_mask, *read_args, *kv_args,
+            )
             if has_kv:
-                up += (
-                    kv_ei.nbytes + kv_ek.nbytes + kv_ev.nbytes + kv_rk.nbytes
-                )
                 n_kvops = int(sum(
                     b.kvents[0].size for b in blocks if b.kvents is not None
                 ))
@@ -2445,6 +2557,24 @@ class BatchedQuorumEngine:
                 ),
             )
             self.last_span_seq = self._obs_span["seq"]
+        dp = self._devprof
+        if dp is not None:
+            # device capacity & profiling plane (ISSUE 15): padding-waste
+            # accounting (padded program K vs live rounds — the padding
+            # rounds are provable no-ops, i.e. measurable wasted device
+            # work) plus the sampled block_until_ready device-time
+            # estimate; the sampled delta is stamped onto this
+            # dispatch's flight-recorder span as `device_ms`
+            dp.note_dispatch(
+                "fused", out.committed, rounds=k,
+                live_rounds=(
+                    min(k, k_rounds) if k_rounds is not None else k
+                ),
+                # only a span THIS dispatch recorded: after disable_obs
+                # the stale _obs_span still references an old ring
+                # record, and stamping device_ms there would corrupt it
+                span=self._obs_span if obs is not None else None,
+            )
         return out
 
     def _refresh_committed_cache(self) -> None:
@@ -2866,9 +2996,8 @@ class BatchedQuorumEngine:
         if self._obs is not None:
             # accumulated: an oversized backlog runs several chunked
             # dispatches per step and the span must account them all
-            self._obs_upload += (
-                ag.nbytes + ap.nbytes + av.nbytes + avalid.nbytes
-                + vg.nbytes + vp.nbytes + vv.nbytes + vvalid.nbytes
+            self._obs_upload += upload_nbytes(
+                ag, ap, av, avalid, vg, vp, vv, vvalid
             )
         out = quorum_step(
             self.dev,
@@ -2888,6 +3017,9 @@ class BatchedQuorumEngine:
             has_votes=bool(votes),
         )
         self._dev = out.state
+        dp = self._devprof
+        if dp is not None:
+            dp.note_dispatch("sparse", out.committed, rounds=1, live_rounds=1)
         return out
 
     def _dispatch_dense(
@@ -2961,14 +3093,10 @@ class BatchedQuorumEngine:
         else:
             kv_args = (None, None, None, None)
         if self._obs is not None:
-            up = ack_max.nbytes + touched.nbytes + vote_new.nbytes
-            if has_reads:
-                up += stage_idx.nbytes + stage_cnt.nbytes + echo.nbytes
-            if has_kv:
-                up += (
-                    kv_ei.nbytes + kv_ek.nbytes + kv_ev.nbytes + kv_rk.nbytes
-                )
-            self._obs_upload += up
+            # the exact kernel argument tuple (upload_nbytes docstring)
+            self._obs_upload += upload_nbytes(
+                ack_max, touched, vote_new, *read_args, *kv_args
+            )
         out = quorum_step_dense(
             self.dev,
             jnp.asarray(ack_max),
@@ -2983,6 +3111,9 @@ class BatchedQuorumEngine:
             has_kv=has_kv,
         )
         self._dev = out.state
+        dp = self._devprof
+        if dp is not None:
+            dp.note_dispatch("dense", out.committed, rounds=1, live_rounds=1)
         return out
 
     # ------------------------------------------------------------------

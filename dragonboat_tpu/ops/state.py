@@ -74,6 +74,59 @@ KV_ENT_SLOTS = 16
 KV_READ_SLOTS = 4
 
 
+# HBM-ledger plane classification (obs/devprof.py, ISSUE 15): which
+# subsystem owns each resident device field.  Everything not listed in
+# an optional plane belongs to the core quorum plane; the optional
+# planes are exactly the field sets the engine's `_read_plane_used` /
+# `_devsm_used` latches gate (``BatchedQuorumEngine._READ_KEYS`` /
+# ``_KV_KEYS`` must stay in lockstep — asserted in tests/test_devprof.py).
+READ_PLANE_FIELDS = ("read_index", "read_count", "read_acks")
+DEVSM_PLANE_FIELDS = ("kv_value", "kv_ent_index", "kv_ent_key", "kv_ent_val")
+
+
+def field_plane(name: str) -> str:
+    """The HBM-ledger plane a :class:`QuorumState` field belongs to."""
+    if name in READ_PLANE_FIELDS:
+        return "read"
+    if name in DEVSM_PLANE_FIELDS:
+        return "devsm"
+    return "quorum"
+
+
+def state_layout(
+    n_groups: int,
+    n_peers: int,
+    n_read_slots: int = None,
+    n_kv_slots: int = None,
+    n_kv_ents: int = None,
+) -> dict:
+    """Shape/dtype/byte layout of the resident device state WITHOUT
+    allocating it (``jax.eval_shape`` over :func:`make_state`): the
+    capacity model's source of truth.  Every field scales linearly with
+    the group axis, so ``sum(nbytes) / n_groups`` is the exact
+    bytes-per-group figure ``predict_bytes`` extrapolates from — and
+    because this walks the same constructor the engine allocates
+    through, a new state field can never silently escape the ledger."""
+    kw = {}
+    if n_read_slots is not None:
+        kw["n_read_slots"] = n_read_slots
+    if n_kv_slots is not None:
+        kw["n_kv_slots"] = n_kv_slots
+    if n_kv_ents is not None:
+        kw["n_kv_ents"] = n_kv_ents
+    sds = jax.eval_shape(lambda: make_state(n_groups, n_peers, **kw))
+    return {
+        name: {
+            "shape": tuple(int(d) for d in leaf.shape),
+            "dtype": str(np.dtype(leaf.dtype)),
+            "nbytes": int(np.prod(leaf.shape, dtype=np.int64))
+            * np.dtype(leaf.dtype).itemsize,
+            "plane": field_plane(name),
+        }
+        for name, leaf in sds._asdict().items()
+    }
+
+
 class QuorumState(NamedTuple):
     """Struct-of-arrays state for G groups × P peer slots.
 

@@ -212,6 +212,10 @@ class TpuQuorumCoordinator:
         # records, so a closed record names the round that released it.
         # None keeps the round loop bit-identical.
         self.replattr = None
+        # device capacity & profiling plane (obs/devprof.py, ISSUE 15;
+        # attached by NodeHost when device_profile > 0).  None keeps the
+        # engine's _devprof latch down and the dispatch path bit-identical.
+        self.devprof = None
         if _obs.enabled():
             self.enable_obs()
         if self._warm_requested:
@@ -270,6 +274,19 @@ class TpuQuorumCoordinator:
     def flight_recorder(self):
         """The attached flight recorder (None while obs is off)."""
         return self._obs.recorder if self._obs is not None else None
+
+    def enable_devprof(self, devprof):
+        """Attach the device capacity & profiling plane (ISSUE 15,
+        obs/devprof.py; NodeHost wires it when
+        ``NodeHostConfig.device_profile`` > 0): binds the DevProf to the
+        engine (flipping its ``_devprof`` latch — sampled device-time
+        estimation, padding-waste accounting, the HBM ledger) and hands
+        it this coordinator so its snapshots can reach the devsm plane's
+        shadow residency."""
+        devprof.coord = self
+        devprof.bind_engine(self.eng)
+        self.devprof = devprof
+        return devprof
 
     def health_snapshot(self) -> dict:
         """Round-loop health for the cluster health sampler (ISSUE 13):

@@ -123,6 +123,82 @@ def test_devsm_families_help_round_trip():
     assert "dragonboat_devsm_slot_occupancy 4" in lines
 
 
+def test_devprof_families_help_round_trip():
+    """ISSUE 15 satellite: every ``dragonboat_devprof_*`` family a
+    DevProfObs registers carries its described ``# HELP`` immediately
+    before its ``# TYPE``, the ledger/program/estimator publishers land
+    the expected values, and the exposition is write-stable."""
+    from dragonboat_tpu.obs.instruments import DevProfObs
+
+    reg = MetricsRegistry()
+    obs = DevProfObs(reg)
+    obs.device_ms(1.5)
+    obs.flush_dispatch(
+        dispatches=4, sampled=1, padded=16, wasted=14,
+        waste_ratio=14 / 16, duty_cycle=0.25,
+    )
+    obs.ledger(
+        artifacts={("quorum", "match"): 1024, ("read", "read_acks"): 256},
+        planes={"quorum": 1024, "read": 256},
+        bytes_per_group=384.0,
+        capacity_groups=1000,
+        model_error_pct=0.0,
+    )
+    obs.program(
+        variant="fused:k4", flops=100.0, bytes_accessed=2048.0,
+        temp_bytes=512, compile_ms=3.0,
+    )
+    obs.programs_done(1)
+    obs.capture(active=True)
+    obs.capture(active=False)
+    out = io.StringIO()
+    reg.write_health_metrics(out)
+    lines = out.getvalue().splitlines()
+    families = (
+        "dragonboat_devprof_hbm_bytes",
+        "dragonboat_devprof_hbm_plane_bytes",
+        "dragonboat_devprof_bytes_per_group",
+        "dragonboat_devprof_capacity_groups",
+        "dragonboat_devprof_model_error_pct",
+        "dragonboat_devprof_device_ms",
+        "dragonboat_devprof_duty_cycle",
+        "dragonboat_devprof_dispatches_total",
+        "dragonboat_devprof_sampled_total",
+        "dragonboat_devprof_padded_rounds_total",
+        "dragonboat_devprof_wasted_rounds_total",
+        "dragonboat_devprof_padding_waste_ratio",
+        "dragonboat_devprof_programs",
+        "dragonboat_devprof_program_compile_ms",
+        "dragonboat_devprof_program_flops",
+        "dragonboat_devprof_program_bytes",
+        "dragonboat_devprof_program_temp_bytes",
+        "dragonboat_devprof_captures_total",
+        "dragonboat_devprof_capture_active",
+    )
+    for name in families:
+        tidx = [
+            i for i, l in enumerate(lines) if l.startswith(f"# TYPE {name} ")
+        ]
+        assert len(tidx) == 1, name
+        help_line = lines[tidx[0] - 1]
+        assert help_line.startswith(f"# HELP {name} "), help_line
+        assert "dragonboat_tpu metric" not in help_line, help_line
+    assert "dragonboat_devprof_wasted_rounds_total 14" in lines
+    assert "dragonboat_devprof_capacity_groups 1000" in lines
+    assert (
+        'dragonboat_devprof_hbm_bytes{artifact="match",plane="quorum"} 1024'
+        in lines
+        or 'dragonboat_devprof_hbm_bytes{plane="quorum",artifact="match"} '
+        "1024" in lines
+    )
+    assert "dragonboat_devprof_capture_active 0" in lines
+    assert "dragonboat_devprof_captures_total 1" in lines
+    # a second write is byte-identical (stable ordering incl. HELP)
+    out2 = io.StringIO()
+    reg.write_health_metrics(out2)
+    assert out2.getvalue().splitlines() == lines
+
+
 def test_lease_families_help_round_trip():
     """ISSUE 10 satellite: every ``dragonboat_lease_*`` family a LeaseObs
     registers (and the coordinator table's gauge) carries its described

@@ -868,11 +868,45 @@ def test_live_tpu_engine_lease_and_coordinator_table():
         # 60s base: the 30s scaled budget still expired once per loaded
         # sweep (the r12 rotating profile's most frequent site) while
         # the same wait passes standalone in seconds — arming is
-        # contention-bound, not broken, so only the margin widens
-        wait_until(
-            lambda: (nh.lease_status(CID) or {}).get("held"),
-            timeout=60.0, what="lease armed",
-        )
+        # contention-bound, not broken, so only the margin widens.
+        # r15 deflake (the ONE remaining rotating site of the r14
+        # sweeps, observed at load >4): the no-arm mode was PROBED, not
+        # guessed — on a starved box leadership CHURNS (one probe
+        # caught host 1 twenty terms past its driven win, leader on
+        # host 2), and a wait that only polls `held` then watches a
+        # FOLLOWER forever: a follower's lease can never arm, so no
+        # margin is wide enough.  The wait therefore re-drives host-1
+        # leadership while it waits (the `_start` transfer/campaign
+        # treatment applied continuously) under ONE hard-capped total
+        # budget — load-scaled like every loadwait site but never past
+        # 300s, so a pathological box surfaces one attributable
+        # failure instead of eating the sweep's global timeout (naive
+        # stacked retries of scaled 60s waits measured exactly that)
+        from tests.loadwait import scaled as _lease_scaled
+
+        def _lead_and_armed():
+            n1 = nh.get_node(CID)
+            if not n1.is_leader():
+                lid, ok = n1.get_leader_id()
+                if ok and lid != 1 and 1 <= lid <= len(nhs):
+                    try:
+                        nhs[lid - 1].request_leader_transfer(CID, 1)
+                    except Exception:
+                        pass
+                else:
+                    n1.request_campaign()
+                return False
+            return bool((nh.lease_status(CID) or {}).get("held"))
+
+        arm_deadline = time.time() + min(300.0, _lease_scaled(90.0))
+        while not _lead_and_armed():
+            if time.time() >= arm_deadline:
+                raise AssertionError(
+                    f"lease armed not reached (leader "
+                    f"{nh.get_leader_id(CID)!r}, status "
+                    f"{nh.lease_status(CID)!r})"
+                )
+            time.sleep(0.2)
         before = (nh.lease_status(CID) or {}).get("reads_local", 0)
         assert _read_retry(nh, CID, "a", timeout=30.0) == "2"
         st = nh.lease_status(CID)
