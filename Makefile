@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test test-all test-kernels test-obs test-trace test-warmup \
 	test-hostplane test-hostproc test-lease test-devsm test-health \
-	test-repltrace test-devprof \
+	test-repltrace test-devprof test-mesh \
 	native soak soak-smoke bench dryrun perf-ledger perf-ledger-check
 
 test: native
@@ -107,6 +107,18 @@ test-health:
 # the engine's dispatch accounting or ops/state.py's layout change
 test-devprof:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_devprof.py -q
+
+# fast cpu gate for the mesh-sharded dispatch plane (ISSUE 16): the
+# mesh ≡ single-device ≡ scalar-oracle commit/read differentials, live
+# migration with watermark preservation + the quiescence refusal,
+# cost-driven rebalancing, verifiably-overlapping per-shard dispatch
+# spans (the no-global-mutex proof), mesh warmup readiness, and the
+# full 3-NodeHost sharded stack — run before the full tier-1 sweep
+# whenever ops/mesh.py, ops/engine.py's dispatch path, the coordinator
+# mesh branch or the placement/rebalance logic change
+test-mesh:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_mesh_dispatch.py \
+	    tests/test_sharding.py -q
 
 # fast cpu gate for the leader-lease read plane (ISSUE 10): the
 # lease ≡ ReadIndex ≡ scalar-oracle differential, the invalidation

@@ -30,7 +30,8 @@ request completion, exactly like the reference's published 9M writes/s
 
 Run standalone:  python bench_e2e.py     (env: E2E_GROUPS, E2E_DURATION,
                  E2E_WINDOW, E2E_RTT_MS, E2E_ENGINE, E2E_DURABLE,
-                 E2E_THREADS, E2E_PROCS, E2E_LEADER_MODE, E2E_DEADLINE)
+                 E2E_THREADS, E2E_PROCS, E2E_LEADER_MODE, E2E_DEADLINE,
+                 E2E_MESH_DEVICES — tpu engine over the mesh dispatch plane)
 From bench.py:   bench_e2e.run_quick() → dict for the JSON detail field.
 """
 from __future__ import annotations
@@ -655,6 +656,13 @@ def _mk_nodehosts(n_hosts, groups, rtt_ms, engine, dirs, trace=0):
                         quorum_engine=engine,
                         engine_block_groups=max(groups, 64),
                         logdb_shards=4,
+                        # mesh-sharded dispatch plane (ISSUE 16): N > 1
+                        # builds each tpu-engine coordinator over the
+                        # MeshQuorumEngine facade — one dispatch stream
+                        # per shard instead of one GSPMD program
+                        engine_mesh_devices=int(
+                            os.environ.get("E2E_MESH_DEVICES", "0")
+                        ),
                     ),
                 )
             )

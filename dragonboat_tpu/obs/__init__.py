@@ -14,7 +14,7 @@ handling lives on cheap continuous telemetry"):
   bytes, dispatch/egress wall time, multidev-mutex wait, egress rows and
   reads released, gate reason), dumpable as JSON on demand and
   AUTO-dumped when a span trips the stall threshold — the round-gate
-  watchdog and ``_MULTIDEV_MU`` wait feed the same check;
+  watchdog and the multi-device dispatch-lock wait feed the same check;
 - :mod:`instruments` — ``EngineObs`` / ``CoordObs``: counters, gauges
   and latency histograms published into the existing
   :class:`dragonboat_tpu.events.MetricsRegistry`, so

@@ -380,17 +380,13 @@ class DevProf:
     # pillar 1: the HBM memory ledger
     # ------------------------------------------------------------------
 
-    def hbm_ledger(self) -> dict:
-        """Walk the engine's resident device state and price every
-        artifact (live ``nbytes`` — pure metadata, no transfer), plus
-        the in-flight pipelined dispatch's egress accumulators.  Also
-        publishes the ledger gauges and the capacity-model summary."""
-        eng = self.engine
-        if eng is None:
-            return {}
+    @staticmethod
+    def _engine_artifacts(eng) -> Dict[Tuple[str, str], int]:
+        """Price ONE engine's resident device state: the quorum state
+        tensors plus the in-flight pipelined dispatch's egress
+        accumulators (live ``nbytes`` — pure metadata, no transfer)."""
         artifacts: Dict[Tuple[str, str], int] = {}
-        st = eng._dev
-        for name, arr in st._asdict().items():
+        for name, arr in eng._dev._asdict().items():
             artifacts[(field_plane(name), name)] = int(arr.nbytes)
         inflight = eng._inflight
         if inflight is not None:
@@ -414,6 +410,49 @@ class DevProf:
             # the double buffer: out.state already IS eng._dev (donated
             # chain) so only the egress accumulators are extra residency
             artifacts[("dispatch", "inflight_egress")] = extra
+        return artifacts
+
+    def hbm_ledger(self) -> dict:
+        """Walk the engine's resident device state and price every
+        artifact, plus the in-flight pipelined dispatch's egress
+        accumulators.  Also publishes the ledger gauges and the
+        capacity-model summary.
+
+        On a mesh-sharded facade (``ops/mesh.py``) every per-shard
+        engine is walked: the top-level artifacts/planes aggregate
+        across shards (residency totals stay comparable with the
+        single-device ledger), a ``shards`` section itemizes each
+        shard's residency, and the gauges publish BOTH the aggregate
+        rows and per-shard ``dragonboat_devprof_hbm_bytes{shard}``
+        rows."""
+        eng = self.engine
+        if eng is None:
+            return {}
+        shards = getattr(eng, "shards", None)
+        shard_rows: Optional[list] = None
+        shard_artifacts: Optional[list] = None
+        if shards:
+            artifacts = {}
+            shard_rows, shard_artifacts = [], []
+            for s in shards:
+                arts = self._engine_artifacts(s)
+                arts.setdefault(("dispatch", "inflight_egress"), 0)
+                shard_artifacts.append(arts)
+                splanes: Dict[str, int] = {}
+                for (plane, art), nbytes in arts.items():
+                    artifacts[(plane, art)] = (
+                        artifacts.get((plane, art), 0) + nbytes
+                    )
+                    splanes[plane] = splanes.get(plane, 0) + nbytes
+                shard_rows.append({
+                    "planes": splanes,
+                    "state_bytes": sum(
+                        b for (p, _), b in arts.items() if p != "dispatch"
+                    ),
+                    "total_bytes": sum(splanes.values()),
+                })
+        else:
+            artifacts = self._engine_artifacts(eng)
         planes: Dict[str, int] = {}
         for (plane, _), nbytes in artifacts.items():
             planes[plane] = planes.get(plane, 0) + nbytes
@@ -433,6 +472,8 @@ class DevProf:
             "state_bytes": state_bytes,
             "total_bytes": sum(planes.values()),
         }
+        if shard_rows is not None:
+            ledger["shards"] = shard_rows
         model = self.capacity_model(ledger_state_bytes=state_bytes)
         ledger["capacity"] = model
         obs = self._obs
@@ -450,6 +491,7 @@ class DevProf:
                 bytes_per_group=model["bytes_per_group"],
                 capacity_groups=model.get("max_groups") or 0,
                 model_error_pct=model.get("model_error_pct"),
+                shard_artifacts=shard_artifacts,
             )
         with self._mu:
             self._ledger_mono = time.monotonic()
@@ -472,22 +514,33 @@ class DevProf:
         extrapolate max groups per device.  ``budget_bytes`` overrides
         the device's own ``memory_stats()['bytes_limit']`` (absent on
         backends that don't report one, e.g. cpu — ``max_groups`` is
-        then None unless a budget is passed)."""
+        then None unless a budget is passed).
+
+        On a mesh-sharded facade the geometry half models ONE SHARD
+        (each per-shard engine is an independent single-device
+        allocation) and the capacity answer multiplies by mesh size:
+        ``max_groups_per_device`` from the tightest per-device budget,
+        ``max_groups`` = that × ``mesh_shards``."""
         eng = self.engine
         if eng is None:
             return {}
         from ..ops.engine import WARM_K_BUCKETS
 
+        shards = getattr(eng, "shards", None)
+        # geometry donor: one shard's engine on a mesh (per-device
+        # residency), the engine itself otherwise
+        geng = shards[0] if shards else eng
+        n_shards = len(shards) if shards else 1
         key = (bool(eng._read_plane_used), bool(eng._devsm_used))
         base = self._predict_cache.get(key)
         if base is None:
             k = max(WARM_K_BUCKETS)
             base = predict_bytes(
-                eng.n_groups, eng.n_peers,
-                n_read_slots=eng.n_read_slots,
-                n_kv_slots=eng.n_kv_slots,
-                n_kv_ents=eng.n_kv_ents,
-                n_kv_reads=eng.n_kv_reads,
+                geng.n_groups, geng.n_peers,
+                n_read_slots=geng.n_read_slots,
+                n_kv_slots=geng.n_kv_slots,
+                n_kv_ents=geng.n_kv_ents,
+                n_kv_reads=geng.n_kv_reads,
                 k_bucket=k,
                 include_reads=key[0],
                 include_kv=key[1],
@@ -498,7 +551,7 @@ class DevProf:
             # tensors a fused dispatch actually ships (predict_bytes's
             # closed form is the engine-less twin; the test suite
             # asserts the two agree on every plane combination)
-            _, args, _ = eng._variant_args(
+            _, args, _ = geng._variant_args(
                 "fused", k, key[0], key[1], abstract=True
             )
             base["dispatch_bytes"] = _spec_nbytes(args)
@@ -508,43 +561,75 @@ class DevProf:
         # the cached geometry half is immutable
         pred = dict(base)
         if ledger_state_bytes is None:
+            engines = shards if shards else [eng]
             ledger_state_bytes = sum(
-                int(arr.nbytes) for arr in eng._dev._asdict().values()
+                int(arr.nbytes)
+                for e in engines
+                for arr in e._dev._asdict().values()
             )
         measured = ledger_state_bytes
+        predicted_state = pred["state_bytes"] * n_shards
         if measured:
             pred["measured_state_bytes"] = measured
             pred["model_error_pct"] = round(
-                (pred["state_bytes"] - measured) / measured * 100.0, 4
+                (predicted_state - measured) / measured * 100.0, 4
             )
+        per_device_budgets = None
         if budget_bytes is None:
-            budget_bytes = self._device_budget()
+            budget_bytes, per_device_budgets = self._device_budget()
         pred["budget_bytes"] = budget_bytes
         # every term scales linearly with G, so one division extrapolates:
         # resident bytes/group plus the fused dispatch's per-group upload
         per_group = (
             pred["bytes_per_group"]
-            + pred["dispatch_bytes"] / max(1, eng.n_groups)
+            + pred["dispatch_bytes"] / max(1, geng.n_groups)
         )
         pred["bytes_per_group_with_dispatch"] = per_group
-        pred["max_groups"] = (
-            int(budget_bytes // per_group) if budget_bytes else None
-        )
+        per_dev = int(budget_bytes // per_group) if budget_bytes else None
+        if n_shards > 1:
+            pred["mesh_shards"] = n_shards
+            pred["state_bytes_total"] = predicted_state
+            pred["total_bytes_total"] = pred["total_bytes"] * n_shards
+            if per_device_budgets is not None:
+                pred["device_budgets"] = per_device_budgets
+            pred["max_groups_per_device"] = per_dev
+            pred["max_groups"] = (
+                per_dev * n_shards if per_dev is not None else None
+            )
+        else:
+            pred["max_groups"] = per_dev
         return pred
 
-    def _device_budget(self) -> Optional[int]:
-        """The backend-reported memory budget of the device holding the
-        engine state (None where the backend has no ``memory_stats`` —
-        the cpu client)."""
+    def _device_budget(self) -> Tuple[Optional[int], Optional[list]]:
+        """The backend-reported memory budget of the device(s) holding
+        the engine state: ``(per_device_budget, per_shard_budgets)``.
+        On a mesh the per-device budget is the TIGHTEST shard's (a
+        capacity plan must fit the worst device); per_shard_budgets
+        lists them all.  ``(None, None)`` where the backend has no
+        ``memory_stats`` — the cpu client."""
         eng = self.engine
-        try:
-            dev = next(iter(eng._dev.committed.devices()))
-            stats = dev.memory_stats()
-        except Exception:
-            return None
-        if not stats:
-            return None
-        return stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        shards = getattr(eng, "shards", None)
+        engines = shards if shards else [eng]
+        budgets: list = []
+        for e in engines:
+            try:
+                dev = next(iter(e._dev.committed.devices()))
+                stats = dev.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                budgets.append(None)
+                continue
+            budgets.append(
+                stats.get("bytes_limit")
+                or stats.get("bytes_reservable_limit")
+            )
+        known = [b for b in budgets if b]
+        if not known:
+            return None, None
+        if shards:
+            return min(known), budgets
+        return known[0], None
 
     # ------------------------------------------------------------------
     # pillar 2: the program registry

@@ -41,6 +41,15 @@ This module closes that gap with three layers:
                       window with the lease held
   ``devsm_rebind``    ≥ ``devsm_rebind_binds`` device-plane rebinds of
                       one group inside the window (a bind/unbind loop)
+  ``shard_imbalance`` mesh-sharded engine (ops/mesh.py): per-shard
+                      dispatch-cost EMA skew above
+                      ``shard_imbalance_ratio`` (or group-count skew
+                      > 1) across ``shard_imbalance_samples``
+                      consecutive samples — the facade's own
+                      rebalancer fires at a LOWER ratio, so an open
+                      event means placement is failing to converge
+                      (e.g. every hot group is migration-ineligible);
+                      closes when a migration or load shift rebalances
   ==================  ==================================================
 
   Every open/close publishes ``dragonboat_health_*`` families, records a
@@ -99,6 +108,7 @@ DETECTORS = (
     "worker_flap",
     "lease_thrash",
     "devsm_rebind",
+    "shard_imbalance",
 )
 
 #: recovery-attribution aliases for :meth:`NodeHost.health_report` /
@@ -108,6 +118,7 @@ ATTRIBUTION = {
     "failover": "leader_flap",
     "worker_respawn": "worker_flap",
     "devsm_rebind": "devsm_rebind",
+    "shard_rebalance": "shard_imbalance",
 }
 
 
@@ -144,6 +155,8 @@ class HealthSampler:
         lease_thrash_events: int = 4,
         devsm_rebind_binds: int = 3,
         flap_window_s: float = 10.0,
+        shard_imbalance_samples: int = 3,
+        shard_imbalance_ratio: float = 3.0,
     ):
         if capacity < 1:
             raise ValueError("health ring capacity must be >= 1")
@@ -163,6 +176,8 @@ class HealthSampler:
         self.lease_thrash_events = lease_thrash_events
         self.devsm_rebind_binds = devsm_rebind_binds
         self.flap_window_s = flap_window_s
+        self.shard_imbalance_samples = shard_imbalance_samples
+        self.shard_imbalance_ratio = shard_imbalance_ratio
         # sample ring (the FlightRecorder shape: bounded, lock-light)
         self._buf: List[Optional[dict]] = [None] * capacity
         self._n = 0
@@ -182,6 +197,7 @@ class HealthSampler:
         self._lease_events: Dict[int, deque] = {}
         self._devsm_binds: Dict[int, deque] = {}
         self._prev_hostproc: Optional[dict] = None
+        self._imbalance_streak = 0
 
     # ------------------------------------------------------------------
     # sampling (tick worker)
@@ -291,6 +307,8 @@ class HealthSampler:
                 self._set(det, f"group:{cid}", False, now, {})
         hostproc = (sample.get("host") or {}).get("hostproc")
         self._eval_worker_flap(hostproc, now)
+        coord = (sample.get("host") or {}).get("coord")
+        self._eval_shard_imbalance(coord, now)
 
     def _eval_commit_stall(self, cid, g, prev, now) -> None:
         flat = (
@@ -436,6 +454,36 @@ class HealthSampler:
         self._set(
             "worker_flap", "host", alive < workers or bumped, now,
             {"alive": alive, "workers": workers, "restarts": restarts},
+        )
+
+    def _eval_shard_imbalance(self, coord: Optional[dict], now) -> None:
+        shards = (coord or {}).get("shards")
+        if not shards or len(shards) < 2:
+            # single-device / non-mesh coordinator: no placement to skew
+            self._imbalance_streak = 0
+            self._set("shard_imbalance", "host", False, now, {})
+            return
+        counts = [s.get("groups", 0) for s in shards]
+        loads = [float(s.get("load_ms", 0.0)) for s in shards]
+        hot, cool = max(loads), min(loads)
+        # cost skew needs real load on the hot shard (the EMA idles at
+        # ~0 and a 0.002ms/0.0005ms ratio is noise, not imbalance);
+        # count skew of a single group is the rebalancer's own dead band
+        cost_skew = (
+            hot >= 1e-3
+            and hot > self.shard_imbalance_ratio * max(cool, 1e-6)
+        )
+        count_skew = max(counts) - min(counts) > 1
+        streak = (
+            self._imbalance_streak + 1 if (cost_skew or count_skew) else 0
+        )
+        self._imbalance_streak = streak
+        self._set(
+            "shard_imbalance", "host",
+            streak >= self.shard_imbalance_samples, now,
+            {"groups": counts, "load_ms": loads,
+             "migrations": (coord or {}).get("migrations"),
+             "samples": streak},
         )
 
     # ------------------------------------------------------------------

@@ -23,7 +23,8 @@ Families (device plane, published by ``EngineObs``):
   ``…reads_released_total`` — read-plane traffic
 - ``dragonboat_device_upload_bytes_total`` — host→device event tensors
 - ``dragonboat_device_egress_rows_total`` — rows whose commit advanced
-- ``dragonboat_device_multidev_wait_ms_total`` — ``_MULTIDEV_MU`` wait
+- ``dragonboat_device_multidev_wait_ms_total`` — multi-device dispatch
+  lock wait (zero on single-device / mesh-sharded engines)
 - ``dragonboat_device_stalls_total`` — watchdog-flagged spans
 - ``dragonboat_device_warmup_seconds`` / ``…warmup_programs_total`` —
   AOT warm-compile wall time and programs warmed (ISSUE 7)
@@ -66,6 +67,7 @@ _DEVSM = "dragonboat_devsm_"
 _HEALTH = "dragonboat_health_"
 _REPL = "dragonboat_repl_"
 _DEVPROF = "dragonboat_devprof_"
+_MESH = "dragonboat_mesh_"
 
 #: recovery-duration buckets (seconds): a worker respawn lands near the
 #: bottom, a failover around election timeouts, a wedged rebind loop or
@@ -101,7 +103,9 @@ _HELP = {
     _DEV + "reads_released_total": "client reads released by confirmed slots",
     _DEV + "upload_bytes_total": "host-to-device event tensor bytes",
     _DEV + "egress_rows_total": "rows whose commit watermark advanced",
-    _DEV + "multidev_wait_ms_total": "milliseconds waiting on _MULTIDEV_MU",
+    _DEV + "multidev_wait_ms_total": "milliseconds waiting on the "
+    "engine's multi-device dispatch lock (zero on single-device and "
+    "mesh-sharded engines)",
     _DEV + "stalls_total": "stall-watchdog-flagged dispatch spans",
     _DEV + "warmup_seconds": "wall seconds spent AOT warm-compiling",
     _DEV + "warmup_programs_total": "device programs AOT warm-compiled",
@@ -206,6 +210,18 @@ _HELP = {
     _DEVPROF + "captures_total": "on-demand jax.profiler capture "
     "windows started",
     _DEVPROF + "capture_active": "1 while a capture window is recording",
+    # mesh dispatch plane (ops/mesh.py, ISSUE 16)
+    _MESH + "shards": "per-shard engines behind the mesh dispatch plane",
+    _MESH + "groups": "raft groups currently placed on the shard, by "
+    "shard (the live group-to-shard assignment table)",
+    _MESH + "migrations_total": "groups migrated between shards by the "
+    "cost-driven placement pass (stage-out/stage-in, watermarks "
+    "preserved)",
+    _MESH + "migration_ms": "stage-out to stage-in wall time per group "
+    "migration",
+    _MESH + "dispatch_concurrency": "shard dispatch streams observed "
+    "simultaneously in flight per fan-out (the no-global-mutex "
+    "evidence: >1 means two shards dispatched concurrently)",
 }
 
 
@@ -224,7 +240,7 @@ class EngineObs:
     obs-off host path stays bit-identical (module docstring contract).
     """
 
-    __slots__ = ("recorder", "registry")
+    __slots__ = ("recorder", "registry", "shard")
 
     _COUNTERS = (
         _DEV + "dispatch_total",
@@ -255,10 +271,17 @@ class EngineObs:
     )
 
     def __init__(
-        self, recorder: FlightRecorder, registry: Optional[MetricsRegistry] = None
+        self,
+        recorder: FlightRecorder,
+        registry: Optional[MetricsRegistry] = None,
+        shard: Optional[int] = None,
     ):
         self.recorder = recorder
         self.registry = registry or DEFAULT_REGISTRY
+        #: shard index when the engine is one shard of a mesh dispatch
+        #: plane — stamped into dispatch spans so the ring shows which
+        #: stream launched what (the span-overlap evidence keys on it)
+        self.shard = shard
         r = self.registry
         _describe(r, self._COUNTERS + (
             _DEV + "staged_rounds", _DEV + "read_slots_in_use",
@@ -384,6 +407,8 @@ class EngineObs:
         extra = {"dispatches": n_dispatches} if n_dispatches > 1 else {}
         if k_rounds is not None:
             extra["k_rounds"] = k_rounds
+        if self.shard is not None:
+            extra["shard"] = self.shard
         span = self.recorder.record(
             kind,
             gate=gate,
@@ -778,13 +803,27 @@ class DevProfObs:
     def ledger(
         self, *, artifacts: dict, planes: dict, bytes_per_group: float,
         capacity_groups: int, model_error_pct: Optional[float],
+        shard_artifacts: Optional[list] = None,
     ) -> None:
+        """``shard_artifacts`` (mesh-sharded facade, ops/mesh.py): a list
+        of per-shard artifact dicts — each publishes its own
+        ``hbm_bytes{plane,artifact,shard}`` rows alongside the
+        aggregated shard-less rows, so a scrape sees both the whole
+        mesh's residency and each device's."""
         r = self.registry
         for (plane, artifact), nbytes in artifacts.items():
             r.gauge_set(
                 _DEVPROF + "hbm_bytes", nbytes,
                 labels={"plane": plane, "artifact": artifact},
             )
+        if shard_artifacts:
+            for i, per_shard in enumerate(shard_artifacts):
+                for (plane, artifact), nbytes in per_shard.items():
+                    r.gauge_set(
+                        _DEVPROF + "hbm_bytes", nbytes,
+                        labels={"plane": plane, "artifact": artifact,
+                                "shard": str(i)},
+                    )
         for plane in self._PLANES:
             r.gauge_set(
                 _DEVPROF + "hbm_plane_bytes", planes.get(plane, 0),
@@ -821,6 +860,91 @@ class DevProfObs:
         if active:
             r.counter_add(_DEVPROF + "captures_total")
         r.gauge_set(_DEVPROF + "capture_active", 1 if active else 0)
+
+
+#: dispatch-concurrency buckets: how many shard streams were in flight
+#: at once (mesh sizes are small powers of two; >1 is the headline)
+CONCURRENCY_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+
+class MeshObs:
+    """Mesh-dispatch-plane instruments (ops/mesh.py, ISSUE 16).
+
+    Families (``dragonboat_mesh_*``):
+
+    - gauge ``shards`` — per-shard engines behind the facade
+    - gauge ``groups{shard}`` — the live group→shard assignment table
+    - ``migrations_total`` + histogram ``migration_ms`` — cost-driven
+      placement moves and their stage-out→stage-in wall time
+    - histogram ``dispatch_concurrency`` — shard dispatch streams
+      simultaneously in flight per fan-out; any observation above 1 is
+      the direct "two shards dispatched concurrently" evidence the old
+      global mutex made impossible
+
+    Holds the SHARED recorder the per-shard ``EngineObs`` publish into
+    (one ring, so per-shard dispatch spans interleave and overlap is
+    assertable from span timestamps alone) — same ``recorder`` /
+    ``registry`` surface as ``EngineObs`` so the coordinator's obs
+    wiring is facade-agnostic.
+    """
+
+    __slots__ = ("recorder", "registry", "n_shards")
+
+    def __init__(
+        self,
+        recorder: FlightRecorder,
+        registry: Optional[MetricsRegistry] = None,
+        n_shards: int = 1,
+    ):
+        self.recorder = recorder
+        self.registry = registry or DEFAULT_REGISTRY
+        self.n_shards = n_shards
+        r = self.registry
+        _describe(r, (
+            _MESH + "shards", _MESH + "groups",
+            _MESH + "migrations_total", _MESH + "migration_ms",
+            _MESH + "dispatch_concurrency",
+        ))
+        r.gauge_set(_MESH + "shards", n_shards)
+        for i in range(n_shards):
+            r.gauge_set(_MESH + "groups", 0, labels={"shard": str(i)})
+        r.counter_add(_MESH + "migrations_total", 0)
+        r.histogram_declare(
+            _MESH + "migration_ms", buckets=LATENCY_BUCKETS_MS
+        )
+        r.histogram_declare(
+            _MESH + "dispatch_concurrency", buckets=CONCURRENCY_BUCKETS
+        )
+
+    def placement(self, counts) -> None:
+        """Publish the live assignment table (groups per shard)."""
+        r = self.registry
+        for i, n in enumerate(counts):
+            r.gauge_set(_MESH + "groups", n, labels={"shard": str(i)})
+
+    def migration(self, cluster_id, src, dst, wall_ms, counts) -> dict:
+        r = self.registry
+        r.counter_add(_MESH + "migrations_total")
+        r.histogram_observe(
+            _MESH + "migration_ms", wall_ms, buckets=LATENCY_BUCKETS_MS
+        )
+        self.placement(counts)
+        return self.recorder.record(
+            "mesh_migration",
+            cluster_id=cluster_id,
+            src_shard=src,
+            dst_shard=dst,
+            wall_ms=round(wall_ms, 4),
+        )
+
+    def concurrency(self, peak: int) -> None:
+        """One fan-out's high-water mark of simultaneously in-flight
+        shard dispatch streams."""
+        if peak > 0:
+            self.registry.histogram_observe(
+                _MESH + "dispatch_concurrency", peak,
+                buckets=CONCURRENCY_BUCKETS,
+            )
 
 
 class CoordObs:
@@ -884,8 +1008,10 @@ class CoordObs:
         ``fuse_skip`` names why a K>1 backlog did NOT fuse
         (``"warmup"`` — programs still compiling, ``"votes"`` — an
         election rode this round, ``"churn"`` — unwarmed in-program
-        recycles/pre-staged rounds in the backlog) so the warmup gate can
-        assert proposals never blocked on compilation."""
+        recycles/pre-staged rounds in the backlog, ``"mesh_warmup"`` —
+        a mesh coordinator's per-shard program sets still warming) so
+        the warmup gate can assert proposals never blocked on
+        compilation."""
         r = self.registry
         r.counter_add(_COORD + "rounds_total")
         if ops:

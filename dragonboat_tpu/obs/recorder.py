@@ -81,7 +81,11 @@ class FlightRecorder:
                             harvest; an in-flight span lacks it)
     ``egress_rows``         rows whose commit watermark advanced
     ``reads_released``      client reads released by confirmed slots
-    ``mu_wait_ms``          time spent waiting on ``_MULTIDEV_MU``
+    ``mu_wait_ms``          time spent waiting on the engine's multi-
+                            device dispatch lock (zero on single-device
+                            and mesh-sharded engines)
+    ``shard``               mesh shard index of the launching stream
+                            (mesh-sharded engines only, ops/mesh.py)
     ``wall_ms``             whole-round wall time (coordinator spans)
     ``device_ms``           sampled post-launch ``block_until_ready``
                             delta (the devprof device-time estimator,

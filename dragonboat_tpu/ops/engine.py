@@ -375,21 +375,6 @@ class BatchedQuorumEngine:
         out.commit[cid]                        # -> advanced commit index
     """
 
-    #: PROCESS-WIDE serialization of multi-device dispatches.  XLA's CPU
-    #: client runs each collective as an all-participant rendezvous on a
-    #: shared per-device thread pool; two INDEPENDENT sharded programs
-    #: (different engines — e.g. three in-process NodeHost coordinators
-    #: in the sharding tests) launched from different threads can
-    #: interleave their per-device work in different orders and deadlock
-    #: the rendezvous (observed: CollectivePermute participants of two
-    #: run_ids waiting on each other forever once the CI box shrank to
-    #: 2 vCPUs; programs of ONE engine are ordered by their donated-state
-    #: data dependency and cannot interleave).  Engines whose state spans
-    #: more than one device therefore hold this lock from launch through
-    #: the blocking egress; single-device engines (every production
-    #: deployment runs one engine per process anyway) take a no-op path.
-    _MULTIDEV_MU = threading.RLock()
-
     def __init__(
         self,
         n_groups: int,
@@ -442,9 +427,25 @@ class BatchedQuorumEngine:
             len(getattr(sharding, "device_set", ())) if sharding is not None
             else 1
         )
-        # reentrant on purpose: step -> step_rounds -> _harvest_inflight
-        # all guard themselves (see _MULTIDEV_MU)
-        self._dispatch_mu = self._MULTIDEV_MU if n_dev > 1 else nullcontext()
+        # Per-shard dispatch lock.  Engines whose state spans more than
+        # one device (GSPMD-partitioned programs with collectives) hold
+        # this lock from launch through the blocking egress: XLA's CPU
+        # client runs each collective as an all-participant rendezvous on
+        # a shared per-device thread pool, and two sharded programs of
+        # the SAME engine launched from different threads could otherwise
+        # interleave their per-device work and deadlock the rendezvous
+        # (programs of one engine are normally ordered by their
+        # donated-state data dependency; the lock makes that ordering
+        # explicit across host threads).  This used to be a PROCESS-WIDE
+        # class lock (`_MULTIDEV_MU`) because independent multi-device
+        # engines in one process shared the rendezvous pool too; the mesh
+        # dispatch plane (ops/mesh.py) now gives every shard its own
+        # single-device engine — no collectives, no rendezvous — so the
+        # global mutex died and each engine keeps only its own lock.
+        # Reentrant on purpose: step -> step_rounds -> _harvest_inflight
+        # all guard themselves.
+        self._n_devices = n_dev
+        self._dispatch_mu = threading.RLock() if n_dev > 1 else nullcontext()
         self._dev: QuorumState = self.mirror.to_device(sharding)
         self._cache_stale = False
         self.groups: Dict[int, GroupInfo] = {}
@@ -580,7 +581,7 @@ class BatchedQuorumEngine:
         self._obs = None
         self._obs_span = None      # span of the in-flight fused dispatch
         self._obs_kv_span = None   # apply_kernel span of the same dispatch
-        self._obs_mu_wait = 0.0    # _MULTIDEV_MU wait of the next dispatch
+        self._obs_mu_wait = 0.0    # _dispatch_mu wait of the next dispatch
         self._obs_upload = 0       # upload bytes of the current dispatch
         # --- device capacity & profiling plane (ISSUE 15) ---------------
         # LATCH, same contract as _obs: None by default, every hot-path
@@ -620,7 +621,7 @@ class BatchedQuorumEngine:
             "cache_hits": 0, "cache_misses": 0, "error": None,
         }
 
-    def enable_obs(self, recorder=None, registry=None):
+    def enable_obs(self, recorder=None, registry=None, shard=None):
         """Attach device-plane instruments (``obs.instruments.EngineObs``):
         per-dispatch flight-recorder spans plus the ``dragonboat_device_*``
         metric families in ``registry`` (default: the process registry
@@ -630,7 +631,9 @@ class BatchedQuorumEngine:
         instruments — an engine self-attached by the module latch must not
         swallow a later explicit wiring (NodeHost routing the families
         into ITS registry would otherwise silently publish to the default
-        one and expose nothing)."""
+        one and expose nothing).  ``shard`` tags this engine's dispatch
+        spans with its mesh shard index (``ops/mesh.py`` wiring — all
+        shards share ONE recorder, the tag tells their streams apart)."""
         if self._obs is not None and recorder is None and registry is None:
             return self._obs
         from ..obs.instruments import EngineObs
@@ -642,7 +645,7 @@ class BatchedQuorumEngine:
                 self._obs.recorder if self._obs is not None
                 else _obs.default_recorder()
             )
-        self._obs = EngineObs(recorder, registry=registry)
+        self._obs = EngineObs(recorder, registry=registry, shard=shard)
         return self._obs
 
     def disable_obs(self) -> None:
@@ -2165,15 +2168,18 @@ class BatchedQuorumEngine:
                 return self._step_rounds_locked(
                     do_tick, pipelined, pad_rounds_to, tick_rounds
                 )
-        t0 = time.perf_counter()
+        # _dispatch_mu wait (EXACTLY zero on single-device engines, where
+        # the "lock" is a nullcontext — don't record timer noise there):
+        # attributed to the NEXT dispatch's span; a wait past the stall
+        # threshold auto-dumps via the span's stall check.  ACCUMULATED,
+        # not assigned — step()'s reroute into step_rounds() re-enters
+        # here with the reentrant lock already held, and its ~0 wait must
+        # not erase the contended outer acquire.
+        timed = self._n_devices > 1
+        t0 = time.perf_counter() if timed else 0.0
         with self._dispatch_mu:
-            # _MULTIDEV_MU wait (zero on single-device engines): attributed
-            # to the NEXT dispatch's span; a wait past the stall threshold
-            # auto-dumps via the span's stall check.  ACCUMULATED, not
-            # assigned — step()'s reroute into step_rounds() re-enters here
-            # with the reentrant lock already held, and its ~0 wait must
-            # not erase the contended outer acquire.
-            self._obs_mu_wait += (time.perf_counter() - t0) * 1e3
+            if timed:
+                self._obs_mu_wait += (time.perf_counter() - t0) * 1e3
             return self._step_rounds_locked(
                 do_tick, pipelined, pad_rounds_to, tick_rounds
             )
@@ -2753,9 +2759,11 @@ class BatchedQuorumEngine:
         if obs is None:
             with self._dispatch_mu:
                 return self._step_locked(do_tick)
-        t0 = time.perf_counter()
+        timed = self._n_devices > 1
+        t0 = time.perf_counter() if timed else 0.0
         with self._dispatch_mu:
-            self._obs_mu_wait += (time.perf_counter() - t0) * 1e3
+            if timed:
+                self._obs_mu_wait += (time.perf_counter() - t0) * 1e3
             return self._step_locked(do_tick)
 
     def _step_locked(self, do_tick: bool) -> StepResult:

@@ -22,6 +22,19 @@ All shapes are static: ``G`` groups × ``P`` peer slots, event batches
 padded to a fixed ``K`` with a validity mask (invalid rows scatter out of
 bounds with ``mode='drop'``).  Everything fuses into one XLA program; on
 TPU the sort/scatter work sits in VMEM with no host round-trips.
+
+Every kernel is also PLACEMENT-AGNOSTIC by construction: no collective
+primitive appears anywhere in this module, because no per-group update
+ever reads another group's row — the group axis is embarrassingly
+parallel.  That property is what the mesh dispatch plane
+(``ops/mesh.py``, ISSUE 16) builds on: instead of one GSPMD-partitioned
+program whose compiled collectives forced a global dispatch mutex, each
+mesh shard launches these SAME kernels as ordinary single-device
+programs over its group partition, from its own stream, with no
+cross-shard rendezvous to deadlock and therefore no lock to serialize
+behind.  A kernel change here is automatically a change on every shard;
+keep the no-collectives invariant or the mesh plane's concurrency story
+breaks.
 """
 from __future__ import annotations
 

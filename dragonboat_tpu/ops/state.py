@@ -296,6 +296,28 @@ class HostMirror:
         if clear_kv:  # engine skips while its devsm plane is untouched
             self.clear_kv(row)
 
+    def row_image(self, row: int, skip=frozenset()) -> dict:
+        """Per-field dense copy of one row — the stage-out half of a
+        cross-shard group migration (``ops/mesh.py``).  ``skip`` names
+        fields the caller deliberately leaves behind: the mesh plane
+        skips its read/kv-plane columns because the migration quiescence
+        gate has already drained them, so the target's fresh-registration
+        defaults are the correct values."""
+        return {
+            k: np.copy(a[row]) for k, a in self.arrays.items()
+            if k not in skip
+        }
+
+    def restore_row(self, row: int, image: dict) -> None:
+        """Paste a captured ``row_image`` onto ``row`` verbatim — the
+        stage-in half of a migration (same geometry on both shards; the
+        cross-shard twin of ``recycle_row``).  The caller owns dirty
+        tracking: unlike ``recycle_row`` there is no in-program twin
+        applying the same write, so the row MUST be re-uploaded."""
+        a = self.arrays
+        for k, v in image.items():
+            a[k][row] = v
+
     def clear_kv(self, row: int) -> None:
         """Reset a row's device state machine: value slots to zero AND the
         pending-entry buffer freed.  A recycle's fresh tenant starts from

@@ -56,8 +56,22 @@ def run_sharded_stack_check(
     group-sharded over ``n_devices`` (``ExpertConfig.engine_mesh_devices``):
     real coordinator registration/staging/rounds, device-tick elections,
     and ``writes_per_group`` committed proposals per group.  Returns the
-    total committed write count; raises on any failure."""
+    total committed write count; raises on any failure.
+
+    ``n_devices`` is capped at the host's core count: each mesh shard
+    carries its own dispatch-stream thread, and this check builds THREE
+    coordinators, so 8 virtual shards on a 2-vCPU CI box means 24
+    dispatch threads thrashing 2 cores — measured 386s vs 12s for the
+    identical check at one stream per core.  Wide-mesh coverage (8
+    shards, single engine) lives in tests/test_mesh_dispatch.py and the
+    bench mesh_axis rung, which don't triple the stream count."""
+    import os
+
     from .ops.sharding import GROUP_AXIS
+
+    n_devices = min(n_devices, max(2, os.cpu_count() or 2))
+    while groups % n_devices:
+        n_devices -= 1
 
     router = ChanRouter()
     addrs = {i: f"mc{i}:1" for i in (1, 2, 3)}

@@ -68,29 +68,30 @@ class TpuQuorumCoordinator:
             k_bucket,
         )
 
-        # group-axis mesh sharding (ExpertConfig.engine_mesh_devices):
-        # every kernel op is row-wise over groups, so GSPMD partitions the
-        # whole fused step with zero steady-state collectives — each chip
-        # steps its slice of groups (ops/sharding.py design note)
-        sharding = None
+        # mesh-sharded dispatch plane (ExpertConfig.engine_mesh_devices,
+        # ops/mesh.py): no data ever flows BETWEEN groups, so N mesh
+        # devices run N independent single-device per-shard engines —
+        # each shard owns a contiguous group partition with its OWN
+        # concurrent dispatch stream and per-shard dispatch lock.  This
+        # replaced the GSPMD-partitioned single engine whose every
+        # dispatch was an all-device rendezvous serialized process-wide
+        # by the old _MULTIDEV_MU class lock (zero dispatch concurrency
+        # from mesh hardware); the GSPMD path remains available by
+        # constructing BatchedQuorumEngine(sharding=...) directly.
         mesh_n = 0  # effective shard count (0 = unsharded)
+        mesh_devs = None
         if mesh_devices > 1:
             import jax
-            import numpy as _np
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            from .ops.sharding import GROUP_AXIS, make_mesh
 
             devs = jax.devices()
             n = min(mesh_devices, len(devs))
             if n > 1:
                 capacity = ((capacity + n - 1) // n) * n
-                mesh = make_mesh(_np.array(devs[:n]))
-                sharding = NamedSharding(mesh, P(GROUP_AXIS))
+                mesh_devs = devs[:n]
                 mesh_n = n
                 plog.info(
-                    "quorum engine sharded over %d devices (%d rows)",
-                    n, capacity,
+                    "quorum engine mesh-sharded over %d devices "
+                    "(%d rows, %d per shard)", n, capacity, capacity // n,
                 )
         self.mesh_devices = mesh_n
         # persistent XLA compilation cache (ISSUE 7): enabled BEFORE any
@@ -110,10 +111,18 @@ class TpuQuorumCoordinator:
                 )
             except OSError as e:
                 plog.warning("compilation cache unavailable: %r", e)
-        self.eng = BatchedQuorumEngine(
-            capacity, n_peers, event_cap=max(4 * capacity, 4096),
-            device_ticks=drive_ticks, sharding=sharding,
-        )
+        if mesh_n > 1:
+            from .ops.mesh import MeshQuorumEngine
+
+            self.eng = MeshQuorumEngine(
+                capacity, n_peers, event_cap=max(4 * capacity, 4096),
+                devices=mesh_devs, device_ticks=drive_ticks,
+            )
+        else:
+            self.eng = BatchedQuorumEngine(
+                capacity, n_peers, event_cap=max(4 * capacity, 4096),
+                device_ticks=drive_ticks,
+            )
         self.capacity = capacity
         # adaptive K-round batching (ISSUE 7 tentpole): once the warmup
         # pass has compiled the padded fused program set, the round
@@ -125,14 +134,13 @@ class TpuQuorumCoordinator:
         # would silently drop the ticks past the pad clamp
         self.fused_k_max = max(WARM_K_BUCKETS)
         self.fused_dispatches = 0
-        # auto-warm only unsharded ticking engines: the fused live path
-        # is tick-deficit replay (meaningless without drive_ticks), and
-        # on a MESH-sharded engine the warm dispatches are multi-device
-        # collectives in exactly the XLA-CPU-client rendezvous zone
-        # _MULTIDEV_MU exists for — multi-chip fused batching is ROADMAP
-        # item 3's work, not a warmup default (a sharded caller can
-        # still warm explicitly via start_warmup()).
-        self._warm_requested = warm_fused and drive_ticks and mesh_n <= 1
+        # auto-warm only ticking engines: the fused live path is
+        # tick-deficit replay, meaningless without drive_ticks.  Mesh
+        # coordinators warm too — each shard's program set is
+        # single-device (no collectives, no rendezvous), walked
+        # sequentially off the round thread by the facade's niced
+        # background warmer (ops/mesh.py warmup_fused).
+        self._warm_requested = warm_fused and drive_ticks
         # device-tick mode: the per-tick firing decisions (election due,
         # heartbeat due, check-quorum window) come from the device tick
         # kernel; registered nodes set raft.device_ticks accordingly
@@ -164,6 +172,10 @@ class TpuQuorumCoordinator:
         # (NodeHost.start_cluster with Config.device_kv).  None keeps the
         # round loop bit-identical — every hook below gates on it.
         self.devsm = None
+        # cost-driven placement cadence (mesh only): the round thread
+        # runs at most one bounded rebalance pass per interval
+        self._rebalance_interval = 1.0
+        self._next_rebalance = time.monotonic() + self._rebalance_interval
         # monotonically increasing tick sequence written ONLY by the tick
         # thread; the round compares against the last value it consumed, so
         # a tick arriving mid-round is never lost (no lock needed: single
@@ -233,12 +245,17 @@ class TpuQuorumCoordinator:
         round uses the already-compiled single-round programs — a
         proposal never waits on XLA.
 
-        No-op (returns None) on a mesh-sharded or tickless coordinator
-        unless ``force``: the fused live path is tick-deficit replay,
-        and multi-device warm dispatches sit in exactly the XLA-CPU
-        rendezvous zone ``_MULTIDEV_MU`` exists for (multi-chip fused
-        batching is ROADMAP item 3's work)."""
-        if not force and (self.mesh_devices > 1 or not self.drive_ticks):
+        Mesh-sharded coordinators warm too: the facade's background
+        walker compiles each shard's SINGLE-DEVICE program set
+        sequentially (no collectives, so the historical multi-device
+        first-compile rendezvous wedge cannot recur), and the
+        ``fused_ready`` readiness latch flips only once every shard
+        finished — until then fused-eligible rounds record
+        ``fuse_skip="mesh_warmup"``.
+
+        No-op (returns None) on a tickless coordinator unless ``force``:
+        the fused live path is tick-deficit replay."""
+        if not force and not self.drive_ticks:
             return None
         return self.eng.warmup_fused()
 
@@ -307,6 +324,13 @@ class TpuQuorumCoordinator:
         lt = self.lease_table
         if lt is not None:
             d["lease_groups_held"] = lt.held_count(self._tick_seen)
+        if self.mesh_devices > 1:
+            # per-shard placement/cost view (mesh dispatch plane): group
+            # counts, dispatch-cost EMA and per-shard warm readiness,
+            # plus the lifetime migration count — the shard_imbalance
+            # health detector keys off these
+            d["shards"] = self.eng.shard_stats()
+            d["migrations"] = self.eng.migrations
         return d
 
     # ------------------------------------------------------------------
@@ -805,7 +829,12 @@ class TpuQuorumCoordinator:
             read_confirms: list = []
             if deficit > 1:
                 if not fused_ok:
-                    fuse_skip = "warmup"
+                    # distinguish a mesh coordinator's per-shard program
+                    # sets still warming from the single-device case —
+                    # the readiness latch is all-shards-ready
+                    fuse_skip = (
+                        "mesh_warmup" if self.mesh_devices > 1 else "warmup"
+                    )
                 elif has_votes:
                     fuse_skip = "votes"
                 elif has_churn:
@@ -971,6 +1000,20 @@ class TpuQuorumCoordinator:
                 fused=fused,
                 fuse_skip=fuse_skip,
             )
+        # cost-driven placement (mesh dispatch plane): a time-gated
+        # rebalance pass on dispatched rounds only — quiet coordinators
+        # have no load to balance.  Runs under _mu like every other
+        # engine access; the pass is bounded (one migration) and bails
+        # unless the shard cost EMAs actually skew.
+        if self.mesh_devices > 1:
+            now = time.monotonic()
+            if now >= self._next_rebalance:
+                self._next_rebalance = now + self._rebalance_interval
+                try:
+                    with self._mu:
+                        self.eng.maybe_rebalance()
+                except Exception:
+                    plog.exception("mesh rebalance failed")
 
     def _collect_read_confirms(self, res, out: list) -> None:
         """Map confirmed-read egress slots back to their ctxs (under _mu).
@@ -1011,3 +1054,6 @@ class TpuQuorumCoordinator:
         self.eng.cancel_warmup()
         self._pending.set()
         self._thread.join(timeout=5)
+        stop_streams = getattr(self.eng, "stop", None)
+        if stop_streams is not None:  # mesh facade: join shard streams
+            stop_streams()

@@ -199,6 +199,53 @@ def test_devprof_families_help_round_trip():
     assert out2.getvalue().splitlines() == lines
 
 
+def test_mesh_families_help_round_trip():
+    """ISSUE 16 satellite: every ``dragonboat_mesh_*`` family a MeshObs
+    registers carries its described ``# HELP`` immediately before its
+    ``# TYPE``, the placement/migration/concurrency publishers land the
+    expected values, and the exposition round-trips byte-identically."""
+    from dragonboat_tpu.obs import FlightRecorder
+    from dragonboat_tpu.obs.instruments import MeshObs
+
+    reg = MetricsRegistry()
+    obs = MeshObs(FlightRecorder(capacity=4, stall_ms=0), reg, n_shards=2)
+    obs.placement([3, 1])
+    obs.migration(7, src=0, dst=1, wall_ms=2.5, counts=[2, 2])
+    obs.concurrency(2)
+    out = io.StringIO()
+    reg.write_health_metrics(out)
+    lines = out.getvalue().splitlines()
+    families = (
+        "dragonboat_mesh_shards",
+        "dragonboat_mesh_groups",
+        "dragonboat_mesh_migrations_total",
+        "dragonboat_mesh_migration_ms",
+        "dragonboat_mesh_dispatch_concurrency",
+    )
+    for name in families:
+        tidx = [
+            i for i, l in enumerate(lines) if l.startswith(f"# TYPE {name} ")
+        ]
+        assert len(tidx) == 1, name
+        help_line = lines[tidx[0] - 1]
+        assert help_line.startswith(f"# HELP {name} "), help_line
+        assert "dragonboat_tpu metric" not in help_line, help_line
+    assert "dragonboat_mesh_shards 2" in lines
+    assert 'dragonboat_mesh_groups{shard="0"} 2' in lines
+    assert 'dragonboat_mesh_groups{shard="1"} 2' in lines
+    assert "dragonboat_mesh_migrations_total 1" in lines
+    # any concurrency observation above 1 is the overlap evidence the
+    # retired global dispatch mutex made impossible
+    assert any(
+        l.startswith('dragonboat_mesh_dispatch_concurrency_bucket{le="2"} 1')
+        for l in lines
+    ), [l for l in lines if l.startswith("dragonboat_mesh_dispatch")]
+    # a second write is byte-identical (stable ordering incl. HELP)
+    out2 = io.StringIO()
+    reg.write_health_metrics(out2)
+    assert out2.getvalue().splitlines() == lines
+
+
 def test_lease_families_help_round_trip():
     """ISSUE 10 satellite: every ``dragonboat_lease_*`` family a LeaseObs
     registers (and the coordinator table's gauge) carries its described

@@ -122,10 +122,10 @@ def test_full_stack_sharded_engine():
     """3 NodeHosts, each with an 8-way group-sharded engine: device-tick
     elections + committed proposals through the full stack (shared
     harness with ``__graft_entry__.dryrun_multichip`` phase D).  Load is
-    sized for the 2-vCPU CI box: the engines' cross-engine dispatch
-    serialization (BatchedQuorumEngine._MULTIDEV_MU — the XLA CPU
-    collective-rendezvous deadlock note there) stops the three
-    coordinators' dispatches overlapping, so wall time scales with
+    sized for the 2-vCPU CI box: mesh coordinators shard over per-shard
+    single-device engines (ops/mesh.py — the old process-wide
+    ``_MULTIDEV_MU`` serialization is gone), but three 8-shard
+    coordinators on two vCPUs still timeslice, so wall time scales with
     groups × writes."""
     from dragonboat_tpu.testing import run_sharded_stack_check
 

@@ -184,6 +184,44 @@ def _propose_retry(nh, s, data, timeout=30.0, attempts=3):
                 raise
 
 
+def _config_change_retry(nh, cid, request, pred, what, budget=90.0):
+    """Drive a membership change under full-suite load (the r07
+    contention-flake class): a single synchronous attempt couples one
+    request tracker to one leadership term, and either can die of
+    weather while the cluster is healthy — worse, the proposal may
+    COMMIT after its ack timed out.  So the request is only the driver
+    and membership is the verdict: re-issue within a load-scaled budget
+    until ``pred(membership)`` holds (a duplicate attempt after a
+    silent commit is rejected by the config-change guard, which the
+    membership check absorbs; a PendingConfigChangeExistError means the
+    timed-out attempt is STILL in flight on the node — also just keep
+    polling, it may yet commit)."""
+    from dragonboat_tpu.requests import (
+        PendingConfigChangeExistError, RejectedError, TimeoutError_,
+    )
+    from tests.loadwait import scale, scaled
+
+    deadline = time.time() + scaled(budget)
+    last = None
+    while time.time() < deadline:
+        try:
+            request(scaled(15.0))
+            return
+        except (TimeoutError_, RejectedError,
+                PendingConfigChangeExistError) as e:
+            last = e
+        try:
+            m = nh.sync_get_cluster_membership(cid, timeout=scaled(10.0))
+        except TimeoutError_:
+            m = None
+        if m is not None and pred(m):
+            return  # the "failed" attempt actually committed
+    raise AssertionError(
+        f"{what} not achieved within {scaled(budget):.1f}s "
+        f"(base {budget:.1f}s x load {scale():.2f}); last={last!r}"
+    )
+
+
 def _wait_membership(nh, cid, pred, timeout=15.0, what="membership"):
     """Poll membership until ``pred(m)`` holds, within a load-scaled
     budget (ISSUE 13 deflake): a single ``sync_get_cluster_membership``
@@ -218,7 +256,12 @@ def test_tpu_engine_membership_change():
     nh4 = _mk_nh("mc4:1", router, "tpu")
     try:
         _wait_leader(nhs, CID)
-        nhs[0].sync_request_add_node(CID, 4, "mc4:1", timeout=60.0)
+        _config_change_retry(
+            nhs[0], CID,
+            lambda t: nhs[0].sync_request_add_node(CID, 4, "mc4:1",
+                                                   timeout=t),
+            lambda m: 4 in m.addresses, what="add node 4",
+        )
         nh4.start_cluster(
             {}, True, KVSM,
             Config(cluster_id=CID, node_id=4, election_rtt=10, heartbeat_rtt=1),
@@ -229,7 +272,11 @@ def test_tpu_engine_membership_change():
         _wait_membership(
             nhs[0], CID, lambda m: 4 in m.addresses, what="node 4 joined"
         )
-        nhs[0].sync_request_delete_node(CID, 4, timeout=60.0)
+        _config_change_retry(
+            nhs[0], CID,
+            lambda t: nhs[0].sync_request_delete_node(CID, 4, timeout=t),
+            lambda m: 4 not in m.addresses, what="delete node 4",
+        )
         for i in range(5):
             _propose_retry(nhs[0], s, f"n{i}=1".encode())
         _wait_membership(
@@ -244,6 +291,8 @@ def test_tpu_engine_membership_change():
 def test_scalar_vs_tpu_differential():
     """Same workload in both modes: identical SM results and final state —
     the bit-identical commit discipline at the cluster level."""
+    from tests.loadwait import scaled
+
     results = {}
     for engine in ("scalar", "tpu"):
         router = ChanRouter()
@@ -253,10 +302,16 @@ def test_scalar_vs_tpu_differential():
             s = nhs[0].get_noop_session(CID)
             vals = []
             for i in range(30):
-                r = nhs[0].sync_propose(s, f"k{i % 7}=v{i}".encode(), 30.0)
+                # load-scaled TIMEOUT only, never a retry: a noop-session
+                # duplicate would fork the scalar/tpu count sequences and
+                # fail the differential on a healthy cluster
+                r = nhs[0].sync_propose(
+                    s, f"k{i % 7}=v{i}".encode(), scaled(30.0)
+                )
                 vals.append(r.value)
             reads = [
-                nhs[0].sync_read(CID, f"k{j}", timeout=30.0) for j in range(7)
+                nhs[0].sync_read(CID, f"k{j}", timeout=scaled(30.0))
+                for j in range(7)
             ]
             results[engine] = (vals, reads)
         finally:
