@@ -5,8 +5,9 @@ PY ?= python
 
 .PHONY: test test-all test-kernels test-obs test-trace test-warmup \
 	test-hostplane test-hostproc test-lease test-devsm test-health \
-	test-repltrace test-devprof test-mesh \
-	native soak soak-smoke bench dryrun perf-ledger perf-ledger-check
+	test-repltrace test-devprof test-mesh test-recovery \
+	native soak soak-smoke soak-churn soak-churn-smoke \
+	bench dryrun perf-ledger perf-ledger-check
 
 test: native
 	$(PY) -m pytest tests/ -x -q -m "not slow"
@@ -98,6 +99,22 @@ test-devsm:
 test-health:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_health.py -q
 
+# fast cpu gate for the closed-loop recovery plane (ISSUE 17): the
+# actuation matrix on a scripted NodeHost stub (quorum_at_risk ->
+# evict+promote, leader_flap -> transfer with the hold-when-all-flapped
+# rule, devsm_rebind -> force release, commit_stall -> fast-lane
+# redrive, worker_flap observe-only), every guardrail (rate limit,
+# cooldown, strike suppression, not_leader retries, dry run), the
+# recovery-off structural identity and the live netsplit MTTR A/B —
+# test_health runs FIRST (the recovery suite mutates the default
+# detector registry; alphabetical tier-1 order already guarantees this)
+# — run before the full tier-1 sweep whenever obs/recovery.py,
+# obs/health.py's subscription API or the nodehost recovery wiring
+# change
+test-recovery:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_health.py \
+	    tests/test_recovery.py -q
+
 # fast cpu gate for the device capacity & profiling plane (ISSUE 15):
 # profile-off structural identity, the HBM ledger ≡ live-array bytes
 # differential, the capacity model's no-drift assertions against the
@@ -183,6 +200,19 @@ soak-native: native
 
 soak-native-smoke: native
 	SOAK_NATIVE_SM=1 SOAK_SESSIONS=1 $(PY) soak.py --minutes 1 --groups 8
+
+# BlackWater churn soak (ISSUE 17): 100 witness-heavy groups over 4
+# hosts under leader-flap storms, netsplit holds, SIGSTOP stalls,
+# kill -9 restarts and membership recycles — run twice with the same
+# seed (once plain, once --recover) to reproduce the MTTR A/B the
+# bench's churn_soak axis scores
+soak-churn: native
+	$(PY) soak.py --churn --minutes 1 --groups 100 --seed 7
+	$(PY) soak.py --churn --minutes 1 --groups 100 --seed 7 --recover
+
+soak-churn-smoke: native
+	$(PY) soak.py --churn --minutes 0.1 --groups 20 --seed 7
+	$(PY) soak.py --churn --minutes 0.1 --groups 20 --seed 7 --recover
 
 bench: native
 	$(PY) bench.py

@@ -1817,6 +1817,17 @@ def main() -> None:
         )
         _note(f"devprof_axis: {json.dumps(detail['devprof_axis'])[:300]}")
 
+    # BlackWater churn soak A/B (ISSUE 17): same-seed recovery OFF/ON
+    # runs of soak.py --churn, scored by per-detector MTTR p99 with a
+    # zero-linearizability-violation gate — the perf ledger's "Recovery"
+    # table derives from this section.  Two full soak arms are minutes
+    # of wall time, so the axis honors its own skip gate.
+    if os.environ.get("BENCH_SKIP_CHURN") != "1":
+        detail["churn_soak"] = _run_e2e_axis(
+            "--churn-soak", "BENCH_CHURN_TIMEOUT", "3600"
+        )
+        _note(f"churn_soak: {json.dumps(detail['churn_soak'])[:300]}")
+
     # full detail (per-rank stats and all) goes to a FILE; the stdout line
     # stays small enough that the driver's 2000-char tail capture can never
     # truncate the headline (VERDICT r3 missing #1)
@@ -1901,6 +1912,15 @@ def main() -> None:
         }
         cap = (detail["devprof_axis"] or {}).get("capacity") or {}
         slim["devprof_axis"]["model_error_pct"] = cap.get("model_error_pct")
+    if isinstance(slim.get("churn_soak"), dict):
+        # verdict + per-detector p99 A/B only on stdout; the full arm
+        # summaries (counts, actions, censored opens) live in
+        # BENCH_DETAIL.json
+        slim["churn_soak"] = {
+            k: v for k, v in slim["churn_soak"].items()
+            if k in ("churn_ok", "linearizable", "groups", "seed",
+                     "mttr_p99", "error", "tail")
+        }
     if isinstance(slim.get("host_workers"), dict):
         # headline fields only; the full A/B records live in
         # BENCH_DETAIL.json's host_workers.axis section

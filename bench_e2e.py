@@ -2630,6 +2630,81 @@ def run_mp(
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_churn_soak() -> dict:
+    """BlackWater churn soak A/B (ISSUE 17), scored by automated MTTR.
+
+    Shells ``soak.py --churn`` twice with the SAME seed and schedule —
+    recovery plane OFF, then ON — and merges the two summaries into one
+    record.  The scored quantity is per-detector MTTR (health-event open
+    to close, p50/p99 across the fleet, censored opens included); the
+    gate is zero linearizability violations in BOTH arms.  Env knobs:
+    CHURN_GROUPS (default 100), CHURN_MINUTES, CHURN_SEED,
+    CHURN_ARM_TIMEOUT (seconds, per arm).
+    """
+    groups = int(os.environ.get("CHURN_GROUPS", "100"))
+    minutes = float(os.environ.get("CHURN_MINUTES", "0.1"))
+    seed = int(os.environ.get("CHURN_SEED", "7"))
+    arm_timeout = float(os.environ.get("CHURN_ARM_TIMEOUT", "1800"))
+    soak = os.path.join(os.path.dirname(os.path.abspath(__file__)), "soak.py")
+
+    def _arm(recover: bool) -> dict:
+        cmd = [
+            sys.executable, soak, "--churn",
+            "--minutes", str(minutes),
+            "--groups", str(groups),
+            "--seed", str(seed),
+        ]
+        if recover:
+            cmd.append("--recover")
+        try:
+            p = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=arm_timeout,
+            )
+        except subprocess.TimeoutExpired:
+            return {"churn_ok": False, "linearizable": False,
+                    "error": f"arm timed out after {arm_timeout}s"}
+        # the summary is the last stdout line; stderr carries progress
+        lines = [ln for ln in p.stdout.strip().splitlines() if ln.strip()]
+        try:
+            s = json.loads(lines[-1])
+        except Exception:
+            s = {"churn_ok": False, "linearizable": False,
+                 "error": f"unparseable summary (exit {p.returncode}): "
+                          f"{(lines or ['<empty>'])[-1][:200]}"}
+        s["exit_code"] = p.returncode
+        return s
+
+    off = _arm(False)
+    on = _arm(True)
+    improvement = {}
+    for det, o in (off.get("mttr") or {}).items():
+        n = (on.get("mttr") or {}).get(det)
+        if not n or o.get("p99_s") is None or n.get("p99_s") is None:
+            continue
+        improvement[det] = {
+            "off_p99_s": o["p99_s"],
+            "on_p99_s": n["p99_s"],
+            "off_p50_s": o.get("p50_s"),
+            "on_p50_s": n.get("p50_s"),
+            "speedup_x": (
+                round(o["p99_s"] / n["p99_s"], 3) if n["p99_s"] else None
+            ),
+        }
+    return {
+        "groups": groups,
+        "minutes": minutes,
+        "seed": seed,
+        "churn_ok": bool(off.get("churn_ok")) and bool(on.get("churn_ok")),
+        "linearizable": (
+            bool(off.get("linearizable")) and bool(on.get("linearizable"))
+        ),
+        "mttr_p99": improvement,
+        "recovery_actions": on.get("recovery_actions"),
+        "off": off,
+        "on": on,
+    }
+
+
 def run_quick() -> dict:
     """Bounded run for bench.py's detail field (driver time budget)."""
     groups = int(os.environ.get("E2E_GROUPS", "1024"))
@@ -2694,5 +2769,8 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--devprof-axis" in sys.argv:
         print(json.dumps(run_devprof_axis()), file=sys.stdout)
+        sys.exit(0)
+    if "--churn-soak" in sys.argv:
+        print(json.dumps(run_churn_soak()), file=sys.stdout)
         sys.exit(0)
     print(json.dumps(run_quick()), file=sys.stdout)

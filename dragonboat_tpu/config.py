@@ -11,7 +11,7 @@ so the pure-host scalar path stays available for differential testing.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
 
 class ConfigError(ValueError):
@@ -304,6 +304,42 @@ class NodeHostConfig:
     # binds ephemeral (NodeHost.metrics_server.port).  Env
     # DBTPU_METRICS_ADDR is the no-config fallback.
     metrics_addr: str = ""
+    # closed-loop recovery plane (obs/recovery.py, ISSUE 17): let the
+    # health detectors ACTUATE — quorum_at_risk evicts the unreachable
+    # voter and promotes a standing observer (or adds a standby
+    # witness, the BlackWater move), leader_flap transfers leadership
+    # away from the flapping hosts, devsm_rebind force-releases the
+    # device binding, commit_stall re-drives the fast-lane
+    # eject/re-enroll path; worker_flap stays observe-only (the
+    # hostproc monitor owns respawn).  Every action is rate-limited per
+    # group, cooldown-gated and flap-damped (RecoveryController
+    # guardrails).  Requires the health plane (health_sample_ms > 0) —
+    # auto_recover without it logs a warning and constructs nothing.
+    # False (default) = recovery off, nothing constructed, no sampler
+    # subscription, request paths bit-identical; env DBTPU_AUTO_RECOVER
+    # is the no-config fallback.
+    auto_recover: bool = False
+    # dry-run for the recovery plane: decisions run end to end and are
+    # logged/counted (dragonboat_recovery_dryrun_total) but no
+    # remediation executes.  Env DBTPU_RECOVER_DRY_RUN is the
+    # no-config fallback.
+    auto_recover_dry_run: bool = False
+    # guardrail/behavior overrides for the RecoveryController
+    # (rate_limit_s, cooldown_s, max_reopens, reopen_window_s,
+    # action_timeout_s, workers, max_attempts, retry_delay_s,
+    # standby_witness_addrs) — merged over the controller defaults;
+    # unknown keys raise at construction.
+    auto_recover_knobs: Dict[str, object] = field(default_factory=dict)
+    # wall-clock lease guard (lease.py, ISSUE 17 churn-soak caught): the
+    # leader lease's validity clock is the event loop's tick counter — a
+    # CPU-starved or descheduled leader ticks slower than wall time, so
+    # its tick-valid lease can outlive the majority's wall-time election
+    # and serve a stale read.  True additionally bounds validity by
+    # monotonic wall time (quorum-th newest ack within
+    # duration * rtt_millisecond wall seconds) — strictly conservative:
+    # starvation can only expire the lease early, never extend it.
+    # Default off: tick-driven virtual-clock tests stay deterministic.
+    lease_wall_guard: bool = False
     # device capacity & profiling plane (obs/devprof.py, ISSUE 15):
     # N > 0 attaches a DevProf to the batched quorum engine — the HBM
     # memory ledger + capacity model (dragonboat_devprof_hbm_bytes /

@@ -67,6 +67,7 @@ attribution is not).
 from __future__ import annotations
 
 import collections
+import time
 from typing import Dict, Iterable, Optional
 
 _L = "dragonboat_lease_"
@@ -162,16 +163,31 @@ class LeaderLease:
         "election_timeout", "epsilon", "duration",
         "_pending", "_unrecorded", "bases", "ceded", "skew", "_held",
         "obs", "grants", "expiries", "reads_local", "reads_fallback",
+        "tick_interval_s", "wall_clock", "_ack_walls",
     )
 
     def __init__(self, election_timeout: int,
-                 drift_ticks: Optional[int] = None):
+                 drift_ticks: Optional[int] = None,
+                 tick_interval_s: Optional[float] = None):
         self.election_timeout = election_timeout
         self.epsilon = (
             drift_ticks if drift_ticks is not None
             else max(1, election_timeout // 5)
         )
         self.duration = max(1, election_timeout - self.epsilon)
+        # wall-clock guard (ISSUE 17, churn-soak caught): the tick clock
+        # is the event loop's — a starved or descheduled leader ticks
+        # SLOWER than wall time, so its tick-valid lease can outlive the
+        # majority's wall-time election and serve a stale read.  With
+        # ``tick_interval_s`` set (the host's tick period in seconds),
+        # validity additionally requires the quorum-th newest ack to be
+        # within ``duration * tick_interval_s`` WALL seconds — monotonic
+        # time keeps running while the process is starved or SIGSTOPped,
+        # so starvation can only expire the lease, never extend it.
+        # Default off: purely tick-driven tests stay deterministic.
+        self.tick_interval_s = tick_interval_s
+        self.wall_clock = time.monotonic
+        self._ack_walls: Dict[int, float] = {}
         self.obs: Optional[LeaseObs] = None
         self.grants = 0
         self.expiries = 0
@@ -199,6 +215,7 @@ class LeaderLease:
         self._pending = {}
         self._unrecorded = {}
         self.bases = {}
+        self._ack_walls = {}
         self.ceded = False
         self.skew = 0
 
@@ -214,6 +231,7 @@ class LeaderLease:
         if self._held:
             self._note_expired()
         self.bases = {}
+        self._ack_walls = {}
 
     def cede(self) -> None:
         """Leadership transfer: the target may campaign immediately
@@ -277,6 +295,8 @@ class LeaderLease:
         if dq:
             head = dq[0]
             self.bases[node_id] = head[0]
+            if self.tick_interval_s is not None:
+                self._ack_walls[node_id] = self.wall_clock()
             head[1] -= 1
             if head[1] <= 0:
                 dq.popleft()
@@ -293,10 +313,11 @@ class LeaderLease:
         current voting membership (remotes + witnesses)."""
         if self.ceded:
             return 0
+        voters = list(voter_ids)
         now = now + self.skew
         bases = sorted(
             (now if nid == self_id else self.bases.get(nid, -1))
-            for nid in voter_ids
+            for nid in voters
         )
         n = len(bases)
         if n < quorum:
@@ -304,7 +325,22 @@ class LeaderLease:
         basis = bases[n - quorum]  # quorum-th newest (kth_largest)
         if basis < 0:
             return 0
-        return basis + self.duration - now
+        rem = basis + self.duration - now
+        if rem > 0 and self.tick_interval_s is not None:
+            # wall-clock guard: a starved tick loop must not overextend
+            # the lease (see __init__) — the quorum-th newest ack must
+            # also be fresh in WALL time
+            now_w = self.wall_clock()
+            walls = sorted(
+                (now_w if nid == self_id else self._ack_walls.get(nid, -1.0))
+                for nid in voters
+            )
+            wall_basis = walls[n - quorum]
+            if (wall_basis < 0
+                    or now_w - wall_basis
+                    > self.duration * self.tick_interval_s):
+                return 0
+        return rem
 
     def check(self, now: int, quorum: int,
               voter_ids: Iterable[int], self_id: int) -> int:

@@ -234,6 +234,14 @@ class Node:
         lease_obs = getattr(self, "lease_obs", None)
         if lease_obs is not None and self.peer.raft.lease is not None:
             self.peer.raft.lease.obs = lease_obs
+        # wall-clock lease guard (ISSUE 17; set by NodeHost when
+        # Config.read_lease and NodeHostConfig.lease_wall_guard): the
+        # host's tick period in seconds — validity then also requires
+        # wall-fresh quorum acks, so tick starvation expires the lease
+        # instead of extending it
+        lease_wall_s = getattr(self, "lease_wall_s", None)
+        if lease_wall_s is not None and self.peer.raft.lease is not None:
+            self.peer.raft.lease.tick_interval_s = lease_wall_s
         # replication attribution (ISSUE 14): the raft-level ack/commit
         # hooks gate on `replattr is not None`, so trace-off hosts never
         # touch the plane
@@ -1985,6 +1993,13 @@ class Node:
                     for nid, rp in voters.items()
                     if nid == r.node_id or rp.is_active()
                 )
+                # the ids behind the count: quorum_at_risk actuation
+                # (obs/recovery.py) evicts exactly these
+                d["unreachable_ids"] = [
+                    nid
+                    for nid, rp in voters.items()
+                    if nid != r.node_id and not rp.is_active()
+                ]
             lease = r.lease
             if lease is not None:
                 ls = lease.stats()

@@ -421,6 +421,37 @@ class NodeHost:
                 registry=self.raft_events.registry,
                 recorder=self.flight_recorder,
             )
+        # closed-loop recovery plane (obs/recovery.py, ISSUE 17): the
+        # health detectors actuate guard-railed remediations.  OFF by
+        # default (auto_recover=False and no env): nothing constructed,
+        # no subscriber registered on the sampler (its ``_subs`` latch
+        # stays None — asserted structurally in tests/test_recovery.py).
+        self.recovery = None
+        auto_recover = nhconfig.auto_recover or (
+            os.environ.get("DBTPU_AUTO_RECOVER", "") in ("1", "true", "on")
+        )
+        if auto_recover:
+            if self.health is None:
+                # actuation without detection is meaningless; degrade
+                # loudly (the devprof inert-knob precedent)
+                plog.warning(
+                    "auto_recover set but the health plane is off "
+                    "(health_sample_ms=0); recovery off"
+                )
+            else:
+                from .obs.recovery import RecoveryController
+
+                dry = nhconfig.auto_recover_dry_run or (
+                    os.environ.get("DBTPU_RECOVER_DRY_RUN", "")
+                    in ("1", "true", "on")
+                )
+                self.recovery = RecoveryController(
+                    self,
+                    self.health,
+                    dry_run=dry,
+                    registry=self.raft_events.registry,
+                    **dict(nhconfig.auto_recover_knobs),
+                )
         # device capacity & profiling plane (obs/devprof.py, ISSUE 15):
         # HBM ledger + capacity model, warm-set program registry,
         # sampled device-time estimator and on-demand jax.profiler
@@ -633,6 +664,15 @@ class NodeHost:
         if self.health is None:
             return {"status": "ok", "health_plane": "off"}
         return self.health.report()
+
+    def recovery_report(self) -> dict:
+        """Closed-loop recovery actuation report (obs/recovery.py,
+        ISSUE 17): executed/dry-run actions per detector, skip reasons,
+        flap-suppressed keys and the guardrail knobs.  A plain off stub
+        while ``auto_recover`` is off."""
+        if self.recovery is None:
+            return {"enabled": False, "recovery_plane": "off"}
+        return self.recovery.report()
 
     def debug_dump(self, path: Optional[str] = None) -> str:
         """Write the flight-recorder ring plus any in-flight/completed
@@ -897,6 +937,11 @@ class NodeHost:
 
                 self._lease_obs = LeaseObs(self.raft_events.registry)
             node.lease_obs = self._lease_obs
+        if config.read_lease and self.nhconfig.lease_wall_guard:
+            # wall-clock lease guard (ISSUE 17): bound lease validity by
+            # monotonic wall time so a starved tick loop cannot
+            # overextend it past the majority's wall-time election
+            node.lease_wall_s = self.nhconfig.rtt_millisecond / 1000.0
         if self.hostplane is not None:
             node.ingress = self.hostplane.ingress
             node.pending_proposals.set_egress(self.hostplane.egress)
@@ -954,6 +999,10 @@ class NodeHost:
             # planes it reads
             self.metrics_server.stop()
             self.metrics_server = None
+        if self.recovery is not None:
+            # before the nodes: an in-flight remediation (config change,
+            # transfer) must drain while its group still exists
+            self.recovery.stop()
         with self._mu:
             nodes = list(self._clusters.values())
             self._clusters.clear()

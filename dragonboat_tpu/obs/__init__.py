@@ -32,6 +32,15 @@ handling lives on cheap continuous telemetry"):
   (``dragonboat_health_*`` families, ``NodeHost.health_report``), and
   the live scrape endpoint (``/metrics``, ``/healthz``,
   ``/debug/health``, ``/debug/trace``, ``/debug/devprof``).
+- :mod:`recovery` — the closed-loop recovery plane (ISSUE 17): a
+  RecoveryController subscribed to detector OPEN events drives
+  guard-railed remediations (quorum_at_risk → evict dead voter +
+  promote standing observer / add standby witness, leader_flap →
+  transfer away from flapping hosts, devsm_rebind → force device
+  release, commit_stall → fast-lane redrive; worker_flap
+  observe-only), rate-limited per group, cooldown-gated, flap-damped,
+  with a dry-run mode (``dragonboat_recovery_*`` families,
+  ``NodeHost.recovery_report``).
 - :mod:`devprof` — the device capacity & profiling plane (ISSUE 15):
   the HBM memory ledger + capacity model
   (``dragonboat_devprof_hbm_bytes{plane,artifact}``, max groups per

@@ -198,6 +198,57 @@ class HealthSampler:
         self._devsm_binds: Dict[int, deque] = {}
         self._prev_hostproc: Optional[dict] = None
         self._imbalance_streak = 0
+        # detector-event subscribers (ISSUE 17): ``None`` until the
+        # first registration — the same latch discipline as ``_obs``,
+        # so an unsubscribed sampler pays one attribute load per event
+        # and nothing else
+        self._subs: Optional[Dict[str, list]] = None
+
+    # ------------------------------------------------------------------
+    # detector-event subscription (ISSUE 17)
+    # ------------------------------------------------------------------
+
+    def on_open(self, cb) -> None:
+        """Register ``cb(event: dict)`` for detector OPEN transitions.
+
+        The callback runs on the sampling thread (the NodeHost tick
+        worker in live mode) AFTER the event is recorded — metrics
+        bumped, flight-recorder span written — and receives a copy of
+        the event dict (``detector``/``key``/``detail``/``opened_*``).
+        Callbacks are exception-guarded: a failing subscriber is logged
+        and never breaks sampling.  Subscribers must not block — hand
+        work to your own thread (the RecoveryController queues).
+        """
+        if self._subs is None:
+            self._subs = {"open": [], "close": []}
+        self._subs["open"].append(cb)
+
+    def on_close(self, cb) -> None:
+        """Register ``cb(event: dict)`` for detector CLOSE transitions.
+
+        Runs after the open→close duration has been appended to the
+        recovery attribution (``recovery_stats`` already includes it
+        when the callback observes the event — ordering asserted in
+        tests/test_health.py); the event copy carries ``duration_s``.
+        Same exception guard and non-blocking contract as :meth:`on_open`.
+        """
+        if self._subs is None:
+            self._subs = {"open": [], "close": []}
+        self._subs["close"].append(cb)
+
+    def _dispatch(self, kind: str, ev: dict) -> None:
+        subs = self._subs
+        if subs is None:
+            return
+        for cb in subs[kind]:
+            try:
+                cb(dict(ev))
+            except Exception:
+                # a failing subscriber must never break sampling
+                plog.exception(
+                    "health %s subscriber failed for %s %s",
+                    kind, ev.get("detector"), ev.get("key"),
+                )
 
     # ------------------------------------------------------------------
     # sampling (tick worker)
@@ -375,7 +426,10 @@ class HealthSampler:
         self._set(
             "quorum_at_risk", f"group:{cid}", active, now,
             {"cluster_id": cid, "reachable": reachable, "voters": voters,
-             "quorum": quorum},
+             "quorum": quorum,
+             # actuation targeting (ISSUE 17): which voters the
+             # check-quorum leader cannot reach right now
+             "unreachable_ids": list(g.get("unreachable_ids") or ())},
         )
 
     def _eval_leader_flap(self, cid, g, prev, now) -> None:
@@ -383,14 +437,22 @@ class HealthSampler:
             cid, deque(maxlen=max(8, self.leader_flap_changes * 2))
         )
         if prev is not None and g.get("leader_id") != prev.get("leader_id"):
-            dq.append(now)
-        while dq and now - dq[0] > self.flap_window_s:
+            # (when, who) — the leader ids seen inside the flap window
+            # are actuation targeting (ISSUE 17): transfer AWAY from the
+            # hosts that participated in the flap
+            dq.append((now, g.get("leader_id")))
+        while dq and now - dq[0][0] > self.flap_window_s:
             dq.popleft()
+        recent = []
+        for _, lid in dq:
+            if lid and lid not in recent:
+                recent.append(lid)
         self._set(
             "leader_flap", f"group:{cid}",
             len(dq) >= self.leader_flap_changes, now,
             {"cluster_id": cid, "changes": len(dq),
-             "leader_id": g.get("leader_id")},
+             "leader_id": g.get("leader_id"),
+             "recent_leaders": recent},
         )
 
     def _eval_lease_thrash(self, cid, g, prev, now) -> None:
@@ -517,6 +579,7 @@ class HealthSampler:
                         "health", detector=detector, key=key, state="open",
                         **{f"d_{k_}": v for k_, v in detail.items()},
                     )
+                self._dispatch("open", ev)
             else:
                 ev["detail"] = dict(detail)  # refresh while open
             return
@@ -542,6 +605,9 @@ class HealthSampler:
                 "health", detector=detector, key=key, state="close",
                 recovery_ms=round(dur * 1e3, 3),
             )
+        # close subscribers observe the event AFTER the duration landed
+        # in the recovery attribution (ordering asserted in tests)
+        self._dispatch("close", ev)
 
     def _open_count(self, detector: str) -> int:
         return sum(1 for d, _ in self._open if d == detector)
@@ -569,6 +635,12 @@ class HealthSampler:
 
     def closed_events(self) -> List[dict]:
         return [dict(e) for e in self._closed]
+
+    def recovery_durations(self) -> Dict[str, List[float]]:
+        """Raw per-detector open→close durations (seconds).  The churn
+        soak merges these across hosts and recomputes fleet-level
+        percentiles — per-host percentiles cannot be merged."""
+        return {d: list(v) for d, v in self._recoveries.items() if v}
 
     def recovery_stats(self) -> Dict[str, dict]:
         """Per-detector open→close duration percentiles (seconds)."""

@@ -68,6 +68,7 @@ _HEALTH = "dragonboat_health_"
 _REPL = "dragonboat_repl_"
 _DEVPROF = "dragonboat_devprof_"
 _MESH = "dragonboat_mesh_"
+_RECOV = "dragonboat_recovery_"
 
 #: recovery-duration buckets (seconds): a worker respawn lands near the
 #: bottom, a failover around election timeouts, a wedged rebind loop or
@@ -222,6 +223,21 @@ _HELP = {
     _MESH + "dispatch_concurrency": "shard dispatch streams observed "
     "simultaneously in flight per fan-out (the no-global-mutex "
     "evidence: >1 means two shards dispatched concurrently)",
+    # closed-loop recovery plane (obs/recovery.py, ISSUE 17)
+    _RECOV + "actions_total": "remediations the RecoveryController "
+    "executed, by detector and action (evict_dead / promote_standby / "
+    "transfer_leader / devsm_release / fastlane_redrive)",
+    _RECOV + "dryrun_total": "remediations the controller WOULD have "
+    "executed but only logged (dry-run mode), by detector and action",
+    _RECOV + "skipped_total": "open events the controller declined to "
+    "act on, by reason (not_leader / rate_limited / cooldown / "
+    "suppressed / observe_only / no_target)",
+    _RECOV + "suppressed_keys": "detector keys currently flap-damped "
+    "(an action re-opened its detector max_reopens times), by detector",
+    _RECOV + "failures_total": "remediations that raised or timed out, "
+    "by detector and action",
+    _RECOV + "action_seconds": "wall seconds one executed remediation "
+    "took (decide-to-commit, e.g. config-change round trip), by action",
 }
 
 
@@ -707,6 +723,98 @@ class HealthObs:
         r.histogram_observe(
             _HEALTH + "recovery_seconds", duration_s,
             buckets=RECOVERY_BUCKETS_S, labels=labels,
+        )
+
+
+class RecoveryObs:
+    """Closed-loop recovery instruments (obs/recovery.py, ISSUE 17).
+
+    Families (``dragonboat_recovery_*``):
+
+    - ``actions_total{detector,action}`` — remediations executed
+    - ``dryrun_total{detector,action}`` — remediations logged-only
+      (dry-run mode)
+    - ``skipped_total{reason}`` — open events declined (not leader on
+      this host, rate limit, cooldown, flap-suppressed, observe-only
+      detector, no viable target)
+    - gauge ``suppressed_keys{detector}`` — keys currently flap-damped
+    - ``failures_total{detector,action}`` — remediations that raised
+    - histogram ``action_seconds{action}`` — decide-to-commit wall per
+      executed remediation
+
+    Zero-registered per detector/action at construction (the HealthObs
+    precedent: a scrape distinguishes "recovery off" — families absent
+    — from "on but idle" — families at zero).  Same ``is not None``
+    latch contract as every other plane.
+    """
+
+    __slots__ = ("registry",)
+
+    #: skip-reason vocabulary (zero-registered)
+    SKIP_REASONS = (
+        "not_leader", "rate_limited", "cooldown", "suppressed",
+        "observe_only", "no_target", "stopped",
+    )
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 matrix=()):
+        """``matrix`` — iterable of ``(detector, action)`` pairs to
+        zero-register (the controller's actuation matrix)."""
+        self.registry = registry or DEFAULT_REGISTRY
+        r = self.registry
+        _describe(r, (
+            _RECOV + "actions_total", _RECOV + "dryrun_total",
+            _RECOV + "skipped_total", _RECOV + "suppressed_keys",
+            _RECOV + "failures_total", _RECOV + "action_seconds",
+        ))
+        for det, action in matrix:
+            labels = {"detector": det, "action": action}
+            r.counter_add(_RECOV + "actions_total", 0, labels=labels)
+            r.counter_add(_RECOV + "dryrun_total", 0, labels=labels)
+            r.counter_add(_RECOV + "failures_total", 0, labels=labels)
+            r.gauge_set(
+                _RECOV + "suppressed_keys", 0, labels={"detector": det}
+            )
+            r.histogram_declare(
+                _RECOV + "action_seconds", buckets=RECOVERY_BUCKETS_S,
+                labels={"action": action},
+            )
+        for reason in self.SKIP_REASONS:
+            r.counter_add(
+                _RECOV + "skipped_total", 0, labels={"reason": reason}
+            )
+
+    def action(self, detector: str, action: str, *,
+               duration_s: float) -> None:
+        r = self.registry
+        labels = {"detector": detector, "action": action}
+        r.counter_add(_RECOV + "actions_total", labels=labels)
+        r.histogram_observe(
+            _RECOV + "action_seconds", duration_s,
+            buckets=RECOVERY_BUCKETS_S, labels={"action": action},
+        )
+
+    def dryrun(self, detector: str, action: str) -> None:
+        self.registry.counter_add(
+            _RECOV + "dryrun_total",
+            labels={"detector": detector, "action": action},
+        )
+
+    def skipped(self, reason: str) -> None:
+        self.registry.counter_add(
+            _RECOV + "skipped_total", labels={"reason": reason}
+        )
+
+    def failure(self, detector: str, action: str) -> None:
+        self.registry.counter_add(
+            _RECOV + "failures_total",
+            labels={"detector": detector, "action": action},
+        )
+
+    def suppressed(self, detector: str, count: int) -> None:
+        self.registry.gauge_set(
+            _RECOV + "suppressed_keys", count,
+            labels={"detector": detector},
         )
 
 

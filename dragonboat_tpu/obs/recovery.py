@@ -1,0 +1,545 @@
+"""Closed-loop recovery plane: health detectors that actuate (ISSUE 17
+tentpole, ROADMAP item 5).
+
+The health plane (obs/health.py, ISSUE 13) measures every failure as a
+detector open→close duration; this module closes the loop.  A
+:class:`RecoveryController` subscribes to detector OPEN events
+(``HealthSampler.on_open``) and drives guard-railed remediations through
+the NodeHost's own public actuation surfaces:
+
+==================  ====================================================
+detector            remediation
+==================  ====================================================
+``quorum_at_risk``  **evict_dead** — REMOVE_NODE one unreachable voter
+                    (the check-quorum leader's ``unreachable_ids``),
+                    restoring the quorum safety margin immediately —
+                    then **promote_standby** — ADD_NODE a standing
+                    observer to voter (legal promotion: the raft core
+                    moves the observer's tracked progress to the voter
+                    set) or, with a configured standby pool and no
+                    observer, ADD_WITNESS a fresh metadata-only voter.
+                    That is the BlackWater move (PAPERS.md): durability
+                    capacity on unreliable nodes is cheapest as
+                    witnesses/observers promoted on demand — note the
+                    reference core *forbids* in-place witness→full
+                    promotion (``could not promote witness``), so
+                    "promote a witness" is spelled observer-promotion
+                    or fresh-witness-add, never ADD_NODE of a witness id
+``leader_flap``     **transfer_leader** — leadership transferred to a
+                    voter that did NOT appear in the flap window's
+                    ``recent_leaders`` (away from the flapping hosts)
+``devsm_rebind``    **devsm_release** — force-release the device
+                    binding (``DevSMPlane.on_unbind``): a bind/unbind
+                    loop stops burning uploads and reads fall back to
+                    the gated host shadow until leadership settles
+``commit_stall``    **fastlane_redrive** — re-drive the fast-lane
+                    eject/re-enroll path (``Node.fast_eject`` +
+                    ``set_step_ready``): a group wedged in the native
+                    lane hands back to scalar raft, which runs the full
+                    protocol
+``worker_flap``     observe-and-attribute ONLY — the hostproc monitor
+                    already respawns dead workers; a second respawn
+                    here would double-actuate (asserted in tests: one
+                    kill -9 = exactly one restart-counter bump)
+==================  ====================================================
+
+Every actuation is guard-railed:
+
+- **rate limit per group** (``rate_limit_s``): minimum seconds between
+  any two executed actions touching the same detector key (group/host)
+- **cooldown per (detector, key)** (``cooldown_s``): after an action,
+  that detector+key pair cannot actuate again until the cooldown ages
+- **flap damping** (``max_reopens`` / ``reopen_window_s``): an action
+  whose detector re-opens within the window earns a strike; at
+  ``max_reopens`` strikes the key is suppressed — reported, counted in
+  ``dragonboat_recovery_suppressed_keys``, no further actions until a
+  full quiet window passes
+- **dry-run** (``dry_run=True``): decisions run end to end and are
+  logged + counted (``dragonboat_recovery_dryrun_total``) but nothing
+  executes
+
+Threading: detector callbacks (tick-worker context) only enqueue; a
+small pool of daemon action threads executes remediations with bounded
+sync timeouts, so a slow config change can never stall sampling.  An
+action that finds this host is not the group's leader re-enqueues with
+a short delay for a bounded number of attempts — under churn the leader
+moves between detection and actuation, and some host in the group will
+win the race.
+
+Off contract (the ``_obs is not None`` latch precedent): the plane is
+OFF by default.  ``NodeHostConfig.auto_recover = False`` constructs
+nothing — no controller, no subscriber on the sampler, no registry
+families — asserted structurally in tests/test_recovery.py.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from ..logger import get_logger
+
+plog = get_logger("recovery")
+
+#: the actuation matrix — (detector, action) vocabulary, zero-registered
+#: by RecoveryObs so a scrape distinguishes "off" from "idle"
+MATRIX = (
+    ("quorum_at_risk", "evict_dead"),
+    ("quorum_at_risk", "promote_standby"),
+    ("leader_flap", "transfer_leader"),
+    ("devsm_rebind", "devsm_release"),
+    ("commit_stall", "fastlane_redrive"),
+)
+
+#: detectors the controller attributes but never actuates: worker_flap
+#: belongs to the hostproc monitor (double-actuation guard), the rest
+#: self-correct (apply executor, lease plane, mesh rebalancer)
+OBSERVE_ONLY = (
+    "worker_flap", "apply_lag", "lease_thrash", "shard_imbalance",
+)
+
+
+class RecoveryController:
+    """Guard-railed detector-driven remediation over one NodeHost.
+
+    Built by NodeHost when ``auto_recover=True`` (requires the health
+    plane); unit tests construct it directly over a hand-fed
+    :class:`~dragonboat_tpu.obs.health.HealthSampler`.
+    """
+
+    def __init__(
+        self,
+        nh,
+        sampler,
+        *,
+        dry_run: bool = False,
+        registry=None,
+        rate_limit_s: float = 2.0,
+        cooldown_s: float = 5.0,
+        max_reopens: int = 3,
+        reopen_window_s: float = 60.0,
+        action_timeout_s: float = 5.0,
+        workers: int = 2,
+        max_attempts: int = 3,
+        retry_delay_s: float = 0.3,
+        standby_witness_addrs: Tuple[str, ...] = (),
+    ):
+        self.nh = nh
+        self.sampler = sampler
+        self.dry_run = bool(dry_run)
+        self.rate_limit_s = float(rate_limit_s)
+        self.cooldown_s = float(cooldown_s)
+        self.max_reopens = int(max_reopens)
+        self.reopen_window_s = float(reopen_window_s)
+        self.action_timeout_s = float(action_timeout_s)
+        self.max_attempts = int(max_attempts)
+        self.retry_delay_s = float(retry_delay_s)
+        self.standby_witness_addrs = tuple(standby_witness_addrs)
+        self._obs = None
+        if registry is not None:
+            from .instruments import RecoveryObs
+
+            self._obs = RecoveryObs(registry=registry, matrix=MATRIX)
+        self._mu = threading.Lock()
+        # guardrail state, all keyed on the detector event key
+        self._last_key_action: Dict[str, float] = {}           # rate limit
+        self._last_det_action: Dict[Tuple[str, str], float] = {}  # cooldown
+        self._strikes: Dict[Tuple[str, str], Tuple[int, float]] = {}
+        self._suppressed: Dict[Tuple[str, str], float] = {}
+        # attribution / introspection
+        self.actions: Dict[Tuple[str, str], int] = {m: 0 for m in MATRIX}
+        self.dryruns: Dict[Tuple[str, str], int] = {m: 0 for m in MATRIX}
+        self.skips: Dict[str, int] = {}
+        self.failures: Dict[Tuple[str, str], int] = {}
+        self.observed: Dict[str, int] = {}
+        self._recent: deque = deque(maxlen=64)
+        self._next_witness_id: Dict[int, int] = {}
+        self._stopped = threading.Event()
+        self._q: "queue.Queue" = queue.Queue()
+        self._threads: List[threading.Thread] = []
+        for i in range(max(1, int(workers))):
+            t = threading.Thread(
+                target=self._worker_main, name=f"dbtpu-recover-{i}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        sampler.on_open(self._on_open)
+        sampler.on_close(self._on_close)
+        plog.info(
+            "recovery controller on (dry_run=%s rate_limit=%.1fs "
+            "cooldown=%.1fs max_reopens=%d)",
+            self.dry_run, self.rate_limit_s, self.cooldown_s,
+            self.max_reopens,
+        )
+
+    # ------------------------------------------------------------------
+    # detector callbacks (sampling-thread context: enqueue only)
+    # ------------------------------------------------------------------
+
+    def _on_open(self, ev: dict) -> None:
+        if self._stopped.is_set():
+            return
+        det = ev.get("detector")
+        with self._mu:
+            self.observed[det] = self.observed.get(det, 0) + 1
+            self._note_reopen(det, ev.get("key"), ev.get("opened_mono"))
+        self._q.put((ev, 1))
+
+    def _on_close(self, ev: dict) -> None:
+        # nothing to actuate on close; the sampler already recorded the
+        # MTTR attribution before this callback ran (ordering contract)
+        pass
+
+    def _note_reopen(self, det: str, key: str, mono) -> None:
+        """Strike accounting (held under ``_mu``): an OPEN arriving
+        within ``reopen_window_s`` of an executed action on the same
+        (detector, key) means the action did not stick."""
+        k = (det, key)
+        now = mono if mono is not None else time.monotonic()
+        acted = self._last_det_action.get(k)
+        if acted is None or now - acted > self.reopen_window_s:
+            return
+        count, _ = self._strikes.get(k, (0, 0.0))
+        count += 1
+        self._strikes[k] = (count, now)
+        if count >= self.max_reopens and k not in self._suppressed:
+            self._suppressed[k] = now
+            plog.warning(
+                "recovery SUPPRESS %s %s after %d re-opens", det, key,
+                count,
+            )
+            if self._obs is not None:
+                self._obs.suppressed(
+                    det,
+                    sum(1 for d, _ in self._suppressed if d == det),
+                )
+
+    # ------------------------------------------------------------------
+    # action workers
+    # ------------------------------------------------------------------
+
+    def _worker_main(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            ev, attempt = item
+            try:
+                self._handle(ev, attempt)
+            except Exception:
+                plog.exception(
+                    "recovery handler failed for %s %s",
+                    ev.get("detector"), ev.get("key"),
+                )
+
+    def _handle(self, ev: dict, attempt: int) -> None:
+        det, key = ev.get("detector"), ev.get("key")
+        if self._stopped.is_set():
+            self._skip("stopped")
+            return
+        if det in OBSERVE_ONLY or det not in {m[0] for m in MATRIX}:
+            self._skip("observe_only")
+            return
+        now = time.monotonic()
+        k = (det, key)
+        with self._mu:
+            sup = self._suppressed.get(k)
+            if sup is not None:
+                count, last = self._strikes.get(k, (0, sup))
+                if now - last <= self.reopen_window_s:
+                    self._skip_locked("suppressed")
+                    return
+                # a full quiet window passed: lift the suppression
+                del self._suppressed[k]
+                self._strikes.pop(k, None)
+                if self._obs is not None:
+                    self._obs.suppressed(
+                        det,
+                        sum(1 for d, _ in self._suppressed if d == det),
+                    )
+            last_key = self._last_key_action.get(key)
+            if last_key is not None and now - last_key < self.rate_limit_s:
+                self._skip_locked("rate_limited")
+                return
+            last_det = self._last_det_action.get(k)
+            if last_det is not None and now - last_det < self.cooldown_s:
+                self._skip_locked("cooldown")
+                return
+        t0 = time.perf_counter()
+        try:
+            outcome = self._actuate(det, ev)
+        except Exception as e:
+            with self._mu:
+                self.failures[(det, "?")] = (
+                    self.failures.get((det, "?"), 0) + 1
+                )
+            if self._obs is not None:
+                self._obs.failure(det, "?")
+            plog.warning("recovery action failed %s %s: %r", det, key, e)
+            return
+        if outcome is None:
+            self._skip("no_target")
+            return
+        if outcome == "not_leader":
+            self._skip("not_leader")
+            if attempt < self.max_attempts and not self._stopped.is_set():
+                # the leader moved between detection and actuation —
+                # retry shortly; some host in the group wins the race
+                timer = threading.Timer(
+                    self.retry_delay_s,
+                    lambda: self._q.put((ev, attempt + 1)),
+                )
+                timer.daemon = True
+                timer.start()
+            return
+        # outcome: list of (action, executed_detail) performed
+        dur = time.perf_counter() - t0
+        stamp = time.monotonic()
+        with self._mu:
+            self._last_key_action[key] = stamp
+            self._last_det_action[k] = stamp
+            for action, detail in outcome:
+                m = (det, action)
+                if self.dry_run:
+                    self.dryruns[m] = self.dryruns.get(m, 0) + 1
+                else:
+                    self.actions[m] = self.actions.get(m, 0) + 1
+                self._recent.append({
+                    "ts": time.time(),
+                    "detector": det,
+                    "key": key,
+                    "action": action,
+                    "dry_run": self.dry_run,
+                    "duration_s": round(dur, 4),
+                    "detail": detail,
+                })
+        for action, detail in outcome:
+            if self.dry_run:
+                plog.warning(
+                    "recovery DRY-RUN %s %s -> %s %s", det, key, action,
+                    detail,
+                )
+                if self._obs is not None:
+                    self._obs.dryrun(det, action)
+            else:
+                plog.warning(
+                    "recovery ACT %s %s -> %s %s (%.3fs)", det, key,
+                    action, detail, dur,
+                )
+                if self._obs is not None:
+                    self._obs.action(det, action, duration_s=dur)
+
+    def _skip(self, reason: str) -> None:
+        with self._mu:
+            self._skip_locked(reason)
+
+    def _skip_locked(self, reason: str) -> None:
+        self.skips[reason] = self.skips.get(reason, 0) + 1
+        if self._obs is not None:
+            self._obs.skipped(reason)
+
+    # ------------------------------------------------------------------
+    # the actuation matrix
+    # ------------------------------------------------------------------
+
+    def _actuate(self, det: str, ev: dict):
+        """Dispatch one open event; returns ``None`` (no viable target),
+        ``"not_leader"`` (retryable) or a list of (action, detail)."""
+        detail = ev.get("detail") or {}
+        cid = detail.get("cluster_id")
+        if det == "quorum_at_risk":
+            return self._act_quorum(cid, detail)
+        if det == "leader_flap":
+            return self._act_leader_flap(cid, detail)
+        if det == "devsm_rebind":
+            return self._act_devsm(cid, detail)
+        if det == "commit_stall":
+            return self._act_commit_stall(cid, detail)
+        return None
+
+    def _node(self, cid):
+        if cid is None:
+            return None
+        try:
+            return self.nh.get_node(cid)
+        except Exception:
+            return None  # group stopped since the event opened
+
+    def _act_quorum(self, cid, detail):
+        node = self._node(cid)
+        if node is None:
+            return None
+        if not node.is_leader():
+            return "not_leader"
+        m = node.get_membership()
+        dead = [
+            nid for nid in detail.get("unreachable_ids") or ()
+            if nid in m.addresses or nid in (m.witnesses or {})
+        ]
+        out = []
+        if dead:
+            # one eviction per actuation: dropping the unreachable voter
+            # restores the quorum margin (and closes the detector); a
+            # mass-evict under a transient partition would be the cure
+            # worse than the disease
+            victim = min(dead)
+            out.append(("evict_dead", {
+                "cluster_id": cid, "node_id": victim,
+                "unreachable": sorted(dead),
+            }))
+            if not self.dry_run:
+                self.nh.sync_request_delete_node(
+                    cid, victim, timeout=self.action_timeout_s
+                )
+        # restore durability: promote a standing observer to voter
+        # (the raft core carries its progress over), or add a fresh
+        # witness from the standby pool — NEVER ADD_NODE a witness id
+        # (the reference core rejects in-place witness promotion)
+        observers = dict(m.observers or {})
+        if observers:
+            oid = min(observers)
+            out.append(("promote_standby", {
+                "cluster_id": cid, "node_id": oid,
+                "address": observers[oid], "kind": "observer",
+            }))
+            if not self.dry_run:
+                self.nh.sync_request_add_node(
+                    cid, oid, observers[oid],
+                    timeout=self.action_timeout_s,
+                )
+        elif self.standby_witness_addrs:
+            used = set(m.addresses) | set(m.observers or {})
+            used |= set(m.witnesses or {}) | set(m.removed or {})
+            wid = max(
+                self._next_witness_id.get(cid, 0), max(used, default=0) + 1
+            )
+            self._next_witness_id[cid] = wid + 1
+            addr = self.standby_witness_addrs[
+                cid % len(self.standby_witness_addrs)
+            ]
+            out.append(("promote_standby", {
+                "cluster_id": cid, "node_id": wid, "address": addr,
+                "kind": "witness",
+            }))
+            if not self.dry_run:
+                self.nh.sync_request_add_witness(
+                    cid, wid, addr, timeout=self.action_timeout_s
+                )
+        return out or None
+
+    def _act_leader_flap(self, cid, detail):
+        node = self._node(cid)
+        if node is None:
+            return None
+        if not node.is_leader():
+            return "not_leader"
+        m = node.get_membership()
+        recent = set(detail.get("recent_leaders") or ())
+        if recent and node.node_id not in recent:
+            # leadership already escaped the flapping set (e.g. another
+            # host's controller landed it here): transferring again
+            # would re-enter the churn this action exists to stop
+            return None
+        witnesses = set(m.witnesses or {})
+        candidates = [
+            nid for nid in sorted(m.addresses)
+            if nid != node.node_id and nid not in witnesses
+        ]
+        targets = [nid for nid in candidates if nid not in recent]
+        if not targets:
+            # every voter participated in the flap: there is no stable
+            # host to move to, and another transfer is itself a leader
+            # change that resets the detector's quiet window — holding
+            # leadership is the only move that lets the flap die out
+            return None
+        target = targets[0]
+        if not self.dry_run:
+            self.nh.request_leader_transfer(cid, target)
+        return [("transfer_leader", {
+            "cluster_id": cid, "target": target,
+            "away_from": sorted(recent),
+        })]
+
+    def _act_devsm(self, cid, detail):
+        node = self._node(cid)
+        if node is None:
+            return None
+        coord = getattr(self.nh, "quorum_coordinator", None)
+        if coord is not None:
+            if self.dry_run:
+                plane = coord.devsm
+                if plane is None or not plane.tracks(cid):
+                    return None
+            elif not coord.devsm_force_release(cid):
+                return None
+        else:
+            plane = node.devsm_plane
+            if plane is None:
+                return None
+            if not self.dry_run:
+                plane.on_unbind(cid)
+        return [("devsm_release", {
+            "cluster_id": cid, "binds": detail.get("binds"),
+        })]
+
+    def _act_commit_stall(self, cid, detail):
+        node = self._node(cid)
+        if node is None:
+            return None
+        if not node.fast_lane:
+            return None  # the stall is not the native lane's
+        if not self.dry_run:
+            node.fast_eject()
+            self.nh.engine.set_step_ready(cid)
+        return [("fastlane_redrive", {"cluster_id": cid})]
+
+    # ------------------------------------------------------------------
+    # introspection / teardown
+    # ------------------------------------------------------------------
+
+    def report(self) -> dict:
+        """Aggregated actuation report (``NodeHost.recovery_report``,
+        the churn soak's RECOV capture)."""
+        with self._mu:
+            return {
+                "enabled": True,
+                "dry_run": self.dry_run,
+                "actions": {
+                    f"{d}:{a}": n
+                    for (d, a), n in sorted(self.actions.items()) if n
+                },
+                "dryruns": {
+                    f"{d}:{a}": n
+                    for (d, a), n in sorted(self.dryruns.items()) if n
+                },
+                "skips": dict(self.skips),
+                "failures": {
+                    f"{d}:{a}": n
+                    for (d, a), n in sorted(self.failures.items())
+                },
+                "observed": dict(self.observed),
+                "suppressed": [
+                    {"detector": d, "key": k}
+                    for d, k in sorted(self._suppressed)
+                ],
+                "recent": list(self._recent),
+                "guardrails": {
+                    "rate_limit_s": self.rate_limit_s,
+                    "cooldown_s": self.cooldown_s,
+                    "max_reopens": self.max_reopens,
+                    "reopen_window_s": self.reopen_window_s,
+                },
+            }
+
+    def stop(self) -> None:
+        """Stop the action workers; queued events are dropped.  The
+        sampler keeps its subscriber entries (it is torn down with the
+        host right after), but a stopped controller ignores callbacks."""
+        self._stopped.set()
+        for _ in self._threads:
+            self._q.put(None)
+        for t in self._threads:
+            t.join(timeout=2.0)

@@ -374,6 +374,19 @@ class TpuQuorumCoordinator:
             self.eng.kv_egress_hook = self.devsm.deliver
         return self.devsm
 
+    def devsm_force_release(self, cluster_id: int) -> bool:
+        """Actuation surface for the recovery plane (obs/recovery.py,
+        ISSUE 17): force-release the group's device binding so a
+        bind/unbind loop stops burning uploads — reads fall back to the
+        gated host shadow and the bind re-arms only on the next
+        leadership transition.  Returns True when the group was tracked
+        (something to release)."""
+        plane = self.devsm
+        if plane is None or not plane.tracks(cluster_id):
+            return False
+        plane.on_unbind(cluster_id)
+        return True
+
     def _sync_row_locked(self, node: "Node") -> None:
         """(Re)build the group's row from scalar raft state — the rare-path
         resync used at registration and after membership changes."""

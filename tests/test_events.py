@@ -199,6 +199,67 @@ def test_devprof_families_help_round_trip():
     assert out2.getvalue().splitlines() == lines
 
 
+def test_recovery_families_help_round_trip():
+    """ISSUE 17 satellite: every ``dragonboat_recovery_*`` family a
+    RecoveryObs registers carries its described ``# HELP`` immediately
+    before its ``# TYPE``, the actuation/skip/suppression publishers
+    land the expected values, and the exposition is write-stable."""
+    from dragonboat_tpu.obs.instruments import RecoveryObs
+    from dragonboat_tpu.obs.recovery import MATRIX
+
+    reg = MetricsRegistry()
+    obs = RecoveryObs(reg, matrix=MATRIX)
+    obs.action("quorum_at_risk", "evict_dead", duration_s=0.12)
+    obs.dryrun("leader_flap", "transfer_leader")
+    obs.skipped("rate_limited")
+    obs.failure("devsm_rebind", "devsm_release")
+    obs.suppressed("leader_flap", 1)
+    out = io.StringIO()
+    reg.write_health_metrics(out)
+    lines = out.getvalue().splitlines()
+    families = (
+        "dragonboat_recovery_actions_total",
+        "dragonboat_recovery_dryrun_total",
+        "dragonboat_recovery_skipped_total",
+        "dragonboat_recovery_suppressed_keys",
+        "dragonboat_recovery_failures_total",
+        "dragonboat_recovery_action_seconds",
+    )
+    for name in families:
+        tidx = [
+            i for i, l in enumerate(lines) if l.startswith(f"# TYPE {name} ")
+        ]
+        assert len(tidx) == 1, name
+        help_line = lines[tidx[0] - 1]
+        assert help_line.startswith(f"# HELP {name} "), help_line
+        assert "dragonboat_tpu metric" not in help_line, help_line
+    # the full matrix is zero-registered: a scrape distinguishes
+    # "recovery off" (families absent) from "on but idle" (zeros)
+    for det, action in MATRIX:
+        assert any(
+            l.startswith("dragonboat_recovery_actions_total")
+            and f'detector="{det}"' in l and f'action="{action}"' in l
+            for l in lines
+        ), (det, action)
+    assert any(
+        l.startswith("dragonboat_recovery_actions_total")
+        and 'detector="quorum_at_risk"' in l and 'action="evict_dead"' in l
+        and l.endswith(" 1")
+        for l in lines
+    ), [l for l in lines if l.startswith("dragonboat_recovery_actions")]
+    assert any(
+        l.startswith("dragonboat_recovery_skipped_total")
+        and 'reason="rate_limited"' in l and l.endswith(" 1")
+        for l in lines
+    )
+    assert 'dragonboat_recovery_suppressed_keys{detector="leader_flap"} 1' \
+        in lines
+    # a second write is byte-identical (stable ordering incl. HELP)
+    out2 = io.StringIO()
+    reg.write_health_metrics(out2)
+    assert out2.getvalue().splitlines() == lines
+
+
 def test_mesh_families_help_round_trip():
     """ISSUE 16 satellite: every ``dragonboat_mesh_*`` family a MeshObs
     registers carries its described ``# HELP`` immediately before its

@@ -536,6 +536,74 @@ def test_unit_group_gone_closes_events_and_drops_memory():
     )
 
 
+def test_unit_subscription_callbacks_and_ordering():
+    """ISSUE 17 satellite: on_open/on_close subscribers fire per
+    transition, and a close callback observes the recovery attribution
+    ALREADY including its event (the controller's MTTR contract)."""
+    hs = _unit_sampler(commit_stall_samples=2)
+    events = []
+    hs.on_open(lambda ev: events.append((
+        "open", ev["detector"], ev["key"],
+        hs.recovery_stats().get("commit_stall", {}).get("n", 0),
+    )))
+    hs.on_close(lambda ev: events.append((
+        "close", ev["detector"], ev["duration_s"],
+        hs.recovery_stats().get("commit_stall", {}).get("n", 0),
+    )))
+    g = {"committed": 5, "pending_proposals": True, "leader_id": 1}
+    for _ in range(3):
+        hs.ingest(_sample({7: dict(g)}))
+    assert events and events[0][:3] == ("open", "commit_stall", "group:7")
+    assert events[0][3] == 0  # open: nothing attributed yet
+    g["committed"] = 6
+    hs.ingest(_sample({7: dict(g)}))
+    closes = [e for e in events if e[0] == "close"]
+    assert len(closes) == 1
+    assert closes[0][1] == "commit_stall"
+    assert closes[0][2] is not None  # duration_s carried on the event
+    # ordering: when the callback ran, the duration was ALREADY in the
+    # recovery attribution
+    assert closes[0][3] == 1
+    # the event copies are snapshots: mutating one must not corrupt the
+    # sampler's records
+    assert hs.recovery_stats()["commit_stall"]["n"] == 1
+
+
+def test_unit_subscription_exception_guarded():
+    """A failing subscriber is logged and skipped — sampling continues,
+    later subscribers still run, the event still records."""
+    hs = _unit_sampler(commit_stall_samples=1)
+    seen = []
+
+    def _bad(ev):
+        raise RuntimeError("subscriber boom")
+
+    hs.on_open(_bad)
+    hs.on_open(lambda ev: seen.append(ev["detector"]))
+    hs.on_close(_bad)
+    hs.on_close(lambda ev: seen.append("closed:" + ev["detector"]))
+    g = {"committed": 5, "pending_proposals": True, "leader_id": 1}
+    hs.ingest(_sample({7: dict(g)}))
+    hs.ingest(_sample({7: dict(g)}))
+    assert "commit_stall" in seen
+    g["committed"] = 6
+    hs.ingest(_sample({7: dict(g)}))
+    assert "closed:commit_stall" in seen
+    assert hs.recovery_stats()["commit_stall"]["n"] == 1
+    assert not hs.open_events()
+
+
+def test_unit_unsubscribed_latch_stays_none():
+    """The _subs latch follows the _obs discipline: no subscription,
+    no structure — an event dispatch is one attribute load."""
+    hs = _unit_sampler(commit_stall_samples=1)
+    g = {"committed": 5, "pending_proposals": True, "leader_id": 1}
+    hs.ingest(_sample({7: dict(g)}))
+    hs.ingest(_sample({7: dict(g)}))
+    assert hs.open_events()
+    assert hs._subs is None
+
+
 def test_unit_worker_flap_restart_bump():
     hs = _unit_sampler()
     hs.ingest(_sample(hostproc={"alive": 2, "workers": 2, "restarts": 0}))

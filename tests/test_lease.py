@@ -425,6 +425,39 @@ def test_lease_ack_attribution_is_conservative():
     assert lease.bases[2] == 400
 
 
+def test_lease_wall_guard_expires_starved_tick_clock():
+    """ISSUE 17 churn-soak caught: the lease clock is the event loop's
+    tick counter, so a starved/descheduled leader's tick-valid lease can
+    outlive the majority's WALL-time election and serve a stale read.
+    With ``tick_interval_s`` set, validity additionally requires the
+    quorum-th newest ack to be wall-fresh — starvation expires the
+    lease, never extends it."""
+    wall = [100.0]
+    lease = LeaderLease(10, tick_interval_s=0.05)  # duration 8 ticks
+    lease.wall_clock = lambda: wall[0]
+    voters, quorum, self_id = [1, 2, 3], 2, 1
+    lease.record_send(5, [2, 3])
+    lease.record_ack(2, 6)
+    assert lease.valid(6, quorum, voters, self_id)
+    # tick clock FROZEN at 6 (starved loop) while wall time runs past
+    # duration * tick_interval_s = 0.4s: the guard must expire it even
+    # though the tick arithmetic still says valid
+    wall[0] += 0.39
+    assert lease.valid(6, quorum, voters, self_id)
+    wall[0] += 0.02
+    assert not lease.valid(6, quorum, voters, self_id)
+    # a fresh quorum ack re-arms it (tick basis AND wall basis move)
+    lease.record_send(6, [2, 3])
+    lease.record_ack(2, 7)
+    assert lease.valid(7, quorum, voters, self_id)
+    # without the knob the same freeze stays (unsafely) valid — the
+    # default-off contract tick-driven tests rely on
+    bare = LeaderLease(10)
+    bare.record_send(5, [2, 3])
+    bare.record_ack(2, 6)
+    assert bare.valid(6, quorum, voters, self_id)
+
+
 def test_lease_survives_sustained_hint_broadcast_load():
     """Review-caught liveness hole: every ReadIndex fallback broadcasts
     a hint heartbeat (= one record_send), so per-SEND FIFO capacity

@@ -1,0 +1,826 @@
+"""Closed-loop recovery plane suite (ISSUE 17).
+
+Contracts under test:
+
+- recovery-OFF structural identity: ``auto_recover=False`` constructs
+  nothing — no controller, no sampler subscription (the ``_subs`` latch
+  stays ``None``), no ``dragonboat_recovery_*`` families;
+  ``auto_recover`` without the health plane degrades to a warning;
+- the actuation matrix on synthetic detector events over a fake
+  NodeHost: ``quorum_at_risk`` evicts the unreachable voter then
+  promotes the standing observer (and commits a witness add from the
+  standby pool when no observer stands by), ``leader_flap`` transfers
+  to a voter outside the flap window's recent leaders, ``commit_stall``
+  re-drives the fast-lane eject, ``devsm_rebind`` force-releases the
+  binding, ``worker_flap`` is observe-only;
+- guardrails: per-group rate limit, per-detector cooldown, flap
+  suppression after ``max_reopens`` re-opens (reported + gauged),
+  dry-run executes nothing while counting intent, not-leader retries;
+- live: a 3-voter + standby-observer group under a netsplit heals
+  MTTR-faster with ``auto_recover=on`` (evict + promote closes the
+  detector long before the split heals) than off (the detector can
+  only close when the partition does) — the A/B the churn soak scores
+  at fleet scale; a flapping group's leadership is transferred off the
+  flapping pair; one kill -9 produces exactly one hostproc restart
+  (double-actuation guard).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from dragonboat_tpu import Config, NodeHostConfig, Result
+from dragonboat_tpu.config import ExpertConfig
+from dragonboat_tpu.events import MetricsRegistry
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.obs.health import HealthSampler
+from dragonboat_tpu.obs.recovery import MATRIX, RecoveryController
+from dragonboat_tpu.transport import ChanRouter, ChanTransport
+from dragonboat_tpu.wire.types import Membership
+
+from tests.loadwait import wait_until
+
+# heavy multi-NodeHost tests serialize on one xdist worker
+pytestmark = pytest.mark.xdist_group("heavy-multiprocess")
+
+RTT_MS = 5
+CID = 940
+
+
+class CounterSM:
+    def __init__(self, cluster_id, node_id):
+        self.count = 0
+
+    def update(self, cmd):
+        self.count += 1
+        return Result(value=self.count)
+
+    def lookup(self, query):
+        return self.count
+
+    def save_snapshot(self, w, files, done):
+        w.write(self.count.to_bytes(8, "little"))
+
+    def recover_from_snapshot(self, r, files, done):
+        self.count = int.from_bytes(r.read(8), "little")
+
+    def close(self):
+        pass
+
+
+# ----------------------------------------------------------------------
+# fakes: a recording NodeHost for matrix-level unit tests
+# ----------------------------------------------------------------------
+
+
+class _FakeEngine:
+    def set_step_ready(self, cid):
+        pass
+
+
+class _FakeNode:
+    def __init__(self, node_id=1, leader=True, fast_lane=False,
+                 membership=None):
+        self.node_id = node_id
+        self._leader = leader
+        self.fast_lane = fast_lane
+        self._membership = membership or Membership(
+            addresses={1: "h1", 2: "h2", 3: "h3"}
+        )
+        self.ejects = 0
+        self.devsm_plane = None
+
+    def is_leader(self):
+        return self._leader
+
+    def get_membership(self):
+        return self._membership
+
+    def fast_eject(self):
+        self.ejects += 1
+
+
+class _FakeNH:
+    quorum_coordinator = None
+
+    def __init__(self, node):
+        self.node = node
+        self.engine = _FakeEngine()
+        self.calls = []
+
+    def get_node(self, cid):
+        return self.node
+
+    def sync_request_delete_node(self, cid, nid, timeout=5.0):
+        self.calls.append(("delete", cid, nid))
+
+    def sync_request_add_node(self, cid, nid, addr, timeout=5.0):
+        self.calls.append(("add_node", cid, nid, addr))
+
+    def sync_request_add_witness(self, cid, nid, addr, timeout=5.0):
+        self.calls.append(("add_witness", cid, nid, addr))
+
+    def request_leader_transfer(self, cid, target):
+        self.calls.append(("transfer", cid, target))
+
+
+def _rig(node=None, registry=None, **knobs):
+    """A unit sampler + controller pair over a fake NodeHost."""
+    kw = dict(rate_limit_s=0.0, cooldown_s=0.0, max_reopens=3,
+              reopen_window_s=60.0, workers=1, retry_delay_s=0.05,
+              max_attempts=4)
+    kw.update(knobs)
+    hs = HealthSampler(nh=None, registry=registry or MetricsRegistry())
+    nh = _FakeNH(node or _FakeNode())
+    rc = RecoveryController(nh, hs, registry=registry, **kw)
+    return hs, nh, rc
+
+
+def _open(hs, detector, detail, key=None):
+    hs._set(detector, key or f"group:{detail.get('cluster_id', 7)}",
+            True, time.monotonic(), detail)
+
+
+def _close(hs, detector, detail=None, key=None):
+    hs._set(detector, key or f"group:{(detail or {}).get('cluster_id', 7)}",
+            False, time.monotonic(), detail or {})
+
+
+# ----------------------------------------------------------------------
+# actuation matrix (synthetic events, fake host)
+# ----------------------------------------------------------------------
+
+
+def test_quorum_at_risk_evicts_dead_then_promotes_observer():
+    node = _FakeNode(membership=Membership(
+        addresses={1: "h1", 2: "h2", 3: "h3"}, observers={4: "h4"},
+    ))
+    hs, nh, rc = _rig(node)
+    try:
+        _open(hs, "quorum_at_risk", {
+            "cluster_id": 7, "reachable": 2, "voters": 3, "quorum": 2,
+            "unreachable_ids": [3],
+        })
+        wait_until(lambda: len(nh.calls) >= 2, timeout=5.0,
+                   what="quorum actions")
+        # order matters: the eviction restores the quorum margin (and
+        # closes the detector) BEFORE the promotion re-adds capacity
+        assert nh.calls[0] == ("delete", 7, 3)
+        assert nh.calls[1] == ("add_node", 7, 4, "h4")
+        assert rc.actions[("quorum_at_risk", "evict_dead")] == 1
+        assert rc.actions[("quorum_at_risk", "promote_standby")] == 1
+    finally:
+        rc.stop()
+
+
+def test_quorum_at_risk_adds_standby_witness_when_no_observer():
+    """The BlackWater move: with no standing observer, durability
+    capacity is restored by committing an ADD_WITNESS config change
+    from the standby pool (witness promotion IS a config change — the
+    raft core forbids in-place witness→voter, so the fresh-witness add
+    is the legal spelling)."""
+    node = _FakeNode(membership=Membership(
+        addresses={1: "h1", 2: "h2", 3: "h3"}, witnesses={9: "w9"},
+    ))
+    hs, nh, rc = _rig(node, standby_witness_addrs=("spare:1",))
+    try:
+        _open(hs, "quorum_at_risk", {
+            "cluster_id": 7, "reachable": 2, "voters": 4, "quorum": 3,
+            "unreachable_ids": [3],
+        })
+        wait_until(lambda: len(nh.calls) >= 2, timeout=5.0,
+                   what="witness add")
+        assert nh.calls[0] == ("delete", 7, 3)
+        kind, cid, wid, addr = nh.calls[1]
+        assert kind == "add_witness" and cid == 7 and addr == "spare:1"
+        # a fresh id past every known member — never a reused witness id
+        assert wid > 9
+    finally:
+        rc.stop()
+
+
+def test_leader_flap_transfers_off_the_flapping_hosts():
+    node = _FakeNode(node_id=1, membership=Membership(
+        addresses={1: "h1", 2: "h2", 3: "h3"}, witnesses={4: "w4"},
+    ))
+    hs, nh, rc = _rig(node)
+    try:
+        _open(hs, "leader_flap", {
+            "cluster_id": 7, "changes": 4, "leader_id": 1,
+            "recent_leaders": [1, 2],
+        })
+        wait_until(lambda: nh.calls, timeout=5.0, what="transfer")
+        # off the flapping pair {1,2}, never to a witness
+        assert nh.calls[0] == ("transfer", 7, 3)
+        assert rc.actions[("leader_flap", "transfer_leader")] == 1
+    finally:
+        rc.stop()
+
+
+def test_leader_flap_no_action_when_leadership_already_escaped():
+    """A leader that is NOT itself in the flap window's recent set is
+    the remediation's end state — another transfer would re-enter the
+    churn (the soak's bounce-back race)."""
+    node = _FakeNode(node_id=3, membership=Membership(
+        addresses={1: "h1", 2: "h2", 3: "h3"},
+    ))
+    hs, nh, rc = _rig(node)
+    try:
+        _open(hs, "leader_flap", {
+            "cluster_id": 7, "changes": 4, "leader_id": 3,
+            "recent_leaders": [1, 2],
+        })
+        wait_until(lambda: rc.skips.get("no_target", 0) >= 1, timeout=5.0,
+                   what="no_target skip")
+        assert not nh.calls
+    finally:
+        rc.stop()
+
+
+def test_leader_flap_holds_when_every_voter_flapped():
+    """No stable host to move to: a transfer is itself a leader change
+    that resets the detector's quiet window, so the controller must hold
+    leadership rather than ping-pong inside the flapping set (the churn
+    soak's netsplit-election tail)."""
+    node = _FakeNode(node_id=1, membership=Membership(
+        addresses={1: "h1", 2: "h2", 3: "h3"},
+    ))
+    hs, nh, rc = _rig(node)
+    try:
+        _open(hs, "leader_flap", {
+            "cluster_id": 7, "changes": 5, "leader_id": 1,
+            "recent_leaders": [3, 2, 1],
+        })
+        wait_until(lambda: rc.skips.get("no_target", 0) >= 1, timeout=5.0,
+                   what="no_target skip")
+        assert not nh.calls
+    finally:
+        rc.stop()
+
+
+def test_commit_stall_redrives_fast_lane_only():
+    node = _FakeNode(fast_lane=True)
+    hs, nh, rc = _rig(node)
+    try:
+        _open(hs, "commit_stall", {"cluster_id": 7, "samples": 3})
+        wait_until(lambda: node.ejects >= 1, timeout=5.0, what="eject")
+        assert rc.actions[("commit_stall", "fastlane_redrive")] == 1
+    finally:
+        rc.stop()
+    # a scalar-lane group has no native lane to re-drive: no action
+    node2 = _FakeNode(fast_lane=False)
+    hs2, nh2, rc2 = _rig(node2)
+    try:
+        _open(hs2, "commit_stall", {"cluster_id": 7, "samples": 3})
+        wait_until(lambda: rc2.skips.get("no_target", 0) >= 1, timeout=5.0,
+                   what="no_target skip")
+        assert node2.ejects == 0
+    finally:
+        rc2.stop()
+
+
+def test_devsm_rebind_force_releases_binding():
+    released = []
+
+    class _FakeCoord:
+        class devsm:
+            @staticmethod
+            def tracks(cid):
+                return True
+
+        @staticmethod
+        def devsm_force_release(cid):
+            released.append(cid)
+            return True
+
+    node = _FakeNode()
+    hs, nh, rc = _rig(node)
+    nh.quorum_coordinator = _FakeCoord()
+    try:
+        _open(hs, "devsm_rebind", {"cluster_id": 7, "binds": 5})
+        wait_until(lambda: released, timeout=5.0, what="release")
+        assert released == [7]
+        assert rc.actions[("devsm_rebind", "devsm_release")] == 1
+    finally:
+        rc.stop()
+
+
+def test_worker_flap_is_observe_only():
+    hs, nh, rc = _rig()
+    try:
+        _open(hs, "worker_flap", {"alive": 1, "workers": 2, "restarts": 1},
+              key="host")
+        wait_until(lambda: rc.skips.get("observe_only", 0) >= 1,
+                   timeout=5.0, what="observe-only skip")
+        assert not nh.calls
+        assert rc.observed.get("worker_flap") == 1
+        rep = rc.report()
+        assert rep["observed"]["worker_flap"] == 1
+        assert not rep["actions"]
+    finally:
+        rc.stop()
+
+
+def test_not_leader_retries_until_leadership_lands():
+    node = _FakeNode(leader=False)
+    # a long retry runway: the flip below must land inside it even on
+    # a loaded box
+    hs, nh, rc = _rig(node, retry_delay_s=0.2, max_attempts=100)
+    try:
+        _open(hs, "leader_flap", {
+            "cluster_id": 7, "changes": 4, "leader_id": 2,
+            "recent_leaders": [1, 2],
+        })
+        wait_until(lambda: rc.skips.get("not_leader", 0) >= 1,
+                   timeout=5.0, what="not_leader skip")
+        assert not nh.calls
+        node._leader = True  # leadership landed between retries
+        wait_until(lambda: nh.calls, timeout=5.0, what="retried transfer")
+        assert nh.calls[0][0] == "transfer"
+    finally:
+        rc.stop()
+
+
+# ----------------------------------------------------------------------
+# guardrails
+# ----------------------------------------------------------------------
+
+
+def test_rate_limit_per_group_spans_detectors():
+    node = _FakeNode(fast_lane=True, membership=Membership(
+        addresses={1: "h1", 2: "h2", 3: "h3"}, observers={4: "h4"},
+    ))
+    hs, nh, rc = _rig(node, rate_limit_s=30.0)
+    try:
+        _open(hs, "quorum_at_risk", {
+            "cluster_id": 7, "reachable": 2, "voters": 3, "quorum": 2,
+            "unreachable_ids": [3],
+        })
+        wait_until(lambda: nh.calls, timeout=5.0, what="first action")
+        n0 = len(nh.calls)
+        # a different detector on the SAME group inside the rate window
+        _open(hs, "commit_stall", {"cluster_id": 7, "samples": 3})
+        wait_until(lambda: rc.skips.get("rate_limited", 0) >= 1,
+                   timeout=5.0, what="rate-limit skip")
+        assert len(nh.calls) == n0 and node.ejects == 0
+    finally:
+        rc.stop()
+
+
+def test_cooldown_per_detector_key():
+    node = _FakeNode(membership=Membership(
+        addresses={1: "h1", 2: "h2", 3: "h3"},
+    ))
+    hs, nh, rc = _rig(node, cooldown_s=30.0)
+    try:
+        detail = {"cluster_id": 7, "changes": 4, "leader_id": 1,
+                  "recent_leaders": [1, 2]}
+        _open(hs, "leader_flap", detail)
+        wait_until(lambda: nh.calls, timeout=5.0, what="first transfer")
+        _close(hs, "leader_flap", detail)
+        _open(hs, "leader_flap", detail)
+        wait_until(lambda: rc.skips.get("cooldown", 0) >= 1, timeout=5.0,
+                   what="cooldown skip")
+        assert len(nh.calls) == 1
+    finally:
+        rc.stop()
+
+
+def test_flap_suppression_after_max_reopens():
+    """An action whose detector re-opens ``max_reopens`` times inside
+    the window gets suppressed — reported, gauged, no further actions
+    — and a full quiet window lifts the suppression."""
+    reg = MetricsRegistry()
+    node = _FakeNode(membership=Membership(
+        addresses={1: "h1", 2: "h2", 3: "h3"},
+    ))
+    hs, nh, rc = _rig(node, registry=reg, max_reopens=2,
+                      reopen_window_s=60.0)
+    try:
+        detail = {"cluster_id": 7, "changes": 4, "leader_id": 1,
+                  "recent_leaders": [1, 2]}
+        for i in range(2):
+            _open(hs, "leader_flap", detail)
+            wait_until(lambda i=i: len(nh.calls) == i + 1, timeout=5.0,
+                       what=f"transfer {i + 1}")
+            _close(hs, "leader_flap", detail)
+        # the second re-open hit max_reopens: suppressed from here on
+        _open(hs, "leader_flap", detail)
+        wait_until(lambda: rc.skips.get("suppressed", 0) >= 1, timeout=5.0,
+                   what="suppressed skip")
+        assert len(nh.calls) == 2
+        rep = rc.report()
+        assert {"detector": "leader_flap", "key": "group:7"} in (
+            rep["suppressed"]
+        )
+        assert reg.gauge_value(
+            "dragonboat_recovery_suppressed_keys",
+            {"detector": "leader_flap"},
+        ) == 1
+        assert reg.counter_value(
+            "dragonboat_recovery_skipped_total", {"reason": "suppressed"}
+        ) >= 1
+        # a full quiet window after the last strike lifts the damper
+        # (backdate the action stamp too: a fresh open inside the
+        # reopen window of a real action would legitimately re-strike)
+        k = ("leader_flap", "group:7")
+        with rc._mu:
+            count, last = rc._strikes[k]
+            rc._strikes[k] = (count, last - 120.0)
+            rc._last_det_action[k] -= 120.0
+        _close(hs, "leader_flap", detail)
+        _open(hs, "leader_flap", detail)
+        wait_until(lambda: len(nh.calls) == 3, timeout=5.0,
+                   what="post-quiet transfer")
+        assert reg.gauge_value(
+            "dragonboat_recovery_suppressed_keys",
+            {"detector": "leader_flap"},
+        ) == 0
+    finally:
+        rc.stop()
+
+
+def test_dry_run_executes_nothing():
+    reg = MetricsRegistry()
+    node = _FakeNode(fast_lane=True, membership=Membership(
+        addresses={1: "h1", 2: "h2", 3: "h3"}, observers={4: "h4"},
+    ))
+    hs, nh, rc = _rig(node, registry=reg, dry_run=True)
+    try:
+        _open(hs, "quorum_at_risk", {
+            "cluster_id": 7, "reachable": 2, "voters": 3, "quorum": 2,
+            "unreachable_ids": [3],
+        })
+        wait_until(
+            lambda: rc.dryruns.get(("quorum_at_risk", "evict_dead"), 0) >= 1,
+            timeout=5.0, what="dry-run decision",
+        )
+        # the full decision ran (both actions intended), nothing executed
+        assert rc.dryruns[("quorum_at_risk", "promote_standby")] == 1
+        assert not nh.calls and node.ejects == 0
+        assert rc.actions[("quorum_at_risk", "evict_dead")] == 0
+        assert reg.counter_value(
+            "dragonboat_recovery_dryrun_total",
+            {"detector": "quorum_at_risk", "action": "evict_dead"},
+        ) == 1
+        assert reg.counter_value(
+            "dragonboat_recovery_actions_total",
+            {"detector": "quorum_at_risk", "action": "evict_dead"},
+        ) == 0
+        rep = rc.report()
+        assert rep["dry_run"] and rep["dryruns"]
+    finally:
+        rc.stop()
+
+
+# ----------------------------------------------------------------------
+# off structural identity + wiring
+# ----------------------------------------------------------------------
+
+
+def _mk_host(addr="rc:1", router=None, health_ms=0, auto=False,
+             dry_run=False, knobs=None):
+    router = router or ChanRouter()
+    return NodeHost(
+        NodeHostConfig(
+            node_host_dir=":memory:",
+            rtt_millisecond=RTT_MS,
+            raft_address=addr,
+            raft_rpc_factory=lambda s, rh, ch: ChanTransport(
+                s, rh, ch, router=router
+            ),
+            enable_metrics=True,
+            health_sample_ms=health_ms,
+            auto_recover=auto,
+            auto_recover_dry_run=dry_run,
+            auto_recover_knobs=knobs or {},
+            expert=ExpertConfig(
+                quorum_engine="scalar", engine_warm_fused=False,
+            ),
+        )
+    )
+
+
+def _start(nh, cid=CID, node_id=1, addrs=None, join=False, **cfg_kw):
+    nh.start_cluster(
+        {} if join else (addrs or {node_id: nh.raft_address()}),
+        join, CounterSM,
+        Config(cluster_id=cid, node_id=node_id, election_rtt=10,
+               heartbeat_rtt=1, **cfg_kw),
+    )
+
+
+def test_recovery_off_structural_identity():
+    nh = _mk_host(health_ms=20, auto=False)
+    try:
+        _start(nh)
+        wait_until(lambda: nh.get_leader_id(CID)[1], timeout=10.0,
+                   what="leader")
+        assert nh.recovery is None
+        # no subscriber was registered: the sampler's latch stays None
+        assert nh.health._subs is None
+        assert not any(
+            f.startswith("dragonboat_recovery_")
+            for f in nh.metrics_registry.families()
+        )
+        assert nh.recovery_report() == {
+            "enabled": False, "recovery_plane": "off",
+        }
+    finally:
+        nh.stop()
+
+
+def test_auto_recover_without_health_plane_degrades():
+    nh = _mk_host(health_ms=0, auto=True)
+    try:
+        assert nh.health is None and nh.recovery is None
+        assert nh.recovery_report()["enabled"] is False
+    finally:
+        nh.stop()
+
+
+def test_auto_recover_wires_controller_and_families():
+    nh = _mk_host(
+        health_ms=20, auto=True, dry_run=True,
+        knobs={"rate_limit_s": 1.0, "max_reopens": 5},
+    )
+    try:
+        _start(nh)
+        assert nh.recovery is not None and nh.recovery.dry_run
+        assert nh.recovery.rate_limit_s == 1.0
+        assert nh.recovery.max_reopens == 5
+        assert nh.health._subs is not None
+        fams = nh.metrics_registry.families()
+        for fam in ("dragonboat_recovery_actions_total",
+                    "dragonboat_recovery_skipped_total"):
+            assert fam in fams, fam
+        rep = nh.recovery_report()
+        assert rep["enabled"] and rep["guardrails"]["rate_limit_s"] == 1.0
+    finally:
+        nh.stop()
+    assert nh.recovery._stopped.is_set()
+
+
+def test_unknown_knob_raises():
+    with pytest.raises(TypeError):
+        _mk_host(health_ms=20, auto=True, knobs={"not_a_knob": 1})
+
+
+# ----------------------------------------------------------------------
+# live: netsplit MTTR A/B (the churn soak's per-group scenario)
+# ----------------------------------------------------------------------
+
+
+def _mttr_netsplit_arm(auto: bool, hold_s: float) -> float:
+    """One arm of the A/B: 3 check-quorum voters + a standby observer,
+    host 3 netsplit for ``hold_s``; returns the quorum_at_risk MTTR
+    measured on host 1 (the leader)."""
+    router = ChanRouter()
+    addrs = {i: f"ab{i}:1" for i in (1, 2, 3)}
+    knobs = {"rate_limit_s": 0.2, "cooldown_s": 0.5, "retry_delay_s": 0.1,
+             "max_attempts": 5, "action_timeout_s": 10.0}
+    nhs = {
+        i: _mk_host(addr=f"ab{i}:1", router=router, health_ms=25,
+                    auto=auto, knobs=knobs)
+        for i in (1, 2, 3, 4)
+    }
+    try:
+        for i in (1, 2, 3):
+            _start(nhs[i], node_id=i, addrs=addrs, check_quorum=True)
+
+        def _drive_leader1():
+            n1 = nhs[1].get_node(CID)
+            if n1.is_leader():
+                return True
+            lid, ok = n1.get_leader_id()
+            if ok and lid in (2, 3):
+                try:
+                    nhs[lid].request_leader_transfer(CID, 1)
+                except Exception:
+                    pass
+            else:
+                n1.request_campaign()
+            return False
+
+        wait_until(_drive_leader1, timeout=20.0, interval=0.2,
+                   what="leader on host 1")
+        # standby observer on host 4 (the promotion target)
+        nhs[1].sync_request_add_observer(CID, 4, "ab4:1", timeout=10.0)
+        _start(nhs[4], node_id=4, join=True, is_observer=True)
+        s = nhs[1].get_noop_session(CID)
+        assert nhs[1].sync_propose(s, b"x", timeout=30.0)
+        health = nhs[1].health
+        health.quorum_risk_samples = 2
+        wait_until(lambda: len(health) >= 3, timeout=10.0, what="samples")
+        # cut host 3 from everyone, hold, then heal
+        router.partition("ab3:1", "ab1:1")
+        router.partition("ab3:1", "ab2:1")
+        wait_until(
+            lambda: any(
+                e["detector"] == "quorum_at_risk"
+                for e in health.open_events()
+            ),
+            timeout=20.0, what="quorum_at_risk open",
+        )
+        healed = threading.Timer(hold_s, router.heal)
+        healed.daemon = True
+        healed.start()
+        wait_until(
+            lambda: health.recovery_stats().get("quorum_at_risk"),
+            timeout=hold_s + 30.0, what="quorum_at_risk close",
+        )
+        healed.join()
+        if auto:
+            # the remediation committed: the dead voter is out, the
+            # observer serves as a voter now
+            m = nhs[1].sync_get_cluster_membership(CID, timeout=10.0)
+            assert 3 not in m.addresses and 4 in m.addresses, m
+            rep = nhs[1].recovery_report()
+            assert rep["actions"].get("quorum_at_risk:evict_dead", 0) >= 1
+            assert rep["actions"].get(
+                "quorum_at_risk:promote_standby", 0
+            ) >= 1
+            # writes still land on the remediated quorum
+            assert nhs[1].sync_propose(s, b"post", timeout=30.0)
+        return health.recovery_stats()["quorum_at_risk"]["max_s"]
+    finally:
+        for nh in nhs.values():
+            nh.stop()
+
+
+def test_live_netsplit_mttr_on_beats_off():
+    """The acceptance A/B at unit scale: with auto_recover the detector
+    closes when the evict commits (seconds), without it the close can
+    only arrive after the partition heals (the hold time)."""
+    hold_s = 6.0
+    mttr_off = _mttr_netsplit_arm(False, hold_s)
+    mttr_on = _mttr_netsplit_arm(True, hold_s)
+    # off cannot close before the heal; on must beat the hold window
+    assert mttr_off >= hold_s * 0.8, (mttr_off, mttr_on)
+    assert mttr_on < mttr_off, (mttr_off, mttr_on)
+
+
+def test_live_leader_flap_transferred_off_flapping_pair():
+    """Bounce leadership 1<->2 exactly ``leader_flap_changes`` times;
+    the flap detector opens, the controller on the current leader
+    transfers to host 3 (outside the flap window's recent leaders) and
+    leadership settles there.  Host 3 runs recovery OFF so the newly
+    elected host cannot re-actuate on its own open event."""
+    router = ChanRouter()
+    addrs = {i: f"lf{i}:1" for i in (1, 2, 3)}
+    knobs = {"rate_limit_s": 0.2, "cooldown_s": 0.5, "retry_delay_s": 0.2,
+             "max_attempts": 25}
+    nhs = {
+        i: _mk_host(addr=f"lf{i}:1", router=router, health_ms=25,
+                    auto=(i != 3), knobs=knobs)
+        for i in (1, 2, 3)
+    }
+    try:
+        for i in (1, 2, 3):
+            _start(nhs[i], node_id=i, addrs=addrs)
+        for hs in (nhs[i].health for i in (1, 2, 3)):
+            hs.leader_flap_changes = 3
+            hs.flap_window_s = 60.0
+
+        def _leader():
+            for i in (1, 2, 3):
+                lid, ok = nhs[i].get_leader_id(CID)
+                if ok and lid in (1, 2, 3):
+                    return lid
+            return None
+
+        def _drive(target):
+            lid = _leader()
+            if lid == target:
+                return True
+            if lid is not None:
+                try:
+                    nhs[lid].request_leader_transfer(CID, target)
+                except Exception:
+                    pass
+            return False
+
+        wait_until(lambda: _leader() is not None, timeout=20.0,
+                   what="leader")
+        wait_until(lambda: _drive(1), timeout=20.0, interval=0.3,
+                   what="leader on host 1")
+        # forget the election churn that got us here: only the
+        # deliberate bounces below may count as flap participants
+        # (otherwise host 3 can land in recent_leaders and the "away
+        # from the flappers" target set goes empty)
+        time.sleep(0.3)
+        for i in (1, 2, 3):
+            for dq in nhs[i].health._leader_changes.values():
+                dq.clear()
+
+        def _flap_open():
+            return any(
+                e["detector"] == "leader_flap"
+                for i in (1, 2)
+                for e in nhs[i].health.open_events()
+            )
+
+        # bounce inside the pair {1,2} until the detector opens, then
+        # STOP: a manual transfer still in flight at open time would
+        # race the controller's (stale leader views make the exact
+        # bounce count nondeterministic); the controllers' not_leader
+        # retry runway absorbs any stray landing
+        deadline = time.time() + 60.0
+        while not _flap_open():
+            assert time.time() < deadline, "flap detector never opened"
+            lid = _leader()
+            if lid not in (1, 2):
+                time.sleep(0.1)
+                continue
+            try:
+                nhs[lid].request_leader_transfer(CID, 2 if lid == 1 else 1)
+            except Exception:
+                pass
+            settle = time.time() + 3.0
+            while (time.time() < settle and _leader() == lid
+                   and not _flap_open()):
+                time.sleep(0.05)
+
+        def _acted():
+            for i in (1, 2):
+                rep = nhs[i].recovery_report()
+                if rep["actions"].get("leader_flap:transfer_leader"):
+                    return rep
+            return None
+
+        rep = wait_until(_acted, timeout=30.0, what="controller transfer")
+        wait_until(lambda: _leader() == 3, timeout=20.0,
+                   what="leadership off the flapping pair")
+        act = [r for r in rep["recent"]
+               if r["action"] == "transfer_leader"][0]
+        assert act["detail"]["target"] == 3
+        assert set(act["detail"]["away_from"]) <= {1, 2}
+    finally:
+        for nh in nhs.values():
+            nh.stop()
+
+
+# ----------------------------------------------------------------------
+# worker_flap double-actuation guard (live hostproc)
+# ----------------------------------------------------------------------
+
+
+def test_kill9_worker_single_respawn_with_recovery_on(tmp_path):
+    """Satellite: the hostproc monitor owns respawn — with the
+    controller subscribed, one kill -9 still produces exactly ONE
+    restart-counter bump (observe-and-attribute, never a second
+    respawn)."""
+    router = ChanRouter()
+    nh = NodeHost(
+        NodeHostConfig(
+            node_host_dir=str(tmp_path / "nh"),
+            rtt_millisecond=RTT_MS,
+            raft_address="wf:1",
+            raft_rpc_factory=lambda s, rh, ch: ChanTransport(
+                s, rh, ch, router=router
+            ),
+            enable_metrics=True,
+            health_sample_ms=20,
+            auto_recover=True,
+            expert=ExpertConfig(
+                quorum_engine="scalar", engine_warm_fused=False,
+                host_workers=1,
+            ),
+        )
+    )
+    if nh.hostproc is None:
+        nh.stop()
+        pytest.skip("hostproc spawn unavailable")
+    try:
+        _start(nh)
+        wait_until(lambda: nh.get_leader_id(CID)[1], timeout=10.0,
+                   what="leader")
+        wait_until(lambda: len(nh.health) >= 2, timeout=10.0,
+                   what="samples")
+        base_restarts = nh.hostproc.restarts_total
+        pid = nh.hostproc.worker_pid(0)
+        assert pid
+        os.kill(pid, signal.SIGKILL)
+        wait_until(
+            lambda: nh.hostproc.restarts_total == base_restarts + 1,
+            timeout=30.0, what="monitor respawn",
+        )
+        # the controller attributed the flap without acting
+        wait_until(
+            lambda: nh.recovery.observed.get("worker_flap", 0) >= 1,
+            timeout=15.0, what="controller attribution",
+        )
+        # settle: no second bump arrives, no recovery action fired
+        time.sleep(1.0)
+        assert nh.hostproc.restarts_total == base_restarts + 1
+        rep = nh.recovery_report()
+        assert not any(
+            k.startswith("worker_flap") for k in rep["actions"]
+        )
+        assert rep["skips"].get("observe_only", 0) >= 1
+    finally:
+        nh.stop()

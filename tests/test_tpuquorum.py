@@ -65,18 +65,24 @@ def _mk_nh(addr, router, engine="tpu"):
 
 
 def _wait_leader(nhs, cid, timeout=15.0):
-    # load-scaled: election timing on a box running the full tier-1
-    # sweep stretches far past the idle-box margin (r07 flake class)
-    from tests.loadwait import scaled
+    # load-scaled AND re-sampled (the r14/r17 deflake discipline): a
+    # budget priced once at entry underprices an election that starts
+    # on a momentarily-idle box sharing it with a heavy neighbor
+    # spinning up; the budget only ever GROWS toward
+    # ``timeout * current_scale``
+    from tests.loadwait import scale, scaled
 
-    deadline = time.time() + scaled(timeout)
-    while time.time() < deadline:
+    start = time.time()
+    budget = scaled(timeout)
+    while True:
         for nh in nhs:
             _, ok = nh.get_leader_id(cid)
             if ok:
                 return
+        budget = max(budget, timeout * scale())
+        if time.time() - start >= budget:
+            raise TimeoutError("no leader")
         time.sleep(0.01)
-    raise TimeoutError("no leader")
 
 
 def _cluster(router, engine, n=3, prefix="tq"):
@@ -135,17 +141,23 @@ def test_tpu_engine_leader_failover():
     nhs, addrs = _cluster(router, "tpu", prefix="fo")
     try:
         _wait_leader(nhs, CID)
-        from tests.loadwait import scaled
+        from tests.loadwait import scale, scaled
 
         lid = 0
-        deadline = time.time() + scaled(10.0)
-        while not lid and time.time() < deadline:
+        start = time.time()
+        budget = scaled(10.0)
+        while not lid:
             for nh in nhs:
                 l, ok = nh.get_leader_id(CID)
                 if ok:
                     lid = l
                     break
             else:
+                # re-sampled budget (r17 deflake): the one-shot deadline
+                # underpriced entry-time-idle waits
+                budget = max(budget, 10.0 * scale())
+                if time.time() - start >= budget:
+                    break
                 time.sleep(0.05)
         assert lid
         leader_nh = nhs[lid - 1]
@@ -153,13 +165,21 @@ def test_tpu_engine_leader_failover():
         survivors = [nh for nh in nhs if nh is not leader_nh]
         _wait_leader(survivors, CID)
         s = survivors[0].get_noop_session(CID)
+        # deadline-based re-sampled retry, not a fixed attempt count:
+        # 20 x 3s priced the post-failover re-election for an idle box
         committed = False
-        for _ in range(20):
+        start = time.time()
+        budget = scaled(60.0)
+        while not committed:
             try:
-                survivors[0].sync_propose(s, b"after=failover", timeout=3.0)
+                survivors[0].sync_propose(
+                    s, b"after=failover", timeout=scaled(3.0)
+                )
                 committed = True
-                break
             except Exception:
+                budget = max(budget, 60.0 * scale())
+                if time.time() - start >= budget:
+                    break
                 time.sleep(0.2)
         assert committed
         assert survivors[0].sync_read(CID, "after", timeout=30.0) == "failover"
@@ -201,9 +221,12 @@ def _config_change_retry(nh, cid, request, pred, what, budget=90.0):
     )
     from tests.loadwait import scale, scaled
 
-    deadline = time.time() + scaled(budget)
+    start = time.time()
+    # re-sampled while waiting (r17 deflake): the budget only ever
+    # grows toward ``budget * current_scale``
+    limit = scaled(budget)
     last = None
-    while time.time() < deadline:
+    while True:
         try:
             request(scaled(15.0))
             return
@@ -216,8 +239,11 @@ def _config_change_retry(nh, cid, request, pred, what, budget=90.0):
             m = None
         if m is not None and pred(m):
             return  # the "failed" attempt actually committed
+        limit = max(limit, budget * scale())
+        if time.time() - start >= limit:
+            break
     raise AssertionError(
-        f"{what} not achieved within {scaled(budget):.1f}s "
+        f"{what} not achieved within {limit:.1f}s "
         f"(base {budget:.1f}s x load {scale():.2f}); last={last!r}"
     )
 
@@ -232,18 +258,23 @@ def _wait_membership(nh, cid, pred, timeout=15.0, what="membership"):
     from dragonboat_tpu.requests import TimeoutError_
     from tests.loadwait import scale, scaled
 
-    deadline = time.time() + scaled(timeout)
+    start = time.time()
+    # re-sampled while waiting (r17 deflake; see _config_change_retry)
+    limit = scaled(timeout)
     last = None
-    while time.time() < deadline:
+    while True:
         try:
             last = nh.sync_get_cluster_membership(cid, timeout=scaled(10.0))
         except TimeoutError_:
             last = None
         if last is not None and pred(last):
             return last
+        limit = max(limit, timeout * scale())
+        if time.time() - start >= limit:
+            break
         time.sleep(0.1)
     raise AssertionError(
-        f"{what} not reached within {scaled(timeout):.1f}s "
+        f"{what} not reached within {limit:.1f}s "
         f"(base {timeout:.1f}s x load {scale():.2f}); last={last}"
     )
 
