@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test test-all test-kernels test-obs test-trace test-warmup \
 	test-hostplane test-hostproc test-lease test-devsm test-health \
-	test-repltrace test-devprof test-mesh test-recovery \
+	test-repltrace test-devprof test-mesh test-recovery test-hiercommit \
 	native soak soak-smoke soak-churn soak-churn-smoke \
 	bench dryrun perf-ledger perf-ledger-check
 
@@ -146,6 +146,14 @@ test-mesh:
 # transport/latency.py or the coordinator lease table change
 test-lease:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_lease.py -q
+
+# fast cpu gate for the hierarchical commit plane (ISSUE 18): sub-quorum
+# ≡ classic differentials, the fused class-mask rule vs the scalar
+# oracle, leader-change intersection safety and far-read batching — run
+# before the full tier-1 sweep whenever raft/hier.py, the raft commit or
+# vote paths, or the engine's hier fold change
+test-hiercommit:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_hiercommit.py -q
 
 # parallel run: heavy multi-NodeHost modules carry
 # xdist_group("heavy-multiprocess") and serialize on one worker while

@@ -1679,6 +1679,282 @@ def _xdom_commit_attribution(groups, rtt_ms, far_ms, duration, threads,
 
 
 # ======================================================================
+# hierarchical commit rung (hier, ISSUE 18)
+# ======================================================================
+
+
+def _mk_hier_hosts(rtt_ms, far_one_way_s, trace=0):
+    """Four hosts in a 2+2 domain split: hd1+hd2 near (domain A), hd3+hd4
+    one far link away (domain B).  With n=4 voters the classic quorum is
+    3, so every classic commit must wait on a far ack — the topology the
+    domain-local sub-quorum (raft/hier.py) is built to beat."""
+    from dragonboat_tpu import NodeHostConfig
+    from dragonboat_tpu.config import ExpertConfig
+    from dragonboat_tpu.monkey import set_latency
+    from dragonboat_tpu.nodehost import NodeHost
+    from dragonboat_tpu.transport import ChanRouter, ChanTransport
+    from dragonboat_tpu.transport.latency import crossdomain
+
+    router = ChanRouter()
+    nhs = []
+    for i in (1, 2, 3, 4):
+        nhs.append(
+            NodeHost(
+                NodeHostConfig(
+                    node_host_dir=":memory:",
+                    rtt_millisecond=rtt_ms,
+                    raft_address=f"hd{i}:1",
+                    raft_rpc_factory=lambda src, rh, ch: ChanTransport(
+                        src, rh, ch, router=router
+                    ),
+                    trace_sample_every=trace,
+                    expert=ExpertConfig(
+                        quorum_engine="scalar", logdb_shards=2
+                    ),
+                )
+            )
+        )
+    set_latency(
+        nhs,
+        crossdomain(
+            ["hd1:1", "hd2:1"], ["hd3:1", "hd4:1"], far_one_way_s
+        ),
+    )
+    return nhs
+
+
+def _hier_place_leaders(nhs, cids):
+    """_xdom_place_leaders for the 4-host topology: host 1 (near domain)
+    leads every group."""
+    deadline = time.time() + 120
+    led = set()
+    while len(led) < len(cids) and time.time() < deadline:
+        for cid in cids:
+            if cid in led:
+                continue
+            n1 = nhs[0].get_node(cid)
+            if n1.is_leader():
+                led.add(cid)
+                continue
+            lid, ok = n1.get_leader_id()
+            if ok and lid != 1 and 1 <= lid <= len(nhs):
+                try:
+                    nhs[lid - 1].request_leader_transfer(cid, 1)
+                except Exception:
+                    pass
+            else:
+                n1.request_campaign()
+        time.sleep(0.2)
+    assert len(led) == len(cids), (
+        f"near-domain leaders: {len(led)}/{len(cids)}"
+    )
+
+
+def _closer_by_class(summ) -> dict:
+    """Collapse the per-peer attribution table to closer counts per
+    latency class — the number the hier rung's flip assertion reads."""
+    agg: dict = {}
+    for d in summ["peers"].values():
+        agg[d["cls"]] = agg.get(d["cls"], 0) + d["closer"]
+    return agg
+
+
+def _hier_far_read_phase(nhs, cids, threads=4, reads_per_thread=25) -> dict:
+    """Far-domain read path (ISSUE 18 tentpole, part 4): concurrent
+    linearizable reads issued FROM a far-domain host (hd3) while the
+    leader sits in the near domain.  Without batching each read pays its
+    own cross-domain leader round trip; the FarReadBatcher coalesces
+    mid-flight arrivals onto the in-flight confirmation."""
+    far = nhs[2]  # hd3, domain B
+    cid = cids[0]
+    errors = [0]
+
+    def worker():
+        for _ in range(reads_per_thread):
+            try:
+                far.sync_read(cid, None, timeout=30.0)
+            except Exception:
+                errors[0] += 1
+
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    fr = far.get_node(cid).peer.raft.far_reads
+    total = threads * reads_per_thread
+    return {
+        "reads": total,
+        "errors": errors[0],
+        "elapsed_s": round(elapsed, 3),
+        "reads_per_sec": round(total / elapsed, 1) if elapsed else None,
+        "leader_round_trips": fr.batches,
+        "reads_coalesced": fr.coalesced,
+        "coalesce_ratio": (
+            round(fr.coalesced / total, 3) if total else None
+        ),
+    }
+
+
+def run_hier() -> dict:
+    """Hierarchical commit rung (ISSUE 18 tentpole): a 4-host group in a
+    2+2 domain split (near leader + one near follower; two followers one
+    far link away).  n=4 voters makes the classic quorum 3, so WITHOUT
+    hier every commit close pays the far round trip; WITH
+    ``hier_commit=True`` the near-domain sub-quorum (2 of {hd1,hd2})
+    closes at the near RTT and the far acks catch up asynchronously.
+
+    Two variants on identical topology and identical pure-write load,
+    both with replication attribution sampling on (the trace overhead
+    cancels in the A/B).  Asserted: the closer table flips far→near (off:
+    every sampled close is a far-class ack; on: near-class closers
+    dominate), commit close p99 drops from ≥ the far RTT to ≤ 0.5× the
+    far RTT, write throughput does not regress beyond noise, the
+    sub-quorum counters confirm the near rule (not a lucky topology) did
+    the closing, and the far-domain read phase coalesces concurrent
+    follower reads onto shared leader round trips.
+
+    Env knobs: E2E_HIER_GROUPS (8), E2E_HIER_DURATION (8s),
+    E2E_HIER_RTT_MS (20 tick), E2E_HIER_FAR_MS (20 one-way),
+    E2E_HIER_THREADS (4), E2E_HIER_TRACE_SAMPLE (1-in-4).
+    """
+    groups = int(os.environ.get("E2E_HIER_GROUPS", "8"))
+    duration = float(os.environ.get("E2E_HIER_DURATION", "8"))
+    rtt_ms = int(os.environ.get("E2E_HIER_RTT_MS", "20"))
+    far_ms = float(os.environ.get("E2E_HIER_FAR_MS", "20"))
+    threads = int(os.environ.get("E2E_HIER_THREADS", "4"))
+    sample = int(os.environ.get("E2E_HIER_TRACE_SAMPLE", "4"))
+    payload = _payload()
+    from dragonboat_tpu import Config
+
+    doms = {1: "A", 2: "A", 3: "B", 4: "B"}
+    far_rtt_ms = 2 * far_ms
+    out = {
+        "groups": groups,
+        "rtt_ms": rtt_ms,
+        "far_one_way_ms": far_ms,
+        "duration_s": duration,
+        "sample_every": sample,
+        "domains": {str(k): v for k, v in doms.items()},
+        "topology": (
+            "2+2 split: leader + 1 near follower; 2-follower far "
+            "domain; classic quorum (3/4) must cross the far link"
+        ),
+        "variants": {},
+    }
+    for hier in (False, True):
+        nhs = _mk_hier_hosts(rtt_ms, far_ms / 1e3, trace=sample)
+        try:
+            addrs = {i: f"hd{i}:1" for i in (1, 2, 3, 4)}
+            cids = [BASE_CID + g for g in range(groups)]
+            for cid in cids:
+                for i, nh in enumerate(nhs, start=1):
+                    nh.start_cluster(
+                        addrs, False, CounterSM,
+                        Config(
+                            cluster_id=cid, node_id=i, election_rtt=10,
+                            heartbeat_rtt=1, check_quorum=True,
+                            hier_commit=hier,
+                            hier_domains=dict(doms) if hier else {},
+                        ),
+                    )
+            _hier_place_leaders(nhs, cids)
+            leaders = {cid: nhs[0] for cid in cids}
+            for cid in cids:
+                nhs[0].sync_propose(
+                    nhs[0].get_noop_session(cid), payload, timeout=30.0
+                )
+            time.sleep(0.5)
+            mixed = _measure_mixed(
+                leaders, cids, payload, 0, time.time() + duration, threads
+            )
+            # let straggler far acks land so their RTTs make the table
+            time.sleep(max(1.0, 4 * far_ms / 1e3))
+            summ = nhs[0].replattr.summary()
+            hsnap = None
+            far_read = None
+            if hier:
+                hsnap = {
+                    "subquorum_closes": 0, "fallback_closes": 0,
+                    "election_holds": 0,
+                }
+                for cid in cids:
+                    s = nhs[0].get_node(cid).peer.raft.hier.snapshot()
+                    for k in hsnap:
+                        hsnap[k] += s[k]
+                far_read = _hier_far_read_phase(nhs, cids)
+            out["variants"]["hier_on" if hier else "hier_off"] = {
+                **{k: v for k, v in mixed.items()},
+                "close_ms": summ["close_ms"],
+                "closer_by_class": _closer_by_class(summ),
+                "peers": summ["peers"],
+                "commits_attributed": summ["commits_attributed"],
+                "hier": hsnap,
+                "far_read": far_read,
+            }
+        finally:
+            for nh in nhs:
+                try:
+                    nh.stop()
+                except Exception:
+                    pass
+    on = out["variants"]["hier_on"]
+    off = out["variants"]["hier_off"]
+    p99_on = on["close_ms"]["p99"]
+    p99_off = off["close_ms"]["p99"]
+    out["close_p99_ms_hier"] = p99_on
+    out["close_p99_ms_classic"] = p99_off
+    out["close_p99_speedup"] = (
+        round(p99_off / p99_on, 1) if p99_on and p99_off else None
+    )
+    wps_ratio = (
+        on["ops_per_sec"] / off["ops_per_sec"] if off["ops_per_sec"] else None
+    )
+    out["ops_ratio_on_off"] = round(wps_ratio, 3) if wps_ratio else None
+    # acceptance (ISSUE 18): the closer table flips far→near ...
+    cls_off = off["closer_by_class"]
+    cls_on = on["closer_by_class"]
+    assert cls_off.get("B", 0) > 0 and cls_off.get("A", 0) == 0, (
+        f"classic closers not all far-class: {cls_off} — the 2+2 "
+        "topology is not forcing the far ack"
+    )
+    assert cls_on.get("A", 0) > cls_on.get("B", 0), (
+        f"hier closers did not flip to the near class: {cls_on}"
+    )
+    # ... commit close p99 drops below half the far RTT (vs >= it off) ...
+    assert p99_off is not None and p99_off >= far_rtt_ms * 0.9, (
+        f"classic close p99 {p99_off}ms below the {far_rtt_ms}ms far "
+        "RTT — the injected topology is not being exercised"
+    )
+    assert p99_on is not None and p99_on <= 0.5 * far_rtt_ms, (
+        f"hier close p99 {p99_on}ms not under half the {far_rtt_ms}ms "
+        "far RTT"
+    )
+    # ... the sub-quorum did the closing ...
+    assert on["hier"]["subquorum_closes"] > 0, (
+        f"no sub-quorum closes recorded: {on['hier']}"
+    )
+    # ... throughput within noise (the sub-quorum path should only help:
+    # sync_propose unblocks at the near close) ...
+    assert wps_ratio is None or wps_ratio >= 0.8, (
+        f"hier-on write throughput regressed {wps_ratio}x"
+    )
+    # ... and far-domain reads coalesce onto shared leader round trips
+    fr = on["far_read"]
+    assert fr["errors"] == 0, f"far-domain reads failed: {fr}"
+    assert fr["reads_coalesced"] > 0, (
+        f"far reads never coalesced: {fr}"
+    )
+    assert fr["leader_round_trips"] < fr["reads"], (
+        f"every far read paid its own leader round trip: {fr}"
+    )
+    out["assert_ok"] = True
+    return out
+
+
+# ======================================================================
 # device state machine rung (devsm, ISSUE 11)
 # ======================================================================
 
@@ -2772,5 +3048,8 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--churn-soak" in sys.argv:
         print(json.dumps(run_churn_soak()), file=sys.stdout)
+        sys.exit(0)
+    if "--hier-axis" in sys.argv:
+        print(json.dumps(run_hier()), file=sys.stdout)
         sys.exit(0)
     print(json.dumps(run_quick()), file=sys.stdout)

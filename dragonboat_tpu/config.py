@@ -60,6 +60,27 @@ class Config:
     # bit-identical (the _devsm_used latch precedent).  On the scalar
     # engine the flag is inert — the same SM just runs host-side.
     device_kv: bool = False
+    # hierarchical commit plane (ISSUE 18, dragonboat_tpu/raft/hier.py):
+    # partition the voter set into latency domains (``hier_domains``:
+    # node_id -> domain label) and let a leader whose own domain holds a
+    # durable sub-quorum (majority of that domain, CD-Raft / Fast
+    # Hierarchical Raft rule) close commits at the near RTT — far-domain
+    # voters catch up asynchronously through the ordinary
+    # replicate/resend machinery.  Safety comes from the paired vote
+    # rule: winning an election additionally requires enough grants
+    # inside every eligible (>= 2 voters) domain to guarantee
+    # intersection with any sub-quorum that may have committed there,
+    # so a new leader always carries every sub-quorum-committed entry.
+    # Classic-quorum commits remain valid throughout (the rule is
+    # max(classic, sub-quorum)).  Liveness tradeoff (documented in
+    # docs/overview.md): while an eligible domain is entirely
+    # unreachable, elections stall until it heals or membership drops
+    # it.  OFF (default) keeps every request path structurally
+    # bit-identical (raft.hier is None, the lease/_obs latch precedent).
+    # Peers absent from ``hier_domains`` classify as domain "" and never
+    # form sub-quorums.
+    hier_commit: bool = False
+    hier_domains: Dict[int, str] = field(default_factory=dict)
 
     def validate(self) -> None:
         # mirrors reference config.Config.Validate (config/config.go:168-223)
@@ -94,6 +115,18 @@ class Config:
             raise ConfigError("read_lease requires check_quorum")
         if self.read_lease and self.quiesce:
             raise ConfigError("read_lease can not be used with quiesce")
+        if self.hier_domains and not isinstance(self.hier_domains, dict):
+            raise ConfigError("hier_domains must map node_id -> domain label")
+        if self.hier_commit:
+            for nid, dom in self.hier_domains.items():
+                if not isinstance(nid, int) or nid < 1:
+                    raise ConfigError(
+                        f"hier_domains key {nid!r} is not a node id"
+                    )
+                if not isinstance(dom, str):
+                    raise ConfigError(
+                        f"hier_domains[{nid}] must be a str domain label"
+                    )
 
 
 @dataclass

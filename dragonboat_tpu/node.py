@@ -234,6 +234,12 @@ class Node:
         lease_obs = getattr(self, "lease_obs", None)
         if lease_obs is not None and self.peer.raft.lease is not None:
             self.peer.raft.lease.obs = lease_obs
+        # hierarchical-commit instruments (ISSUE 18; set by NodeHost when
+        # enable_metrics is on and the group has hier_commit): same
+        # gate-on-`is not None` discipline as the lease instruments
+        hier_obs = getattr(self, "hier_obs", None)
+        if hier_obs is not None and self.peer.raft.hier is not None:
+            self.peer.raft.hier.obs = hier_obs
         # wall-clock lease guard (ISSUE 17; set by NodeHost when
         # Config.read_lease and NodeHostConfig.lease_wall_guard): the
         # host's tick period in seconds — validity then also requires
@@ -404,6 +410,19 @@ class Node:
             # the current voter set — the coordinator already linked the
             # releasing round's span seq via replattr.note_device_round
             r._note_commit()
+            if r.hier is not None:
+                # hier close attribution (ISSUE 18): scalar matches stay
+                # current in offload mode (rp.try_update precedes
+                # offload.ack), so the classic kth-largest recomputes
+                # here to tell a sub-quorum close from a full-quorum one
+                voters = r.voting_members()
+                match_of = {nid: rm.match for nid, rm in voters.items()}
+                m_sorted = sorted(match_of.values())
+                q_classic = m_sorted[len(m_sorted) - r.quorum()]
+                r.hier.note_close(via_sub=commit_q > q_classic)
+                r.hier.note_far_lag(
+                    match_of, voters.keys(), r.log.committed
+                )
             r.broadcast_replicate_message()
         if (
             commit_q
@@ -438,9 +457,18 @@ class Node:
         if election is not None:
             won, term = election
             if r.is_candidate() and r.term == term:
-                if won:
+                # hier vote rule (raft/hier.py): the device `won` flag is
+                # the classic quorum only — the scalar votes dict (always
+                # maintained, handle_vote_resp runs before the offload
+                # gate) re-verifies domain intersection here.  The flag
+                # re-fires on later rounds, so a held promotion lands
+                # once the intersecting grant arrives.
+                if won and r.hier_election_ok():
                     r.become_leader()
                     r.broadcast_replicate_message()
+                elif won:
+                    if r.hier is not None:
+                        r.hier.note_election_hold()
                 else:
                     r.become_follower(r.term, 0)
         if (elect or hb or demote) and r.device_ticks:

@@ -120,6 +120,9 @@ class NodeHost:
         # shared leader-lease instruments (ISSUE 10), created lazily by
         # the first lease-enabled group when enable_metrics is on
         self._lease_obs = None
+        # shared hierarchical-commit instruments (ISSUE 18), created
+        # lazily by the first hier-enabled group when enable_metrics is on
+        self._hier_obs = None
         # storage
         in_memory = nhconfig.node_host_dir == ":memory:"
         # directory management: deployment-id layout + flock + compat flag
@@ -385,6 +388,13 @@ class NodeHost:
             def _peer_class(addr: str):
                 inj = self.transport.latency
                 if inj is not None:
+                    # per-pair asymmetric overrides reclassify the link
+                    # (ISSUE 18 bugfix — a near peer behind an injected
+                    # slow link must not label "near" in closer/laggard
+                    # rows); peer_class falls back to the static domain
+                    peer_class = getattr(inj, "peer_class", None)
+                    if peer_class is not None:
+                        return peer_class(nhconfig.raft_address, addr)
                     domain_of = getattr(inj, "domain_of", None)
                     if domain_of is not None:
                         return domain_of(addr)
@@ -937,6 +947,15 @@ class NodeHost:
 
                 self._lease_obs = LeaseObs(self.raft_events.registry)
             node.lease_obs = self._lease_obs
+        if config.hier_commit and self.nhconfig.enable_metrics:
+            # hierarchical-commit instruments (ISSUE 18): one shared
+            # HierObs per host, the LeaseObs pattern — lazy so hosts
+            # with no hier-enabled group never register the families
+            if self._hier_obs is None:
+                from .raft.hier import HierObs
+
+                self._hier_obs = HierObs(self.raft_events.registry)
+            node.hier_obs = self._hier_obs
         if config.read_lease and self.nhconfig.lease_wall_guard:
             # wall-clock lease guard (ISSUE 17): bound lease validity by
             # monotonic wall time so a starved tick loop cannot

@@ -548,6 +548,17 @@ class BatchedQuorumEngine:
         # SM-free engine keeps today's host cost and eager-op set
         # bit-identical.
         self._devsm_used = False
+        # --- hierarchical commit plane (hier, ISSUE 18) ------------------
+        # LATCH, same contract as _read_plane_used/_devsm_used: until the
+        # first enabling set_hier the near/sub_quorum arrays are provably
+        # all-zero, every dispatch runs has_hier=False — the compiled
+        # program set stays byte-identical to the pre-hier build — and
+        # the rare-path row syncs skip the hier fields (_sync_keys).
+        # Flipping the latch makes the next dispatch of each variant
+        # compile its has_hier=True twin once (the late-devsm precedent);
+        # hier deployments install domain geometry at registration /
+        # first promotion, ahead of steady-state load.
+        self._hier_used = False
         # host record of the rel index staged in each device entry-buffer
         # slot (-1 = free): slot ``rel % E`` is reusable once the
         # HARVESTED commit watermark has passed its tenant (the device
@@ -960,6 +971,7 @@ class BatchedQuorumEngine:
                 purge_reads=False,
                 has_kv=has_kv,
                 purge_kv=False,
+                has_hier=self._hier_used,
             )
             return quorum_multiround, args, statics
         if kind == "dense":
@@ -977,6 +989,7 @@ class BatchedQuorumEngine:
                 has_votes=False,
                 has_reads=has_reads,
                 has_kv=has_kv,
+                has_hier=self._hier_used,
             )
             return quorum_step_dense, args, statics
         # sparse single-round (the quiet-path workhorse)
@@ -997,6 +1010,7 @@ class BatchedQuorumEngine:
             do_tick=do_tick,
             track_contact=self.device_ticks or do_tick,
             has_votes=has_votes,
+            has_hier=self._hier_used,
         )
         return quorum_step, args, statics
 
@@ -1143,6 +1157,9 @@ class BatchedQuorumEngine:
         if self._devsm_used:  # fresh registration starts from an empty KV
             self.mirror.clear_kv(row)
             self._reset_kv_rows([row])
+        if self._hier_used:  # else provably already clear
+            a["near"][row, :] = False
+            a["sub_quorum"][row] = 0
         self._dirty.add(row)
         return gi
 
@@ -1276,6 +1293,33 @@ class BatchedQuorumEngine:
         a["match"][row, a["self_slot"][row]] = self._rel(gi, last_index)
         a["active"][row, :] = False
         self._purge_row_events(row)
+        self._dirty.add(row)
+
+    def set_hier(
+        self, cluster_id: int, near_ids, sub_quorum: int
+    ) -> None:
+        """Install a row's hier sub-quorum geometry (ISSUE 18): the
+        leader-domain voter mask plus the domain-majority cardinality the
+        fused commit reduction runs (kernels._finish_step has_hier
+        branch).  ``sub_quorum=0`` disables the rule for the row — the
+        coordinator pushes the real geometry at leader promotion and
+        zeroes it on demotion.  A disable on a never-enabled engine is a
+        no-op (the arrays are provably already clear), so hier-off hosts
+        keep the latch down and their compiled program set unchanged."""
+        if sub_quorum <= 0 and not self._hier_used:
+            return
+        gi = self.groups[cluster_id]
+        a = self.mirror.arrays
+        row = gi.row
+        self._sync_row(row)
+        a["near"][row, :] = False
+        for nid in near_ids:
+            slot = gi.slots.get(nid)
+            if slot is not None:
+                a["near"][row, slot] = True
+        a["sub_quorum"][row] = max(int(sub_quorum), 0)
+        if sub_quorum > 0:
+            self._hier_used = True
         self._dirty.add(row)
 
     def set_candidate(self, cluster_id: int, term: int) -> None:
@@ -2506,6 +2550,7 @@ class BatchedQuorumEngine:
             has_kv=has_kv,
             # the devsm twin of purge_reads, same normalization rationale
             purge_kv=self._devsm_used and has_churn,
+            has_hier=self._hier_used,
         )
         self._dev = out.state
         if obs is not None:
@@ -2652,6 +2697,7 @@ class BatchedQuorumEngine:
 
     _READ_KEYS = ("read_index", "read_count", "read_acks")
     _KV_KEYS = ("kv_value", "kv_ent_index", "kv_ent_key", "kv_ent_val")
+    _HIER_KEYS = ("near", "sub_quorum")
 
     def _sync_keys(self):
         """Mirror fields the rare-path row syncs move between host and
@@ -2659,12 +2705,14 @@ class BatchedQuorumEngine:
         used (see the ``_read_plane_used`` latch in ``__init__``); before
         that both sides are all-zero by construction and the extra eager
         gather/scatter programs must not be dispatched at all.  The devsm
-        arrays follow the same rule on their own latch."""
+        and hier arrays follow the same rule on their own latches."""
         skip = ()
         if not self._read_plane_used:
             skip += self._READ_KEYS
         if not self._devsm_used:
             skip += self._KV_KEYS
+        if not self._hier_used:
+            skip += self._HIER_KEYS
         if not skip:
             return list(self.mirror.arrays)
         return [k for k in self.mirror.arrays if k not in skip]
@@ -3023,6 +3071,7 @@ class BatchedQuorumEngine:
             # consume one-shot contact acks without the reset)
             track_contact=self.device_ticks or do_tick,
             has_votes=bool(votes),
+            has_hier=self._hier_used,
         )
         self._dev = out.state
         dp = self._devprof
@@ -3117,6 +3166,7 @@ class BatchedQuorumEngine:
             has_votes=bool(votes),
             has_reads=has_reads,
             has_kv=has_kv,
+            has_hier=self._hier_used,
         )
         self._dev = out.state
         dp = self._devprof

@@ -409,6 +409,7 @@ def quorum_step_impl(
     do_tick: bool = True,
     track_contact: bool = True,
     has_votes: bool = True,
+    has_hier: bool = False,
 ) -> StepOutputs:
     """ONE fused dispatch for a whole engine round (SURVEY.md §7).
 
@@ -474,7 +475,8 @@ def quorum_step_impl(
         votes = st.votes
 
     return _finish_step(
-        st, match, next_, active, votes, election_tick, last_index, do_tick
+        st, match, next_, active, votes, election_tick, last_index, do_tick,
+        has_hier=has_hier,
     )
 
 
@@ -487,6 +489,7 @@ def _finish_step(
     election_tick: jax.Array,
     last_index: jax.Array,
     do_tick: bool,
+    has_hier: bool = False,
 ) -> StepOutputs:
     """Tally/commit/tick tail shared by the sparse and dense steps — the
     ingestion front-ends differ, the raft semantics must not."""
@@ -498,6 +501,17 @@ def _finish_step(
 
     # --- commit advancement (twin: try_commit raft.go:888-909) ----------
     q = commit_quorum(match, st.voting, st.quorum)
+    if has_hier:
+        # hier sub-quorum rule (twin: Raft._hier_try_commit, ISSUE 18):
+        # the near-domain kth-largest can close ahead of the far acks;
+        # the classic quorum stays the floor.  sub_quorum == 0 rows
+        # (hier off / ineligible domain / non-leader) keep the classic
+        # value bit-for-bit — the clamp only satisfies _kth_largest's
+        # 1 <= k precondition and its result is discarded by the where.
+        q_near = _kth_largest(
+            match, st.voting & st.near, jnp.maximum(st.sub_quorum, 1)
+        )
+        q = jnp.where(st.sub_quorum > 0, jnp.maximum(q, q_near), q)
     is_leader = (st.node_state == LEADER) & st.live
     # raft paper p8: only current-term entries commit by counting; on the
     # leader q >= term_start ⟺ log.match_term(q, term) (see state.py)
@@ -525,7 +539,7 @@ def _finish_step(
 
 quorum_step = jax.jit(
     quorum_step_impl,
-    static_argnames=("do_tick", "track_contact", "has_votes"),
+    static_argnames=("do_tick", "track_contact", "has_votes", "has_hier"),
     donate_argnums=(0,),
 )
 
@@ -547,6 +561,7 @@ def quorum_step_dense_impl(
     has_votes: bool = True,
     has_reads: bool = False,
     has_kv: bool = False,
+    has_hier: bool = False,
 ) -> StepOutputs:
     """Dense-ingestion twin of :func:`quorum_step_impl` — zero scatters.
 
@@ -591,7 +606,8 @@ def quorum_step_dense_impl(
         votes = st.votes
 
     out = _finish_step(
-        st, match, next_, active, votes, election_tick, last_index, do_tick
+        st, match, next_, active, votes, election_tick, last_index, do_tick,
+        has_hier=has_hier,
     )
     if has_reads:
         # read plane LAST: stage / echo ingest / confirm / release
@@ -625,6 +641,7 @@ quorum_step_dense = jax.jit(
     quorum_step_dense_impl,
     static_argnames=(
         "do_tick", "track_contact", "has_votes", "has_reads", "has_kv",
+        "has_hier",
     ),
     donate_argnums=(0,),
 )
@@ -643,6 +660,7 @@ def quorum_multistep_impl(
     do_tick: bool = True,
     track_contact: bool = True,
     has_votes: bool = True,
+    has_hier: bool = False,
 ) -> StepOutputs:
     """R engine rounds in ONE dispatch via ``lax.scan``.
 
@@ -671,6 +689,7 @@ def quorum_multistep_impl(
             do_tick=do_tick,
             track_contact=track_contact,
             has_votes=has_votes,
+            has_hier=has_hier,
         )
         acc = (out.won, out.lost, out.flags)
         return out.state, acc
@@ -693,7 +712,7 @@ def quorum_multistep_impl(
 
 quorum_multistep = jax.jit(
     quorum_multistep_impl,
-    static_argnames=("do_tick", "track_contact", "has_votes"),
+    static_argnames=("do_tick", "track_contact", "has_votes", "has_hier"),
     donate_argnums=(0,),
 )
 
@@ -706,6 +725,7 @@ def quorum_multistep_dense_impl(
     do_tick: bool = True,
     track_contact: bool = True,
     has_votes: bool = True,
+    has_hier: bool = False,
 ) -> StepOutputs:
     """R dense rounds in ONE dispatch (see :func:`quorum_multistep_impl`).
 
@@ -732,6 +752,7 @@ def quorum_multistep_dense_impl(
             do_tick=do_tick,
             track_contact=track_contact,
             has_votes=has_votes,
+            has_hier=has_hier,
         )
         acc = (out.won, out.lost, out.flags)
         return out.state, acc
@@ -750,7 +771,7 @@ def quorum_multistep_dense_impl(
 
 quorum_multistep_dense = jax.jit(
     quorum_multistep_dense_impl,
-    static_argnames=("do_tick", "track_contact", "has_votes"),
+    static_argnames=("do_tick", "track_contact", "has_votes", "has_hier"),
     donate_argnums=(0,),
 )
 
@@ -858,6 +879,7 @@ def quorum_multiround_impl(
     purge_reads: bool = True,
     has_kv: bool = False,
     purge_kv: bool = True,
+    has_hier: bool = False,
 ) -> StepOutputs:
     """K engine rounds — INCLUDING membership churn — in ONE dispatch.
 
@@ -973,6 +995,7 @@ def quorum_multiround_impl(
             has_votes=has_votes,
             has_reads=has_reads,
             has_kv=has_kv,
+            has_hier=has_hier,
         )
         stc = out.state
         if do_tick:
@@ -1059,7 +1082,7 @@ quorum_multiround = jax.jit(
     quorum_multiround_impl,
     static_argnames=(
         "do_tick", "track_contact", "has_votes", "has_churn", "has_reads",
-        "purge_reads", "has_kv", "purge_kv",
+        "purge_reads", "has_kv", "purge_kv", "has_hier",
     ),
     donate_argnums=(0,),
 )

@@ -82,6 +82,7 @@ KV_READ_SLOTS = 4
 # ``_KV_KEYS`` must stay in lockstep — asserted in tests/test_devprof.py).
 READ_PLANE_FIELDS = ("read_index", "read_count", "read_acks")
 DEVSM_PLANE_FIELDS = ("kv_value", "kv_ent_index", "kv_ent_key", "kv_ent_val")
+HIER_PLANE_FIELDS = ("near", "sub_quorum")
 
 
 def field_plane(name: str) -> str:
@@ -90,6 +91,8 @@ def field_plane(name: str) -> str:
         return "read"
     if name in DEVSM_PLANE_FIELDS:
         return "devsm"
+    if name in HIER_PLANE_FIELDS:
+        return "hier"
     return "quorum"
 
 
@@ -180,6 +183,15 @@ class QuorumState(NamedTuple):
     kv_ent_key: jax.Array      # (G,E) i32: key slot of the staged op
     kv_ent_val: jax.Array      # (G,E) i32: value of the staged op
 
+    # --- hierarchical commit plane (ISSUE 18) --------------------------
+    # Scalar twin: ``raft/hier.py`` HierPlane's near-voter set and
+    # sub-quorum cardinality for a LEADER row (host-authoritative, pushed
+    # at promotion like the membership columns).  ``sub_quorum == 0``
+    # disables the rule for the row — the commit reduction then matches
+    # the classic kth-largest bit-for-bit.
+    near: jax.Array            # (G,P) bool: leader-domain voter slots
+    sub_quorum: jax.Array      # (G,) i32: domain majority; 0 = hier off
+
 
 def make_state(
     n_groups: int,
@@ -221,6 +233,8 @@ def make_state(
         kv_ent_index=jnp.full((g, e), -1, I32),
         kv_ent_key=jnp.zeros((g, e), I32),
         kv_ent_val=jnp.zeros((g, e), I32),
+        near=jnp.zeros((g, p), BOOL),
+        sub_quorum=zi,
     )
 
 

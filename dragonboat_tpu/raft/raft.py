@@ -48,6 +48,7 @@ from ..wire import (
     entries_size,
 )
 from ..lease import LeaderLease
+from .hier import FarReadBatcher, HierPlane, sub_quorum_size
 from .log import CompactedError, EntryLog, ILogDB, UnavailableError
 from .rate import InMemRateLimiter
 from .readindex import ReadIndex
@@ -160,6 +161,23 @@ class Raft:
         # latch — every hook below gates on `is not None`, so trace-off
         # request paths stay bit-identical (the lease/offload precedent)
         self.replattr = None
+        # hierarchical commit plane (raft/hier.py, ISSUE 18,
+        # Config.hier_commit): None is the structural latch — every hook
+        # below gates on `is not None`, so hier-off request paths stay
+        # bit-identical (the lease/replattr precedent).  The plane holds
+        # the domain map plus the coupled sub-quorum commit / vote
+        # intersection rules; the far-read batcher rides beside it and
+        # activates only on followers whose domain differs from the
+        # leader's.
+        self.hier = (
+            HierPlane(c.hier_domains, c.node_id) if c.hier_commit else None
+        )
+        self.far_reads = FarReadBatcher() if c.hier_commit else None
+        # whether the most recent commit advancement closed via the
+        # near-domain sub-quorum rather than the classic quorum — read
+        # by _note_commit so replication attribution counts the closer
+        # against the rule that actually closed the commit
+        self._commit_via_sub = False
         self.ready_to_read: List[ReadyToRead] = []
         self.dropped_entries: List[Entry] = []
         self.dropped_read_indexes: List[SystemCtx] = []
@@ -655,21 +673,54 @@ class Raft:
             idx += 1
         self.matched.sort()
         q = self.matched[self.num_voting_members() - self.quorum()]
+        if self.hier is not None:
+            return self._hier_try_commit(q)
         # raft paper p8: only entries from the leader's current term are
         # committed by counting replicas
         return self.log.try_commit(q, self.term)
+
+    def _hier_try_commit(self, q_classic: int) -> bool:
+        """Sub-quorum commit rule (hier.py module docstring): the
+        effective commit candidate is ``max(classic, near-domain
+        kth-largest)`` — the near-domain majority can close ahead of the
+        far acks, and the classic quorum remains the fallback.  The
+        current-term guard stays inside ``log.try_commit`` exactly as on
+        the classic path."""
+        hier = self.hier
+        voters = self.voting_members()
+        match_of = {nid: r.match for nid, r in voters.items()}
+        q_near = hier.commit_quorum(match_of, voters.keys())
+        advanced = self.log.try_commit(max(q_classic, q_near), self.term)
+        if advanced:
+            self._commit_via_sub = q_near > q_classic
+            hier.note_close(via_sub=q_near > q_classic)
+            hier.note_far_lag(match_of, voters.keys(), self.log.committed)
+        return advanced
 
     def _note_commit(self) -> None:
         """Commit watermark advanced (replication attribution hook,
         ISSUE 14): close every covered record against the EXACT voter
         set and quorum the advancing ``try_commit`` counted.  Callers
         invoke this right after a successful commit advancement; the
-        device path's twin lives in ``node._apply_offload_effects``."""
+        device path's twin lives in ``node._apply_offload_effects``.
+
+        Hier (ISSUE 18): when the advancement closed via the near-domain
+        sub-quorum, the attributed quorum position is the sub-quorum
+        size — ``times[q-1]`` then lands on the near ack that actually
+        closed the commit, so the closer table flips far→near while the
+        far peers still fold in as laggards against the full voter set.
+        The device path keeps classic attribution (the kernel does not
+        report which rule advanced)."""
         ra = self.replattr
         if ra is not None:
+            q = self.quorum()
+            if self.hier is not None and self._commit_via_sub:
+                near = self.hier.near_voters(self.voting_members().keys())
+                if near:
+                    q = sub_quorum_size(len(near))
             ra.on_commit(
                 self.cluster_id, self.log.committed, self.term,
-                self.voting_members(), self.quorum(), self.node_id,
+                self.voting_members(), q, self.node_id,
             )
 
     def append_entries(self, entries: List[Entry]) -> None:
@@ -795,6 +846,11 @@ class Raft:
             # invalidates the quorum the open commit records were
             # tallied against — drop them, never cross-term attribute
             self.replattr.on_reset(self.cluster_id)
+        if self.far_reads is not None:
+            # same matrix for the far-read batcher: the leader the
+            # in-flight fetch targeted (or the term it was valid in) is
+            # gone — every held ctx reports dropped so clients retry
+            self.dropped_read_indexes.extend(self.far_reads.invalidate())
         self.clear_pending_config_change()
         self.abort_leader_transfer()
         self.reset_remotes()
@@ -1497,6 +1553,23 @@ class Raft:
         if self.leader_id == NO_LEADER:
             self.report_dropped_read_index(m)
             return
+        if (
+            self.far_reads is not None
+            and self.hier is not None
+            and self.hier.is_far_follower(self.leader_id)
+        ):
+            # far-read batching (hier.py FarReadBatcher): at most one
+            # cross-domain fetch in flight; a read arriving mid-flight
+            # holds for the NEXT fetch (it may only ride a fetch
+            # initiated after it arrived) and the whole batch releases
+            # at that fetch's returned index
+            ctx = SystemCtx(low=m.hint, high=m.hint_high)
+            if not self.far_reads.admit(ctx):
+                if self.hier.obs is not None:
+                    self.hier.obs.read_coalesced()
+                return
+            if self.hier.obs is not None:
+                self.hier.obs.read_batch()
         m.to = self.leader_id
         self.send(m)
 
@@ -1510,6 +1583,24 @@ class Raft:
         ctx = SystemCtx(low=m.hint, high=m.hint_high)
         self.leader_is_available()
         self.set_leader_id(m.from_)
+        if self.far_reads is not None and self.far_reads.pending:
+            # release the whole fetch batch at the returned index (every
+            # member arrived before the fetch was initiated, so the
+            # leader's commit point at fetch time linearizes them all),
+            # then forward the next batch's representative
+            released, nxt = self.far_reads.on_resp(ctx)
+            for c in released:
+                self.add_ready_to_read(m.log_index, c)
+            if nxt is not None:
+                self.send(
+                    Message(
+                        type=MT.READ_INDEX,
+                        to=self.leader_id,
+                        hint=nxt.low,
+                        hint_high=nxt.high,
+                    )
+                )
+            return
         self.add_ready_to_read(m.log_index, ctx)
 
     def handle_follower_install_snapshot(self, m: Message) -> None:
@@ -1566,6 +1657,20 @@ class Raft:
             # the device tallies; won/lost lands via node.offload_election
             self.offload.vote(self.cluster_id, m.from_, not m.reject)
             return
+        if self.hier is not None:
+            # hier vote rule (hier.py): quorum alone is not enough — the
+            # granted set must also intersect every eligible domain's
+            # possible sub-quorums.  `>=` instead of the classic `==`:
+            # the tally can sit AT quorum while the intersection bound
+            # waits on a later grant, so every resp must re-test.
+            if count >= self.quorum() and self.hier_election_ok():
+                self.become_leader()
+                self.broadcast_replicate_message()
+            elif count >= self.quorum():
+                self.hier.note_election_hold()
+            elif len(self.votes) - count == self.quorum():
+                self.become_follower(self.term, NO_LEADER)
+            return
         # 3rd paragraph section 5.2 of the raft paper
         if count == self.quorum():
             self.become_leader()
@@ -1573,6 +1678,15 @@ class Raft:
         elif len(self.votes) - count == self.quorum():
             # etcd raft behavior, not in the raft paper
             self.become_follower(self.term, NO_LEADER)
+
+    def hier_election_ok(self) -> bool:
+        """True when the hier vote-intersection rule admits taking
+        office with the current ``votes`` tally (trivially True with the
+        plane off — the device offload path calls this before applying a
+        `won` flag, hier-agnostic)."""
+        if self.hier is None:
+            return True
+        return self.hier.election_ok(self.votes, self.voting_members())
 
     # ------------------------------------------------------------------
     # dropped request reporting

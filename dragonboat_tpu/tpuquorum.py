@@ -430,6 +430,21 @@ class TpuQuorumCoordinator:
             witnesses=witnesses,
             observers=observers,
         )
+        if r.hier is not None:
+            # hier geometry (ISSUE 18) is membership-like: the near mask
+            # and sub-quorum cardinality follow the voter set and this
+            # replica's static domain, not the row's role, so the
+            # registration/resync rebuild is the only push site — the
+            # staged leader/candidate/follower transitions leave it
+            # untouched exactly like the membership columns.  The fused
+            # rule only ever widens q on leader rows (kernels._finish_step
+            # has_hier twin of Raft._hier_try_commit).
+            from .raft.hier import sub_quorum_size
+
+            near = r.hier.near_voters(set(voters) | set(witnesses))
+            self.eng.set_hier(
+                cid, near, sub_quorum_size(len(near)) if near else 0
+            )
         if r.is_leader():
             self.eng.set_leader(
                 cid,

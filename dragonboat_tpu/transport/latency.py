@@ -92,6 +92,24 @@ class LatencyInjector:
         with self._mu:
             return self._domain.get(addr)
 
+    def peer_class(self, src: str, dst: str) -> Optional[str]:
+        """Effective latency class of the ``src``→``dst`` link, as seen
+        from ``src`` (ISSUE 18 bugfix): attribution used to label peers
+        by static domain only, so a near peer behind a ``set_pair``
+        asymmetric override still classified "near" while its acks
+        crawled over an injected slow link — closer/laggard rows lied.
+        When either direction carries a pair override, classify the
+        worse measured one-way delay through :meth:`class_name` instead;
+        otherwise fall back to the static domain label."""
+        with self._mu:
+            has_override = (src, dst) in self._pair or (dst, src) in self._pair
+        if has_override:
+            worst = max(self.delay(src, dst), self.delay(dst, src))
+            cls = self.class_name(worst)
+            if cls is not None:
+                return cls
+        return self.domain_of(dst)
+
     def class_name(self, seconds: float) -> Optional[str]:
         """The latency-class name whose one-way delay matches (nearest;
         None when no class is within 20%)."""

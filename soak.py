@@ -42,6 +42,12 @@ same-applied divergence, zero dropped fast-lane spans.  ``bench_e2e.py
     python soak.py --churn --minutes 2 --groups 100 --seed 7            # OFF arm
     python soak.py --churn --minutes 2 --groups 100 --seed 7 --recover  # ON arm
 
+``--hier`` (ISSUE 18) layers the hierarchical commit plane onto churn
+mode: hosts 1+2 form domain A, hosts 3+4 domain B, and the netsplit
+wave becomes domain-correlated (both B hosts cut at once) — every
+commit closed during the hold closed through A's sub-quorum, and the
+same linearizability gate scores them.
+
 Exit code 0 = green.  Prints one JSON summary line last.
 """
 from __future__ import annotations
@@ -131,6 +137,7 @@ def rank_main() -> int:
 
     churn = os.environ.get("SOAK_CHURN") == "1"
     recover = os.environ.get("SOAK_RECOVER") == "1"
+    hier = os.environ.get("SOAK_HIER") == "1"
     nhc_kw = {}
     if churn:
         # BlackWater churn profile (ISSUE 17): the health detectors run
@@ -223,6 +230,14 @@ def rank_main() -> int:
             base_kw["check_quorum"] = True
         if churn and cid in lease_cids:
             base_kw["read_lease"] = True
+        if churn and hier:
+            # hier arm (ISSUE 18): hosts 1+2 form near domain A, hosts
+            # 3+4 domain B — the parent's domain-correlated waves then
+            # take B down WHOLE, and linearizability is asserted with
+            # sub-quorum commits live.  Recycled standbys (nid >= 5)
+            # stay unassigned: never in a sub-quorum, always safe.
+            base_kw["hier_commit"] = True
+            base_kw["hier_domains"] = {1: "A", 2: "A", 3: "B", 4: "B"}
         base_kw.update(kw)
         if base_kw.get("is_witness"):
             # "witness node cannot take snapshot" (config.validate):
@@ -1051,6 +1066,7 @@ def churn_main(args) -> int:
             "SOAK_ADDRS": addrs, "SOAK_DIR": base,
             "SOAK_CHURN": "1",
             "SOAK_RECOVER": "1" if args.recover else "0",
+            "SOAK_HIER": "1" if getattr(args, "hier", False) else "0",
             "SOAK_THREADS": os.environ.get("SOAK_THREADS", "2"),
             "SOAK_SAMPLE": "8",
             # at 100+ groups the 100ms sampler pass itself is load on
@@ -1129,15 +1145,27 @@ def churn_main(args) -> int:
                 time.sleep(0.8)
             time.sleep(10.0)  # settle: flap windows slide shut
             # ---- netsplit the third voter host (the quorum_at_risk arm:
-            # recovery evicts the dead voter and promotes the observer)
+            # recovery evicts the dead voter and promotes the observer).
+            # hier arm: the wave is domain-CORRELATED — rank3 (the other
+            # domain-B host) goes down with it, so every commit closed
+            # during the hold closed through domain A's sub-quorum and
+            # the final linearizability gate scores exactly those
+            split_victims = [ranks[2]]
+            if getattr(args, "hier", False):
+                split_victims.append(ranks[3])
             print(
                 f"# t+{time.time() - t0:.0f}s round {rnd}: netsplit "
-                "rank2 for 12s", file=sys.stderr,
+                f"rank{'2+3' if len(split_victims) > 1 else '2'} for 12s",
+                file=sys.stderr,
             )
-            if _set_split(ranks, addr_list, ranks[2], True):
+            if any(
+                _set_split(ranks, addr_list, v, True)
+                for v in split_victims
+            ):
                 counts["netsplits"] += 1
             time.sleep(12.0)
-            _set_split(ranks, addr_list, ranks[2], False)
+            for v in split_victims:
+                _set_split(ranks, addr_list, v, False)
             time.sleep(6.0)
             # ---- SIGSTOP freeze: silence without death
             print(
@@ -1223,6 +1251,7 @@ def churn_main(args) -> int:
     summary = {
         "churn_ok": failure is None,
         "recover": bool(args.recover),
+        "hier": bool(getattr(args, "hier", False)),
         "seed": seed,
         "minutes": args.minutes,
         "groups": groups,
@@ -1257,6 +1286,10 @@ def main() -> int:
     ap.add_argument("--recover", action="store_true",
                     help="churn mode: arm the closed-loop recovery plane "
                          "(the A/B ON arm)")
+    ap.add_argument("--hier", action="store_true",
+                    help="churn mode: hierarchical commit plane ON "
+                         "(ISSUE 18) with 2+2 domains and the netsplit "
+                         "wave taking domain B down whole")
     args = ap.parse_args()
     if args.churn:
         return churn_main(args)

@@ -199,6 +199,55 @@ def test_devprof_families_help_round_trip():
     assert out2.getvalue().splitlines() == lines
 
 
+def test_hier_families_help_round_trip():
+    """ISSUE 18 satellite: every ``dragonboat_hier_*`` family a HierObs
+    registers carries its described ``# HELP`` immediately before its
+    ``# TYPE`` (pre-registered at zero, so a scrape sees the whole
+    surface before the first sub-quorum close), and the close/read/hold
+    instruments land the expected values."""
+    from dragonboat_tpu.raft.hier import HierObs
+
+    reg = MetricsRegistry()
+    obs = HierObs(reg)
+    obs.commit_close(via_sub=True)
+    obs.commit_close(via_sub=True)
+    obs.commit_close(via_sub=False)
+    obs.far_lag(7)
+    obs.read_batch()
+    obs.read_coalesced()
+    obs.read_coalesced()
+    obs.election_hold()
+    out = io.StringIO()
+    reg.write_health_metrics(out)
+    lines = out.getvalue().splitlines()
+    families = (
+        "dragonboat_hier_subquorum_commit_total",
+        "dragonboat_hier_fallback_commit_total",
+        "dragonboat_hier_far_lag_entries",
+        "dragonboat_hier_read_batches_total",
+        "dragonboat_hier_reads_coalesced_total",
+        "dragonboat_hier_election_holds_total",
+    )
+    for name in families:
+        tidx = [
+            i for i, l in enumerate(lines) if l.startswith(f"# TYPE {name} ")
+        ]
+        assert len(tidx) == 1, name
+        help_line = lines[tidx[0] - 1]
+        assert help_line.startswith(f"# HELP {name} "), help_line
+        assert "dragonboat_tpu metric" not in help_line, help_line
+    assert "dragonboat_hier_subquorum_commit_total 2" in lines
+    assert "dragonboat_hier_fallback_commit_total 1" in lines
+    assert "dragonboat_hier_far_lag_entries 7" in lines
+    assert "dragonboat_hier_read_batches_total 1" in lines
+    assert "dragonboat_hier_reads_coalesced_total 2" in lines
+    assert "dragonboat_hier_election_holds_total 1" in lines
+    # a second write is byte-identical (stable ordering incl. HELP)
+    out2 = io.StringIO()
+    reg.write_health_metrics(out2)
+    assert out2.getvalue().splitlines() == lines
+
+
 def test_recovery_families_help_round_trip():
     """ISSUE 17 satellite: every ``dragonboat_recovery_*`` family a
     RecoveryObs registers carries its described ``# HELP`` immediately

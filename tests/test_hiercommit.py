@@ -1,0 +1,646 @@
+"""Hierarchical commit plane tests (ISSUE 18).
+
+Coverage map:
+
+- HierPlane rule arithmetic: sub-quorum/intersection bounds and the
+  pigeonhole identity that makes them safe.
+- sub-quorum ≡ classic when domains are symmetric (all voters in one
+  domain the sub-quorum degenerates to the classic majority), and the
+  asymmetric speedup: a near-domain majority closes commits the classic
+  quorum still has in flight.
+- fused ≡ scalar: the batched engine with the (G,P) class mask replays
+  the exact ack streams of a hier-enabled scalar leader bit-for-bit.
+- leader-change intersection safety: a candidate holding the classic
+  quorum but missing the near-domain intersection bound is HELD (the
+  classic rule would promote it and lose a sub-quorum-committed entry —
+  the counterexample is asserted on a classic twin).
+- far-domain catch-up convergence, far-read batching (FarReadBatcher
+  unit + raft-level), invalidation on leader/term change.
+- off-path structural identity: ``hier_commit=False`` constructs
+  nothing — ``raft.hier is None``, engine latch down, hier state fields
+  zero and excluded from row syncs.
+- end-to-end (slow): 4-node 2+2 domain cluster under whole-domain
+  partitions with a HistoryRecorder; history must check linearizable
+  and the leader must have closed commits through the sub-quorum.
+"""
+import threading
+import time
+import random
+
+import pytest
+
+from dragonboat_tpu.config import Config, ConfigError
+from dragonboat_tpu.raft import InMemLogDB, Raft
+from dragonboat_tpu.raft.hier import (
+    MIN_DOMAIN_VOTERS,
+    FarReadBatcher,
+    HierPlane,
+    intersect_threshold,
+    seed_domains_from_latency,
+    seed_domains_from_rtt,
+    sub_quorum_size,
+)
+from dragonboat_tpu.raft.remote import Remote
+from dragonboat_tpu.wire import Entry, Message, MessageType, SystemCtx
+from raft_harness import new_test_raft
+
+MT = MessageType
+
+DOMS_32 = {1: "A", 2: "A", 3: "A", 4: "B", 5: "B"}  # 3 near + 2 far
+DOMS_22 = {1: "A", 2: "A", 3: "B", 4: "B"}
+
+
+def hier_raft(node_id, peers, domains, election=10, heartbeat=1):
+    """new_test_raft twin with the hier plane enabled."""
+    c = Config(
+        node_id=node_id,
+        cluster_id=1,
+        election_rtt=election,
+        heartbeat_rtt=heartbeat,
+        hier_commit=True,
+        hier_domains=dict(domains),
+    )
+    c.validate()
+    r = Raft(c, InMemLogDB(), seed=node_id)
+    for p in peers:
+        if p not in r.remotes:
+            r.remotes[p] = Remote(next=1)
+    r.reset_match_value_array()
+    r.has_not_applied_config_change = lambda: False
+    return r
+
+
+def elect(r, peers):
+    """Grant the campaign from every other voter (domain-complete, so
+    the intersection rule is trivially satisfied)."""
+    r.handle(Message(from_=r.node_id, to=r.node_id, type=MT.ELECTION))
+    for p in peers:
+        if p != r.node_id and not r.is_leader():
+            r.handle(
+                Message(from_=p, to=r.node_id, term=r.term,
+                        type=MT.REQUEST_VOTE_RESP)
+            )
+    assert r.is_leader()
+    return r
+
+
+def ack(r, p, idx):
+    r.handle(
+        Message(from_=p, to=r.node_id, term=r.term,
+                type=MT.REPLICATE_RESP, log_index=idx)
+    )
+
+
+def propose(r):
+    r.handle(
+        Message(from_=r.node_id, to=r.node_id, type=MT.PROPOSE,
+                entries=[Entry(cmd=b"x")])
+    )
+    return r.log.last_index()
+
+
+# ======================================================================
+# rule arithmetic
+# ======================================================================
+
+
+def test_subquorum_intersection_pigeonhole():
+    # (|D|+1)//2 grants + |D|//2+1 sub-quorum members > |D| — every
+    # elected leader's granted set meets every possible sub-quorum
+    for n in range(1, 12):
+        assert intersect_threshold(n) + sub_quorum_size(n) == n + 1
+    # one grant fewer admits a disjoint counterexample
+    for n in range(2, 12):
+        assert (intersect_threshold(n) - 1) + sub_quorum_size(n) <= n
+
+
+def test_eligibility_and_near_voters():
+    hp = HierPlane({1: "A", 2: "A", 3: "B", 5: "C"}, node_id=1)
+    elig = hp.eligible_domains([1, 2, 3, 4, 5])
+    assert set(elig) == {"A"}  # B and C are singletons, 4 unassigned
+    assert sorted(elig["A"]) == [1, 2]
+    assert hp.near_voters([1, 2, 3, 4, 5]) == [1, 2]
+    # a departed near peer drops the domain below eligibility
+    assert hp.near_voters([1, 3, 4, 5]) == []
+    assert hp.commit_quorum({1: 9, 3: 9, 4: 9, 5: 9}, [1, 3, 4, 5]) == 0
+    # the unassigned replica never forms a sub-quorum
+    assert HierPlane({2: "A", 3: "A"}, node_id=1).near_voters([1, 2, 3]) == []
+    assert MIN_DOMAIN_VOTERS == 2
+
+
+def test_commit_quorum_is_domain_majority_kth_largest():
+    hp = HierPlane(DOMS_32, node_id=1)
+    voters = [1, 2, 3, 4, 5]
+    # near = {1,2,3}, sub-quorum 2: second-largest near match
+    assert hp.commit_quorum({1: 7, 2: 5, 3: 0, 4: 0, 5: 0}, voters) == 5
+    # far matches never contribute, however large
+    assert hp.commit_quorum({1: 3, 2: 0, 3: 0, 4: 99, 5: 99}, voters) == 0
+
+
+def test_election_ok_requires_every_eligible_domain():
+    hp = HierPlane(DOMS_32, node_id=4)
+    voters = [1, 2, 3, 4, 5]
+    # A needs 2 grants, B needs 1
+    assert not hp.election_ok({3: True, 4: True, 5: True}, voters)
+    assert hp.election_ok({2: True, 3: True, 4: True, 5: True}, voters)
+    assert not hp.election_ok({1: True, 2: True, 3: True}, voters)  # no B
+
+
+# ======================================================================
+# scalar-plane differential: sub-quorum vs classic
+# ======================================================================
+
+
+def test_subquorum_closes_ahead_of_classic():
+    """The tentpole claim at the scalar level: near-domain acks alone
+    advance the hier leader's commit while the classic twin (same
+    stream) still waits on the third voter."""
+    peers = [1, 2, 3, 4, 5]
+    rh = elect(hier_raft(1, peers, DOMS_32), peers)
+    rc = new_test_raft(1, peers)
+    rc.handle(Message(from_=1, to=1, type=MT.ELECTION))
+    for p in (2, 3, 4, 5):
+        if not rc.is_leader():
+            rc.handle(Message(from_=p, to=1, term=rc.term,
+                              type=MT.REQUEST_VOTE_RESP))
+    assert rc.is_leader()
+    # identical stream: propose, then ONE near follower ack (node 2)
+    for r in (rh, rc):
+        idx = propose(r)
+        ack(r, 2, r.log.last_index())
+    assert rh.log.committed == idx  # self + node2 = A-majority
+    assert rc.log.committed == 0    # classic still needs a 3rd ack
+    assert rh.hier.subquorum_closes >= 1
+    # the classic quorum stays the floor: far acks close it too
+    idx2 = propose(rh)
+    ack(rh, 4, idx2)
+    ack(rh, 5, idx2)
+    assert rh.log.committed == idx2
+    assert rh.hier.fallback_closes >= 1
+
+
+def test_symmetric_domains_identical_to_classic():
+    """All voters in one domain: sub_quorum_size(n) == quorum(n), so the
+    hier rule degenerates to classic — committed must track the classic
+    twin bit-for-bit over a randomized stale/dup ack stream."""
+    peers = [1, 2, 3, 4, 5]
+    doms = {p: "A" for p in peers}
+    rh = elect(hier_raft(1, peers, doms), peers)
+    rc = new_test_raft(1, peers)
+    rc.handle(Message(from_=1, to=1, type=MT.ELECTION))
+    for p in (2, 3):
+        rc.handle(Message(from_=p, to=1, term=rc.term,
+                          type=MT.REQUEST_VOTE_RESP))
+    assert rc.is_leader()
+    # align logs: commit the promotion noops identically
+    for r in (rh, rc):
+        for p in (2, 3):
+            ack(r, p, r.log.last_index())
+    rng = random.Random(5)
+    for _ in range(60):
+        if rng.random() < 0.5:
+            for r in (rh, rc):
+                propose(r)
+        p = rng.choice(peers[1:])
+        idx = rng.randrange(0, rh.log.last_index() + 1)
+        for r in (rh, rc):
+            ack(r, p, idx)
+        assert rh.log.committed == rc.log.committed
+    assert rh.log.committed > 0
+    # never via_sub: q_near can equal but never exceed q_classic
+    assert rh.hier.subquorum_closes == 0
+
+
+def test_far_catchup_convergence():
+    """Far voters trail the sub-quorum close, then converge: far lag is
+    positive right after a near-only close and zero once the far acks
+    arrive; committed never moves backwards."""
+    peers = [1, 2, 3, 4, 5]
+    r = elect(hier_raft(1, peers, DOMS_32), peers)
+
+    def far_lag():
+        vm = r.voting_members()
+        return r.hier.note_far_lag(
+            {nid: rm.match for nid, rm in vm.items()}, vm.keys(),
+            r.log.committed,
+        )
+
+    for _ in range(5):
+        idx = propose(r)
+        ack(r, 2, idx)
+    assert r.log.committed == idx
+    assert far_lag() == idx  # far domain never acked anything
+    before = r.log.committed
+    for p in (4, 5):
+        ack(r, p, idx)
+    assert far_lag() == 0
+    assert r.log.committed == before
+
+
+# ======================================================================
+# leader-change safety
+# ======================================================================
+
+
+def test_election_held_until_domain_intersection():
+    """Candidate 4 (far domain) collects the classic quorum {3,4,5} but
+    only one grant inside the 3-voter near domain (threshold 2): hier
+    HOLDS the promotion; the classic twin promotes on the same tally —
+    and would elect a leader whose voters may all miss a sub-quorum
+    commit closed inside A by {1,2}."""
+    peers = [1, 2, 3, 4, 5]
+    r4 = hier_raft(4, peers, DOMS_32)
+    r4.handle(Message(from_=4, to=4, type=MT.ELECTION))
+    assert r4.is_candidate()
+    for p in (5, 3):
+        r4.handle(Message(from_=p, to=4, term=r4.term,
+                          type=MT.REQUEST_VOTE_RESP))
+    assert r4.is_candidate()           # held: A∩granted = {3} < 2
+    assert r4.hier.election_holds >= 1
+    # classic twin: identical grants → leader (the unsafe promotion)
+    c4 = new_test_raft(4, peers)
+    c4.handle(Message(from_=4, to=4, type=MT.ELECTION))
+    for p in (5, 3):
+        c4.handle(Message(from_=p, to=4, term=c4.term,
+                          type=MT.REQUEST_VOTE_RESP))
+    assert c4.is_leader()
+    # a second near grant satisfies the bound: {2,3} intersects every
+    # 2-member sub-quorum of {1,2,3}
+    r4.handle(Message(from_=2, to=4, term=r4.term,
+                      type=MT.REQUEST_VOTE_RESP))
+    assert r4.is_leader()
+
+
+def test_election_rejections_still_demote():
+    """The hier branch keeps etcd's reject-majority demotion."""
+    peers = [1, 2, 3, 4, 5]
+    r = hier_raft(1, peers, DOMS_32)
+    r.handle(Message(from_=1, to=1, type=MT.ELECTION))
+    for p in (2, 3, 4):
+        r.handle(Message(from_=p, to=1, term=r.term,
+                         type=MT.REQUEST_VOTE_RESP, reject=True))
+    assert r.is_follower()
+
+
+# ======================================================================
+# fused ≡ scalar with the device class mask
+# ======================================================================
+
+
+jax = pytest.importorskip("jax")
+
+
+def _mk_engine_pair(peers, domains, n_groups=2):
+    from dragonboat_tpu.ops import BatchedQuorumEngine
+
+    r = elect(hier_raft(1, peers, domains), peers)
+    eng = BatchedQuorumEngine(n_groups=n_groups, n_peers=len(peers))
+    eng.add_group(1, node_ids=peers, self_id=1)
+    near = r.hier.near_voters(peers)
+    eng.set_hier(1, near, sub_quorum_size(len(near)) if near else 0)
+    eng.set_leader(
+        1, term=r.term, term_start=r.log.last_index(),
+        last_index=r.log.last_index(),
+    )
+    return r, eng
+
+
+def test_fused_commit_matches_scalar_hier_oracle():
+    """The engine's has_hier commit rule replays a hier leader's exact
+    ack stream with bit-identical committed watermarks (the scalar
+    _hier_try_commit twin of kernels._finish_step)."""
+    peers = [1, 2, 3, 4, 5]
+    r, eng = _mk_engine_pair(peers, DOMS_32)
+    rng = random.Random(17)
+    for _ in range(40):
+        for _ in range(rng.randrange(0, 3)):
+            idx = propose(r)
+            eng.ack(1, 1, idx)
+        last = r.log.last_index()
+        for _ in range(rng.randrange(0, 5)):
+            p = rng.choice(peers[1:])
+            idx = rng.randrange(0, last + 1)  # stale/dup included
+            ack(r, p, idx)
+            eng.ack(1, p, idx)
+        eng.step(do_tick=False)
+        assert eng.committed_index(1) == r.log.committed
+    assert r.log.committed > 0
+    assert r.hier.subquorum_closes > 0  # the mask actually engaged
+
+
+def test_fused_commit_matches_scalar_near_only_stream():
+    """Near-domain-only acks: the engine must close at the sub-quorum
+    (classic kth-largest alone would stay at 0 forever)."""
+    peers = [1, 2, 3, 4, 5]
+    r, eng = _mk_engine_pair(peers, DOMS_32)
+    for _ in range(8):
+        idx = propose(r)
+        eng.ack(1, 1, idx)
+        ack(r, 2, idx)
+        eng.ack(1, 2, idx)
+        eng.step(do_tick=False)
+        assert eng.committed_index(1) == r.log.committed == idx
+
+
+def test_engine_ineligible_domain_stays_classic():
+    """sub_quorum=0 (ineligible/unassigned) keeps the classic rule on a
+    hier-latched engine — the where() discards the clamped column."""
+    from dragonboat_tpu.ops import BatchedQuorumEngine
+
+    peers = [1, 2, 3, 4, 5]
+    eng = BatchedQuorumEngine(n_groups=2, n_peers=5)
+    eng.add_group(1, node_ids=peers, self_id=1)
+    eng.set_hier(1, [1, 2], 2)        # latch the plane on group 1
+    eng.add_group(2, node_ids=peers, self_id=1)
+    eng.set_hier(2, [], 0)            # group 2: ineligible
+    for cid in (1, 2):
+        eng.set_leader(cid, term=1, term_start=0, last_index=0)
+    for cid in (1, 2):
+        eng.ack(cid, 1, 5)
+        eng.ack(cid, 2, 5)
+    eng.step(do_tick=False)
+    assert eng.committed_index(1) == 5   # sub-quorum {1,2} closed
+    assert eng.committed_index(2) == 0   # classic needs 3 of 5
+
+
+# ======================================================================
+# off-path structural identity
+# ======================================================================
+
+
+def test_hier_off_structural_identity():
+    """hier_commit=False constructs NOTHING: no plane, no batcher, no
+    engine latch, hier fields excluded from the row syncs and all-zero
+    on device after real dispatches."""
+    import numpy as np
+
+    from dragonboat_tpu.ops import BatchedQuorumEngine
+
+    peers = [1, 2, 3]
+    r = new_test_raft(1, peers)
+    assert r.hier is None and r.far_reads is None
+    # domains without the switch stay inert too
+    c = Config(node_id=1, cluster_id=1, election_rtt=10, heartbeat_rtt=1,
+               hier_domains={1: "A", 2: "A"})
+    c.validate()
+    assert Raft(c, InMemLogDB(), seed=1).hier is None
+
+    eng = BatchedQuorumEngine(n_groups=2, n_peers=3)
+    eng.add_group(1, node_ids=peers, self_id=1)
+    eng.set_leader(1, term=1, term_start=0, last_index=0)
+    eng.set_hier(1, (), 0)  # disable on a never-enabled engine: no-op
+    assert not eng._hier_used
+    for k in eng._HIER_KEYS:
+        assert k not in eng._sync_keys()
+    eng.ack(1, 1, 3)
+    eng.ack(1, 2, 3)
+    eng.step(do_tick=False)
+    assert eng.committed_index(1) == 3
+    assert not eng._hier_used
+    assert not np.asarray(eng.dev.near).any()
+    assert not np.asarray(eng.dev.sub_quorum).any()
+
+
+def test_config_gate_validation():
+    bad = [
+        {0: "A"},            # node ids start at 1
+        {"1": "A"},          # keys are ints
+        {1: 2},              # labels are strings
+    ]
+    for doms in bad:
+        with pytest.raises(ConfigError):
+            Config(node_id=1, cluster_id=1, election_rtt=10,
+                   heartbeat_rtt=1, hier_commit=True,
+                   hier_domains=doms).validate()
+    with pytest.raises(ConfigError):
+        Config(node_id=1, cluster_id=1, election_rtt=10, heartbeat_rtt=1,
+               hier_domains="A").validate()
+
+
+# ======================================================================
+# far-read batching
+# ======================================================================
+
+
+def test_far_read_batcher_unit():
+    b = FarReadBatcher()
+    c1, c2, c3 = (SystemCtx(low=i, high=1) for i in (1, 2, 3))
+    assert b.admit(c1)            # representative, forward
+    assert not b.admit(c2)        # mid-flight: held for next fetch
+    assert not b.admit(c3)
+    assert b.pending == 3 and b.batches == 1 and b.coalesced == 2
+    released, nxt = b.on_resp(c1)
+    assert released == [c1] and nxt == c2 and b.batches == 2
+    released, nxt = b.on_resp(c2)
+    assert released == [c2, c3] and nxt is None and b.pending == 0
+    # stale resp (post-invalidate) releases only itself
+    assert b.admit(c1)
+    dropped = b.invalidate()
+    assert dropped == [c1] and b.pending == 0
+    released, nxt = b.on_resp(c1)
+    assert released == [c1] and nxt is None
+
+
+def test_far_follower_coalesces_read_round_trips():
+    peers = [1, 2, 3, 4]
+    r = hier_raft(3, peers, DOMS_22)
+    r.become_follower(1, 1)  # leader 1 sits in the far domain A
+    r.msgs.clear()
+
+    def read(low):
+        r.handle(Message(type=MT.READ_INDEX, from_=3, to=3,
+                         hint=low, hint_high=1))
+
+    read(11)
+    fwd = [m for m in r.msgs if m.type == MT.READ_INDEX]
+    assert len(fwd) == 1 and fwd[0].to == 1 and fwd[0].hint == 11
+    read(12)
+    read(13)
+    assert len([m for m in r.msgs if m.type == MT.READ_INDEX]) == 1
+    assert r.far_reads.coalesced == 2
+    # leader answers the first fetch: its ctx releases, the next
+    # representative goes out, the held member waits for IT
+    r.handle(Message(type=MT.READ_INDEX_RESP, from_=1, to=3, term=r.term,
+                     log_index=7, hint=11, hint_high=1))
+    assert [(x.index, x.system_ctx.low) for x in r.ready_to_read] == [(7, 11)]
+    fwd = [m for m in r.msgs if m.type == MT.READ_INDEX]
+    assert len(fwd) == 2 and fwd[1].hint == 12
+    r.handle(Message(type=MT.READ_INDEX_RESP, from_=1, to=3, term=r.term,
+                     log_index=9, hint=12, hint_high=1))
+    assert sorted(
+        (x.index, x.system_ctx.low) for x in r.ready_to_read
+    ) == [(7, 11), (9, 12), (9, 13)]
+    assert r.far_reads.pending == 0
+
+
+def test_near_follower_forwards_every_read():
+    peers = [1, 2, 3, 4]
+    r = hier_raft(2, peers, DOMS_22)
+    r.become_follower(1, 1)  # same domain as the leader
+    r.msgs.clear()
+    for low in (21, 22):
+        r.handle(Message(type=MT.READ_INDEX, from_=2, to=2,
+                         hint=low, hint_high=1))
+    assert len([m for m in r.msgs if m.type == MT.READ_INDEX]) == 2
+    assert r.far_reads.batches == 0
+
+
+def test_far_reads_invalidated_on_term_change():
+    peers = [1, 2, 3, 4]
+    r = hier_raft(3, peers, DOMS_22)
+    r.become_follower(1, 1)
+    r.msgs.clear()
+    for low in (31, 32):
+        r.handle(Message(type=MT.READ_INDEX, from_=3, to=3,
+                         hint=low, hint_high=1))
+    assert r.far_reads.pending == 2
+    r.handle(Message(type=MT.HEARTBEAT, from_=2, to=3, term=5))
+    assert r.far_reads.pending == 0
+    assert sorted(c.low for c in r.dropped_read_indexes) == [31, 32]
+
+
+# ======================================================================
+# domain seeding helpers
+# ======================================================================
+
+
+def test_seed_domains_from_latency_injector():
+    from dragonboat_tpu.transport.latency import crossdomain
+
+    inj = crossdomain(["a1:1", "a2:1"], ["b1:1", "b2:1"])
+    doms = seed_domains_from_latency(
+        inj, {1: "a1:1", 2: "a2:1", 3: "b1:1", 4: "b2:1", 5: "c:1"}
+    )
+    assert doms == {1: "A", 2: "A", 3: "B", 4: "B", 5: ""}
+
+
+def test_seed_domains_from_rtt_classifier():
+    doms = seed_domains_from_rtt(
+        1, {2: 0.0004, 3: 0.002, 4: 0.040, 5: 0.0}, near_ratio=4.0
+    )
+    assert doms[1] == "near" and doms[2] == "near"
+    assert doms[3] == "far" and doms[4] == "far"  # 0.002 > 4*0.0004
+    assert doms[5] == "far"  # unmeasured stays out of the sub-quorum
+
+
+# ======================================================================
+# end-to-end: domain partitions under a linearizability recorder
+# ======================================================================
+
+
+@pytest.mark.slow
+def test_domain_partition_soak_linearizable():
+    """2+2 domain cluster: partition the non-leader domain away whole;
+    writes must keep committing through the leader domain's sub-quorum
+    (classic quorum is unreachable), the history must check
+    linearizable, and all replicas must converge after the heal."""
+    from dragonboat_tpu import NodeHostConfig, monkey
+    from dragonboat_tpu.linearizability import (
+        HistoryRecorder, check_linearizable,
+    )
+    from dragonboat_tpu.nodehost import NodeHost
+    from dragonboat_tpu.transport import ChanRouter, ChanTransport
+    from test_chaos import KVSM, _wait_leader
+
+    CID = 18
+    router = ChanRouter()
+    addrs = {i: f"hc{i}:1" for i in (1, 2, 3, 4)}
+    nhs = [
+        NodeHost(
+            NodeHostConfig(
+                node_host_dir=":memory:",
+                rtt_millisecond=5,
+                raft_address=addrs[i],
+                raft_rpc_factory=lambda src, rh, ch: ChanTransport(
+                    src, rh, ch, router=router
+                ),
+            )
+        )
+        for i in (1, 2, 3, 4)
+    ]
+    rec = HistoryRecorder()
+    stop = threading.Event()
+    try:
+        for i, nh in enumerate(nhs, start=1):
+            nh.start_cluster(
+                addrs, False, KVSM,
+                Config(
+                    cluster_id=CID, node_id=i,
+                    election_rtt=10, heartbeat_rtt=1,
+                    hier_commit=True, hier_domains=dict(DOMS_22),
+                ),
+            )
+        _wait_leader(nhs, CID)
+        leader_id = next(
+            lid for nh in nhs
+            for lid, ok in [nh.get_leader_id(CID)] if ok
+        )
+        near = (1, 2) if leader_id in (1, 2) else (3, 4)
+        far = (3, 4) if near == (1, 2) else (1, 2)
+
+        def client(tid):
+            nh = nhs[near[tid % 2] - 1]  # leader-domain hosts only
+            session = nh.get_noop_session(CID)
+            i = 0
+            while not stop.is_set():
+                key = f"k-{tid}-{i % 32}"
+                val = str(i)
+                i += 1
+                done = rec.invoke(tid, "put", key, val)
+                try:
+                    nh.sync_propose(session, f"{key}={val}".encode(), 2.0)
+                    done(True)
+                except Exception:
+                    done(unknown=True)
+
+        clients = [
+            threading.Thread(target=client, args=(t,), daemon=True)
+            for t in range(2)
+        ]
+        for c in clients:
+            c.start()
+        time.sleep(0.5)
+        # whole-domain partition: cut BOTH far replicas at once — the
+        # domain-correlated failure the random-minority chaos never draws
+        for a in far:
+            for b in near:
+                router.partition(addrs[a], addrs[b])
+        time.sleep(2.0)
+        router.heal()
+        time.sleep(1.0)
+        stop.set()
+        for c in clients:
+            c.join(timeout=10)
+        _wait_leader(nhs, CID)
+        barrier_done = rec.invoke(99, "put", "barrier", "1")
+        for _ in range(20):
+            try:
+                s = nhs[near[0] - 1].get_noop_session(CID)
+                nhs[near[0] - 1].sync_propose(s, b"barrier=1", timeout=3.0)
+                barrier_done(True)
+                break
+            except Exception:
+                time.sleep(0.3)
+        else:
+            barrier_done(unknown=True)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                monkey.assert_replicas_converged(nhs, CID)
+                break
+            except AssertionError:
+                time.sleep(0.2)
+        monkey.assert_replicas_converged(nhs, CID)
+        history = rec.history()
+        assert len(history) > 20, "soak produced too little history"
+        ok, bad = check_linearizable(history)
+        assert ok, f"non-linearizable keys: {bad}"
+        # the sub-quorum actually carried the partition window
+        closes = sum(
+            nh.get_node(CID).peer.raft.hier.subquorum_closes for nh in nhs
+        )
+        assert closes > 0
+    finally:
+        stop.set()
+        for nh in nhs:
+            nh.stop()

@@ -753,7 +753,14 @@ def test_live_leader_flap_transferred_off_flapping_pair():
             return None
 
         rep = wait_until(_acted, timeout=30.0, what="controller transfer")
-        wait_until(lambda: _leader() == 3, timeout=20.0,
+        # a transfer's election can lose to the old pair under sweep
+        # load; the detector stays open (the bounce-phase changes age
+        # out only after flap_window_s) so the controller keeps
+        # re-transferring every cooldown_s — the wait must cover
+        # several election rounds, not one (the r15 re-drive lesson:
+        # here the controller is the re-driver, the budget just has to
+        # match its runway)
+        wait_until(lambda: _leader() == 3, timeout=60.0,
                    what="leadership off the flapping pair")
         act = [r for r in rep["recent"]
                if r["action"] == "transfer_leader"][0]
