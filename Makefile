@@ -155,6 +155,17 @@ test-lease:
 test-hiercommit:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_hiercommit.py -q
 
+# fast cpu gate for the device telemetry fold (ISSUE 20): the fold ≡
+# host-oracle differential (sparse/fused/mesh paths, mid-block recycle,
+# migration), stalled-watermark and top-K tie semantics, the telem-off
+# structural identity, the aggregate sampler's drill-down walk +
+# hysteresis units, the busy-row degradation counters, and the chunked
+# /metrics + /debug/telem endpoints — run before the full tier-1 sweep
+# whenever ops/kernels.py's telem fold, ops/state.py's telem plane,
+# obs/health.py's aggregate mode or the engine/mesh harvest change
+test-telem:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_telem.py -q
+
 # parallel run: heavy multi-NodeHost modules carry
 # xdist_group("heavy-multiprocess") and serialize on one worker while
 # the light majority fans out (4 workers x multiprocess clusters

@@ -39,6 +39,7 @@ from __future__ import annotations
 import collections
 import json
 import os
+import random
 import shutil
 import socket
 import subprocess
@@ -1149,6 +1150,239 @@ def run_health_axis() -> dict:
             except Exception:
                 pass
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ======================================================================
+# device telemetry axis (ISSUE 20): aggregate sampler wall flat in G +
+# telem-fold dispatch overhead + on-device top-K hit rate
+# ======================================================================
+
+
+class _TelemNodeShim:
+    """Per-group stand-in for the sampler walk: a bounded-cost
+    ``health_snapshot`` like ``Node``'s, so sampler wall measures the
+    walk discipline, not raft bookkeeping."""
+
+    def health_snapshot(self, lock_timeout=0.0):
+        return {"committed": 1, "applied": 1, "leader_id": 1}
+
+
+class _TelemQcShim:
+    """Engine-facade stand-in exposing exactly the coordinator surface
+    ``HealthSampler.sample`` touches in aggregate mode."""
+
+    def __init__(self, eng):
+        self.eng = eng
+
+    def telem_snapshot(self):
+        return self.eng.telem_snapshot()
+
+    def registered_cids(self):
+        return set(self.eng.groups)
+
+    def health_snapshot(self):
+        return None
+
+
+class _TelemNhShim:
+    def __init__(self, eng, cids):
+        self.quorum_coordinator = _TelemQcShim(eng)
+        self._nodes = {c: _TelemNodeShim() for c in cids}
+        self.tick_count = 0
+        self.hostplane = None
+        self.hostproc = None
+
+    def _get_nodes(self):
+        return None, self._nodes
+
+
+def _telem_engine(groups, last_index=16, telem=True, topk=None):
+    from dragonboat_tpu.ops.engine import BatchedQuorumEngine
+
+    eng = BatchedQuorumEngine(groups, 3, event_cap=4 * groups)
+    if telem:
+        eng.enable_telem(topk=topk)
+    for cid in range(1, groups + 1):
+        eng.add_group(cid, node_ids=[1, 2, 3], self_id=1)
+        eng.set_leader(cid, term=1, term_start=1, last_index=last_index)
+    eng._upload_dirty()
+    return eng
+
+
+def run_telem_axis() -> dict:
+    """Device telemetry axis (ISSUE 20): three pillars, engine-level so
+    the rung-5-scale group counts fit the driver budget on cpu.
+
+    1. **Sampler wall flat in G**: aggregate-mode sampler passes over a
+       small and a 64×-larger device-backed engine — the walk set is
+       top-K + open events, not the group axis, so the per-pass wall
+       must grow ≤2× across the 64× group growth (the O(1)-in-G
+       acceptance gate).  Full-walk wall at both sizes is captured for
+       contrast (that one DOES scale with G).
+    2. **Fold dispatch overhead**: telem-on vs telem-off dispatch wall
+       on twin engines fed the same ack schedule, interleaved windows
+       scored as mean pair-wise delta ± SEM (the trace-axis
+       discipline) — <5% + 2·SEM asserted.
+    3. **Top-K hit rate**: planted worst-lag groups must surface in the
+       on-device top-K with their exact lags, fresh engine per trial.
+
+    Env knobs: TELEM_AXIS_GROUPS (1024), TELEM_AXIS_SCALE (64),
+    TELEM_AXIS_PASSES (50), TELEM_AXIS_PAIRS (4),
+    TELEM_AXIS_DISPATCHES (30/window), TELEM_AXIS_TRIALS (4).
+    """
+    from dragonboat_tpu.events import MetricsRegistry
+    from dragonboat_tpu.obs.health import HealthSampler
+
+    g_small = int(os.environ.get("TELEM_AXIS_GROUPS", "1024"))
+    scale = int(os.environ.get("TELEM_AXIS_SCALE", "64"))
+    passes = int(os.environ.get("TELEM_AXIS_PASSES", "50"))
+    pairs = max(2, int(os.environ.get("TELEM_AXIS_PAIRS", "4")) // 2 * 2)
+    disp_per_win = int(os.environ.get("TELEM_AXIS_DISPATCHES", "30"))
+    trials = int(os.environ.get("TELEM_AXIS_TRIALS", "4"))
+    g_big = g_small * scale
+    out: dict = {"groups_small": g_small, "groups_big": g_big,
+                 "scale": scale}
+
+    # -- pillar 1: sampler wall per pass, aggregate vs full walk -------
+    def sampler_wall(groups, aggregate, n_passes):
+        eng = _telem_engine(groups)
+        # one real fold so the aggregate path has a snapshot to ride
+        for cid in range(1, min(groups, 64) + 1):
+            eng.ack(cid, 2, 1 + cid % 8)
+        eng.step(do_tick=False)
+        cids = list(range(1, groups + 1))
+        hs = HealthSampler(
+            _TelemNhShim(eng, cids), registry=MetricsRegistry(),
+            aggregate=aggregate,
+        )
+        s = hs.sample()  # warm pass (drill-set cache, allocation)
+        walls = []
+        for _ in range(n_passes):
+            s = hs.sample()
+            walls.append(s["wall_ms"])
+        walls.sort()
+        return walls[len(walls) // 2], len(s.get("groups") or {})
+
+    agg_small, walk_small = sampler_wall(g_small, True, passes)
+    agg_big, walk_big = sampler_wall(g_big, True, passes)
+    # the full-walk contrast pays O(G) per pass — a handful suffices
+    full_small, _ = sampler_wall(g_small, False, max(3, passes // 10))
+    full_big, _ = sampler_wall(g_big, False, max(3, passes // 10))
+    # floor the denominator: a sub-10µs pass is measurement noise and
+    # would flunk the ratio on jitter alone
+    ratio = agg_big / max(agg_small, 0.01)
+    out["sampler_wall_ms"] = {
+        "aggregate_small": round(agg_small, 4),
+        "aggregate_big": round(agg_big, 4),
+        "full_small": round(full_small, 4),
+        "full_big": round(full_big, 4),
+        "aggregate_walk_small": walk_small,
+        "aggregate_walk_big": walk_big,
+        "aggregate_big_over_small": round(ratio, 2),
+        "full_big_over_small": round(full_big / max(full_small, 0.01), 2),
+    }
+    out["sampler_flat_ok"] = ratio <= 2.0
+    assert ratio <= 2.0, (
+        f"aggregate sampler wall not flat in G: {agg_small:.3f}ms @ "
+        f"{g_small} vs {agg_big:.3f}ms @ {g_big} ({ratio:.1f}x)"
+    )
+
+    # -- pillar 2: telem-fold dispatch overhead, paired A/B ------------
+    # Gated on the FUSED MULTI-ROUND shape — the coordinator's deployed
+    # dispatch (stage K rounds, one step_rounds scan) where the fold
+    # runs ONCE on the block's final state, amortizing over the scanned
+    # rounds exactly as it does in production.  The single-round shape
+    # (fold per dispatch, the worst case) is measured too but recorded
+    # informationally: on the cpu backend its ~2.7ms wall is host-
+    # staging-dominated and the window weather (±15%, occasional 10×
+    # outliers) swamps the fold's ~0.07ms program delta.
+    rounds_per_block = int(os.environ.get("TELEM_AXIS_ROUNDS", "8"))
+    eng_on = _telem_engine(g_small)
+    eng_off = _telem_engine(g_small, telem=False)
+
+    def window_multi(eng, seed):
+        rng = random.Random(seed)
+        t0 = time.perf_counter()
+        for _ in range(disp_per_win):
+            for _ in range(rounds_per_block):
+                for _ in range(32):
+                    eng.ack(rng.randrange(1, g_small + 1), 2,
+                            rng.randrange(1, 17))
+                eng.begin_round()
+            eng.step_rounds(do_tick=False)
+        return (disp_per_win * rounds_per_block) / (
+            time.perf_counter() - t0
+        )
+
+    def window_single(eng, seed):
+        rng = random.Random(seed)
+        t0 = time.perf_counter()
+        for _ in range(disp_per_win):
+            for _ in range(32):
+                eng.ack(rng.randrange(1, g_small + 1), 2,
+                        rng.randrange(1, 17))
+            eng.step(do_tick=False)
+        return disp_per_win / (time.perf_counter() - t0)
+
+    def paired_delta(win_fn, n_pairs, seed0):
+        deltas = []
+        for pair in range(n_pairs):
+            seed = seed0 + pair
+            if pair % 2 == 0:  # ABBA cancels slow box drift
+                on = win_fn(eng_on, seed)
+                off = win_fn(eng_off, seed)
+            else:
+                off = win_fn(eng_off, seed)
+                on = win_fn(eng_on, seed)
+            deltas.append((off - on) / off * 100.0)
+        mean = sum(deltas) / len(deltas)
+        var = sum((d - mean) ** 2 for d in deltas) / max(
+            1, len(deltas) - 1
+        )
+        sem = (var / len(deltas)) ** 0.5
+        return mean, sem, deltas
+
+    window_multi(eng_on, 0)   # compile all variants before scoring
+    window_multi(eng_off, 0)
+    window_single(eng_on, 0)
+    window_single(eng_off, 0)
+    mean, sem, deltas = paired_delta(window_multi, pairs, 100)
+    s_mean, s_sem, _ = paired_delta(window_single, max(2, pairs // 2), 500)
+    out["rounds_per_block"] = rounds_per_block
+    out["dispatch_overhead_pct"] = round(mean, 2)
+    out["dispatch_overhead_sem_pct"] = round(sem, 2)
+    out["pair_deltas_pct"] = [round(d, 2) for d in deltas]
+    out["single_round_overhead_pct"] = round(s_mean, 2)
+    out["single_round_overhead_sem_pct"] = round(s_sem, 2)
+    out["dispatch_overhead_ok"] = mean < 5.0 + 2 * sem
+    assert mean < 5.0 + 2 * sem, (
+        f"telem fold dispatch overhead too high: {mean:.2f}% "
+        f"(± {sem:.2f} SEM)"
+    )
+
+    # -- pillar 3: top-K hit rate on planted worst lags ----------------
+    k = 8
+    hits = total = 0
+    for trial in range(trials):
+        rng = random.Random(7000 + trial)
+        g = 512
+        eng = _telem_engine(g, last_index=8, topk=k)
+        planted = rng.sample(range(1, g + 1), k)
+        for cid in range(1, g + 1):
+            if cid not in planted:
+                eng.ack(cid, 2, 8)  # lag 0
+        for i, cid in enumerate(planted):
+            eng.ack(cid, 2, i % 4)  # lag 8 - i%4: the worst in the shard
+        eng.step(do_tick=False)
+        top = {c for c, _lag in eng.telem_snapshot()["topk"]}
+        hits += len(top & set(planted))
+        total += k
+    hit_rate = hits / total
+    out["topk_trials"] = trials
+    out["topk_hit_rate"] = round(hit_rate, 4)
+    out["topk_ok"] = hit_rate == 1.0
+    assert hit_rate == 1.0, f"planted worst groups missed top-K: {hit_rate}"
+    return out
 
 
 # ======================================================================
@@ -3042,6 +3276,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--health-axis" in sys.argv:
         print(json.dumps(run_health_axis()), file=sys.stdout)
+        sys.exit(0)
+    if "--telem-axis" in sys.argv:
+        print(json.dumps(run_telem_axis()), file=sys.stdout)
         sys.exit(0)
     if "--devprof-axis" in sys.argv:
         print(json.dumps(run_devprof_axis()), file=sys.stdout)

@@ -328,6 +328,18 @@ class NodeHostConfig:
     # bit-identical; env DBTPU_HEALTH_SAMPLE_MS is the no-config
     # fallback.
     health_sample_ms: int = 0
+    # aggregate health sampling (ISSUE 20, kernels.telem_fold): flip the
+    # quorum engine's device telemetry fold and teach the health sampler
+    # to cover device-backed groups from the fixed-size per-dispatch
+    # aggregate (commit-lag histogram, per-state counts, stalled count,
+    # slot occupancy, on-device top-K worst groups) at O(shards) host
+    # cost — only the top-K flagged groups plus non-device groups take
+    # the per-group raft_mu walk.  Requires the health plane
+    # (health_sample_ms > 0) and the device quorum engine; without
+    # either it logs a warning and changes nothing.  False (default) =
+    # fold off, engine programs byte-identical, sampler walks every
+    # group; env DBTPU_HEALTH_AGGREGATE is the no-config fallback.
+    health_aggregate: bool = False
     # live scrape endpoint (obs/health.py MetricsServer): "host:port"
     # serves /metrics (Prometheus text exposition), /healthz
     # (aggregated detector verdict, 503 while degraded) and

@@ -81,6 +81,10 @@ class MetricsRegistry:
         # per-family ``# HELP`` text (first describe wins, like bucket
         # geometry — a family must read the same across scrapes)
         self._help: Dict[str, str] = {}
+        # cardinality guard (ISSUE 20 satellite): families already
+        # warned about, so an unbounded label set logs once, not once
+        # per scrape
+        self._cardinality_warned: set = set()
 
     def describe(self, name: str, help_text: str) -> None:
         """Register a family's ``# HELP`` text (first call wins)."""
@@ -219,12 +223,37 @@ class MetricsRegistry:
             return f"{name}{{{body}}} {value:g}"
         return f"{name} {value:g}"
 
-    def write_health_metrics(self, out) -> None:
-        """Prometheus text format (reference ``WriteHealthMetrics``
-        ``event.go:31``): one ``# HELP`` + one ``# TYPE`` per metric
-        name, escaped label values and help text, stable ordering
-        (counters, then gauges, then histograms; (name, labels)-sorted
-        within each)."""
+    #: series-per-family count past which the exposition warns (once
+    #: per family) about a probably-unbounded label set; instrument
+    #: label vocabularies are all small and fixed, so anything past this
+    #: is a per-request/per-group label leak.  Override per registry.
+    cardinality_warn = 1000
+
+    def _check_cardinality(self, name: str, series: int) -> None:
+        """Warn ONCE per family whose series count crossed the guard
+        (ISSUE 20 satellite): an unbounded label set grows the scrape
+        linearly and silently — make it loud before it hurts."""
+        if series > self.cardinality_warn and (
+            name not in self._cardinality_warned
+        ):
+            self._cardinality_warned.add(name)
+            plog.warning(
+                "metric family %s has %d label sets (> %d): unbounded "
+                "label values? scrape size grows with every new series",
+                name, series, self.cardinality_warn,
+            )
+
+    def iter_health_metrics(self):
+        """Generator form of the Prometheus text exposition: the
+        instrument state is snapshotted under the registry lock ONCE,
+        then yielded one chunk per metric family — the MetricsServer
+        streams these as chunked transfer instead of materializing the
+        whole exposition in one string (ISSUE 20 satellite: at
+        high group/shard cardinality the single join is a latency spike
+        on the serving thread).  Same invariants as the historical
+        monolithic writer: exactly one ``# HELP`` + ``# TYPE`` per
+        family, escaped values, stable (name, labels)-sorted ordering
+        (counters, then gauges, then histograms)."""
         with self._mu:
             counters = sorted(self._counters.items())
             gauges = sorted(self._gauges.items())
@@ -234,34 +263,58 @@ class MetricsRegistry:
             )
             help_texts = dict(self._help)
 
-        def _head(name: str, kind: str) -> None:
+        def _head(name: str, kind: str) -> str:
             text = help_texts.get(name, f"dragonboat_tpu metric {name}")
-            out.write(f"# HELP {name} {escape_help_text(text)}\n")
-            out.write(f"# TYPE {name} {kind}\n")
+            return (
+                f"# HELP {name} {escape_help_text(text)}\n"
+                f"# TYPE {name} {kind}\n"
+            )
 
         for kind, items in (("counter", counters), ("gauge", gauges)):
-            prev = None
-            for (name, labels), v in items:
-                if name != prev:
-                    _head(name, kind)
-                    prev = name
-                out.write(f"{self._fmt(name, labels, v)}\n")
-        prev = None
-        for (name, labels), bk, counts, total, count in hists:
-            if name != prev:
-                _head(name, "histogram")
-                prev = name
-            cum = 0
-            for le, c in zip(bk, counts):
-                cum += c
-                out.write(
-                    f"{self._fmt(name + '_bucket', labels + (('le', f'{le:g}'),), cum)}\n"
+            i, n = 0, len(items)
+            while i < n:
+                name = items[i][0][0]
+                parts = [_head(name, kind)]
+                j = i
+                while j < n and items[j][0][0] == name:
+                    (_, labels), v = items[j]
+                    parts.append(f"{self._fmt(name, labels, v)}\n")
+                    j += 1
+                self._check_cardinality(name, j - i)
+                yield "".join(parts)
+                i = j
+        i, n = 0, len(hists)
+        while i < n:
+            name = hists[i][0][0]
+            parts = [_head(name, "histogram")]
+            j = i
+            while j < n and hists[j][0][0] == name:
+                (_, labels), bk, counts, total, count = hists[j]
+                cum = 0
+                for le, c in zip(bk, counts):
+                    cum += c
+                    parts.append(
+                        f"{self._fmt(name + '_bucket', labels + (('le', f'{le:g}'),), cum)}\n"
+                    )
+                parts.append(
+                    f"{self._fmt(name + '_bucket', labels + (('le', '+Inf'),), count)}\n"
                 )
-            out.write(
-                f"{self._fmt(name + '_bucket', labels + (('le', '+Inf'),), count)}\n"
-            )
-            out.write(f"{self._fmt(name + '_sum', labels, total)}\n")
-            out.write(f"{self._fmt(name + '_count', labels, count)}\n")
+                parts.append(f"{self._fmt(name + '_sum', labels, total)}\n")
+                parts.append(f"{self._fmt(name + '_count', labels, count)}\n")
+                j += 1
+            self._check_cardinality(name, j - i)
+            yield "".join(parts)
+            i = j
+
+    def write_health_metrics(self, out) -> None:
+        """Prometheus text format (reference ``WriteHealthMetrics``
+        ``event.go:31``): one ``# HELP`` + one ``# TYPE`` per metric
+        name, escaped label values and help text, stable ordering
+        (counters, then gauges, then histograms; (name, labels)-sorted
+        within each).  Delegates to :meth:`iter_health_metrics` so the
+        monolithic and streaming paths can never drift."""
+        for chunk in self.iter_health_metrics():
+            out.write(chunk)
 
     def reset(self) -> None:
         with self._mu:
@@ -269,6 +322,7 @@ class MetricsRegistry:
             self._gauges.clear()
             self._hists.clear()
             self._hist_buckets.clear()
+            self._cardinality_warned.clear()
 
 
 DEFAULT_REGISTRY = MetricsRegistry()

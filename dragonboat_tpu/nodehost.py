@@ -247,6 +247,14 @@ class NodeHost:
                 engine_choice, self.fastlane is not None,
             )
         self.quorum_engine_resolved = engine_choice
+        # aggregate health sampling (ISSUE 20): resolved BEFORE the
+        # coordinator so the engine's telemetry-fold latch flips ahead of
+        # warmup — the warmed fused program set then already includes the
+        # fold instead of paying a recompile on first use.
+        health_aggregate = nhconfig.health_aggregate or (
+            os.environ.get("DBTPU_HEALTH_AGGREGATE", "")
+            in ("1", "true", "on")
+        )
         if engine_choice == "tpu":
             from .tpuquorum import TpuQuorumCoordinator
 
@@ -257,6 +265,7 @@ class NodeHost:
                 compilation_cache_dir=(
                     nhconfig.compilation_cache_dir or None
                 ),
+                telem=health_aggregate,
             )
             if nhconfig.enable_metrics:
                 # device-plane observability rides the same flag as the
@@ -422,6 +431,20 @@ class NodeHost:
             except ValueError:
                 plog.warning("malformed DBTPU_HEALTH_SAMPLE_MS; health off")
                 health_ms = 0
+        if health_aggregate and self.quorum_coordinator is None:
+            # the fold lives in the device quorum kernels; on a scalar
+            # host the knob is inert (visible, not fatal — the devprof
+            # inert-knob precedent)
+            plog.warning(
+                "health_aggregate set but no tpu quorum engine; "
+                "aggregate sampling off"
+            )
+            health_aggregate = False
+        if health_aggregate and health_ms <= 0:
+            plog.warning(
+                "health_aggregate set but the health plane is off "
+                "(health_sample_ms=0); aggregate sampling off"
+            )
         if health_ms > 0:
             from .obs.health import HealthSampler
 
@@ -430,6 +453,7 @@ class NodeHost:
                 sample_ms=health_ms,
                 registry=self.raft_events.registry,
                 recorder=self.flight_recorder,
+                aggregate=health_aggregate,
             )
         # closed-loop recovery plane (obs/recovery.py, ISSUE 17): the
         # health detectors actuate guard-railed remediations.  OFF by

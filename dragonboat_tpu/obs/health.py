@@ -157,6 +157,7 @@ class HealthSampler:
         flap_window_s: float = 10.0,
         shard_imbalance_samples: int = 3,
         shard_imbalance_ratio: float = 3.0,
+        aggregate: bool = False,
     ):
         if capacity < 1:
             raise ValueError("health ring capacity must be >= 1")
@@ -169,6 +170,33 @@ class HealthSampler:
             from .instruments import HealthObs
 
             self._obs = HealthObs(registry=registry, detectors=DETECTORS)
+        # aggregate sampling mode (ISSUE 20): device-backed groups are
+        # covered by the engine's telemetry fold (kernels.telem_fold) at
+        # O(shards) host cost per pass; only the fold's top-K worst
+        # groups, groups with an open per-group event, and non-device
+        # groups take the per-group raft_mu walk.  False keeps the
+        # historical walk-everything pass bit-identical.
+        self.aggregate = bool(aggregate)
+        self._telem_obs = None
+        if aggregate and registry is not None:
+            from .instruments import TelemObs
+
+            self._telem_obs = TelemObs(registry=registry)
+        # aggregate-detector memory: folds are evaluated once per seq (an
+        # idle engine re-serves the same snapshot — stale folds must
+        # neither extend streaks nor close events)
+        self._telem_last_seq = -1
+        self._telem_stall_streak = 0
+        # non-device drill-down set, cached on the membership signature
+        # (len(nodes), device group count) so the aggregate pass never
+        # rebuilds an O(G) set while membership is stable
+        self._nondev_sig = None
+        self._nondev: frozenset = frozenset()
+        # sampler degradation (ISSUE 20 satellite): raft_mu-budget busy
+        # rows per pass, surfaced as dragonboat_health_busy_rows_total
+        # and report()'s sampler_degraded
+        self.busy_rows_total = 0
+        self._last_busy = 0
         self.commit_stall_samples = commit_stall_samples
         self.apply_lag_entries = apply_lag_entries
         self.quorum_risk_samples = quorum_risk_samples
@@ -269,20 +297,51 @@ class HealthSampler:
             return None
 
     def sample(self) -> dict:
-        """Snapshot every group + the host planes, append to the ring,
-        run the detectors, publish the sample metrics."""
+        """Snapshot the group walk set + the host planes, append to the
+        ring, run the detectors, publish the sample metrics.
+
+        In aggregate mode (ISSUE 20) the walk set shrinks from every
+        group to the drill-down set — the telemetry fold's top-K worst
+        groups, groups with an open per-group event (hysteresis must
+        never depend on staying in the top-K), and non-device groups —
+        while the fold covers the rest at O(shards) host cost; with no
+        fold harvested yet (engine warming, nothing dispatched) the
+        pass falls back to the full walk."""
         nh = self.nh
         if nh is None:
             raise RuntimeError("sampler has no NodeHost (unit mode)")
         t0 = time.perf_counter()
         groups: Dict[int, dict] = {}
         _, nodes = nh._get_nodes()
+        qc = nh.quorum_coordinator
+        tel = None
+        walk = nodes
+        if self.aggregate and qc is not None:
+            tel = qc.telem_snapshot()
+            if tel is not None:
+                sig = (len(nodes), tel.get("groups"))
+                if sig != self._nondev_sig:
+                    reg = qc.registered_cids()
+                    self._nondev = frozenset(
+                        c for c in nodes if c not in reg
+                    )
+                    self._nondev_sig = sig
+                drill = set(self._nondev)
+                for cid, _lag in tel.get("topk") or ():
+                    drill.add(cid)
+                for _det, key in self._open:
+                    if key.startswith("group:"):
+                        try:
+                            drill.add(int(key[6:]))
+                        except ValueError:
+                            pass
+                walk = {c: nodes[c] for c in drill if c in nodes}
         # whole-PASS lock budget: the per-group raft_mu timeout shrinks
         # as the deadline approaches, so a host full of contended
         # groups costs one bounded stall total (busy rows past it),
         # never n_groups × timeout on the tick worker
         deadline = t0 + min(0.2, self.sample_ms / 1e3 / 2.0)
-        for cid, node in nodes.items():
+        for cid, node in walk.items():
             try:
                 remaining = deadline - time.perf_counter()
                 groups[cid] = node.health_snapshot(
@@ -291,7 +350,6 @@ class HealthSampler:
             except Exception:
                 groups[cid] = {"error": True}
         host: Dict[str, Optional[dict]] = {}
-        qc = nh.quorum_coordinator
         host["coord"] = qc.health_snapshot() if qc is not None else None
         hp = nh.hostplane
         host["hostplane"] = hp.health_snapshot() if hp is not None else None
@@ -306,6 +364,16 @@ class HealthSampler:
             "groups": groups,
             "host": host,
         }
+        if tel is not None:
+            sample["aggregate"] = True
+            sample["telem"] = tel
+            # gone detection needs full membership (the walk set is a
+            # subset): resolved HERE, where the nodes dict gives O(1)
+            # lookups over the small _prev set — _evaluate must not
+            # treat mere absence from the walk as group removal
+            sample["gone_cids"] = [
+                c for c in self._prev if c not in walk and c not in nodes
+            ]
         self.ingest(sample)
         return sample
 
@@ -316,6 +384,15 @@ class HealthSampler:
             sample["seq"] = self._n
             self._buf[self._n % self.capacity] = sample
             self._n += 1
+        # sampler degradation (ISSUE 20 satellite): rows the raft_mu
+        # budget forced to report busy this pass — counted even in unit
+        # mode so hand-built samples exercise the same path
+        busy = sum(
+            1 for g in (sample.get("groups") or {}).values()
+            if g.get("busy")
+        )
+        self.busy_rows_total += busy
+        self._last_busy = busy
         self._evaluate(sample)
         obs = self._obs
         if obs is not None:
@@ -323,6 +400,7 @@ class HealthSampler:
                 wall_ms=sample.get("wall_ms", 0.0),
                 groups=len(sample.get("groups") or {}),
             )
+            obs.busy_rows(busy)
 
     # ------------------------------------------------------------------
     # detectors
@@ -347,7 +425,17 @@ class HealthSampler:
         # would charge a restarted incarnation with the old one's
         # changes, and under long-running group churn the dicts would
         # grow without bound
-        gone = [c for c in self._prev if c not in groups]
+        if sample.get("aggregate"):
+            # aggregate samples walk only the drill-down set: absence
+            # from the walk is NOT removal — closing on it would flap
+            # every per-group detector as the top-K churns.  sample()
+            # resolved true membership into gone_cids.
+            gone = [
+                c for c in sample.get("gone_cids") or ()
+                if c in self._prev
+            ]
+        else:
+            gone = [c for c in self._prev if c not in groups]
         for cid in gone:
             del self._prev[cid]
             for d in (self._stall_streak, self._risk_streak,
@@ -356,6 +444,9 @@ class HealthSampler:
                 d.pop(cid, None)
             for det in DETECTORS:
                 self._set(det, f"group:{cid}", False, now, {})
+        tel = sample.get("telem")
+        if tel is not None:
+            self._eval_telem(tel, now)
         hostproc = (sample.get("host") or {}).get("hostproc")
         self._eval_worker_flap(hostproc, now)
         coord = (sample.get("host") or {}).get("coord")
@@ -498,6 +589,59 @@ class HealthSampler:
             "devsm_rebind", f"group:{cid}",
             len(dq) >= self.devsm_rebind_binds, now,
             {"cluster_id": cid, "binds": len(dq), "bound": dv.get("bound")},
+        )
+
+    @staticmethod
+    def _lag_tail_bucket(threshold: int) -> int:
+        """First histogram bucket whose lags are all >= ``threshold``
+        (the fold's exact integer log2 bucketing: bucket 0 = lag 0,
+        bucket i covers [2^(i-1), 2^i), top bucket capped)."""
+        b = 1
+        while (1 << (b - 1)) < threshold:
+            b += 1
+        return b
+
+    def _eval_telem(self, tel: dict, now) -> None:
+        """Aggregate-mode detectors (ISSUE 20): ``commit_stall`` and
+        ``apply_lag`` run on the device fold itself — the stalled-group
+        count and the commit-lag histogram tail — under ``aggregate``
+        keys, naming the top-K identities in the detail so operators
+        (and the recovery plane) can drill down to specific groups.
+        Only a FRESH fold advances the evaluation: an idle engine
+        re-serves the same snapshot, which must neither extend streaks
+        nor close open events (the partial-sample hysteresis
+        contract)."""
+        seq = tel.get("seq")
+        if seq == self._telem_last_seq:
+            return
+        self._telem_last_seq = seq
+        if self._telem_obs is not None:
+            self._telem_obs.fold(tel)
+        topk = [list(p) for p in (tel.get("topk") or ())]
+        stalled = int(tel.get("stalled", 0))
+        streak = self._telem_stall_streak + 1 if stalled > 0 else 0
+        self._telem_stall_streak = streak
+        self._set(
+            "commit_stall", "aggregate",
+            streak >= self.commit_stall_samples, now,
+            {"stalled": stalled, "samples": streak, "topk": topk},
+        )
+        # histogram tail at/above the apply-lag threshold (device commit
+        # lag, last_index − committed); same hysteresis rule as the
+        # per-group path — an open event closes at half the threshold
+        hist = list(tel.get("lag_hist") or ())
+        key = ("apply_lag", "aggregate")
+        threshold = (
+            self.apply_lag_entries // 2
+            if key in self._open else self.apply_lag_entries
+        )
+        tail = 0
+        if hist:
+            b = min(self._lag_tail_bucket(threshold), len(hist) - 1)
+            tail = int(sum(hist[b:]))
+        self._set(
+            "apply_lag", "aggregate", tail > 0, now,
+            {"groups_over": tail, "threshold": threshold, "topk": topk},
         )
 
     def _eval_worker_flap(self, hostproc: Optional[dict], now) -> None:
@@ -681,6 +825,12 @@ class HealthSampler:
             "attribution": attribution,
             "samples": self._n,
             "sample_ms": self.sample_ms,
+            "aggregate": self.aggregate,
+            # sampler degradation (ISSUE 20 satellite): a pass that hit
+            # the raft_mu budget left busy rows — the O(G) blowup the
+            # aggregate mode exists to prevent is itself detectable
+            "busy_rows": self.busy_rows_total,
+            "sampler_degraded": self._last_busy > 0,
         }
 
     def to_json(self, limit: Optional[int] = None) -> dict:
@@ -710,8 +860,13 @@ class MetricsServer:
     ==================  ================================================
     path                serves
     ==================  ================================================
-    ``/metrics``        the Prometheus text exposition
-                        (``write_health_metrics``) — live-scrapeable
+    ``/metrics``        the Prometheus text exposition, streamed as
+                        chunked transfer one family at a time
+                        (``iter_health_metrics``, ~16KB coalesced
+                        chunks) so a high-cardinality scrape never
+                        materializes the whole exposition on the
+                        serving thread; HTTP/1.0 scrapers get the
+                        buffered form
     ``/healthz``        the aggregated detector verdict as JSON; HTTP
                         200 while ok, 503 while any detector is open
     ``/debug/health``   the health sample ring + events (404 while the
@@ -722,6 +877,9 @@ class MetricsServer:
                         HBM ledger, capacity model, estimator stats,
                         collected program registry (404 while devprof
                         is off)
+    ``/debug/telem``    the latest device telemetry fold — lag
+                        histogram, state counts, stalled count, top-K
+                        worst groups (404 while the fold is off)
     ==================  ================================================
 
     Serves on daemon threads (``ThreadingHTTPServer``); request handling
@@ -780,19 +938,56 @@ class MetricsServer:
         self._thread.join(timeout=2.0)
 
 
+#: coalesce streamed exposition families into chunks around this size —
+#: one syscall per ~16KB instead of one per family, still never the
+#: whole exposition in one string
+_METRICS_CHUNK = 16384
+
+
 def _serve(nh, handler) -> None:
     path = handler.path.split("?", 1)[0]
     if path == "/metrics":
-        buf = io.StringIO()
-        nh.write_health_metrics(buf)
-        body = buf.getvalue().encode("utf-8")
+        reg = getattr(getattr(nh, "raft_events", None), "registry", None)
+        if reg is None or handler.request_version < "HTTP/1.1":
+            # no registry handle (test doubles expose only
+            # write_health_metrics) or an HTTP/1.0 scraper that cannot
+            # parse chunked framing: serve the buffered form
+            buf = io.StringIO()
+            nh.write_health_metrics(buf)
+            body = buf.getvalue().encode("utf-8")
+            handler.send_response(200)
+            handler.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+            return
+        # streamed exposition (ISSUE 20 satellite): one family at a
+        # time off the registry generator, coalesced to ~16KB chunks —
+        # at high group/shard cardinality the historical single join
+        # was a latency spike on the serving thread
+        handler.protocol_version = "HTTP/1.1"
         handler.send_response(200)
         handler.send_header(
             "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
         )
-        handler.send_header("Content-Length", str(len(body)))
+        handler.send_header("Transfer-Encoding", "chunked")
         handler.end_headers()
-        handler.wfile.write(body)
+        w = handler.wfile
+        pending: list = []
+        size = 0
+        for part in reg.iter_health_metrics():
+            pending.append(part)
+            size += len(part)
+            if size >= _METRICS_CHUNK:
+                data = "".join(pending).encode("utf-8")
+                w.write(b"%x\r\n%s\r\n" % (len(data), data))
+                pending, size = [], 0
+        if pending:
+            data = "".join(pending).encode("utf-8")
+            w.write(b"%x\r\n%s\r\n" % (len(data), data))
+        w.write(b"0\r\n\r\n")
         return
     if path == "/healthz":
         report = nh.health_report()
@@ -837,6 +1032,24 @@ def _serve(nh, handler) -> None:
         # read-only by contract: to_json never triggers compiles or
         # capture windows — a scraper can poll this freely
         body = json.dumps(devprof.to_json(), default=str).encode("utf-8")
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+        return
+    if path == "/debug/telem":
+        qc = getattr(nh, "quorum_coordinator", None)
+        if qc is None or not getattr(qc, "telem_enabled", False):
+            handler.send_error(404, "device telemetry is off")
+            return
+        # read-only by contract: telem_snapshot is the latest harvested
+        # fold (None until the first telem-on dispatch lands) — a
+        # scraper can poll this freely, it never triggers a dispatch
+        body = json.dumps(
+            {"enabled": True, "snapshot": qc.telem_snapshot()},
+            default=str,
+        ).encode("utf-8")
         handler.send_response(200)
         handler.send_header("Content-Type", "application/json")
         handler.send_header("Content-Length", str(len(body)))

@@ -69,6 +69,7 @@ _REPL = "dragonboat_repl_"
 _DEVPROF = "dragonboat_devprof_"
 _MESH = "dragonboat_mesh_"
 _RECOV = "dragonboat_recovery_"
+_TELEM = "dragonboat_telem_"
 
 #: recovery-duration buckets (seconds): a worker respawn lands near the
 #: bottom, a failover around election timeouts, a wedged rebind loop or
@@ -238,6 +239,27 @@ _HELP = {
     "by detector and action",
     _RECOV + "action_seconds": "wall seconds one executed remediation "
     "took (decide-to-commit, e.g. config-change round trip), by action",
+    # device telemetry fold (ops/kernels.py telem_fold, ISSUE 20)
+    _TELEM + "folds_total": "device telemetry aggregates published to "
+    "the health sampler (one fixed-size fold per harvested dispatch)",
+    _TELEM + "groups": "live device-backed groups per raft state in the "
+    "last fold, by state (follower / candidate / leader / observer / "
+    "witness)",
+    _TELEM + "stalled_groups": "groups whose commit watermark stayed "
+    "flat since the previous fold despite pending appended entries",
+    _TELEM + "commit_lag": "groups per log2 commit-lag bucket "
+    "(last_index minus committed) in the last fold, by bucket lower "
+    "bound",
+    _TELEM + "worst_lag": "largest commit lag across live groups in the "
+    "last fold (the top-K drill-down's first row)",
+    _TELEM + "read_slots": "engine read-plane slots occupied in the "
+    "last fold",
+    _TELEM + "kv_ents": "devsm entry-buffer slots holding unapplied ops "
+    "in the last fold",
+    _HEALTH + "busy_rows_total": "per-group sample rows skipped because "
+    "the raft_mu walk hit its budget mid-pass (nonzero means the "
+    "sampler is degrading at this group count — the silent-O(G) "
+    "blowup detector)",
 }
 
 
@@ -673,6 +695,9 @@ class HealthObs:
     - histogram ``recovery_seconds{detector}`` — open→close durations:
       the recovery-time attribution (failover / worker-respawn /
       devsm-rebind p99s the perf ledger publishes)
+    - ``busy_rows_total`` — per-group rows skipped by the raft_mu
+      budget mid-walk (ISSUE 20 satellite: sampler degradation must be
+      itself detectable, not silent)
 
     Same ``is not None`` latch contract as every other plane: health off
     registers none of this.
@@ -688,8 +713,10 @@ class HealthObs:
             _HEALTH + "samples_total", _HEALTH + "sample_ms",
             _HEALTH + "groups", _HEALTH + "events_total",
             _HEALTH + "open", _HEALTH + "recovery_seconds",
+            _HEALTH + "busy_rows_total",
         ))
         r.counter_add(_HEALTH + "samples_total", 0)
+        r.counter_add(_HEALTH + "busy_rows_total", 0)
         r.gauge_set(_HEALTH + "groups", 0)
         r.histogram_declare(_HEALTH + "sample_ms", buckets=LATENCY_BUCKETS_MS)
         for det in detectors:
@@ -709,6 +736,10 @@ class HealthObs:
             _HEALTH + "sample_ms", wall_ms, buckets=LATENCY_BUCKETS_MS
         )
 
+    def busy_rows(self, n: int) -> None:
+        if n:
+            self.registry.counter_add(_HEALTH + "busy_rows_total", n)
+
     def event_open(self, detector: str, *, open_count: int) -> None:
         labels = {"detector": detector}
         r = self.registry
@@ -724,6 +755,72 @@ class HealthObs:
             _HEALTH + "recovery_seconds", duration_s,
             buckets=RECOVERY_BUCKETS_S, labels=labels,
         )
+
+
+class TelemObs:
+    """Device-telemetry-fold instruments (ops/kernels.py ``telem_fold``,
+    ISSUE 20).
+
+    Families (``dragonboat_telem_*``), all refreshed from the latest
+    harvested aggregate — snapshots of the device fold, not host-side
+    accumulation:
+
+    - ``folds_total`` — aggregates published to the sampler
+    - gauge ``groups{state}`` — live groups per raft state
+    - gauge ``stalled_groups`` — commit watermark flat with pending work
+    - gauge ``commit_lag{bucket}`` — log2 lag histogram, labeled by the
+      bucket's lower bound (``0``, ``1``, ``2``, ``4`` … capped top)
+    - gauge ``worst_lag`` — the top-K drill-down's first row
+    - gauge ``read_slots`` / ``kv_ents`` — plane slot occupancy
+
+    Same ``is not None`` latch contract as every other plane: aggregate
+    sampling off registers none of this.
+    """
+
+    __slots__ = ("registry", "_bucket_labels")
+
+    _STATES = ("follower", "candidate", "leader", "observer", "witness")
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 buckets: int = 16):
+        self.registry = registry or DEFAULT_REGISTRY
+        r = self.registry
+        _describe(r, (
+            _TELEM + "folds_total", _TELEM + "groups",
+            _TELEM + "stalled_groups", _TELEM + "commit_lag",
+            _TELEM + "worst_lag", _TELEM + "read_slots",
+            _TELEM + "kv_ents",
+        ))
+        r.counter_add(_TELEM + "folds_total", 0)
+        r.gauge_set(_TELEM + "stalled_groups", 0)
+        r.gauge_set(_TELEM + "worst_lag", 0)
+        r.gauge_set(_TELEM + "read_slots", 0)
+        r.gauge_set(_TELEM + "kv_ents", 0)
+        for s in self._STATES:
+            r.gauge_set(_TELEM + "groups", 0, labels={"state": s})
+        # bucket i counts lags in [2^(i-1), 2^i) (bucket 0 = lag 0;
+        # top bucket capped) — label by the inclusive lower bound
+        self._bucket_labels = tuple(
+            {"bucket": str(0 if i == 0 else 1 << (i - 1))}
+            for i in range(buckets)
+        )
+        for lbl in self._bucket_labels:
+            r.gauge_set(_TELEM + "commit_lag", 0, labels=lbl)
+
+    def fold(self, snap: dict) -> None:
+        """Publish one harvested aggregate (the ``telem_snapshot``
+        dict) into the registry."""
+        r = self.registry
+        r.counter_add(_TELEM + "folds_total")
+        for s, n in zip(self._STATES, snap.get("state_counts", ())):
+            r.gauge_set(_TELEM + "groups", n, labels={"state": s})
+        r.gauge_set(_TELEM + "stalled_groups", snap.get("stalled", 0))
+        topk = snap.get("topk") or ()
+        r.gauge_set(_TELEM + "worst_lag", topk[0][1] if topk else 0)
+        r.gauge_set(_TELEM + "read_slots", snap.get("read_slots", 0))
+        r.gauge_set(_TELEM + "kv_ents", snap.get("kv_ents", 0))
+        for lbl, n in zip(self._bucket_labels, snap.get("lag_hist", ())):
+            r.gauge_set(_TELEM + "commit_lag", n, labels=lbl)
 
 
 class RecoveryObs:

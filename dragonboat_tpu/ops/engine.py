@@ -31,7 +31,7 @@ import jax.numpy as jnp
 
 from .. import obs as _obs
 from ..logger import get_logger
-from .kernels import quorum_step
+from .kernels import TELEM_TOPK, quorum_step
 from .state import (
     CANDIDATE,
     FOLLOWER,
@@ -559,6 +559,29 @@ class BatchedQuorumEngine:
         # hier deployments install domain geometry at registration /
         # first promotion, ahead of steady-state load.
         self._hier_used = False
+        # --- device telemetry plane (telem, ISSUE 20) --------------------
+        # LATCH, same contract as _hier_used: until enable_telem flips it,
+        # telem_prev_committed is provably all-zero, every dispatch runs
+        # has_telem=False — the compiled program set stays byte-identical
+        # to the pre-telem build — and the rare-path row syncs skip the
+        # telem field (_sync_keys).  Flip BEFORE warmup_fused (NodeHost
+        # wires health_aggregate into the coordinator constructor for
+        # exactly this) so the warmed programs carry the fold; a late
+        # flip compiles each variant's has_telem=True twin on next use
+        # (the late-devsm precedent).
+        self._telem_used = False
+        # static top-K width of the fold's drill-down egress; changing it
+        # after programs compiled recompiles them, so it is ctor/enable
+        # time configuration, not a per-dispatch knob
+        self.n_telem_topk = TELEM_TOPK
+        # last harvested aggregate: raw device arrays + the dispatch-time
+        # row->cid capture, materialized into the snapshot dict LAZILY
+        # (telem_snapshot) — per-dispatch harvest cost is one tuple
+        # store, the numpy conversion runs at sampler cadence instead of
+        # dispatch cadence
+        self._last_telem = None
+        self._telem_raw = None
+        self._telem_seq = 0
         # host record of the rel index staged in each device entry-buffer
         # slot (-1 = free): slot ``rel % E`` is reusable once the
         # HARVESTED commit watermark has passed its tenant (the device
@@ -671,6 +694,83 @@ class BatchedQuorumEngine:
 
     def disable_devprof(self) -> None:
         self._devprof = None
+
+    def enable_telem(self, topk: int | None = None) -> None:
+        """Flip the device telemetry latch (ISSUE 20): every subsequent
+        dispatch runs its ``has_telem=True`` variant, folding the shard's
+        health aggregate (``kernels.telem_fold``) into the egress it
+        already pays for.  One-way, like the other plane latches — the
+        telem field starts participating in rare-path row syncs and
+        recycle purges the moment it can be nonzero.  Call BEFORE
+        ``warmup_fused`` to get the fold into the warmed program set; a
+        later call recompiles each variant once on next use.  ``topk``
+        sets the fold's static drill-down width (default
+        ``kernels.TELEM_TOPK``); it must not change after programs
+        compiled against it."""
+        if topk is not None:
+            self.n_telem_topk = int(topk)
+        self._telem_used = True
+
+    @property
+    def telem_enabled(self) -> bool:
+        return self._telem_used
+
+    def telem_snapshot(self) -> dict | None:
+        """The last harvested telemetry aggregate, or None before the
+        first telem-carrying harvest (or while the plane is off).
+
+        PASSIVE by design: the aggregate refreshes whenever a dispatch's
+        egress is harvested — the plane adds no dispatches of its own,
+        so an idle engine serves a stale snapshot.  Consumers read
+        ``seq``/``mono`` for staleness; the health sampler's cadence
+        rides the coordinator round loop, which dispatches every tick.
+
+        LAZY materialization: the harvest stores the raw device arrays
+        (one tuple assignment on the dispatch path); the numpy pull +
+        dict build runs here, at CONSUMER cadence — the sampler reads
+        ~once per 50ms while a loaded shard harvests hundreds of folds
+        a second, and eager per-harvest conversion showed up as
+        dispatch overhead in the telem bench axis."""
+        raw = self._telem_raw
+        if raw is not None:
+            tel, row_cid, rounds, mono, seq = raw
+            self._telem_raw = None
+            self._ingest_telem(tel, row_cid, rounds, mono, seq)
+        t = self._last_telem
+        return dict(t) if t is not None else None
+
+    def _stage_telem(self, tel, row_cid, rounds: int) -> None:
+        """Record one harvested TelemAggregate for lazy materialization.
+        ``row_cid`` must be the DISPATCH-TIME capture (copied), so a
+        re-registration between dispatch and snapshot can't mislabel a
+        drill-down row."""
+        self._telem_seq += 1
+        self._telem_raw = (
+            tel, row_cid, rounds, time.monotonic(), self._telem_seq
+        )
+
+    def _ingest_telem(self, tel, row_cid, rounds, mono, seq) -> None:
+        """Translate a TelemAggregate into the host snapshot dict."""
+        state_counts = np.asarray(tel.state_counts, dtype=np.int64)
+        rows = np.asarray(tel.topk_row)
+        lags = np.asarray(tel.topk_lag)
+        topk = [
+            (int(row_cid[r]), int(lag))
+            for r, lag in zip(rows, lags)
+            if r >= 0 and row_cid[r] >= 0
+        ]
+        self._last_telem = {
+            "seq": seq,
+            "mono": mono,
+            "rounds": int(rounds),
+            "groups": int(state_counts.sum()),
+            "lag_hist": [int(v) for v in np.asarray(tel.lag_hist)],
+            "state_counts": [int(v) for v in state_counts],
+            "stalled": int(tel.stalled),
+            "read_slots": int(tel.read_slots),
+            "kv_ents": int(tel.kv_ents),
+            "topk": topk,
+        }
 
     # ------------------------------------------------------------------
     # AOT warm-compile (ISSUE 7 tentpole)
@@ -972,6 +1072,9 @@ class BatchedQuorumEngine:
                 has_kv=has_kv,
                 purge_kv=False,
                 has_hier=self._hier_used,
+                has_telem=self._telem_used,
+                purge_telem=False,
+                telem_k=self.n_telem_topk,
             )
             return quorum_multiround, args, statics
         if kind == "dense":
@@ -990,6 +1093,8 @@ class BatchedQuorumEngine:
                 has_reads=has_reads,
                 has_kv=has_kv,
                 has_hier=self._hier_used,
+                has_telem=self._telem_used,
+                telem_k=self.n_telem_topk,
             )
             return quorum_step_dense, args, statics
         # sparse single-round (the quiet-path workhorse)
@@ -1011,6 +1116,8 @@ class BatchedQuorumEngine:
             track_contact=self.device_ticks or do_tick,
             has_votes=has_votes,
             has_hier=self._hier_used,
+            has_telem=self._telem_used,
+            telem_k=self.n_telem_topk,
         )
         return quorum_step, args, statics
 
@@ -2163,6 +2270,7 @@ class BatchedQuorumEngine:
             row, term, term_start, last_index,
             clear_reads=self._read_plane_used,
             clear_kv=self._devsm_used,
+            clear_telem=self._telem_used,
         )
         self._committed_cache[row] = 0
         self._synced.discard(row)
@@ -2311,6 +2419,12 @@ class BatchedQuorumEngine:
                 out.kv_applied,
             )
         )
+        if out.telem is not None:
+            # dispatch-time row_cid snapshot: a re-registration while the
+            # block was in flight must not mislabel a drill-down row.
+            # The device arrays stay resident until telem_snapshot pulls
+            # them — the fold must not add a per-dispatch readback
+            self._stage_telem(out.telem, row_cid, rounds=n_rounds)
         res = MultiRoundResult(n_rounds)
         if rdc is not None:
             self._translate_reads(res, rdc, rdi, row_cid, row_base)
@@ -2551,6 +2665,10 @@ class BatchedQuorumEngine:
             # the devsm twin of purge_reads, same normalization rationale
             purge_kv=self._devsm_used and has_churn,
             has_hier=self._hier_used,
+            has_telem=self._telem_used,
+            # the telem twin of purge_reads, same normalization rationale
+            purge_telem=self._telem_used and has_churn,
+            telem_k=self.n_telem_topk,
         )
         self._dev = out.state
         if obs is not None:
@@ -2698,14 +2816,15 @@ class BatchedQuorumEngine:
     _READ_KEYS = ("read_index", "read_count", "read_acks")
     _KV_KEYS = ("kv_value", "kv_ent_index", "kv_ent_key", "kv_ent_val")
     _HIER_KEYS = ("near", "sub_quorum")
+    _TELEM_KEYS = ("telem_prev_committed",)
 
     def _sync_keys(self):
         """Mirror fields the rare-path row syncs move between host and
         device.  The read-plane arrays join only once the plane has been
         used (see the ``_read_plane_used`` latch in ``__init__``); before
         that both sides are all-zero by construction and the extra eager
-        gather/scatter programs must not be dispatched at all.  The devsm
-        and hier arrays follow the same rule on their own latches."""
+        gather/scatter programs must not be dispatched at all.  The devsm,
+        hier and telem arrays follow the same rule on their own latches."""
         skip = ()
         if not self._read_plane_used:
             skip += self._READ_KEYS
@@ -2713,6 +2832,8 @@ class BatchedQuorumEngine:
             skip += self._KV_KEYS
         if not self._hier_used:
             skip += self._HIER_KEYS
+        if not self._telem_used:
+            skip += self._TELEM_KEYS
         if not skip:
             return list(self.mirror.arrays)
         return [k for k in self.mirror.arrays if k not in skip]
@@ -2951,6 +3072,12 @@ class BatchedQuorumEngine:
                 out.kv_applied,
             )
         )
+        if out.telem is not None:
+            # deferred readback: stage the device aggregate, pull it at
+            # snapshot (sampler) cadence, not dispatch cadence
+            self._stage_telem(
+                out.telem, self._row_cid.copy(), rounds=1
+            )
         if rdc is not None:
             self._translate_reads(res, rdc, rdi, self._row_cid, self._row_base)
         # device_get arrays are read-only; the cache must stay writable
@@ -3072,6 +3199,12 @@ class BatchedQuorumEngine:
             track_contact=self.device_ticks or do_tick,
             has_votes=bool(votes),
             has_hier=self._hier_used,
+            has_telem=self._telem_used,
+            telem_k=self.n_telem_topk,
+            # occupancy hints for the telem fold only — this path never
+            # carries read/kv event planes
+            has_reads=self._read_plane_used,
+            has_kv=self._devsm_used,
         )
         self._dev = out.state
         dp = self._devprof
@@ -3167,6 +3300,8 @@ class BatchedQuorumEngine:
             has_reads=has_reads,
             has_kv=has_kv,
             has_hier=self._hier_used,
+            has_telem=self._telem_used,
+            telem_k=self.n_telem_topk,
         )
         self._dev = out.state
         dp = self._devprof

@@ -181,6 +181,120 @@ class TickFlags(NamedTuple):
     checkq_demote: jax.Array  # (G,) bool — CheckQuorum failed, leader must step down
 
 
+# Device telemetry fold (ISSUE 20).  Every aggregate shape is STATIC, so
+# the telemetry egress per dispatch is fixed-size no matter how many
+# groups the shard holds — the property that lets the health plane watch
+# a million groups at O(shards) host cost instead of an O(G) Python walk.
+TELEM_LAG_BUCKETS = 16
+TELEM_STATES = 5   # FOLLOWER..WITNESS (state.py raft states)
+TELEM_TOPK = 8
+
+
+class TelemAggregate(NamedTuple):
+    """Fixed-size per-shard health aggregate (:func:`telem_fold`).
+
+    ``lag`` throughout is the DEVICE-visible commit lag
+    ``last_index - committed`` — entries appended but not yet quorum-
+    committed.  The host-side committed−applied apply lag remains a
+    per-group host signal: the aggregate sampler reads it only for the
+    drill-down set this aggregate names (top-K worst rows plus
+    non-device groups), which is the point of the fold.
+    """
+
+    lag_hist: jax.Array      # (B,) i32 — live groups per log2 lag bucket
+    state_counts: jax.Array  # (TELEM_STATES,) i32 — live groups per raft state
+    stalled: jax.Array       # () i32 — live, lag > 0, committed flat since last fold
+    read_slots: jax.Array    # () i32 — occupied ReadIndex slots (read_count > 0)
+    kv_ents: jax.Array       # () i32 — occupied devsm entry slots (index >= 0)
+    topk_row: jax.Array      # (K,) i32 — worst rows by lag; -1 = fewer than K live
+    topk_lag: jax.Array      # (K,) i32 — their lag values
+
+
+def telem_fold(
+    st: QuorumState, k: int = TELEM_TOPK,
+    count_reads: bool = True, count_kv: bool = True,
+) -> tuple[QuorumState, TelemAggregate]:
+    """Reduce per-group health signals into one :class:`TelemAggregate`.
+
+    Pure masked reductions over the group axis — no collectives (the
+    module invariant), no new input tensors, so the fold rides any
+    dispatch for a handful of VPU passes over state already in HBM.
+    Also advances ``telem_prev_committed`` to this fold's commit
+    watermark: the stalled predicate compares against the PREVIOUS
+    fold, giving "commitIndex flat across a whole dispatch window with
+    pending work" rather than a noisy within-round flatline.
+    """
+    live = st.live
+    lag = jnp.where(live, jnp.maximum(st.last_index - st.committed, 0), 0)
+    # Exact integer log2 bucketing: bucket = #{i < B-1 : lag >= 2^i}
+    # (0→0, 1→1, 2..3→2, …, ≥2^(B-2)→B-1).  Float log2 would disagree
+    # with the integer host oracle near power-of-two boundaries
+    # (float32 rounds 2^25 − 1 up across the bucket edge).
+    # searchsorted(side="right") counts thresholds <= lag — identical to
+    # summing (lag >= 2^i) but a binary search per element instead of a
+    # (G, B-1) compare matrix.
+    thresholds = jnp.asarray(
+        [1 << i for i in range(TELEM_LAG_BUCKETS - 1)], I32
+    )
+    bucket = jnp.searchsorted(thresholds, lag, side="right").astype(I32)
+    # Counting via (G, buckets) compare-matrix column sums — NOT
+    # scatter-add and NOT one-hot matmul.  Scatter lowers to a
+    # serialized per-update loop on the cpu backend (~0.1 ms per
+    # scatter at G=1024, dominating the fold) and one-hot matmuls
+    # materialize float intermediates; a bool compare plus integer
+    # column reduction is a handful of fully-vectorized passes over
+    # G×16 / G×5 elements.
+    bucket_ids = jnp.arange(TELEM_LAG_BUCKETS, dtype=I32)
+    lag_hist = jnp.sum(
+        (bucket[:, None] == bucket_ids[None, :]) & live[:, None],
+        axis=0, dtype=I32,
+    )
+    state_ids = jnp.arange(TELEM_STATES, dtype=I32)
+    state_counts = jnp.sum(
+        (st.node_state.astype(I32)[:, None] == state_ids[None, :])
+        & live[:, None],
+        axis=0, dtype=I32,
+    )
+    stalled = jnp.sum(
+        live & (st.committed == st.telem_prev_committed) & (lag > 0)
+    ).astype(I32)
+    # Slot-occupancy reductions gate on the caller's plane latches: when
+    # a plane has never been used its arrays are provably all-idle, so
+    # the count is the constant 0 and the (G, S)/(G, E) sweeps vanish
+    # from the program entirely.
+    zero = jnp.asarray(0, I32)
+    read_slots = (
+        jnp.sum(st.read_count > 0).astype(I32) if count_reads else zero
+    )
+    kv_ents = (
+        jnp.sum(st.kv_ent_index >= 0).astype(I32) if count_kv else zero
+    )
+    # Top-K worst rows by lag; dead rows mask to -1, sorting below any
+    # live lag (≥ 0).  K sequential argmax passes, not lax.top_k: the
+    # full sort top_k lowers to costs ~0.2ms at G=1024 on the cpu
+    # backend (most of the fold's dispatch overhead), while K masked
+    # argmax sweeps are linear in G.  argmax returns the FIRST maximal
+    # index, so ties break toward the LOWER row — the host oracle sorts
+    # by (-lag, row) to match bit-for-bit.
+    masked = jnp.where(live, lag, -1).astype(I32)
+    # an engine smaller than K egresses its whole group axis
+    k = min(int(k), masked.shape[0])
+    rows, lags = [], []
+    for _ in range(k):  # unrolled — k is static; no while-loop overhead
+        i = jnp.argmax(masked).astype(I32)
+        rows.append(i)
+        lags.append(masked[i])
+        masked = masked.at[i].set(jnp.iinfo(jnp.int32).min)
+    topk_row = jnp.stack(rows)
+    topk_lag = jnp.stack(lags)
+    topk_row = jnp.where(topk_lag >= 0, topk_row, -1).astype(I32)
+    st = st._replace(telem_prev_committed=st.committed)
+    return st, TelemAggregate(
+        lag_hist, state_counts, stalled, read_slots, kv_ents,
+        topk_row, topk_lag,
+    )
+
+
 class StepOutputs(NamedTuple):
     state: QuorumState
     committed: jax.Array    # (G,) i32 rel — post-step commit watermark
@@ -205,6 +319,13 @@ class StepOutputs(NamedTuple):
     kv_read_val: jax.Array | None = None      # (G,R) i32
     kv_read_index: jax.Array | None = None    # (G,R) i32 rel, -1 = none
     kv_applied: jax.Array | None = None       # (G,) i32
+    # device telemetry egress (None unless has_telem, ISSUE 20): the
+    # fixed-size aggregate telem_fold computed over the POST-step state.
+    # A multi-round dispatch folds ONCE on the final scanned state — the
+    # aggregate is a snapshot of where the block left the shard, not a
+    # per-round accumulation (commit watermarks are monotone, so the
+    # final fold is exactly the aggregate a fresh dispatch would see).
+    telem: TelemAggregate | None = None
 
 
 def read_confirm(
@@ -410,6 +531,10 @@ def quorum_step_impl(
     track_contact: bool = True,
     has_votes: bool = True,
     has_hier: bool = False,
+    has_telem: bool = False,
+    telem_k: int = TELEM_TOPK,
+    has_reads: bool = False,
+    has_kv: bool = False,
 ) -> StepOutputs:
     """ONE fused dispatch for a whole engine round (SURVEY.md §7).
 
@@ -474,10 +599,21 @@ def quorum_step_impl(
     else:
         votes = st.votes
 
-    return _finish_step(
+    out = _finish_step(
         st, match, next_, active, votes, election_tick, last_index, do_tick,
         has_hier=has_hier,
     )
+    if has_telem:
+        # has_reads/has_kv carry no event planes on this path — they are
+        # pure occupancy hints so the fold only sweeps read/kv slot
+        # arrays that could actually be non-idle (the engine passes its
+        # plane latches).
+        tst, agg = telem_fold(
+            out.state, telem_k,
+            count_reads=has_reads, count_kv=has_kv,
+        )
+        out = out._replace(state=tst, telem=agg)
+    return out
 
 
 def _finish_step(
@@ -539,7 +675,10 @@ def _finish_step(
 
 quorum_step = jax.jit(
     quorum_step_impl,
-    static_argnames=("do_tick", "track_contact", "has_votes", "has_hier"),
+    static_argnames=(
+        "do_tick", "track_contact", "has_votes", "has_hier", "has_telem",
+        "telem_k", "has_reads", "has_kv",
+    ),
     donate_argnums=(0,),
 )
 
@@ -562,6 +701,8 @@ def quorum_step_dense_impl(
     has_reads: bool = False,
     has_kv: bool = False,
     has_hier: bool = False,
+    has_telem: bool = False,
+    telem_k: int = TELEM_TOPK,
 ) -> StepOutputs:
     """Dense-ingestion twin of :func:`quorum_step_impl` — zero scatters.
 
@@ -634,6 +775,17 @@ def quorum_step_dense_impl(
             state=kst, kv_read_val=kv_rv, kv_read_index=kv_ri,
             kv_applied=kv_ap,
         )
+    if has_telem:
+        # telemetry fold LAST: the aggregate must describe the state this
+        # dispatch leaves behind — including reads released and entries
+        # applied above — and the fold writes no field any plane reads,
+        # so ordering after them is free and keeps the telem-off program
+        # byte-identical.
+        tst, agg = telem_fold(
+            out.state, telem_k,
+            count_reads=has_reads, count_kv=has_kv,
+        )
+        out = out._replace(state=tst, telem=agg)
     return out
 
 
@@ -641,7 +793,7 @@ quorum_step_dense = jax.jit(
     quorum_step_dense_impl,
     static_argnames=(
         "do_tick", "track_contact", "has_votes", "has_reads", "has_kv",
-        "has_hier",
+        "has_hier", "has_telem", "telem_k",
     ),
     donate_argnums=(0,),
 )
@@ -784,6 +936,7 @@ def _apply_recycle(
     last: jax.Array,   # (C,) i32 rel — last_index of the fresh leader
     reset_reads: bool = True,
     reset_kv: bool = True,
+    reset_telem: bool = True,
 ) -> QuorumState:
     """Masked leader-recycle row reset (twin: the host's ``remove_group``
     + ``add_group`` + ``set_leader`` sequence for a SAME-GEOMETRY tenant
@@ -837,6 +990,16 @@ def _apply_recycle(
             kv_ent_key=st.kv_ent_key.at[row].set(zke, mode="drop"),
             kv_ent_val=st.kv_ent_val.at[row].set(zke, mode="drop"),
         )
+    if reset_telem:
+        # the fresh tenant's stall horizon starts at zero (HostMirror.
+        # clear_telem twin).  Compiled OUT (static) while the engine's
+        # telem plane has never been used — the array is provably zero
+        # then, exactly the reset_reads rationale above.
+        st = st._replace(
+            telem_prev_committed=st.telem_prev_committed.at[row].set(
+                zc, mode="drop"
+            ),
+        )
     return st._replace(
         node_state=st.node_state.at[row].set(LEADER, mode="drop"),
         live=st.live.at[row].set(True, mode="drop"),
@@ -880,6 +1043,9 @@ def quorum_multiround_impl(
     has_kv: bool = False,
     purge_kv: bool = True,
     has_hier: bool = False,
+    has_telem: bool = False,
+    purge_telem: bool = True,
+    telem_k: int = TELEM_TOPK,
 ) -> StepOutputs:
     """K engine rounds — INCLUDING membership churn — in ONE dispatch.
 
@@ -967,6 +1133,7 @@ def quorum_multiround_impl(
                 stc, crow, cterm, cstart, clast,
                 reset_reads=has_reads or purge_reads,
                 reset_kv=has_kv or purge_kv,
+                reset_telem=has_telem or purge_telem,
             )
         if has_reads:
             rsi, rsc, rak = ev[i], ev[i + 1], ev[i + 2]
@@ -1063,6 +1230,13 @@ def quorum_multiround_impl(
             carry[c], carry[c + 1], carry[c + 2]
         )
         c += 3
+    telem = None
+    if has_telem:
+        # fold ONCE on the block's final state (see StepOutputs.telem):
+        # one set of reductions per dispatch, not per scanned round
+        st, telem = telem_fold(
+            st, telem_k, count_reads=has_reads, count_kv=has_kv,
+        )
     any_ = lambda x: jnp.any(x, axis=0)  # noqa: E731
     return StepOutputs(
         st,
@@ -1075,6 +1249,7 @@ def quorum_multiround_impl(
         kv_read_val,
         kv_read_index,
         kv_applied,
+        telem,
     )
 
 
@@ -1082,7 +1257,8 @@ quorum_multiround = jax.jit(
     quorum_multiround_impl,
     static_argnames=(
         "do_tick", "track_contact", "has_votes", "has_churn", "has_reads",
-        "purge_reads", "has_kv", "purge_kv", "has_hier",
+        "purge_reads", "has_kv", "purge_kv", "has_hier", "has_telem",
+        "purge_telem", "telem_k",
     ),
     donate_argnums=(0,),
 )

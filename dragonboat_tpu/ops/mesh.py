@@ -1052,6 +1052,62 @@ class MeshQuorumEngine:
         for s in self.shards:
             s.disable_devprof()
 
+    def enable_telem(self, topk: int | None = None) -> None:
+        """Flip every shard's telemetry latch (ISSUE 20): each shard's
+        dispatches fold ITS partition's aggregate with no cross-shard
+        rendezvous (the kernels' no-collectives invariant), and
+        :meth:`telem_snapshot` merges the per-shard aggregates host-side
+        — O(shards) work, independent of the group count."""
+        for s in self.shards:
+            s.enable_telem(topk)
+
+    @property
+    def telem_enabled(self) -> bool:
+        return any(s.telem_enabled for s in self.shards)
+
+    @property
+    def n_telem_topk(self) -> int:
+        return self.shards[0].n_telem_topk
+
+    def telem_snapshot(self) -> dict | None:
+        """Mesh-wide rollup of the shard aggregates: histograms, state
+        counts and occupancy totals SUM (disjoint group partitions); the
+        top-K merges by taking the K worst of the concatenated per-shard
+        top-Ks — exact, because each shard's list already holds its K
+        worst and K is the same everywhere.  None until every telem-on
+        shard has harvested at least one fold (a partial merge would
+        under-report fleet totals)."""
+        snaps = [s.telem_snapshot() for s in self.shards]
+        snaps = [t for t in snaps if t is not None]
+        if not snaps or len(snaps) != sum(
+            1 for s in self.shards if s.telem_enabled
+        ):
+            return None
+        k = self.n_telem_topk
+        merged = {
+            "seq": min(t["seq"] for t in snaps),
+            "mono": min(t["mono"] for t in snaps),
+            "rounds": max(t["rounds"] for t in snaps),
+            "groups": sum(t["groups"] for t in snaps),
+            "lag_hist": [
+                sum(t["lag_hist"][i] for t in snaps)
+                for i in range(len(snaps[0]["lag_hist"]))
+            ],
+            "state_counts": [
+                sum(t["state_counts"][i] for t in snaps)
+                for i in range(len(snaps[0]["state_counts"]))
+            ],
+            "stalled": sum(t["stalled"] for t in snaps),
+            "read_slots": sum(t["read_slots"] for t in snaps),
+            "kv_ents": sum(t["kv_ents"] for t in snaps),
+            "topk": sorted(
+                (pair for t in snaps for pair in t["topk"]),
+                key=lambda p: (-p[1], p[0]),
+            )[:k],
+            "shards": len(snaps),
+        }
+        return merged
+
     @property
     def _obs_instance(self):
         return self._obs

@@ -83,6 +83,7 @@ KV_READ_SLOTS = 4
 READ_PLANE_FIELDS = ("read_index", "read_count", "read_acks")
 DEVSM_PLANE_FIELDS = ("kv_value", "kv_ent_index", "kv_ent_key", "kv_ent_val")
 HIER_PLANE_FIELDS = ("near", "sub_quorum")
+TELEM_PLANE_FIELDS = ("telem_prev_committed",)
 
 
 def field_plane(name: str) -> str:
@@ -93,6 +94,8 @@ def field_plane(name: str) -> str:
         return "devsm"
     if name in HIER_PLANE_FIELDS:
         return "hier"
+    if name in TELEM_PLANE_FIELDS:
+        return "telem"
     return "quorum"
 
 
@@ -192,6 +195,15 @@ class QuorumState(NamedTuple):
     near: jax.Array            # (G,P) bool: leader-domain voter slots
     sub_quorum: jax.Array      # (G,) i32: domain majority; 0 = hier off
 
+    # --- device telemetry plane (ISSUE 20) -----------------------------
+    # Commit watermark at the end of the previous telemetry fold: the
+    # cross-dispatch horizon the stalled-group predicate compares against
+    # (``committed`` flat since the last fold while ``last_index`` shows
+    # pending work).  Written in-program by ``kernels.telem_fold``; reset
+    # with the row on recycle so a fresh tenant never inherits the old
+    # tenant's watermark.
+    telem_prev_committed: jax.Array  # (G,) i32 rel
+
 
 def make_state(
     n_groups: int,
@@ -235,6 +247,7 @@ def make_state(
         kv_ent_val=jnp.zeros((g, e), I32),
         near=jnp.zeros((g, p), BOOL),
         sub_quorum=zi,
+        telem_prev_committed=zi,
     )
 
 
@@ -283,6 +296,7 @@ class HostMirror:
         last_index: int,
         clear_reads: bool = True,
         clear_kv: bool = True,
+        clear_telem: bool = True,
     ) -> None:
         """Numpy twin of ``kernels._apply_recycle``: reset a row to a
         fresh same-geometry leader tenant WITHOUT touching membership
@@ -309,6 +323,8 @@ class HostMirror:
             self.clear_reads(row)
         if clear_kv:  # engine skips while its devsm plane is untouched
             self.clear_kv(row)
+        if clear_telem:  # engine skips while its telem plane is untouched
+            self.clear_telem(row)
 
     def row_image(self, row: int, skip=frozenset()) -> dict:
         """Per-field dense copy of one row — the stage-out half of a
@@ -350,6 +366,14 @@ class HostMirror:
         a["kv_ent_index"][row, :] = -1
         a["kv_ent_key"][row, :] = 0
         a["kv_ent_val"][row, :] = 0
+
+    def clear_telem(self, row: int) -> None:
+        """Reset a row's telemetry watermark: the stalled-group predicate
+        compares ``committed`` against the previous fold's value, and a
+        recycled row restarts its relative indexes at zero — the old
+        tenant's watermark would read as forward progress (or a phantom
+        stall) for the new one."""
+        self.arrays["telem_prev_committed"][row] = 0
 
     def clear_reads(self, row: int) -> None:
         """Drop a row's pending ReadIndex slots (twin of the scalar path's

@@ -60,6 +60,7 @@ class TpuQuorumCoordinator:
         drive_reads: bool = True,
         warm_fused: bool = False,
         compilation_cache_dir: Optional[str] = None,
+        telem: bool = False,
     ):
         from .ops.engine import (
             WARM_K_BUCKETS,
@@ -228,6 +229,13 @@ class TpuQuorumCoordinator:
         # attached by NodeHost when device_profile > 0).  None keeps the
         # engine's _devprof latch down and the dispatch path bit-identical.
         self.devprof = None
+        # device telemetry fold (ISSUE 20, kernels.telem_fold; NodeHost
+        # wires NodeHostConfig.health_aggregate here): flipped BEFORE
+        # warmup starts so the warmed fused programs already include the
+        # fold — a late enable_telem still works but pays one recompile
+        # per variant on next use (the late-devsm precedent).
+        if telem:
+            self.eng.enable_telem()
         if _obs.enabled():
             self.enable_obs()
         if self._warm_requested:
@@ -304,6 +312,37 @@ class TpuQuorumCoordinator:
         devprof.bind_engine(self.eng)
         self.devprof = devprof
         return devprof
+
+    def enable_telem(self, topk: Optional[int] = None) -> None:
+        """Flip the engine's device telemetry fold (ISSUE 20,
+        ``kernels.telem_fold``): every subsequent fused/dense/sparse
+        dispatch egresses a fixed-size health aggregate (commit-lag
+        histogram, per-state counts, stalled count, slot occupancy,
+        on-device top-K worst groups).  One-way, like ``enable_devprof``;
+        prefer the ``telem=True`` constructor kwarg so the warmed program
+        set already includes the fold."""
+        self.eng.enable_telem(topk)
+
+    @property
+    def telem_enabled(self) -> bool:
+        return self.eng.telem_enabled
+
+    def telem_snapshot(self) -> Optional[dict]:
+        """Latest harvested device telemetry aggregate (None until the
+        first telem-on dispatch lands; mesh coordinators merge per-shard
+        folds host-side).  Passive: the dict refreshes only when rounds
+        dispatch, and carries ``seq``/``mono`` so the health sampler can
+        tell a fresh fold from a stale one on an idle engine."""
+        return self.eng.telem_snapshot()
+
+    def registered_cids(self) -> set:
+        """Cluster ids currently registered on the device engine (the
+        aggregate health sampler's coverage set: these groups are
+        watched by the telemetry fold, everything else keeps the
+        per-group raft_mu walk).  Snapshot under the coordinator lock —
+        callers cache it keyed on the membership signature."""
+        with self._mu:
+            return set(self._nodes)
 
     def health_snapshot(self) -> dict:
         """Round-loop health for the cluster health sampler (ISSUE 13):

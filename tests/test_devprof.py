@@ -166,6 +166,8 @@ def test_plane_fields_match_engine_latch_keys():
     the other would let resident state escape its plane."""
     assert tuple(READ_PLANE_FIELDS) == tuple(BatchedQuorumEngine._READ_KEYS)
     assert tuple(DEVSM_PLANE_FIELDS) == tuple(BatchedQuorumEngine._KV_KEYS)
+    from dragonboat_tpu.ops.state import TELEM_PLANE_FIELDS
+    assert tuple(TELEM_PLANE_FIELDS) == tuple(BatchedQuorumEngine._TELEM_KEYS)
 
 
 # ----------------------------------------------------------------------

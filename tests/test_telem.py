@@ -1,0 +1,763 @@
+"""Device-plane telemetry fold suite (ISSUE 20).
+
+Contracts under test:
+
+- **Differential**: the device aggregate (``kernels.telem_fold``
+  harvested through ``BatchedQuorumEngine.telem_snapshot``) is
+  bit-identical to a numpy host oracle computed from the same state —
+  across sparse steps, the fused multi-round scan (including a
+  mid-block ``stage_recycle``), and the mesh facade's host-side merge
+  (including a live migration between shards);
+- **telem OFF structural identity**: until ``enable_telem`` flips the
+  latch, every dispatch runs ``has_telem=False``, the telem field
+  never joins rare-path row syncs, the device array stays all-zero and
+  ``telem_snapshot()`` is None;
+- **aggregate sampler semantics** (synthetic samples): the
+  ``commit_stall``/``apply_lag`` aggregate detectors open and close on
+  fold contents with the same hysteresis discipline as the per-group
+  path, a STALE fold (same seq) neither extends streaks nor closes
+  events, raft_mu-budget ``busy`` rows mid-walk neither close open
+  per-group detectors nor open spurious ones, and absence from the
+  drill-down walk is not treated as group removal;
+- **endpoints**: ``/metrics`` streams as chunked transfer on HTTP/1.1
+  and byte-matches the monolithic writer, the cardinality guard warns
+  once per family, ``/debug/telem`` 404s while the fold is off and
+  serves the live snapshot in aggregate mode.
+"""
+from __future__ import annotations
+
+import io
+import json
+import logging
+import socket
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from dragonboat_tpu import Config, NodeHostConfig, Result
+from dragonboat_tpu.config import ExpertConfig
+from dragonboat_tpu.events import MetricsRegistry
+from dragonboat_tpu.nodehost import NodeHost
+from dragonboat_tpu.obs.health import HealthSampler
+from dragonboat_tpu.ops.engine import BatchedQuorumEngine
+from dragonboat_tpu.ops.kernels import (
+    TELEM_LAG_BUCKETS,
+    TELEM_STATES,
+    TELEM_TOPK,
+)
+from dragonboat_tpu.ops.mesh import MeshQuorumEngine
+from dragonboat_tpu.transport import ChanRouter, ChanTransport
+
+from tests.loadwait import wait_until
+
+RTT_MS = 5
+CID = 940
+
+
+# ----------------------------------------------------------------------
+# host oracle
+# ----------------------------------------------------------------------
+
+
+def _shard_oracle(eng, prev_committed, k=None):
+    """Recompute one shard's TelemAggregate from its device state with
+    plain numpy — the independent twin of ``kernels.telem_fold``.
+    ``prev_committed`` is the device ``telem_prev_committed`` captured
+    BEFORE the dispatch (the fold compares against the previous fold's
+    watermark, then advances it)."""
+    dev = eng.dev
+    live = np.asarray(dev.live)
+    last = np.asarray(dev.last_index).astype(np.int64)
+    comm = np.asarray(dev.committed).astype(np.int64)
+    ns = np.asarray(dev.node_state).astype(np.int64)
+    lag = np.where(live, np.maximum(last - comm, 0), 0)
+    hist = [0] * TELEM_LAG_BUCKETS
+    states = [0] * TELEM_STATES
+    stalled = 0
+    for r in np.nonzero(live)[0]:
+        b = sum(
+            1 for i in range(TELEM_LAG_BUCKETS - 1) if lag[r] >= (1 << i)
+        )
+        hist[b] += 1
+        states[int(ns[r])] += 1
+        if comm[r] == prev_committed[r] and lag[r] > 0:
+            stalled += 1
+    k = k if k is not None else eng.n_telem_topk
+    masked = np.where(live, lag, -1)
+    order = sorted(
+        range(masked.shape[0]), key=lambda r: (-int(masked[r]), r)
+    )[:k]
+    topk = [
+        (int(eng._row_cid[r]), int(masked[r]))
+        for r in order
+        if masked[r] >= 0 and eng._row_cid[r] >= 0
+    ]
+    return {
+        "groups": int(live.sum()),
+        "lag_hist": hist,
+        "state_counts": states,
+        "stalled": stalled,
+        "read_slots": int(np.sum(np.asarray(dev.read_count) > 0)),
+        "kv_ents": int(np.sum(np.asarray(dev.kv_ent_index) >= 0)),
+        "topk": topk,
+    }
+
+
+_AGG_KEYS = (
+    "groups", "lag_hist", "state_counts", "stalled",
+    "read_slots", "kv_ents", "topk",
+)
+
+
+def _assert_matches(snap, oracle, tag=""):
+    assert snap is not None, tag
+    for key in _AGG_KEYS:
+        got = snap[key]
+        if key == "topk":
+            got = [tuple(p) for p in got]
+        assert got == oracle[key], (tag, key, got, oracle[key])
+
+
+def _build(n_groups=12, n_peers=3, last_index=1, cap=256, telem=True):
+    eng = BatchedQuorumEngine(n_groups, n_peers, event_cap=cap)
+    if telem:
+        eng.enable_telem()
+    for cid in range(1, n_groups + 1):
+        eng.add_group(cid, node_ids=list(range(1, n_peers + 1)), self_id=1)
+        eng.set_leader(cid, term=1, term_start=1, last_index=last_index)
+    eng._upload_dirty()
+    return eng
+
+
+def _prev(eng):
+    return np.asarray(eng.dev.telem_prev_committed).copy()
+
+
+# ----------------------------------------------------------------------
+# engine-level differential
+# ----------------------------------------------------------------------
+
+
+def test_telem_sparse_steps_match_oracle():
+    """Random ack schedules over several sparse dispatches: every
+    harvested aggregate equals the numpy oracle bit-for-bit."""
+    import random
+
+    rng = random.Random(2001)
+    g = 12
+    eng = _build(g, last_index=20)
+    for step in range(5):
+        for _ in range(rng.randrange(1, 10)):
+            cid = rng.randrange(1, g + 1)
+            eng.ack(cid, 2, rng.choice([1, 2, 5, 9, 17, 20]))
+        prev = _prev(eng)
+        eng.step(do_tick=False)
+        snap = eng.telem_snapshot()
+        _assert_matches(snap, _shard_oracle(eng, prev), f"step{step}")
+        assert snap["seq"] == step + 1
+        # the fold advanced the device watermark to this fold's commit
+        assert np.array_equal(
+            _prev(eng), np.asarray(eng.dev.committed)
+        )
+
+
+def test_telem_stalled_semantics():
+    """``stalled`` counts live groups whose commit watermark stayed
+    FLAT since the previous fold while entries are pending — a group
+    that commits between folds leaves the count."""
+    eng = _build(4, last_index=10)
+    for cid in (1, 2, 3):
+        eng.ack(cid, 2, 5)  # commit 5, lag 5
+    eng.step(do_tick=False)
+    # first fold: 1-3 moved off the initial watermark; 4 never has
+    assert eng.telem_snapshot()["stalled"] == 1
+    # second fold: 1 and 2 stay flat with lag pending -> stalled; 3
+    # advances; 4 is flat but has no lag (never committed, last==prev)
+    eng.ack(3, 2, 9)
+    prev = _prev(eng)
+    eng.step(do_tick=False)
+    snap = eng.telem_snapshot()
+    _assert_matches(snap, _shard_oracle(eng, prev), "stalled")
+    assert snap["stalled"] == 3  # groups 1, 2, and 4 (lag 10, never moved)
+    assert (4, 10) in [tuple(p) for p in snap["topk"]]
+
+
+def test_telem_topk_ties_break_toward_lower_row():
+    """Equal lags must order by row — ``lax.top_k`` and the host oracle
+    agree on (-lag, row)."""
+    eng = _build(6, last_index=8)
+    for cid in (2, 4, 5):
+        eng.ack(cid, 2, 8)  # lag 0
+    prev = _prev(eng)
+    eng.step(do_tick=False)
+    snap = eng.telem_snapshot()
+    _assert_matches(snap, _shard_oracle(eng, prev), "ties")
+    # rows 0, 2, 5 (cids 1, 3, 6) all sit at lag 8: row order decides
+    assert [tuple(p) for p in snap["topk"]][:3] == [(1, 8), (3, 8), (6, 8)]
+
+
+def test_telem_topk_k_override():
+    eng = BatchedQuorumEngine(8, 3, event_cap=128)
+    eng.enable_telem(topk=2)
+    assert eng.n_telem_topk == 2
+    for cid in range(1, 9):
+        eng.add_group(cid, node_ids=[1, 2, 3], self_id=1)
+        eng.set_leader(cid, term=1, term_start=1, last_index=4)
+    eng._upload_dirty()
+    eng.ack(1, 2, 1)
+    prev = _prev(eng)
+    eng.step(do_tick=False)
+    snap = eng.telem_snapshot()
+    assert len(snap["topk"]) == 2
+    _assert_matches(snap, _shard_oracle(eng, prev), "k=2")
+
+
+def test_telem_fused_multiround_matches_fresh_fold():
+    """A K-round fused block folds ONCE on the final scanned state —
+    the aggregate must equal the oracle over the post-block state with
+    the pre-block watermark (monotone commits make that identical to a
+    fresh single-round fold)."""
+    import random
+
+    rng = random.Random(2002)
+    g = 10
+    eng = _build(g, last_index=30)
+    for _ in range(3):  # three fused blocks
+        n_rounds = rng.randrange(2, 5)
+        for _ in range(n_rounds):
+            for _ in range(rng.randrange(1, 8)):
+                cid = rng.randrange(1, g + 1)
+                eng.ack(cid, 2, rng.choice([2, 7, 13, 28, 30]))
+            eng.begin_round()
+        prev = _prev(eng)
+        res = eng.step_rounds(do_tick=False)
+        assert res is not None
+        snap = eng.telem_snapshot()
+        _assert_matches(snap, _shard_oracle(eng, prev), "fused")
+        assert snap["rounds"] == n_rounds
+
+
+def test_telem_recycle_mid_block_resets_watermark():
+    """A ``stage_recycle`` inside a fused block resets the recycled
+    row's telem watermark in-program: the new tenant's stalled
+    predicate compares against 0, never the old tenant's commit, and
+    the top-K labels the NEW cluster id."""
+    eng = _build(6, last_index=4)
+    for cid in range(1, 7):
+        eng.ack(cid, 2, 4)  # everyone commits 4, lag 0
+    eng.begin_round()
+    eng.step_rounds(do_tick=False)
+    assert eng.telem_snapshot()["stalled"] == 0
+
+    # recycle 3 -> 103 with a pending tail (last_index 9, commits 0)
+    eng.stage_recycle(3, 103, term=2, term_start=0, last_index=9)
+    eng.ack(1, 2, 2)  # keep the block non-empty for another group too
+    eng.begin_round()
+    prev = _prev(eng)
+    prev[eng.groups[103].row] = 0  # in-program reset at round start
+    eng.step_rounds(do_tick=False)
+    snap = eng.telem_snapshot()
+    _assert_matches(snap, _shard_oracle(eng, prev), "recycle")
+    # the fresh tenant: flat at 0 with 9 pending -> stalled, worst lag
+    assert snap["stalled"] == 1
+    assert tuple(snap["topk"][0]) == (103, 9)
+    assert 3 not in [p[0] for p in snap["topk"]]
+
+
+def test_telem_mesh_merge_and_migration():
+    """The mesh facade's merged snapshot equals the sum of per-shard
+    oracles — histograms/counts add, top-K re-sorts by (-lag, cid) —
+    and stays correct across a live ``migrate_group``."""
+    devs = jax.local_devices(backend="cpu")
+    assert len(devs) >= 2, "conftest must force multiple CPU devices"
+    mesh = MeshQuorumEngine(16, 3, event_cap=128, devices=devs[:2])
+    mesh.enable_telem(topk=4)
+    assert mesh.telem_enabled
+    assert mesh.telem_snapshot() is None
+    for cid in range(1, 9):
+        mesh.add_group(cid, node_ids=[1, 2, 3], self_id=1)
+        mesh.set_leader(cid, term=1, term_start=1, last_index=2 * cid)
+    for s in mesh.shards:
+        s._upload_dirty()
+    for cid in range(1, 9):
+        mesh.ack(cid, 2, cid)  # commit cid, lag cid
+
+    def dispatch_and_check(tag):
+        prevs = [_prev(s) for s in mesh.shards]
+        mesh.step(do_tick=True)  # do_tick dispatches EVERY shard
+        oracles = [
+            _shard_oracle(s, p, k=4)
+            for s, p in zip(mesh.shards, prevs)
+        ]
+        snap = mesh.telem_snapshot()
+        assert snap is not None and snap["shards"] == 2
+        for key in ("groups", "stalled", "read_slots", "kv_ents"):
+            assert snap[key] == sum(o[key] for o in oracles), (tag, key)
+        for key in ("lag_hist", "state_counts"):
+            merged = [
+                sum(o[key][i] for o in oracles)
+                for i in range(len(oracles[0][key]))
+            ]
+            assert snap[key] == merged, (tag, key)
+        allk = sorted(
+            (p for o in oracles for p in o["topk"]),
+            key=lambda p: (-p[1], p[0]),
+        )[:4]
+        assert [tuple(p) for p in snap["topk"]] == allk, tag
+        return snap
+
+    s1 = dispatch_and_check("pre-migrate")
+    assert s1["groups"] == 8
+
+    # migrate the worst group to the other shard; the merge must keep
+    # labelling it with the same cid and its (unchanged) lag
+    worst = s1["topk"][0][0]
+    target = 1 - mesh.shard_index(worst)
+    assert mesh.migrate_group(worst, target)
+    assert mesh.shard_index(worst) == target
+    for s in mesh.shards:
+        s._upload_dirty()
+    s2 = dispatch_and_check("post-migrate")
+    assert s2["groups"] == 8
+    assert s2["topk"][0][0] == worst
+
+
+# ----------------------------------------------------------------------
+# telem OFF: structural identity
+# ----------------------------------------------------------------------
+
+
+def test_telem_off_structural_identity():
+    """Until the latch flips, dispatches carry no fold: the snapshot
+    stays None, the telem field never joins rare-path syncs, and the
+    device watermark array is provably all-zero after real traffic."""
+    eng = _build(8, last_index=6, telem=False)
+    assert not eng._telem_used
+    assert not eng.telem_enabled
+    for cid in range(1, 9):
+        eng.ack(cid, 2, 5)
+    eng.step(do_tick=False)
+    eng.ack(1, 2, 6)
+    eng.begin_round()
+    eng.step_rounds(do_tick=False)
+    assert eng.telem_snapshot() is None
+    for k in eng._TELEM_KEYS:
+        assert k not in eng._sync_keys()
+    assert not np.asarray(eng.dev.telem_prev_committed).any()
+    # flipping the latch mid-life starts folding on the next dispatch
+    eng.enable_telem()
+    eng.ack(2, 2, 6)
+    prev = _prev(eng)
+    eng.step(do_tick=False)
+    _assert_matches(
+        eng.telem_snapshot(), _shard_oracle(eng, prev), "post-flip"
+    )
+    for k in eng._TELEM_KEYS:
+        assert k in eng._sync_keys()
+
+
+# ----------------------------------------------------------------------
+# aggregate sampler semantics (synthetic samples)
+# ----------------------------------------------------------------------
+
+
+def _unit_sampler(**kw):
+    return HealthSampler(nh=None, registry=MetricsRegistry(), **kw)
+
+
+def _sample(groups=None, mono=None, telem=None, gone=()):
+    s = {
+        "ts": time.time(),
+        "mono": mono if mono is not None else time.monotonic(),
+        "groups": groups or {},
+        "host": {"hostproc": None},
+    }
+    if telem is not None:
+        s["aggregate"] = True
+        s["telem"] = telem
+        s["gone_cids"] = list(gone)
+    return s
+
+
+def _tel(seq, stalled=0, hist=None, topk=(), states=None):
+    hist = list(hist) if hist is not None else [0] * TELEM_LAG_BUCKETS
+    return {
+        "seq": seq,
+        "mono": time.monotonic(),
+        "rounds": 1,
+        "groups": sum(hist),
+        "lag_hist": hist,
+        "state_counts": list(states) if states else [0] * TELEM_STATES,
+        "stalled": stalled,
+        "read_slots": 0,
+        "kv_ents": 0,
+        "topk": [list(p) for p in topk],
+    }
+
+
+def _open_keys(hs):
+    return sorted((e["detector"], e["key"]) for e in hs.open_events())
+
+
+def test_unit_aggregate_commit_stall_streak_and_stale_seq():
+    hs = _unit_sampler(aggregate=True, commit_stall_samples=2)
+    hs.ingest(_sample(telem=_tel(1, stalled=3, topk=[(7, 40)])))
+    assert not hs.open_events()  # streak 1 of 2
+    hs.ingest(_sample(telem=_tel(2, stalled=3, topk=[(7, 40)])))
+    assert _open_keys(hs) == [("commit_stall", "aggregate")]
+    ev = hs.open_events()[0]
+    assert ev["detail"]["topk"] == [[7, 40]]
+    # a STALE fold (same seq: idle engine) must neither close the event
+    # nor advance the streak bookkeeping
+    for _ in range(3):
+        hs.ingest(_sample(telem=_tel(2, stalled=0)))
+    assert _open_keys(hs) == [("commit_stall", "aggregate")]
+    assert hs._telem_stall_streak == 2
+    # a FRESH clean fold closes it with a measured recovery
+    hs.ingest(_sample(telem=_tel(3, stalled=0)))
+    assert not hs.open_events()
+    assert hs.recovery_stats()["commit_stall"]["n"] == 1
+
+
+def test_unit_aggregate_apply_lag_tail_hysteresis():
+    hs = _unit_sampler(aggregate=True, apply_lag_entries=100)
+    # threshold 100 -> first all-over bucket is 8 (2^7 = 128 >= 100)
+    assert HealthSampler._lag_tail_bucket(100) == 8
+    hist = [0] * TELEM_LAG_BUCKETS
+    hist[8] = 2
+    hs.ingest(_sample(telem=_tel(1, hist=hist, topk=[(9, 200), (4, 130)])))
+    assert _open_keys(hs) == [("apply_lag", "aggregate")]
+    assert hs.open_events()[0]["detail"]["groups_over"] == 2
+    # open -> the close threshold halves (50 -> bucket 7, 2^6 = 64):
+    # groups draining into [64, 128) keep the event open...
+    hist = [0] * TELEM_LAG_BUCKETS
+    hist[7] = 1
+    hs.ingest(_sample(telem=_tel(2, hist=hist)))
+    assert _open_keys(hs) == [("apply_lag", "aggregate")]
+    # ...and a tail fully below the halved threshold closes it
+    hist = [0] * TELEM_LAG_BUCKETS
+    hist[3] = 5
+    hs.ingest(_sample(telem=_tel(3, hist=hist)))
+    assert not hs.open_events()
+    assert hs.recovery_stats()["apply_lag"]["n"] == 1
+
+
+def test_unit_busy_rows_counter_and_degraded_flag():
+    reg = MetricsRegistry()
+    hs = HealthSampler(nh=None, registry=reg)
+    hs.ingest(_sample({1: {"committed": 5, "leader_id": 1}}))
+    assert hs.busy_rows_total == 0
+    assert hs.report()["sampler_degraded"] is False
+    hs.ingest(_sample({
+        1: {"committed": 5, "leader_id": 1},
+        2: {"busy": True},
+        3: {"busy": True},
+    }))
+    assert hs.busy_rows_total == 2
+    rep = hs.report()
+    assert rep["sampler_degraded"] is True and rep["busy_rows"] == 2
+    assert reg.counter_value("dragonboat_health_busy_rows_total") == 2
+    # a clean pass clears the degradation flag; the counter is cumulative
+    hs.ingest(_sample({1: {"committed": 6, "leader_id": 1}}))
+    assert hs.report()["sampler_degraded"] is False
+    assert hs.busy_rows_total == 2
+
+
+def test_unit_busy_gap_keeps_detector_hysteresis():
+    """A raft_mu-budget ``busy`` row mid-walk is a measurement gap, not
+    evidence: an open detector must stay open across it, and no
+    detector may open FROM a busy row (satellite 3)."""
+    hs = _unit_sampler(apply_lag_entries=100)
+    g = {"committed": 1000, "applied": 850, "leader_id": 1}
+    hs.ingest(_sample({7: dict(g)}))
+    assert _open_keys(hs) == [("apply_lag", "group:7")]
+    # busy gap: the walk reached the group but the lock budget was spent
+    for _ in range(3):
+        hs.ingest(_sample({7: {"busy": True}}))
+    assert _open_keys(hs) == [("apply_lag", "group:7")]
+    # a busy row never OPENS anything either, whatever junk it carries
+    hs.ingest(_sample({7: {"busy": True, "committed": 0, "applied": -999}}))
+    assert _open_keys(hs) == [("apply_lag", "group:7")]
+    # the next clean sample resumes the hysteresis exactly where it was
+    hs.ingest(_sample({7: {"committed": 1000, "applied": 990,
+                           "leader_id": 1}}))
+    assert not hs.open_events()
+    assert hs.recovery_stats()["apply_lag"]["n"] == 1
+
+
+def test_unit_aggregate_walk_absence_is_not_gone():
+    """Aggregate samples walk only the drill-down set: a group absent
+    from the walk must keep its open event and per-group memory; only
+    membership-resolved ``gone_cids`` close as removed."""
+    hs = _unit_sampler(aggregate=True, apply_lag_entries=100)
+    g7 = {"committed": 1000, "applied": 850, "leader_id": 1}
+    hs.ingest(_sample({7: dict(g7)}, telem=_tel(1)))
+    assert _open_keys(hs) == [("apply_lag", "group:7")]
+    # 7 churns out of the top-K -> out of the walk.  NOT gone.
+    hs.ingest(_sample({3: {"committed": 2, "leader_id": 1}},
+                      telem=_tel(2)))
+    assert _open_keys(hs) == [("apply_lag", "group:7")]
+    assert 7 in hs._prev
+    # true removal arrives via gone_cids -> closes and forgets
+    hs.ingest(_sample({3: {"committed": 3, "leader_id": 1}},
+                      telem=_tel(3), gone=[7]))
+    assert not hs.open_events()
+    assert 7 not in hs._prev
+
+
+def test_unit_aggregate_publishes_telem_families():
+    r = MetricsRegistry()
+    hs = HealthSampler(nh=None, registry=r, aggregate=True)
+    hist = [0] * TELEM_LAG_BUCKETS
+    hist[0], hist[3] = 4, 1
+    hs.ingest(_sample(telem=_tel(
+        1, stalled=1, hist=hist, topk=[(12, 6)], states=[3, 0, 2, 0, 0],
+    )))
+    assert r.counter_value("dragonboat_telem_folds_total") == 1
+    assert r.gauge_value("dragonboat_telem_stalled_groups") == 1
+    assert r.gauge_value("dragonboat_telem_worst_lag") == 6
+    assert r.gauge_value(
+        "dragonboat_telem_groups", labels={"state": "follower"}
+    ) == 3
+    assert r.gauge_value(
+        "dragonboat_telem_groups", labels={"state": "leader"}
+    ) == 2
+    assert r.gauge_value(
+        "dragonboat_telem_commit_lag", labels={"bucket": "4"}
+    ) == 1
+    # a stale re-serve publishes nothing new
+    hs.ingest(_sample(telem=_tel(1, stalled=0)))
+    assert r.counter_value("dragonboat_telem_folds_total") == 1
+    assert r.gauge_value("dragonboat_telem_stalled_groups") == 1
+
+
+# ----------------------------------------------------------------------
+# exposition streaming + cardinality guard (unit)
+# ----------------------------------------------------------------------
+
+
+def test_iter_health_metrics_matches_monolithic_writer():
+    reg = MetricsRegistry()
+    reg.counter_add("dragonboat_test_total", 3)
+    reg.gauge_set("dragonboat_test_gauge", 1.5, labels={"shard": "0"})
+    reg.gauge_set("dragonboat_test_gauge", 2.5, labels={"shard": "1"})
+    reg.histogram_observe("dragonboat_test_seconds", 0.02)
+    buf = io.StringIO()
+    reg.write_health_metrics(buf)
+    chunks = list(reg.iter_health_metrics())
+    assert "".join(chunks) == buf.getvalue()
+    # one chunk per family, each self-contained with its own preamble
+    assert len(chunks) == 3
+    for c in chunks:
+        assert c.startswith("# HELP ") and "# TYPE " in c
+
+
+def test_cardinality_guard_warns_once_per_family(caplog):
+    reg = MetricsRegistry()
+    reg.cardinality_warn = 5
+    for i in range(8):
+        reg.counter_add("dragonboat_leaky_total", labels={"req": str(i)})
+    with caplog.at_level(logging.WARNING):
+        list(reg.iter_health_metrics())
+        list(reg.iter_health_metrics())  # second scrape: no re-warn
+    warns = [r for r in caplog.records if "dragonboat_leaky_total" in r.getMessage()]
+    assert len(warns) == 1
+    assert "8 label sets" in warns[0].getMessage()
+    # reset() re-arms the guard with the rest of the instrument state
+    reg.reset()
+    assert not reg._cardinality_warned
+
+
+# ----------------------------------------------------------------------
+# live NodeHost: aggregate mode end to end
+# ----------------------------------------------------------------------
+
+
+class CounterSM:
+    def __init__(self, cluster_id, node_id):
+        self.count = 0
+
+    def update(self, cmd):
+        self.count += 1
+        return Result(value=self.count)
+
+    def lookup(self, query):
+        return self.count
+
+    def save_snapshot(self, w, files, done):
+        w.write(self.count.to_bytes(8, "little"))
+
+    def recover_from_snapshot(self, r, files, done):
+        self.count = int.from_bytes(r.read(8), "little")
+
+    def close(self):
+        pass
+
+
+def _mk_host(health_ms=20, engine="tpu", aggregate=True,
+             metrics_addr="127.0.0.1:0"):
+    return NodeHost(
+        NodeHostConfig(
+            node_host_dir=":memory:",
+            rtt_millisecond=RTT_MS,
+            raft_address="tl:1",
+            raft_rpc_factory=lambda s, rh, ch: ChanTransport(
+                s, rh, ch, router=ChanRouter()
+            ),
+            enable_metrics=True,
+            health_sample_ms=health_ms,
+            health_aggregate=aggregate,
+            metrics_addr=metrics_addr,
+            expert=ExpertConfig(
+                quorum_engine=engine,
+                engine_block_groups=64,
+                engine_warm_fused=False,
+            ),
+        )
+    )
+
+
+def _start(nh, cid=CID):
+    nh.start_cluster(
+        {1: nh.raft_address()}, False, CounterSM,
+        Config(cluster_id=cid, node_id=1, election_rtt=10,
+               heartbeat_rtt=1),
+    )
+    wait_until(
+        lambda: nh.get_leader_id(cid)[1], timeout=10.0, what="leader"
+    )
+
+
+def _get(port, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    )
+
+
+def test_live_aggregate_sampling_and_debug_telem():
+    nh = _mk_host()
+    try:
+        _start(nh)
+        assert nh.health is not None and nh.health.aggregate
+        s = nh.get_noop_session(CID)
+        for _ in range(3):
+            assert nh.sync_propose(s, b"x", timeout=10.0)
+        # folds flow: the sampler publishes them and samples go aggregate
+        wait_until(
+            lambda: nh.metrics_registry.counter_value(
+                "dragonboat_telem_folds_total") > 0,
+            timeout=10.0, what="telem fold",
+        )
+        wait_until(
+            lambda: any(
+                smp.get("aggregate") for smp in nh.health.samples()
+            ),
+            timeout=10.0, what="aggregate sample",
+        )
+        rep = nh.health_report()
+        assert rep["aggregate"] is True
+        assert rep["sampler_degraded"] is False
+        # the drill-down walk still reaches the device group (top-K)
+        agg = [smp for smp in nh.health.samples() if smp.get("aggregate")]
+        assert any(CID in smp["groups"] for smp in agg)
+        tel = agg[-1]["telem"]
+        assert tel["groups"] == 1 and sum(tel["lag_hist"]) == 1
+        # /debug/telem serves the live snapshot
+        port = nh.metrics_server.port
+        r = _get(port, "/debug/telem")
+        assert r.status == 200
+        body = json.loads(r.read())
+        assert body["enabled"] is True
+        assert body["snapshot"]["groups"] == 1
+        assert len(body["snapshot"]["lag_hist"]) == TELEM_LAG_BUCKETS
+    finally:
+        nh.stop()
+
+
+def test_debug_telem_404_when_off():
+    nh = _mk_host(engine="scalar", aggregate=False)
+    try:
+        _start(nh)
+        port = nh.metrics_server.port
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(port, "/debug/telem")
+        assert ei.value.code == 404
+    finally:
+        nh.stop()
+
+
+def test_metrics_streams_chunked_and_matches_writer():
+    nh = _mk_host(engine="scalar", aggregate=False)
+    try:
+        _start(nh)
+        s = nh.get_noop_session(CID)
+        for _ in range(3):
+            nh.sync_propose(s, b"x", timeout=10.0)
+        wait_until(lambda: len(nh.health) >= 2, timeout=10.0,
+                   what="samples")
+        port = nh.metrics_server.port
+        # raw HTTP/1.1 exchange: the endpoint must stream chunked
+        with socket.create_connection(("127.0.0.1", port), timeout=10) as sk:
+            sk.sendall(
+                b"GET /metrics HTTP/1.1\r\nHost: t\r\n"
+                b"Connection: close\r\n\r\n"
+            )
+            raw = b""
+            while True:
+                b_ = sk.recv(65536)
+                if not b_:
+                    break
+                raw += b_
+        head, _, payload = raw.partition(b"\r\n\r\n")
+        headers = head.decode().lower()
+        assert "transfer-encoding: chunked" in headers
+        assert "content-length" not in headers
+        # de-chunk and compare against the monolithic writer's families
+        body = b""
+        while payload:
+            size, _, payload = payload.partition(b"\r\n")
+            n = int(size, 16)
+            if n == 0:
+                break
+            body += payload[:n]
+            payload = payload[n + 2:]
+        text = body.decode()
+        buf = io.StringIO()
+        nh.metrics_registry.write_health_metrics(buf)
+        # same families and preamble structure (values may tick between
+        # the two scrapes; names and HELP/TYPE lines are stable)
+        chunk_names = {
+            ln.split()[2] for ln in text.splitlines()
+            if ln.startswith("# HELP")
+        }
+        mono_names = {
+            ln.split()[2] for ln in buf.getvalue().splitlines()
+            if ln.startswith("# HELP")
+        }
+        assert chunk_names == mono_names
+        lines = text.splitlines()
+        for i, line in enumerate(lines):
+            if line.startswith("# TYPE"):
+                name = line.split()[2]
+                assert i > 0 and lines[i - 1].startswith(
+                    f"# HELP {name} "
+                ), line
+        # an HTTP/1.0 scraper still gets the buffered form
+        with socket.create_connection(("127.0.0.1", port), timeout=10) as sk:
+            sk.sendall(b"GET /metrics HTTP/1.0\r\n\r\n")
+            raw = b""
+            while True:
+                b_ = sk.recv(65536)
+                if not b_:
+                    break
+                raw += b_
+        head, _, payload10 = raw.partition(b"\r\n\r\n")
+        assert b"content-length" in head.lower()
+        assert b"dragonboat_health_samples_total" in payload10
+    finally:
+        nh.stop()
